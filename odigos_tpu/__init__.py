@@ -1,0 +1,30 @@
+"""odigos_tpu — a TPU-native observability framework with the capabilities of Odigos.
+
+A re-design (not a port) of the reference system (/root/reference, damemi/odigos):
+a managed telemetry pipeline (receivers → processors → connectors → exporters behind
+a Factory plugin boundary), a CRD-driven control plane (Source, Destination,
+InstrumentationConfig, Action, CollectorsGroup reconcilers), declarative
+destination/profile/distro registries, and — the TPU-native extension — an
+anomaly-detection stage: spans are featurized into columnar tensors and scored by
+JAX models (z-score kernel, span-sequence autoencoder, trace transformer) running
+data-parallel across a TPU mesh, with an `anomalyrouter` connector routing tagged
+spans to dedicated destinations.
+
+Layer map (mirrors SURVEY.md §1):
+    pdata/        columnar telemetry data model (structure-of-arrays spans)
+    components/   collector plugin API + builtin components
+    pipeline/     pipeline graph assembly + service runner
+    pipelinegen/  generated gateway/node collector configs (root→router→datastream)
+    crds/         CRD-style API types + in-memory store
+    controlplane/ reconcilers (instrumentor/scheduler/autoscaler equivalents)
+    features/     span featurization (SpanBatch → fixed-width tensors)
+    models/       JAX anomaly models (zscore, autoencoder, trace transformer)
+    parallel/     device mesh, shardings, ring attention, collectives
+    serving/      batched async scoring engine (the TPU sidecar)
+    train/        fault-injected data gen, training loops, checkpointing
+    destinations/ declarative destination registry
+    profiles/     named config presets
+    distros/      instrumentation distro manifests
+"""
+
+__version__ = "0.1.0"

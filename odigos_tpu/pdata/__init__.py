@@ -1,0 +1,32 @@
+"""Columnar telemetry data model (the pdata layer).
+
+The reference represents telemetry as pointer-rich pdata object trees
+(go.opentelemetry.io/collector/pdata, consumed e.g. in
+collector/receivers/odigosebpfreceiver/traces.go:105 and
+collector/connectors/odigosrouterconnector/connector.go:175). On TPU that
+representation is hostile: featurization would walk Python objects span by span.
+
+We instead make the *batch* the unit: `SpanBatch` is a structure-of-arrays —
+one numpy column per span field, an interned string table, and side lists for
+full-fidelity attributes. Pipeline components operate on whole batches; the
+featurizer hands columns straight to JAX with no per-span work.
+"""
+
+from .spans import (
+    SpanKind,
+    StatusCode,
+    SpanBatch,
+    SpanBatchBuilder,
+    concat_batches,
+)
+from .gen import TraceShape, synthesize_traces
+
+__all__ = [
+    "SpanKind",
+    "StatusCode",
+    "SpanBatch",
+    "SpanBatchBuilder",
+    "concat_batches",
+    "TraceShape",
+    "synthesize_traces",
+]

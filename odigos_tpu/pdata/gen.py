@@ -1,0 +1,143 @@
+"""Synthetic trace generation.
+
+Plays the role the reference's test fixtures play (multi-runtime HTTP services
+under tests/common/services/ plus the traffic-generator Job,
+tests/common/apply/generate-traffic-job.yaml): a deterministic source of
+realistic multi-service trace trees for unit tests, benchmarks, and the
+injected-fault ROC-AUC harness (SURVEY.md §4 item 4).
+
+The default topology mirrors the otel-demo-style 10-service mesh used by
+BASELINE config #2.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .spans import SpanBatch, SpanBatchBuilder, SpanKind, StatusCode
+
+# service -> list of (child service, operation) calls made while handling a request
+DEFAULT_TOPOLOGY: dict[str, list[tuple[str, str]]] = {
+    "frontend": [("cart", "GET /cart"), ("product", "GET /products"),
+                 ("recommendation", "GET /recommend"), ("ad", "GET /ads")],
+    "cart": [("redis", "HGETALL cart")],
+    "product": [("postgres", "SELECT products")],
+    "recommendation": [("product", "GET /products")],
+    "ad": [],
+    "checkout": [("cart", "GET /cart"), ("payment", "POST /charge"),
+                 ("shipping", "POST /ship"), ("email", "POST /send")],
+    "payment": [],
+    "shipping": [("postgres", "SELECT rates")],
+    "email": [],
+    "currency": [],
+    "redis": [],
+    "postgres": [],
+}
+
+ROOT_SERVICES = ("frontend", "checkout", "currency")
+
+# mean self-latency (µs) per service; children add on top
+_BASE_LATENCY_US: dict[str, float] = {
+    "frontend": 800.0, "cart": 300.0, "product": 400.0, "recommendation": 350.0,
+    "ad": 150.0, "checkout": 900.0, "payment": 1200.0, "shipping": 500.0,
+    "email": 250.0, "currency": 80.0, "redis": 60.0, "postgres": 450.0,
+}
+
+
+@dataclass
+class TraceShape:
+    """Parameters of the synthetic workload."""
+
+    topology: dict[str, list[tuple[str, str]]] = field(
+        default_factory=lambda: dict(DEFAULT_TOPOLOGY))
+    root_services: tuple[str, ...] = ROOT_SERVICES
+    error_rate: float = 0.005
+    latency_sigma: float = 0.35  # lognormal shape for self-latency
+    base_latency_us: dict[str, float] = field(
+        default_factory=lambda: dict(_BASE_LATENCY_US))
+    max_depth: int = 6
+
+
+def synthesize_traces(
+    n_traces: int,
+    *,
+    shape: Optional[TraceShape] = None,
+    seed: int = 0,
+    start_unix_nano: int = 1_700_000_000_000_000_000,
+) -> SpanBatch:
+    """Generate ``n_traces`` full trace trees as one SpanBatch.
+
+    Deterministic for a given (n_traces, shape, seed). Spans are emitted in
+    post-order within each trace (children and client spans precede their
+    parent); consumers needing parents-first must sort by start time.
+    """
+    shape = shape or TraceShape()
+    rng = np.random.default_rng(seed)
+    b = SpanBatchBuilder()
+    res_idx = {svc: b.add_resource({
+        "service.name": svc,
+        "k8s.namespace.name": "default",
+        "k8s.deployment.name": svc,
+    }) for svc in shape.topology}
+
+    id_counter = np.uint64(1)
+
+    def next_id() -> int:
+        nonlocal id_counter
+        id_counter += np.uint64(1)
+        return int(id_counter)
+
+    clock = start_unix_nano
+    for t in range(n_traces):
+        trace_id = (int(rng.integers(1, 2**63)) << 64) | next_id()
+        root_svc = shape.root_services[int(rng.integers(len(shape.root_services)))]
+        clock += int(rng.integers(50_000, 2_000_000))  # traces ~ a few ms apart
+        _emit_span(b, rng, shape, res_idx, trace_id, parent_id=0,
+                   service=root_svc, op=f"GET /{root_svc}",
+                   kind=SpanKind.SERVER, start_ns=clock, depth=0,
+                   next_id=next_id)
+
+    return b.build()
+
+
+def _emit_span(b, rng, shape, res_idx, trace_id, parent_id, service, op,
+               kind, start_ns, depth, next_id) -> int:
+    """Emit one span and (recursively) its callees; returns end time ns."""
+    span_id = next_id()
+    self_us = shape.base_latency_us.get(service, 200.0)
+    self_ns = int(rng.lognormal(np.log(self_us), shape.latency_sigma) * 1_000)
+    cursor = start_ns + self_ns // 2
+
+    if depth < shape.max_depth:
+        for child_svc, child_op in shape.topology.get(service, ()):  # fan-out
+            # CLIENT span on caller side wrapping the SERVER span on callee side
+            client_id = next_id()
+            child_start = cursor + int(rng.integers(5_000, 40_000))
+            child_end = _emit_span(
+                b, rng, shape, res_idx, trace_id, parent_id=client_id,
+                service=child_svc, op=child_op, kind=SpanKind.SERVER,
+                start_ns=child_start + int(rng.integers(2_000, 20_000)),
+                depth=depth + 1, next_id=next_id)
+            client_end = child_end + int(rng.integers(2_000, 20_000))
+            b.add_span(
+                trace_id=trace_id, span_id=client_id, parent_span_id=span_id,
+                name=child_op, service=service, kind=SpanKind.CLIENT,
+                status_code=StatusCode.UNSET,
+                start_unix_nano=child_start, end_unix_nano=client_end,
+                resource_index=res_idx[service],
+                attrs={"peer.service": child_svc})
+            cursor = client_end
+
+    end_ns = max(cursor, start_ns + self_ns)
+    is_error = rng.random() < shape.error_rate
+    b.add_span(
+        trace_id=trace_id, span_id=span_id, parent_span_id=parent_id,
+        name=op, service=service, kind=kind,
+        status_code=StatusCode.ERROR if is_error else StatusCode.UNSET,
+        start_unix_nano=start_ns, end_unix_nano=end_ns,
+        resource_index=res_idx[service],
+        attrs={"http.method": op.split(" ")[0]} if " " in op else None)
+    return end_ns

@@ -1,0 +1,333 @@
+"""Structure-of-arrays span batches.
+
+Design notes
+------------
+The hot path of the whole framework is "N spans arrive → featurize → score on
+TPU → tag → route". The reference's hot loops (odigosebpfreceiver/traces.go:17
+tracesReadLoop, odigosrouterconnector/connector.go:175 ConsumeTraces) decode and
+route *per record*; our equivalent must never touch Python per span. So:
+
+* every fixed-width span field is a numpy column (`trace_id_lo`, `duration_ns`,
+  `kind`, ...) — slicing/masking/concatenation are vectorized;
+* strings (service name, span name) are interned into a per-batch string table
+  and stored as int32 indices — the featurizer hashes table entries once per
+  batch, not once per span;
+* variable attributes keep full fidelity in side lists (`span_attrs`,
+  `resources`) for exporters, but nothing on the scoring path reads them.
+
+A batch is immutable once built (columns may be shared between batches after
+`filter`/`concat`); mutation happens by building a new batch.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class SpanKind(enum.IntEnum):
+    """OTLP span kinds (numbering follows opentelemetry-proto trace.proto)."""
+
+    UNSPECIFIED = 0
+    INTERNAL = 1
+    SERVER = 2
+    CLIENT = 3
+    PRODUCER = 4
+    CONSUMER = 5
+
+
+class StatusCode(enum.IntEnum):
+    """OTLP status codes."""
+
+    UNSET = 0
+    OK = 1
+    ERROR = 2
+
+
+# Column name -> dtype for the fixed-width span fields.
+_COLUMNS: dict[str, np.dtype] = {
+    "trace_id_hi": np.dtype(np.uint64),
+    "trace_id_lo": np.dtype(np.uint64),
+    "span_id": np.dtype(np.uint64),
+    "parent_span_id": np.dtype(np.uint64),  # 0 => root span
+    "name": np.dtype(np.int32),  # string-table index
+    "service": np.dtype(np.int32),  # string-table index (denormalized from resource)
+    "scope": np.dtype(np.int32),  # string-table index, -1 => none
+    "kind": np.dtype(np.int8),
+    "status_code": np.dtype(np.int8),
+    "start_unix_nano": np.dtype(np.uint64),
+    "end_unix_nano": np.dtype(np.uint64),
+    "resource_index": np.dtype(np.int32),  # index into .resources
+}
+
+_EMPTY_DICT: dict[str, Any] = {}
+
+
+@dataclass(frozen=True)
+class SpanBatch:
+    """An immutable batch of spans in columnar form.
+
+    Columns are parallel numpy arrays of length ``len(batch)``. ``strings`` is
+    the interned string table shared by the ``name``/``service``/``scope``
+    columns. ``resources`` holds one attribute-dict per distinct resource;
+    ``span_attrs`` holds one attribute-dict per span (empty dicts are shared).
+    """
+
+    strings: tuple[str, ...]
+    resources: tuple[dict[str, Any], ...]
+    span_attrs: tuple[dict[str, Any], ...]
+    columns: dict[str, np.ndarray] = field(default_factory=dict)
+
+    # ------------------------------------------------------------- basics
+    def __len__(self) -> int:
+        if not self.columns:
+            return 0
+        return int(self.columns["span_id"].shape[0])
+
+    def __bool__(self) -> bool:  # an empty batch is falsy
+        return len(self) > 0
+
+    def col(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    @property
+    def duration_ns(self) -> np.ndarray:
+        """End minus start, as int64 nanoseconds (clamped at 0)."""
+        start = self.columns["start_unix_nano"].astype(np.int64)
+        end = self.columns["end_unix_nano"].astype(np.int64)
+        return np.maximum(end - start, 0)
+
+    @property
+    def is_root(self) -> np.ndarray:
+        return self.columns["parent_span_id"] == 0
+
+    def string_at(self, index: int) -> str:
+        return self.strings[index] if 0 <= index < len(self.strings) else ""
+
+    def service_names(self) -> list[str]:
+        return [self.string_at(i) for i in self.columns["service"]]
+
+    def span_names(self) -> list[str]:
+        return [self.string_at(i) for i in self.columns["name"]]
+
+    # --------------------------------------------------------- transforms
+    def filter(self, mask: np.ndarray) -> "SpanBatch":
+        """Select spans where ``mask`` is true. Column arrays are new; the
+        string table and resource dicts are shared with the parent batch."""
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (len(self),):
+            raise ValueError(f"mask shape {mask.shape} != ({len(self)},)")
+        cols = {k: v[mask] for k, v in self.columns.items()}
+        attrs = tuple(a for a, keep in zip(self.span_attrs, mask) if keep)
+        return replace(self, columns=cols, span_attrs=attrs)
+
+    def take(self, indices: np.ndarray) -> "SpanBatch":
+        indices = np.asarray(indices)
+        if indices.dtype == bool:
+            raise TypeError("take() requires integer indices; use filter() for masks")
+        cols = {k: v[indices] for k, v in self.columns.items()}
+        attrs = tuple(self.span_attrs[int(i)] for i in indices)
+        return replace(self, columns=cols, span_attrs=attrs)
+
+    def with_span_attr(self, key: str, values: Sequence[Any],
+                       mask: Optional[np.ndarray] = None) -> "SpanBatch":
+        """Return a batch where ``attrs[key] = values[i]`` for spans selected
+        by ``mask`` (all spans if None). This is how the anomaly processor tags
+        spans — a single vectorized pass, dict copy only for touched spans."""
+        n = len(self)
+        if mask is None:
+            mask = np.ones(n, dtype=bool)
+        idxs = np.nonzero(mask)[0]
+        if len(values) == len(idxs):
+            per_masked = True
+        elif len(values) == n:
+            per_masked = False
+        else:
+            raise ValueError(
+                f"values length {len(values)} matches neither masked count "
+                f"{len(idxs)} nor batch size {n}")
+        new_attrs = list(self.span_attrs)
+        for j, i in enumerate(idxs):
+            d = dict(new_attrs[i])
+            d[key] = values[j] if per_masked else values[i]
+            new_attrs[i] = d
+        return replace(self, span_attrs=tuple(new_attrs))
+
+    def group_key_by_resource(self, attr_keys: Sequence[str]) -> list[tuple]:
+        """Per-span grouping key from resource attributes (used by routers).
+
+        Keys are computed once per distinct resource (bounded, deduped) and
+        gathered through the resource_index column — O(resources), not O(spans).
+        """
+        per_resource = [tuple(res.get(k) for k in attr_keys)
+                        for res in self.resources]
+        return [per_resource[ri] for ri in self.columns["resource_index"].tolist()]
+
+    # -------------------------------------------------------------- iter
+    def iter_spans(self) -> Iterator[dict[str, Any]]:
+        """Debug/exporter-only per-span dict view. NOT for the hot path."""
+        for i in range(len(self)):
+            yield self.span_dict(i)
+
+    def span_dict(self, i: int) -> dict[str, Any]:
+        c = self.columns
+        return {
+            "trace_id": f"{int(c['trace_id_hi'][i]):016x}{int(c['trace_id_lo'][i]):016x}",
+            "span_id": f"{int(c['span_id'][i]):016x}",
+            "parent_span_id": f"{int(c['parent_span_id'][i]):016x}",
+            "name": self.string_at(int(c["name"][i])),
+            "service": self.string_at(int(c["service"][i])),
+            "kind": SpanKind(int(c["kind"][i])).name,
+            "status_code": StatusCode(int(c["status_code"][i])).name,
+            "start_unix_nano": int(c["start_unix_nano"][i]),
+            "end_unix_nano": int(c["end_unix_nano"][i]),
+            "attributes": dict(self.span_attrs[i]),
+            "resource": dict(self.resources[int(c["resource_index"][i])]),
+        }
+
+    @staticmethod
+    def empty() -> "SpanBatch":
+        cols = {k: np.empty(0, dtype=dt) for k, dt in _COLUMNS.items()}
+        return SpanBatch(strings=(), resources=(), span_attrs=(), columns=cols)
+
+
+class SpanBatchBuilder:
+    """Incremental builder; freezes into an immutable SpanBatch.
+
+    Receivers decode into a builder; `build()` materializes columns once.
+    """
+
+    def __init__(self) -> None:
+        self._strings: list[str] = []
+        self._intern: dict[str, int] = {}
+        self._resources: list[dict[str, Any]] = []
+        self._res_intern: dict[tuple, int] = {}
+        self._span_attrs: list[dict[str, Any]] = []
+        self._cols: dict[str, list] = {k: [] for k in _COLUMNS}
+
+    def intern(self, s: str) -> int:
+        idx = self._intern.get(s)
+        if idx is None:
+            idx = len(self._strings)
+            self._strings.append(s)
+            self._intern[s] = idx
+        return idx
+
+    def add_resource(self, attrs: dict[str, Any]) -> int:
+        key = tuple(sorted((k, str(v)) for k, v in attrs.items()))
+        idx = self._res_intern.get(key)
+        if idx is None:
+            idx = len(self._resources)
+            self._resources.append(dict(attrs))
+            self._res_intern[key] = idx
+        return idx
+
+    def add_span(
+        self,
+        *,
+        trace_id: int,
+        span_id: int,
+        parent_span_id: int = 0,
+        name: str,
+        service: str,
+        kind: int = SpanKind.INTERNAL,
+        status_code: int = StatusCode.UNSET,
+        start_unix_nano: int,
+        end_unix_nano: int,
+        resource_index: int = -1,
+        attrs: Optional[dict[str, Any]] = None,
+        scope: str = "",
+    ) -> None:
+        if resource_index < 0:
+            resource_index = self.add_resource({"service.name": service})
+        c = self._cols
+        c["trace_id_hi"].append((trace_id >> 64) & 0xFFFFFFFFFFFFFFFF)
+        c["trace_id_lo"].append(trace_id & 0xFFFFFFFFFFFFFFFF)
+        c["span_id"].append(span_id & 0xFFFFFFFFFFFFFFFF)
+        c["parent_span_id"].append(parent_span_id & 0xFFFFFFFFFFFFFFFF)
+        c["name"].append(self.intern(name))
+        c["service"].append(self.intern(service))
+        c["scope"].append(self.intern(scope) if scope else -1)
+        c["kind"].append(int(kind))
+        c["status_code"].append(int(status_code))
+        c["start_unix_nano"].append(start_unix_nano)
+        c["end_unix_nano"].append(end_unix_nano)
+        c["resource_index"].append(resource_index)
+        self._span_attrs.append(attrs if attrs else _EMPTY_DICT)
+
+    def __len__(self) -> int:
+        return len(self._span_attrs)
+
+    def build(self) -> SpanBatch:
+        cols = {
+            k: np.asarray(v, dtype=_COLUMNS[k]) for k, v in self._cols.items()
+        }
+        return SpanBatch(
+            strings=tuple(self._strings),
+            resources=tuple(self._resources),
+            span_attrs=tuple(self._span_attrs),
+            columns=cols,
+        )
+
+
+def concat_batches(batches: Sequence[SpanBatch]) -> SpanBatch:
+    """Concatenate batches, re-basing string-table and resource indices.
+
+    This is the batch-processor primitive (the analog of the reference's batch
+    processor in every generated pipeline, SURVEY.md §3.3). String tables are
+    merged with interning so repeated flushes don't grow tables unboundedly.
+    """
+    batches = [b for b in batches if len(b) > 0]
+    if not batches:
+        return SpanBatch.empty()
+    if len(batches) == 1:
+        return batches[0]
+
+    strings: list[str] = []
+    intern: dict[str, int] = {}
+    resources: list[dict[str, Any]] = []
+    res_intern: dict[tuple, int] = {}  # content key -> new index
+    span_attrs: list[dict[str, Any]] = []
+    out_cols: dict[str, list[np.ndarray]] = {k: [] for k in _COLUMNS}
+
+    for b in batches:
+        # string remap table for this batch (vectorized gather afterwards)
+        remap = np.empty(max(len(b.strings), 1), dtype=np.int32)
+        for i, s in enumerate(b.strings):
+            j = intern.get(s)
+            if j is None:
+                j = len(strings)
+                strings.append(s)
+                intern[s] = j
+            remap[i] = j
+        res_remap = np.empty(max(len(b.resources), 1), dtype=np.int32)
+        for i, r in enumerate(b.resources):
+            rk = tuple(sorted((k, str(v)) for k, v in r.items()))
+            j = res_intern.get(rk)
+            if j is None:
+                j = len(resources)
+                resources.append(r)
+                res_intern[rk] = j
+            res_remap[i] = j
+
+        for k in _COLUMNS:
+            colv = b.columns[k]
+            if k in ("name", "service"):
+                colv = remap[colv]
+            elif k == "scope":
+                colv = np.where(colv >= 0, remap[np.maximum(colv, 0)], -1)
+            elif k == "resource_index":
+                colv = res_remap[colv]
+            out_cols[k].append(colv.astype(_COLUMNS[k], copy=False))
+        span_attrs.extend(b.span_attrs)
+
+    cols = {k: np.concatenate(v) for k, v in out_cols.items()}
+    return SpanBatch(
+        strings=tuple(strings),
+        resources=tuple(resources),
+        span_attrs=tuple(span_attrs),
+        columns=cols,
+    )

@@ -1,0 +1,31 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the analog of the reference testing
+multi-node topologies on a single machine via KinD multi-node,
+tests/common/apply/kind-config.yaml — SURVEY.md §4 item 5). Environment must be
+set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def demo_batch():
+    """A medium synthetic batch shared across tests (session-scoped: cheap)."""
+    from odigos_tpu.pdata import synthesize_traces
+
+    return synthesize_traces(64, seed=7)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

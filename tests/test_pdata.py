@@ -1,0 +1,143 @@
+"""pdata layer tests: columnar invariants, builder, concat, filter, generator."""
+
+import numpy as np
+
+from odigos_tpu.pdata import (
+    SpanBatch,
+    SpanBatchBuilder,
+    SpanKind,
+    StatusCode,
+    concat_batches,
+    synthesize_traces,
+)
+
+
+def _tiny_batch(n=5, service="svc", trace_id=0xABC):
+    b = SpanBatchBuilder()
+    for i in range(n):
+        b.add_span(
+            trace_id=trace_id, span_id=i + 1, parent_span_id=0 if i == 0 else 1,
+            name=f"op{i % 2}", service=service, kind=SpanKind.SERVER,
+            status_code=StatusCode.OK if i % 2 else StatusCode.ERROR,
+            start_unix_nano=1000 * i, end_unix_nano=1000 * i + 500,
+            attrs={"i": i},
+        )
+    return b.build()
+
+
+def test_builder_roundtrip():
+    batch = _tiny_batch()
+    assert len(batch) == 5
+    assert batch.service_names() == ["svc"] * 5
+    assert batch.span_names() == ["op0", "op1", "op0", "op1", "op0"]
+    np.testing.assert_array_equal(batch.duration_ns, np.full(5, 500))
+    assert batch.is_root.tolist() == [True, False, False, False, False]
+    d = batch.span_dict(0)
+    assert d["service"] == "svc" and d["kind"] == "SERVER"
+    assert d["attributes"] == {"i": 0}
+
+
+def test_string_interning():
+    batch = _tiny_batch(n=100)
+    # only 3 strings: op0, op1, svc
+    assert len(batch.strings) == 3
+    assert len(batch.resources) == 1
+
+
+def test_filter_and_take():
+    batch = _tiny_batch()
+    errs = batch.filter(batch.col("status_code") == int(StatusCode.ERROR))
+    assert len(errs) == 3
+    assert all(d["status_code"] == "ERROR" for d in errs.iter_spans())
+    head = batch.take(np.array([0, 1]))
+    assert len(head) == 2
+
+
+def test_with_span_attr_masked():
+    batch = _tiny_batch()
+    mask = np.array([True, False, True, False, False])
+    tagged = batch.with_span_attr("odigos.anomaly.score", [0.9, 0.8], mask)
+    assert tagged.span_attrs[0]["odigos.anomaly.score"] == 0.9
+    assert "odigos.anomaly.score" not in tagged.span_attrs[1]
+    # original untouched (immutability)
+    assert "odigos.anomaly.score" not in batch.span_attrs[0]
+
+
+def test_concat_rebases_string_table():
+    a = _tiny_batch(service="svc-a", trace_id=1)
+    b = _tiny_batch(service="svc-b", trace_id=2)
+    merged = concat_batches([a, b])
+    assert len(merged) == 10
+    assert merged.service_names() == ["svc-a"] * 5 + ["svc-b"] * 5
+    # op0/op1 shared between the two tables after interning
+    assert sorted(merged.strings) == ["op0", "op1", "svc-a", "svc-b"]
+    assert len(merged.resources) == 2
+    np.testing.assert_array_equal(
+        merged.col("resource_index"), [0] * 5 + [1] * 5)
+
+
+def test_concat_empty_and_single():
+    assert len(concat_batches([])) == 0
+    a = _tiny_batch()
+    assert concat_batches([a]) is a
+    assert len(concat_batches([SpanBatch.empty(), a])) == 5
+
+
+def test_synthesize_traces_deterministic():
+    a = synthesize_traces(10, seed=3)
+    b = synthesize_traces(10, seed=3)
+    assert len(a) == len(b) > 10
+    np.testing.assert_array_equal(a.col("span_id"), b.col("span_id"))
+    np.testing.assert_array_equal(a.duration_ns, b.duration_ns)
+
+
+def test_synthesize_traces_structure():
+    batch = synthesize_traces(20, seed=1)
+    # every trace has exactly one root
+    roots = batch.filter(batch.is_root)
+    tid = set(zip(roots.col("trace_id_hi").tolist(),
+                  roots.col("trace_id_lo").tolist()))
+    assert len(tid) == 20
+    # parents precede children is not guaranteed globally, but parent ids must
+    # exist within the same trace
+    ids = set(batch.col("span_id").tolist())
+    for pid in batch.col("parent_span_id"):
+        assert pid == 0 or int(pid) in ids
+    # multiple services and kinds present
+    assert len(set(batch.service_names())) >= 5
+    kinds = set(batch.col("kind").tolist())
+    assert int(SpanKind.SERVER) in kinds and int(SpanKind.CLIENT) in kinds
+
+
+def test_group_key_by_resource():
+    batch = synthesize_traces(5, seed=2)
+    keys = batch.group_key_by_resource(["k8s.namespace.name", "service.name"])
+    assert len(keys) == len(batch)
+    assert all(k[0] == "default" for k in keys)
+
+
+def test_take_rejects_bool_mask():
+    import pytest
+    batch = _tiny_batch()
+    with pytest.raises(TypeError):
+        batch.take(batch.col("status_code") == int(StatusCode.ERROR))
+
+
+def test_with_span_attr_bad_length():
+    import pytest
+    batch = _tiny_batch()
+    with pytest.raises(ValueError):
+        batch.with_span_attr("k", [1, 2, 3], np.array([True, True, False, False, False]))
+
+
+def test_concat_dedupes_resources_by_content():
+    # two separate builders producing identical resources must merge tables
+    a = _tiny_batch(service="same")
+    b = _tiny_batch(service="same")
+    merged = concat_batches([a, b])
+    assert len(merged.resources) == 1
+    # rolling-flush pattern must not grow the table
+    acc = merged
+    for _ in range(3):
+        acc = concat_batches([acc, _tiny_batch(service="same")])
+    assert len(acc.resources) == 1
