@@ -1,0 +1,20 @@
+"""Builtin component factories. Importing this package registers them all
+(the builder-config.yaml role: the set of imports *is* the distro)."""
+
+from .api import (  # noqa: F401
+    Capabilities,
+    Component,
+    ComponentKind,
+    Connector,
+    Consumer,
+    Exporter,
+    Factory,
+    FanoutConsumer,
+    Processor,
+    Receiver,
+    Registry,
+    Signal,
+    register,
+    registry,
+)
+from . import receivers, processors, exporters, connectors  # noqa: F401

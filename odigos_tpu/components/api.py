@@ -1,0 +1,218 @@
+"""Collector component plugin API.
+
+This is our equivalent of the OpenTelemetry Collector `component.Factory`
+boundary the reference builds everything on (SURVEY.md §2.3; e.g.
+collector/processors/odigossamplingprocessor/factory.go:13 registers a traces
+processor via processor.WithTraces, collector/odigosotelcol/main.go:26 collects
+factories into the distro). Keeping the same seam means the TPU anomaly stage
+is a pure add-on: a build without the `tpuanomaly` factory registered behaves
+byte-identically, which is the north star's hard requirement.
+
+Concepts:
+
+* ``Signal`` — traces/metrics/logs.
+* ``Consumer`` — anything with ``consume(batch)``; pipelines are chains of
+  consumers ending in exporters.
+* ``Receiver`` — pushes batches into one or more pipelines.
+* ``Processor`` — transforms a batch, forwards to the next consumer. May hold
+  state and flush asynchronously (it receives the next consumer at build time).
+* ``Exporter`` — terminal consumer.
+* ``Connector`` — exporter in one pipeline, receiver in others: the fan-out /
+  fan-in primitive (forward, router, anomalyrouter).
+* ``Factory`` — named constructor + default config; registered in a
+  ``Registry`` (the builder-config.yaml equivalent is just the set of
+  registered factories).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Protocol, runtime_checkable
+
+from ..pdata.spans import SpanBatch
+
+
+class Signal(str, enum.Enum):
+    TRACES = "traces"
+    METRICS = "metrics"
+    LOGS = "logs"
+
+
+class ComponentKind(str, enum.Enum):
+    RECEIVER = "receiver"
+    PROCESSOR = "processor"
+    EXPORTER = "exporter"
+    CONNECTOR = "connector"
+
+
+@dataclass(frozen=True)
+class Capabilities:
+    mutates_data: bool = False
+
+
+@runtime_checkable
+class Consumer(Protocol):
+    def consume(self, batch: SpanBatch) -> None: ...
+
+
+class FanoutConsumer:
+    """Delivers one batch to several consumers (a receiver feeding multiple
+    pipelines, or a pipeline with multiple exporters)."""
+
+    def __init__(self, consumers: list[Consumer]):
+        self.consumers = list(consumers)
+
+    def consume(self, batch: SpanBatch) -> None:
+        errs = []
+        for c in self.consumers:
+            try:
+                c.consume(batch)
+            except Exception as e:  # deliver to all even if one fails
+                errs.append(e)
+        if errs:
+            raise errs[0]
+
+
+class Component:
+    """Lifecycle base. Components are built stopped; the service starts them
+    in reverse topological order (exporters first) and shuts down forward."""
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        self.name = name
+        self.config = config
+        self._started = False
+
+    def start(self) -> None:
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._started = False
+
+    # health hook (OpAMP-style status; see controlplane health aggregation)
+    def healthy(self) -> bool:
+        return True
+
+
+class Receiver(Component):
+    """Produces batches. ``next_consumer`` is set by the pipeline builder."""
+
+    next_consumer: Consumer
+
+    def set_consumer(self, consumer: Consumer) -> None:
+        self.next_consumer = consumer
+
+
+class Processor(Component, Consumer):
+    """Transform stage. Default implementation: synchronous map via
+    ``process``; override ``consume`` for async/stateful processors."""
+
+    next_consumer: Consumer
+    capabilities: Capabilities = Capabilities()
+
+    def set_consumer(self, consumer: Consumer) -> None:
+        self.next_consumer = consumer
+
+    def process(self, batch: SpanBatch) -> Optional[SpanBatch]:
+        return batch
+
+    def consume(self, batch: SpanBatch) -> None:
+        out = self.process(batch)
+        if out is not None and len(out):
+            self.next_consumer.consume(out)
+
+
+class Exporter(Component, Consumer):
+    def consume(self, batch: SpanBatch) -> None:
+        self.export(batch)
+
+    def export(self, batch: SpanBatch) -> None:
+        raise NotImplementedError
+
+
+class Connector(Component, Consumer):
+    """Bridges pipelines. The builder calls ``set_outputs`` with a mapping of
+    downstream pipeline name -> consumer; ``consume`` routes among them."""
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.outputs: dict[str, Consumer] = {}
+
+    def set_outputs(self, outputs: dict[str, Consumer]) -> None:
+        self.outputs = dict(outputs)
+
+
+CreateFn = Callable[[str, dict[str, Any]], Component]
+
+
+@dataclass(frozen=True)
+class Factory:
+    """Named component constructor — the plugin unit.
+
+    ``type_name`` is the config key before the optional "/instance" suffix
+    (``batch``, ``tpuanomaly``, ``otlp/2``...), matching collector semantics.
+    """
+
+    type_name: str
+    kind: ComponentKind
+    create: CreateFn
+    default_config: Callable[[], dict[str, Any]] = field(default=dict)
+    signals: tuple[Signal, ...] = (Signal.TRACES,)
+    stability: str = "beta"
+
+    def build(self, name: str, user_config: Optional[dict[str, Any]] = None) -> Component:
+        cfg = self.default_config()
+        if user_config:
+            cfg = _deep_merge(cfg, user_config)
+        return self.create(name, cfg)
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class Registry:
+    """The set of factories a distro is built from (builder-config.yaml
+    equivalent). Thread-safe; global default in ``registry``."""
+
+    def __init__(self) -> None:
+        self._factories: dict[tuple[ComponentKind, str], Factory] = {}
+        self._lock = threading.Lock()
+
+    def register(self, factory: Factory) -> None:
+        key = (factory.kind, factory.type_name)
+        with self._lock:
+            if key in self._factories:
+                raise ValueError(f"duplicate factory {key}")
+            self._factories[key] = factory
+
+    def get(self, kind: ComponentKind, component_id: str) -> Factory:
+        type_name = component_id.split("/", 1)[0]
+        try:
+            return self._factories[(kind, type_name)]
+        except KeyError:
+            raise KeyError(
+                f"no {kind.value} factory {type_name!r} registered "
+                f"(known: {sorted(t for k, t in self._factories if k == kind)})"
+            ) from None
+
+    def has(self, kind: ComponentKind, component_id: str) -> bool:
+        return (kind, component_id.split("/", 1)[0]) in self._factories
+
+    def types(self, kind: ComponentKind) -> list[str]:
+        return sorted(t for k, t in self._factories if k == kind)
+
+
+registry = Registry()
+
+
+def register(factory: Factory) -> Factory:
+    registry.register(factory)
+    return factory

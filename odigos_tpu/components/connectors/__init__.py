@@ -1,0 +1,1 @@
+from . import forward, router  # noqa: F401

@@ -1,0 +1,25 @@
+"""Forward connector — 1:N pipeline bridge.
+
+The reference composes destination pipelines with `forward/<dest>` connectors
+(common/pipelinegen/config_builder.go:99-108). Ours passes batches through to
+every configured output pipeline unchanged.
+"""
+
+from __future__ import annotations
+
+from ...pdata.spans import SpanBatch
+from ..api import ComponentKind, Connector, Factory, register
+
+
+class ForwardConnector(Connector):
+    def consume(self, batch: SpanBatch) -> None:
+        for consumer in self.outputs.values():
+            consumer.consume(batch)
+
+
+register(Factory(
+    type_name="forward",
+    kind=ComponentKind.CONNECTOR,
+    create=ForwardConnector,
+    default_config=dict,
+))

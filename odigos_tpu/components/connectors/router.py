@@ -1,0 +1,108 @@
+"""Data-stream router connector.
+
+Re-design of odigosrouterconnector (collector/connectors/odigosrouterconnector/
+connector.go:148 determineRoutingPipelines, :175 ConsumeTraces; routing map
+shape routingmap.go:12-33): telemetry is routed to data-stream pipelines by
+source identity key ``namespace/kind/name`` derived from resource attributes.
+
+Columnar twist: the reference walks resource-spans one by one; we compute the
+routing key once per *distinct resource* in the batch, partition span indices
+with numpy masks, and emit one sub-batch per destination pipeline. Unmatched
+resources go to the configured default pipeline (if any).
+
+Config:
+    data_streams: [{name, sources: [{namespace, kind, name}],
+                    pipelines: [pipeline names]}]
+    default_pipelines: [pipeline names]
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Connector, Factory, register
+
+_KIND_ATTRS = (
+    ("deployment", "k8s.deployment.name"),
+    ("statefulset", "k8s.statefulset.name"),
+    ("daemonset", "k8s.daemonset.name"),
+    ("cronjob", "k8s.cronjob.name"),
+)
+
+
+def resource_routing_key(res: dict[str, Any]) -> str | None:
+    """ns/kind/name key for one resource (connector.go:148 equivalent)."""
+    ns = res.get("k8s.namespace.name")
+    if not ns:
+        return None
+    for kind, attr in _KIND_ATTRS:
+        name = res.get(attr)
+        if name:
+            return f"{ns}/{kind}/{name}"
+    return None
+
+
+def build_routing_map(data_streams: list[dict[str, Any]]) -> dict[str, list[str]]:
+    """source key -> pipeline names (SignalRoutingMap equivalent)."""
+    out: dict[str, list[str]] = {}
+    for ds in data_streams:
+        for src in ds.get("sources", []):
+            key = f"{src['namespace']}/{src.get('kind', 'deployment').lower()}/{src['name']}"
+            out.setdefault(key, [])
+            for p in ds.get("pipelines", []):
+                if p not in out[key]:
+                    out[key].append(p)
+    return out
+
+
+class RouterConnector(Connector):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.routing_map = build_routing_map(config.get("data_streams", []))
+        self.default_pipelines = list(config.get("default_pipelines", []))
+
+    def consume(self, batch: SpanBatch) -> None:
+        # pipeline -> list of resource indices routed there
+        res_targets: list[list[str]] = []
+        for res in batch.resources:
+            key = resource_routing_key(res)
+            pipelines = self.routing_map.get(key) if key else None
+            res_targets.append(pipelines if pipelines else self.default_pipelines)
+
+        # group spans by destination pipeline via resource_index gather
+        by_pipeline: dict[str, np.ndarray] = {}
+        res_idx = batch.col("resource_index")
+        distinct = np.unique(res_idx)
+        for ri in distinct:
+            targets = res_targets[int(ri)]
+            if not targets:
+                continue
+            mask = res_idx == ri
+            for p in targets:
+                prev = by_pipeline.get(p)
+                by_pipeline[p] = mask if prev is None else (prev | mask)
+
+        delivered = np.zeros(len(batch), dtype=bool)
+        for pipeline, mask in by_pipeline.items():
+            consumer = self.outputs.get(pipeline)
+            if consumer is None:
+                continue
+            delivered |= mask
+            sub = batch if mask.all() else batch.filter(mask)
+            consumer.consume(sub)
+        n_dropped = int((~delivered).sum())
+        if n_dropped:
+            meter.add(f"odigos_router_dropped_spans_total{{connector={self.name}}}",
+                      n_dropped)
+
+
+register(Factory(
+    type_name="odigosrouter",
+    kind=ComponentKind.CONNECTOR,
+    create=RouterConnector,
+    default_config=lambda: {"data_streams": [], "default_pipelines": []},
+))

@@ -1,0 +1,1 @@
+from . import debug, filelog, mock  # noqa: F401

@@ -1,0 +1,48 @@
+"""Debug exporter — counts batches/spans, optionally keeps or prints them.
+
+The terminal of BASELINE config #1 (otlp → batch → debug). `keep=True` retains
+batches in memory for test assertions (the simple-trace-db role from the
+reference e2e harness, tests/common/apply/simple-trace-db-deployment.yaml).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ...pdata.spans import SpanBatch
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Exporter, Factory, register
+
+
+class DebugExporter(Exporter):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._lock = threading.Lock()
+        self.batches: list[SpanBatch] = []
+        self.span_count = 0
+        self.batch_count = 0
+
+    def export(self, batch: SpanBatch) -> None:
+        with self._lock:
+            self.batch_count += 1
+            self.span_count += len(batch)
+            if self.config.get("keep", False):
+                self.batches.append(batch)
+        meter.add(f"odigos_exporter_spans_total{{exporter={self.name}}}", len(batch))
+        if self.config.get("verbosity") == "detailed":
+            for d in batch.iter_spans():
+                print(f"[{self.name}] {d['service']} {d['name']} "
+                      f"{d['kind']} {d['status_code']} attrs={d['attributes']}")
+
+    def all_spans(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [d for b in self.batches for d in b.iter_spans()]
+
+
+register(Factory(
+    type_name="debug",
+    kind=ComponentKind.EXPORTER,
+    create=DebugExporter,
+    default_config=lambda: {"keep": False, "verbosity": "basic"},
+))

@@ -1,0 +1,47 @@
+"""File exporter — JSONL span dump (durable test destination)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+
+from ...pdata.spans import SpanBatch
+from ..api import ComponentKind, Exporter, Factory, register
+
+
+class FileExporter(Exporter):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._lock = threading.Lock()
+        self._fh = None
+
+    def start(self) -> None:
+        super().start()
+        path = self.config.get("path")
+        if not path:
+            raise ValueError(f"{self.name}: 'path' is required")
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def export(self, batch: SpanBatch) -> None:
+        if self._fh is None:
+            raise RuntimeError(f"{self.name}: export before start")
+        lines = [json.dumps(d, default=str) for d in batch.iter_spans()]
+        with self._lock:
+            self._fh.write("\n".join(lines) + "\n")
+            self._fh.flush()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+        super().shutdown()
+
+
+register(Factory(
+    type_name="file",
+    kind=ComponentKind.EXPORTER,
+    create=FileExporter,
+    default_config=dict,
+))

@@ -1,0 +1,48 @@
+"""Mock destination exporter — fault-injection test double.
+
+Mirrors the reference's mockdestinationexporter
+(collector/exporters/mockdestinationexporter/README.md:1-19, exporter.go:23):
+`reject_fraction` makes a deterministic fraction of exports fail,
+`response_duration_ms` adds latency — used to test retry/backpressure behavior
+without a real backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch
+from ..api import ComponentKind, Exporter, Factory, register
+
+
+class MockDestinationError(RuntimeError):
+    pass
+
+
+class MockDestinationExporter(Exporter):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._rng = np.random.default_rng(int(config.get("seed", 0)))
+        self.accepted_spans = 0
+        self.rejected_batches = 0
+
+    def export(self, batch: SpanBatch) -> None:
+        dur_ms = float(self.config.get("response_duration_ms", 0))
+        if dur_ms:
+            time.sleep(dur_ms / 1000.0)
+        if self._rng.random() < float(self.config.get("reject_fraction", 0.0)):
+            self.rejected_batches += 1
+            raise MockDestinationError(f"{self.name}: injected rejection")
+        self.accepted_spans += len(batch)
+
+
+register(Factory(
+    type_name="mockdestination",
+    kind=ComponentKind.EXPORTER,
+    create=MockDestinationExporter,
+    default_config=lambda: {
+        "reject_fraction": 0.0, "response_duration_ms": 0, "seed": 0},
+))

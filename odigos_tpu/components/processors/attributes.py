@@ -1,0 +1,73 @@
+"""Attribute transform processor.
+
+Covers the reference's attribute-manipulation action processors
+(addclusterinfo / renameattribute / deleteattribute compiled by
+autoscaler/controllers/actions/*.go into collector processors): insert,
+rename, delete keys on span or resource attributes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+from ...pdata.spans import SpanBatch
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+class AttributesProcessor(Processor):
+    """Config: actions: [{action: insert|update|upsert|delete|rename,
+    key: ..., value: ..., new_key: ..., scope: span|resource}]"""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def process(self, batch: SpanBatch) -> SpanBatch:
+        actions = self.config.get("actions", [])
+        if not actions:
+            return batch
+        span_attrs = None
+        resources = None
+        for a in actions:
+            scope = a.get("scope", "span")
+            if scope == "resource":
+                if resources is None:
+                    resources = [dict(r) for r in batch.resources]
+                _apply(resources, a)
+            else:
+                if span_attrs is None:
+                    span_attrs = [dict(d) for d in batch.span_attrs]
+                _apply(span_attrs, a)
+        out = batch
+        if span_attrs is not None:
+            out = replace(out, span_attrs=tuple(span_attrs))
+        if resources is not None:
+            out = replace(out, resources=tuple(resources))
+        return out
+
+
+def _apply(dicts: list[dict[str, Any]], action: dict[str, Any]) -> None:
+    kind = action.get("action", "upsert")
+    key = action["key"]
+    for d in dicts:
+        if kind == "insert":
+            d.setdefault(key, action.get("value"))
+        elif kind == "update":
+            if key in d:
+                d[key] = action.get("value")
+        elif kind == "upsert":
+            d[key] = action.get("value")
+        elif kind == "delete":
+            d.pop(key, None)
+        elif kind == "rename":
+            if key in d:
+                d[action["new_key"]] = d.pop(key)
+        else:
+            raise ValueError(f"unknown attributes action {kind!r}")
+
+
+register(Factory(
+    type_name="attributes",
+    kind=ComponentKind.PROCESSOR,
+    create=AttributesProcessor,
+    default_config=lambda: {"actions": []},
+))

@@ -1,0 +1,93 @@
+"""Batch processor.
+
+Every generated pipeline in the reference ends its processor chain with
+`batch` (autoscaler/controllers/clustercollector/configmap.go base config;
+SURVEY.md §3.3). Ours accumulates SpanBatches and flushes a single
+concatenated batch when either `send_batch_size` spans are pending or
+`timeout_s` elapses — the concat is the cheap columnar merge from pdata, so
+downstream stages (featurizer!) always see large, TPU-friendly batches.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ...pdata.spans import SpanBatch, concat_batches
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+class BatchProcessor(Processor):
+    capabilities = Capabilities(mutates_data=False)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._lock = threading.Lock()
+        self._pending: list[SpanBatch] = []
+        self._pending_spans = 0
+        self._timer: Optional[threading.Timer] = None
+        self.send_batch_size = int(config.get("send_batch_size", 8192))
+        self.send_batch_max_size = int(config.get("send_batch_max_size", 0))
+        self.timeout_s = float(config.get("timeout_s", 0.2))
+
+    def consume(self, batch: SpanBatch) -> None:
+        to_send: list[SpanBatch] = []
+        with self._lock:
+            self._pending.append(batch)
+            self._pending_spans += len(batch)
+            if self._pending_spans >= self.send_batch_size:
+                to_send = self._take_locked()
+            elif self._timer is None and self.timeout_s > 0:
+                self._timer = threading.Timer(self.timeout_s, self._flush_timer)
+                self._timer.daemon = True
+                self._timer.start()
+        if to_send:
+            self._send(to_send)
+
+    def _take_locked(self) -> list[SpanBatch]:
+        taken = self._pending
+        self._pending = []
+        self._pending_spans = 0
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        return taken
+
+    def _flush_timer(self) -> None:
+        with self._lock:
+            self._timer = None
+            taken = self._take_locked()
+        if taken:
+            self._send(taken)
+
+    def _send(self, batches: list[SpanBatch]) -> None:
+        merged = concat_batches(batches)
+        if not merged:
+            return
+        max_size = self.send_batch_max_size
+        if max_size and len(merged) > max_size:
+            import numpy as np
+            for lo in range(0, len(merged), max_size):
+                idx = np.arange(lo, min(lo + max_size, len(merged)))
+                self.next_consumer.consume(merged.take(idx))
+        else:
+            self.next_consumer.consume(merged)
+
+    def flush(self) -> None:
+        with self._lock:
+            taken = self._take_locked()
+        if taken:
+            self._send(taken)
+
+    def shutdown(self) -> None:
+        self.flush()
+        super().shutdown()
+
+
+register(Factory(
+    type_name="batch",
+    kind=ComponentKind.PROCESSOR,
+    create=BatchProcessor,
+    default_config=lambda: {
+        "send_batch_size": 8192, "send_batch_max_size": 0, "timeout_s": 0.2},
+))

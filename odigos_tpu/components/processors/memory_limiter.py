@@ -1,0 +1,73 @@
+"""Memory limiter + admission control.
+
+The reference protects the gateway with a memory_limiter processor plus a
+forked configgrpc that rejects OTLP *before decoding* under pressure
+(collector/config/configgrpc/README.md:1-12); rejections feed the HPA custom
+metric odigos_gateway_memory_limiter_rejections_total
+(autoscaler/controllers/metricshandler/custom_metrics_handler.go:27).
+
+Ours tracks an estimated in-flight byte budget (columnar batches make the
+estimate cheap: sum of column nbytes) and refuses batches above the hard
+limit, incrementing the same-named rejection counter that our autoscaler's
+HPA math consumes. Soft limit triggers aggressive downstream flushing via
+gc, mirroring spike-limit headroom (resource_config.go:22-32).
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+from typing import Any
+
+from ...pdata.spans import SpanBatch
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Factory, Processor, register
+
+REJECTION_METRIC = "odigos_gateway_memory_limiter_rejections_total"
+
+
+def batch_nbytes(batch: SpanBatch) -> int:
+    n = sum(col.nbytes for col in batch.columns.values())
+    n += sum(len(s) for s in batch.strings)
+    n += 64 * len(batch.span_attrs)  # rough per-span attr overhead
+    return n
+
+
+class MemoryLimiterError(RuntimeError):
+    """Raised to the caller (receiver) so it can apply backpressure."""
+
+
+class MemoryLimiterProcessor(Processor):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.limit_bytes = int(config.get("limit_mib", 512)) * 1024 * 1024
+        spike = float(config.get("spike_limit_fraction", 0.2))
+        self.soft_bytes = int(self.limit_bytes * (1.0 - spike))
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def consume(self, batch: SpanBatch) -> None:
+        size = batch_nbytes(batch)
+        with self._lock:
+            if self._inflight + size > self.limit_bytes:
+                meter.add(REJECTION_METRIC)
+                raise MemoryLimiterError(
+                    f"{self.name}: refusing batch of {size} B "
+                    f"({self._inflight} B in flight, limit {self.limit_bytes} B)")
+            soft_exceeded = self._inflight + size > self.soft_bytes
+            self._inflight += size
+        if soft_exceeded:
+            gc.collect(0)
+        try:
+            self.next_consumer.consume(batch)
+        finally:
+            with self._lock:
+                self._inflight -= size
+
+
+register(Factory(
+    type_name="memory_limiter",
+    kind=ComponentKind.PROCESSOR,
+    create=MemoryLimiterProcessor,
+    default_config=lambda: {"limit_mib": 512, "spike_limit_fraction": 0.2},
+))

@@ -1,0 +1,39 @@
+"""Traffic metrics processor.
+
+Equivalent of odigostrafficmetrics (collector/processors/odigostrafficmetrics/
+processor.go:31,71): appended as the last processor of every generated
+pipeline, it measures span count and estimated bytes per source (service) and
+feeds the own-telemetry meter that the UI/autoscaler read.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any
+
+from ...pdata.spans import SpanBatch
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Factory, Processor, register
+from .memory_limiter import batch_nbytes
+
+
+class TrafficMetricsProcessor(Processor):
+    def process(self, batch: SpanBatch) -> SpanBatch:
+        pipeline = self.config.get("pipeline", self.name)
+        meter.add(f"odigos_traffic_spans_total{{pipeline={pipeline}}}", len(batch))
+        meter.add(f"odigos_traffic_bytes_total{{pipeline={pipeline}}}",
+                  batch_nbytes(batch))
+        if self.config.get("per_service", True):
+            counts = Counter(batch.col("service").tolist())
+            for sid, n in counts.items():
+                svc = batch.string_at(int(sid))
+                meter.add(f"odigos_traffic_spans_total{{service={svc}}}", n)
+        return batch
+
+
+register(Factory(
+    type_name="odigostrafficmetrics",
+    kind=ComponentKind.PROCESSOR,
+    create=TrafficMetricsProcessor,
+    default_config=lambda: {"per_service": True},
+))

@@ -1,0 +1,1 @@
+from . import synthetic  # noqa: F401  (registers factories on import)

@@ -1,0 +1,82 @@
+"""Synthetic trace receiver.
+
+The in-process stand-in for the reference's traffic-generator Job + OTLP
+receiver front door (tests/common/apply/generate-traffic-job.yaml feeding the
+otlp receiver in every generated pipeline). Pushes deterministic synthetic
+trace batches at a configured rate — used by tests, the e2e slice, and bench.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from ...pdata.gen import synthesize_traces
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Factory, Receiver, Signal, register
+
+
+class SyntheticReceiver(Receiver):
+    """Config:
+    traces_per_batch: traces per emitted batch
+    n_batches: stop after this many (0 = run until shutdown)
+    interval_s: sleep between batches (0 = as fast as possible)
+    seed: base RNG seed (batch i uses seed+i)
+    """
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"recv-{self.name}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        cfg = self.config
+        n_batches = int(cfg.get("n_batches", 0))
+        interval = float(cfg.get("interval_s", 0.0))
+        per_batch = int(cfg.get("traces_per_batch", 10))
+        seed = int(cfg.get("seed", 0))
+        i = 0
+        while not self._stop.is_set():
+            if n_batches and i >= n_batches:
+                break
+            batch = synthesize_traces(per_batch, seed=seed + i)
+            try:
+                self.next_consumer.consume(batch)
+            except Exception:
+                # downstream refused (memory limiter, flaky destination):
+                # backpressure = drop this batch, back off, keep emitting.
+                meter.add(f"odigos_receiver_refused_batches_total{{receiver={self.name}}}")
+                self._stop.wait(max(interval, 0.01))
+            i += 1
+            if interval:
+                self._stop.wait(interval)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until the configured n_batches have been emitted."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        super().shutdown()
+
+
+register(Factory(
+    type_name="synthetic",
+    kind=ComponentKind.RECEIVER,
+    create=SyntheticReceiver,
+    default_config=lambda: {
+        "traces_per_batch": 10, "n_batches": 0, "interval_s": 0.0, "seed": 0},
+    signals=(Signal.TRACES,),
+))

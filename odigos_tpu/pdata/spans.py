@@ -66,6 +66,11 @@ _COLUMNS: dict[str, np.dtype] = {
 _EMPTY_DICT: dict[str, Any] = {}
 
 
+def _resource_key(attrs: dict[str, Any]) -> tuple:
+    """Content key for resource interning. repr() keeps 80 and "80" distinct."""
+    return tuple(sorted((k, repr(v)) for k, v in attrs.items()))
+
+
 @dataclass(frozen=True)
 class SpanBatch:
     """An immutable batch of spans in columnar form.
@@ -140,6 +145,9 @@ class SpanBatch:
         n = len(self)
         if mask is None:
             mask = np.ones(n, dtype=bool)
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (n,):
+            raise ValueError(f"mask shape {mask.shape} != ({n},)")
         idxs = np.nonzero(mask)[0]
         if len(values) == len(idxs):
             per_masked = True
@@ -217,7 +225,7 @@ class SpanBatchBuilder:
         return idx
 
     def add_resource(self, attrs: dict[str, Any]) -> int:
-        key = tuple(sorted((k, str(v)) for k, v in attrs.items()))
+        key = _resource_key(attrs)
         idx = self._res_intern.get(key)
         if idx is None:
             idx = len(self._resources)
@@ -305,7 +313,7 @@ def concat_batches(batches: Sequence[SpanBatch]) -> SpanBatch:
             remap[i] = j
         res_remap = np.empty(max(len(b.resources), 1), dtype=np.int32)
         for i, r in enumerate(b.resources):
-            rk = tuple(sorted((k, str(v)) for k, v in r.items()))
+            rk = _resource_key(r)
             j = res_intern.get(rk)
             if j is None:
                 j = len(resources)

@@ -1,0 +1,4 @@
+from .service import Collector
+from .graph import build_graph, validate_config
+
+__all__ = ["Collector", "build_graph", "validate_config"]

@@ -1,0 +1,219 @@
+"""Pipeline graph assembly.
+
+Builds a running component graph from a collector-style config dict:
+
+    {"receivers": {...}, "processors": {...}, "exporters": {...},
+     "connectors": {...},
+     "service": {"pipelines": {"traces/in": {"receivers": [...],
+                                             "processors": [...],
+                                             "exporters": [...]}}}}
+
+Semantics follow the OTel collector the reference is built on (SURVEY.md §2.3):
+
+* receiver/exporter/connector ids name **singleton** instances shared across
+  pipelines; processors are instantiated **per pipeline** (collector behavior —
+  stateful processors like batch must not be shared).
+* a connector id appearing under one pipeline's ``exporters`` and another's
+  ``receivers`` bridges them; its ``outputs`` map is keyed by downstream
+  pipeline name (how odigosrouterconnector addresses data-stream pipelines).
+* the connector graph must be a DAG.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..components.api import (
+    Component,
+    ComponentKind,
+    Connector,
+    Consumer,
+    Exporter,
+    FanoutConsumer,
+    Processor,
+    Receiver,
+    Registry,
+    registry as default_registry,
+)
+
+
+@dataclass
+class Graph:
+    receivers: dict[str, Receiver] = field(default_factory=dict)
+    exporters: dict[str, Exporter] = field(default_factory=dict)
+    connectors: dict[str, Connector] = field(default_factory=dict)
+    # (pipeline, id) -> processor instance
+    processors: dict[tuple[str, str], Processor] = field(default_factory=dict)
+    pipeline_entries: dict[str, Consumer] = field(default_factory=dict)
+    # pipelines in topological order (upstream before downstream via connectors)
+    pipeline_order: list[str] = field(default_factory=list)
+    # pipeline -> processors in chain (declaration) order
+    pipeline_processors: dict[str, list[Processor]] = field(default_factory=dict)
+
+    def all_components(self) -> list[Component]:
+        return (list(self.exporters.values()) + list(self.connectors.values())
+                + list(self.processors.values()) + list(self.receivers.values()))
+
+    def processors_topological(self) -> list[Processor]:
+        """Processors ordered so flushing each in turn pushes pending data
+        strictly downstream: upstream pipelines first, chain order within a
+        pipeline. Required for lossless drain/shutdown (a downstream batch
+        processor must flush *after* upstream flushes land in it)."""
+        out: list[Processor] = []
+        for pname in self.pipeline_order:
+            out.extend(self.pipeline_processors.get(pname, []))
+        return out
+
+    def component(self, component_id: str) -> Component:
+        """Lookup by id across kinds (test/UI convenience)."""
+        for m in (self.receivers, self.exporters, self.connectors):
+            if component_id in m:
+                return m[component_id]
+        for (_, cid), proc in self.processors.items():
+            if cid == component_id:
+                return proc
+        raise KeyError(component_id)
+
+
+def validate_config(config: dict[str, Any]) -> list[str]:
+    """Static validation; returns a list of problems (empty = valid)."""
+    problems = []
+    pipelines = config.get("service", {}).get("pipelines", {})
+    if not pipelines:
+        problems.append("service.pipelines is empty")
+    declared = {
+        ComponentKind.RECEIVER: set(config.get("receivers", {})),
+        ComponentKind.PROCESSOR: set(config.get("processors", {})),
+        ComponentKind.EXPORTER: set(config.get("exporters", {})),
+        ComponentKind.CONNECTOR: set(config.get("connectors", {})),
+    }
+    conn_ids = declared[ComponentKind.CONNECTOR]
+    for pname, p in pipelines.items():
+        if not p.get("receivers"):
+            problems.append(f"pipeline {pname}: no receivers")
+        if not p.get("exporters"):
+            problems.append(f"pipeline {pname}: no exporters")
+        for rid in p.get("receivers", []):
+            if rid not in declared[ComponentKind.RECEIVER] and rid not in conn_ids:
+                problems.append(f"pipeline {pname}: unknown receiver {rid}")
+        for pid in p.get("processors", []):
+            if pid not in declared[ComponentKind.PROCESSOR]:
+                problems.append(f"pipeline {pname}: unknown processor {pid}")
+        for eid in p.get("exporters", []):
+            if eid not in declared[ComponentKind.EXPORTER] and eid not in conn_ids:
+                problems.append(f"pipeline {pname}: unknown exporter {eid}")
+
+    # connector DAG check: edge pipeline_A -> pipeline_B when a connector is
+    # exporter in A and receiver in B
+    in_pipelines: dict[str, list[str]] = {}
+    for pname, p in pipelines.items():
+        for rid in p.get("receivers", []):
+            if rid in conn_ids:
+                in_pipelines.setdefault(rid, []).append(pname)
+    edges: dict[str, list[str]] = {p: [] for p in pipelines}
+    for pname, p in pipelines.items():
+        for eid in p.get("exporters", []):
+            if eid in conn_ids:
+                edges[pname].extend(in_pipelines.get(eid, []))
+    state: dict[str, int] = {}
+
+    def dfs(node: str, stack: list[str]) -> None:
+        state[node] = 1
+        for nxt in edges[node]:
+            if state.get(nxt) == 1:
+                problems.append(
+                    f"connector cycle: {' -> '.join(stack + [node, nxt])}")
+            elif state.get(nxt, 0) == 0:
+                dfs(nxt, stack + [node])
+        state[node] = 2
+
+    for p in pipelines:
+        if state.get(p, 0) == 0:
+            dfs(p, [])
+    return problems
+
+
+def _topological_pipelines(pipelines: dict[str, Any]) -> list[str]:
+    """Kahn topo sort over connector edges (A -> B when a connector is an
+    exporter of A and a receiver of B). Config validated acyclic already."""
+    conn_receivers: dict[str, list[str]] = {}
+    for pname, p in pipelines.items():
+        for rid in p.get("receivers", []):
+            conn_receivers.setdefault(rid, []).append(pname)
+    edges: dict[str, list[str]] = {p: [] for p in pipelines}
+    indeg: dict[str, int] = {p: 0 for p in pipelines}
+    for pname, p in pipelines.items():
+        for eid in p.get("exporters", []):
+            for downstream in conn_receivers.get(eid, []):
+                edges[pname].append(downstream)
+                indeg[downstream] += 1
+    queue = [p for p, d in indeg.items() if d == 0]
+    order: list[str] = []
+    while queue:
+        node = queue.pop(0)
+        order.append(node)
+        for nxt in edges[node]:
+            indeg[nxt] -= 1
+            if indeg[nxt] == 0:
+                queue.append(nxt)
+    return order
+
+
+def build_graph(config: dict[str, Any],
+                reg: Registry | None = None) -> Graph:
+    reg = reg or default_registry
+    problems = validate_config(config)
+    if problems:
+        raise ValueError("invalid pipeline config: " + "; ".join(problems))
+
+    g = Graph()
+    pipelines = config.get("service", {}).get("pipelines", {})
+    conn_cfgs = config.get("connectors", {})
+
+    # 1. singletons: exporters and connectors
+    for eid, ecfg in config.get("exporters", {}).items():
+        g.exporters[eid] = reg.get(ComponentKind.EXPORTER, eid).build(eid, ecfg)
+    for cid, ccfg in conn_cfgs.items():
+        g.connectors[cid] = reg.get(ComponentKind.CONNECTOR, cid).build(cid, ccfg)
+
+    # 2. per-pipeline chains, built exporters-first so entries exist
+    for pname, p in pipelines.items():
+        terminal: list[Consumer] = []
+        for eid in p.get("exporters", []):
+            terminal.append(g.connectors[eid] if eid in g.connectors
+                            else g.exporters[eid])
+        tail: Consumer = terminal[0] if len(terminal) == 1 else FanoutConsumer(terminal)
+        chain: list[Processor] = []
+        for pid in reversed(p.get("processors", [])):
+            proc = reg.get(ComponentKind.PROCESSOR, pid).build(
+                pid, config.get("processors", {}).get(pid))
+            proc.set_consumer(tail)
+            g.processors[(pname, pid)] = proc
+            chain.append(proc)
+            tail = proc
+        g.pipeline_processors[pname] = list(reversed(chain))
+        g.pipeline_entries[pname] = tail
+    g.pipeline_order = _topological_pipelines(pipelines)
+
+    # 3. connector outputs: downstream pipeline name -> entry consumer
+    for cid, conn in g.connectors.items():
+        outputs = {
+            pname: g.pipeline_entries[pname]
+            for pname, p in pipelines.items()
+            if cid in p.get("receivers", [])
+        }
+        conn.set_outputs(outputs)
+
+    # 4. receivers feed the fanout of every pipeline that lists them
+    for rid, rcfg in config.get("receivers", {}).items():
+        feeds = [g.pipeline_entries[pname]
+                 for pname, p in pipelines.items()
+                 if rid in p.get("receivers", [])]
+        if not feeds:
+            continue  # declared but unused
+        recv = reg.get(ComponentKind.RECEIVER, rid).build(rid, rcfg)
+        recv.set_consumer(feeds[0] if len(feeds) == 1 else FanoutConsumer(feeds))
+        g.receivers[rid] = recv
+
+    return g

@@ -1,0 +1,1 @@
+"""Shared utilities (the `common/` of the reference)."""

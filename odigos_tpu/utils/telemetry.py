@@ -1,0 +1,106 @@
+"""Own-telemetry: counters/gauges/histograms for the framework itself.
+
+The reference injects a self-telemetry pipeline into every collector config
+(autoscaler/controllers/clustercollector/configmap.go:42) and appends the
+odigostrafficmetrics processor to every pipeline; the UI and the HPA custom
+metric (odigos_gateway_memory_limiter_rejections_total) are fed from it.
+
+We keep a process-local metrics registry with the same roles: pipeline
+components record into it, the autoscaler's HPA math and the scoring engine's
+latency accounting read from it, and `snapshot()` is the scrape endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import insort
+from collections import defaultdict
+from typing import Optional
+
+
+class _Histogram:
+    __slots__ = ("values", "count", "total", "max_samples")
+
+    def __init__(self, max_samples: int = 8192):
+        self.values: list[float] = []  # sorted reservoir
+        self.count = 0
+        self.total = 0.0
+        self.max_samples = max_samples
+
+    def record(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if len(self.values) >= self.max_samples:
+            # simple reservoir decimation: drop every other sample
+            self.values = self.values[::2]
+        insort(self.values, v)
+
+    def quantile(self, q: float) -> float:
+        if not self.values:
+            return 0.0
+        idx = min(int(q * len(self.values)), len(self.values) - 1)
+        return self.values[idx]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class Meter:
+    """Thread-safe metrics registry. Labels are flattened into the name by the
+    caller convention ``name{key=value}`` to keep the structure flat."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, _Histogram] = {}
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def record(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram()
+            h.record(value)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def gauge(self, name: str) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name)
+
+    def quantile(self, name: str, q: float) -> float:
+        with self._lock:
+            h = self._hists.get(name)
+            return h.quantile(q) if h else 0.0
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat scrape of all instruments (histograms as _p50/_p99/_mean/_count)."""
+        with self._lock:
+            out: dict[str, float] = dict(self._counters)
+            out.update(self._gauges)
+            for name, h in self._hists.items():
+                out[f"{name}_count"] = float(h.count)
+                out[f"{name}_mean"] = h.mean
+                out[f"{name}_p50"] = h.quantile(0.50)
+                out[f"{name}_p99"] = h.quantile(0.99)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+meter = Meter()

@@ -1,0 +1,262 @@
+"""Pipeline/component tests: graph build, lifecycle, routing, batching,
+memory limiting, hot reload — the collector service layer."""
+
+import time
+
+import numpy as np
+import pytest
+
+from odigos_tpu.components import registry, ComponentKind
+from odigos_tpu.components.processors.memory_limiter import (
+    MemoryLimiterError, REJECTION_METRIC)
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline import Collector, validate_config
+from odigos_tpu.utils.telemetry import meter
+
+
+def basic_config(**over):
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 5, "n_batches": 4}},
+        "processors": {"batch": {"send_batch_size": 100, "timeout_s": 0.05}},
+        "exporters": {"debug": {"keep": True}},
+        "service": {"pipelines": {
+            "traces/in": {"receivers": ["synthetic"],
+                          "processors": ["batch"],
+                          "exporters": ["debug"]},
+        }},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_registry_has_builtins():
+    assert "batch" in registry.types(ComponentKind.PROCESSOR)
+    assert "debug" in registry.types(ComponentKind.EXPORTER)
+    assert "synthetic" in registry.types(ComponentKind.RECEIVER)
+    assert "forward" in registry.types(ComponentKind.CONNECTOR)
+    assert "odigosrouter" in registry.types(ComponentKind.CONNECTOR)
+
+
+def test_validate_config_problems():
+    bad = {"service": {"pipelines": {
+        "traces/x": {"receivers": ["nope"], "exporters": []}}}}
+    probs = validate_config(bad)
+    assert any("unknown receiver" in p for p in probs)
+    assert any("no exporters" in p for p in probs)
+
+
+def test_end_to_end_basic():
+    with Collector(basic_config()) as c:
+        c.drain_receivers()
+        dbg = c.component("debug")
+        expected = sum(len(synthesize_traces(5, seed=s)) for s in range(4))
+        assert dbg.span_count == expected
+        # batching collapsed 4 receiver pushes into fewer exporter batches
+        assert dbg.batch_count <= 4
+
+
+def test_batch_processor_size_trigger():
+    cfg = basic_config()
+    cfg["receivers"]["synthetic"]["n_batches"] = 8
+    cfg["processors"]["batch"] = {"send_batch_size": 50, "timeout_s": 10.0,
+                                  "send_batch_max_size": 64}
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        dbg = c.component("debug")
+        assert dbg.span_count > 0
+        assert all(len(b) <= 64 for b in dbg.batches)
+
+
+def test_router_connector_datastreams():
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 10, "n_batches": 2}},
+        "processors": {},
+        "connectors": {"odigosrouter": {
+            "data_streams": [
+                {"name": "ds-frontend",
+                 "sources": [{"namespace": "default", "kind": "deployment",
+                              "name": "frontend"}],
+                 "pipelines": ["traces/ds-frontend"]},
+            ],
+            "default_pipelines": ["traces/ds-default"],
+        }},
+        "exporters": {"debug/frontend": {"keep": True},
+                      "debug/default": {"keep": True}},
+        "service": {"pipelines": {
+            "traces/in": {"receivers": ["synthetic"],
+                          "exporters": ["odigosrouter"]},
+            "traces/ds-frontend": {"receivers": ["odigosrouter"],
+                                   "exporters": ["debug/frontend"]},
+            "traces/ds-default": {"receivers": ["odigosrouter"],
+                                  "exporters": ["debug/default"]},
+        }},
+    }
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        front = c.component("debug/frontend")
+        other = c.component("debug/default")
+        assert front.span_count > 0 and other.span_count > 0
+        for d in front.all_spans():
+            assert d["resource"]["k8s.deployment.name"] == "frontend"
+        for d in other.all_spans():
+            assert d["resource"]["k8s.deployment.name"] != "frontend"
+        total = sum(len(synthesize_traces(10, seed=s)) for s in range(2))
+        assert front.span_count + other.span_count == total
+
+
+def test_forward_connector_fanout():
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 3, "n_batches": 1}},
+        "connectors": {"forward/a": {}},
+        "exporters": {"debug/1": {"keep": True}, "debug/2": {"keep": True}},
+        "service": {"pipelines": {
+            "traces/in": {"receivers": ["synthetic"], "exporters": ["forward/a"]},
+            "traces/d1": {"receivers": ["forward/a"], "exporters": ["debug/1"]},
+            "traces/d2": {"receivers": ["forward/a"], "exporters": ["debug/2"]},
+        }},
+    }
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        assert c.component("debug/1").span_count == c.component("debug/2").span_count > 0
+
+
+def test_connector_cycle_detected():
+    cfg = {
+        "receivers": {"synthetic": {}},
+        "connectors": {"forward/a": {}, "forward/b": {}},
+        "exporters": {"debug": {}},
+        "service": {"pipelines": {
+            "traces/1": {"receivers": ["forward/b"], "exporters": ["forward/a"]},
+            "traces/2": {"receivers": ["forward/a"], "exporters": ["forward/b"]},
+        }},
+    }
+    with pytest.raises(ValueError, match="cycle"):
+        Collector(cfg)
+
+
+def test_memory_limiter_rejects():
+    meter.reset()
+    cfg = basic_config()
+    cfg["processors"]["memory_limiter"] = {"limit_mib": 0}  # reject everything
+    cfg["service"]["pipelines"]["traces/in"]["processors"] = ["memory_limiter"]
+    with Collector(cfg) as c:
+        big = synthesize_traces(50, seed=0)
+        entry = c.graph.pipeline_entries["traces/in"]
+        with pytest.raises(MemoryLimiterError):
+            entry.consume(big)
+        assert meter.counter(REJECTION_METRIC) >= 1
+
+
+def test_attributes_processor():
+    cfg = basic_config()
+    cfg["processors"]["attributes"] = {"actions": [
+        {"action": "upsert", "key": "cluster", "value": "c1", "scope": "resource"},
+        {"action": "insert", "key": "env", "value": "prod"},
+    ]}
+    cfg["service"]["pipelines"]["traces/in"]["processors"] = ["attributes", "batch"]
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        spans = c.component("debug").all_spans()
+        assert spans and all(d["resource"]["cluster"] == "c1" for d in spans)
+        assert all(d["attributes"]["env"] == "prod" for d in spans)
+
+
+def test_traffic_metrics_recorded():
+    meter.reset()
+    cfg = basic_config()
+    cfg["processors"]["odigostrafficmetrics"] = {"pipeline": "traces/in"}
+    cfg["service"]["pipelines"]["traces/in"]["processors"] = [
+        "batch", "odigostrafficmetrics"]
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        snap = meter.snapshot()
+        assert snap.get("odigos_traffic_spans_total{pipeline=traces/in}", 0) > 0
+        assert any(k.startswith("odigos_traffic_spans_total{service=")
+                   for k in snap)
+
+
+def test_hot_reload_swaps_graph():
+    cfg = basic_config()
+    cfg["receivers"]["synthetic"]["n_batches"] = 2
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        first = c.component("debug").span_count
+        assert first > 0
+        new_cfg = basic_config()
+        new_cfg["receivers"]["synthetic"] = {"traces_per_batch": 2,
+                                             "n_batches": 1, "seed": 99}
+        c.reload(new_cfg)
+        c.drain_receivers()
+        dbg2 = c.component("debug")
+        assert dbg2.span_count == len(synthesize_traces(2, seed=99))
+
+
+def test_mock_destination_rejects():
+    from odigos_tpu.components.exporters.mock import MockDestinationError
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 2, "n_batches": 1}},
+        "exporters": {"mockdestination": {"reject_fraction": 1.0}},
+        "service": {"pipelines": {
+            "traces/in": {"receivers": ["synthetic"],
+                          "exporters": ["mockdestination"]},
+        }},
+    }
+    # build without starting: drive the pipeline entry directly so the
+    # synthetic receiver doesn't race the assertion
+    c = Collector(cfg)
+    with pytest.raises(MockDestinationError):
+        c.graph.pipeline_entries["traces/in"].consume(
+            synthesize_traces(1, seed=0))
+    assert c.component("mockdestination").rejected_batches == 1
+
+
+def test_topological_flush_across_connector():
+    # downstream pipeline declared BEFORE upstream; both have batch processors
+    # with long timeouts. drain/shutdown must flush upstream-first so no spans
+    # are stranded in the downstream batcher (code-review regression).
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 4, "n_batches": 3}},
+        "processors": {"batch": {"send_batch_size": 100000, "timeout_s": 3600}},
+        "connectors": {"forward/a": {}},
+        "exporters": {"debug": {"keep": True}},
+        "service": {"pipelines": {
+            # note: downstream first in declaration order
+            "traces/down": {"receivers": ["forward/a"],
+                            "processors": ["batch"],
+                            "exporters": ["debug"]},
+            "traces/in": {"receivers": ["synthetic"],
+                          "processors": ["batch"],
+                          "exporters": ["forward/a"]},
+        }},
+    }
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        expected = sum(len(synthesize_traces(4, seed=s)) for s in range(3))
+        assert c.component("debug").span_count == expected
+
+
+def test_receiver_survives_downstream_rejection():
+    # first batches rejected by a full-rejecting mock; receiver thread must
+    # keep running and count refusals instead of dying (code-review regression).
+    meter.reset()
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 1, "n_batches": 3}},
+        "exporters": {"mockdestination": {"reject_fraction": 1.0}},
+        "service": {"pipelines": {
+            "traces/in": {"receivers": ["synthetic"],
+                          "exporters": ["mockdestination"]},
+        }},
+    }
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        refused = meter.counter(
+            "odigos_receiver_refused_batches_total{receiver=synthetic}")
+        assert refused == 3
+
+
+def test_resource_intern_type_fidelity():
+    from odigos_tpu.pdata import SpanBatchBuilder
+    b = SpanBatchBuilder()
+    i1 = b.add_resource({"port": 80})
+    i2 = b.add_resource({"port": "80"})
+    assert i1 != i2
