@@ -1,0 +1,19 @@
+from .featurizer import (
+    FeaturizerConfig,
+    SpanFeatures,
+    TraceSequences,
+    featurize,
+    assemble_sequences,
+    CAT_FIELDS,
+    CONT_FIELDS,
+)
+
+__all__ = [
+    "FeaturizerConfig",
+    "SpanFeatures",
+    "TraceSequences",
+    "featurize",
+    "assemble_sequences",
+    "CAT_FIELDS",
+    "CONT_FIELDS",
+]

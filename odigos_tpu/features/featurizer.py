@@ -1,0 +1,236 @@
+"""Span featurization: SpanBatch → fixed-width tensors.
+
+The north star (BASELINE.json) calls for featurizing spans as "(service,
+span-kind, duration, hashed attrs, parent edge)" before TPU scoring. The hot
+path is pure columnar:
+
+* string-valued categoricals (service, span name) are hashed **once per
+  string-table entry** (tables are tiny) and gathered through the index
+  columns — zero per-span Python;
+* the parent edge (parent span's service) is resolved with a vectorized
+  searchsorted join on span ids;
+* attribute hashing (the only per-span Python work, since attrs live in
+  side dicts) is opt-in via ``attr_slots > 0`` and cached per distinct dict
+  content; the throughput path runs with ``attr_slots=0``. The C++ native
+  decoder (odigos_tpu/native) hashes attrs at decode time instead.
+
+Hashes are stable across processes (blake2b), so vocab ids are reproducible
+between training and serving — the property the reference gets from its
+YAML-pinned registries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Optional
+
+import numpy as np
+
+from ..pdata.spans import SpanBatch
+
+# categorical feature columns, in order
+CAT_FIELDS = ("service", "name", "kind", "status", "parent_service")
+# continuous feature columns, in order
+CONT_FIELDS = ("log_duration_us", "is_root", "depth_hint")
+
+
+@dataclass(frozen=True)
+class FeaturizerConfig:
+    service_vocab: int = 512
+    name_vocab: int = 2048
+    attr_vocab: int = 4096
+    # 0 = skip attr hashing (pure columnar hot path). In every vocab, id 0 is
+    # reserved for "unknown/missing".
+    attr_slots: int = 0
+
+
+@dataclass(frozen=True)
+class SpanFeatures:
+    """Fixed-width features for one batch of spans.
+
+    categorical: (n, C) int32 — C = len(CAT_FIELDS) + attr_slots
+    continuous:  (n, D) float32 — D = len(CONT_FIELDS)
+    """
+
+    categorical: np.ndarray
+    continuous: np.ndarray
+
+    def __len__(self) -> int:
+        return int(self.categorical.shape[0])
+
+
+@lru_cache(maxsize=65536)
+def _stable_hash(s: str) -> int:
+    return int.from_bytes(hashlib.blake2b(s.encode(), digest_size=8).digest(),
+                          "little")
+
+
+def _hash_table(strings: tuple[str, ...], vocab: int) -> np.ndarray:
+    """Hash every string-table entry into [1, vocab) (0 = unknown)."""
+    out = np.empty(max(len(strings), 1), dtype=np.int32)
+    for i, s in enumerate(strings):
+        out[i] = 1 + _stable_hash(s) % (vocab - 1)
+    return out
+
+
+@lru_cache(maxsize=65536)
+def _attr_slot_hashes(items: tuple, slots: int, vocab: int) -> tuple[int, ...]:
+    vals = [0] * slots
+    for k, v in items:
+        h = _stable_hash(f"{k}\x1f{v}")
+        slot = h % slots
+        vals[slot] = 1 + (h >> 8) % (vocab - 1)
+    return tuple(vals)
+
+
+def featurize(batch: SpanBatch,
+              config: Optional[FeaturizerConfig] = None) -> SpanFeatures:
+    config = config or FeaturizerConfig()
+    n = len(batch)
+    if n == 0:
+        c_width = len(CAT_FIELDS) + config.attr_slots
+        return SpanFeatures(np.zeros((0, c_width), np.int32),
+                            np.zeros((0, len(CONT_FIELDS)), np.float32))
+
+    service_h = _hash_table(batch.strings, config.service_vocab)
+    name_h = _hash_table(batch.strings, config.name_vocab)
+
+    svc_col = batch.col("service")
+    service_ids = service_h[svc_col]
+    name_ids = name_h[batch.col("name")]
+    kind = batch.col("kind").astype(np.int32)
+    status = batch.col("status_code").astype(np.int32)
+
+    # parent edge: vectorized self-join span_id -> service id
+    span_ids = batch.col("span_id")
+    parent_ids = batch.col("parent_span_id")
+    order = np.argsort(span_ids, kind="stable")
+    sorted_ids = span_ids[order]
+    pos = np.searchsorted(sorted_ids, parent_ids)
+    pos = np.clip(pos, 0, n - 1)
+    found = sorted_ids[pos] == parent_ids
+    parent_rows = order[pos]
+    parent_service = np.where(found, service_ids[parent_rows], 0).astype(np.int32)
+
+    cols = [service_ids, name_ids, kind, status, parent_service]
+
+    if config.attr_slots:
+        slots = np.empty((n, config.attr_slots), dtype=np.int32)
+        for i, attrs in enumerate(batch.span_attrs):
+            if attrs:
+                key = tuple(sorted((k, str(v)) for k, v in attrs.items()))
+                slots[i] = _attr_slot_hashes(key, config.attr_slots,
+                                             config.attr_vocab)
+            else:
+                slots[i] = 0
+        categorical = np.column_stack(cols + [slots])
+    else:
+        categorical = np.column_stack(cols)
+
+    dur_us = batch.duration_ns.astype(np.float64) / 1_000.0
+    log_dur = np.log1p(dur_us).astype(np.float32)
+    is_root = (parent_ids == 0).astype(np.float32)
+    # depth hint: children of found parents get parent depth unknown here;
+    # cheap proxy = 0 for roots, 1 for spans with in-batch parent, 0.5 orphan
+    depth_hint = np.where(parent_ids == 0, 0.0,
+                          np.where(found, 1.0, 0.5)).astype(np.float32)
+    continuous = np.column_stack([log_dur, is_root, depth_hint])
+
+    return SpanFeatures(categorical.astype(np.int32, copy=False),
+                        continuous.astype(np.float32, copy=False))
+
+
+@dataclass(frozen=True)
+class TraceSequences:
+    """Traces assembled as padded span sequences (for sequence models).
+
+    categorical: (T, L, C) int32 (0-padded)
+    continuous:  (T, L, D) float32 (0-padded)
+    mask:        (T, L) bool — True at real spans
+    span_index:  (T, L) int32 — row in the source batch, -1 at padding
+                 (used to scatter per-span scores back onto the batch)
+    n_truncated: spans dropped because a trace exceeded max_len
+    """
+
+    categorical: np.ndarray
+    continuous: np.ndarray
+    mask: np.ndarray
+    span_index: np.ndarray
+    n_truncated: int
+
+    @property
+    def n_traces(self) -> int:
+        return int(self.mask.shape[0])
+
+
+def assemble_sequences(batch: SpanBatch,
+                       features: Optional[SpanFeatures] = None,
+                       *,
+                       max_len: int = 64,
+                       config: Optional[FeaturizerConfig] = None,
+                       pad_traces_to: Optional[int] = None) -> TraceSequences:
+    """Group spans by trace, order by start time, pad/truncate to ``max_len``.
+
+    Fully vectorized: unique trace keys → per-span position via sorted
+    cumcount → scatter into (T, L) tensors. ``pad_traces_to`` rounds T up
+    (bucketed shapes keep XLA recompilation bounded — the static-shape
+    discipline from SURVEY.md's XLA notes).
+    """
+    features = features if features is not None else featurize(batch, config)
+    n = len(batch)
+    if n == 0:
+        C = features.categorical.shape[1] if features.categorical.ndim == 2 else len(CAT_FIELDS)
+        D = features.continuous.shape[1] if features.continuous.ndim == 2 else len(CONT_FIELDS)
+        T = pad_traces_to or 0
+        return TraceSequences(
+            np.zeros((T, max_len, C), np.int32),
+            np.zeros((T, max_len, D), np.float32),
+            np.zeros((T, max_len), bool),
+            np.full((T, max_len), -1, np.int32), 0)
+
+    hi = batch.col("trace_id_hi")
+    lo = batch.col("trace_id_lo")
+    # structured dtype keeps (hi, lo) exact — no xor-collision risk
+    composite = np.empty(n, dtype=[("hi", np.uint64), ("lo", np.uint64)])
+    composite["hi"], composite["lo"] = hi, lo
+    uniq, inverse = np.unique(composite, return_inverse=True)
+    T_real = len(uniq)
+
+    start = batch.col("start_unix_nano")
+    order = np.lexsort((start, inverse))  # trace-major, time-minor
+    inv_sorted = inverse[order]
+    # position of each span within its trace (cumcount over sorted runs)
+    first_of_run = np.empty(n, dtype=bool)
+    first_of_run[0] = True
+    first_of_run[1:] = inv_sorted[1:] != inv_sorted[:-1]
+    run_starts = np.nonzero(first_of_run)[0]
+    pos_in_trace = np.arange(n) - np.repeat(run_starts, np.diff(
+        np.append(run_starts, n)))
+
+    keep = pos_in_trace < max_len
+    n_truncated = int(n - keep.sum())
+    rows = order[keep]
+    t_idx = inv_sorted[keep]
+    l_idx = pos_in_trace[keep]
+
+    if pad_traces_to:
+        # bucket: round up to the next multiple so distinct trace counts map
+        # to a bounded set of XLA shapes
+        T = ((T_real + pad_traces_to - 1) // pad_traces_to) * pad_traces_to
+    else:
+        T = T_real
+    C = features.categorical.shape[1]
+    D = features.continuous.shape[1]
+    cat = np.zeros((T, max_len, C), np.int32)
+    cont = np.zeros((T, max_len, D), np.float32)
+    mask = np.zeros((T, max_len), bool)
+    span_index = np.full((T, max_len), -1, np.int32)
+
+    cat[t_idx, l_idx] = features.categorical[rows]
+    cont[t_idx, l_idx] = features.continuous[rows]
+    mask[t_idx, l_idx] = True
+    span_index[t_idx, l_idx] = rows.astype(np.int32)
+
+    return TraceSequences(cat, cont, mask, span_index, n_truncated)

@@ -1,0 +1,27 @@
+"""JAX anomaly models (the TPU scoring stage of the north star).
+
+Three models, matching BASELINE.json configs #3–#5:
+
+* ``zscore``      — per-(service, operation) latency z-score detector; a pure
+                    jitted kernel with Welford-style streaming state.
+* ``autoencoder`` — span-sequence autoencoder over trace trees; anomaly =
+                    reconstruction error.
+* ``transformer`` — DeepTraLog-style trace transformer classifier (flagship);
+                    per-span and per-trace anomaly logits.
+
+All models expose:  ``init(rng) -> variables``, a jittable scoring function,
+and (for the learned ones) a jittable train step. Scores are calibrated so
+"bigger = more anomalous" and thresholded by the tpuanomaly processor.
+"""
+
+from .zscore import ZScoreDetector, ZScoreState
+from .autoencoder import SpanAutoencoder
+from .transformer import TraceTransformer, TransformerConfig
+
+__all__ = [
+    "ZScoreDetector",
+    "ZScoreState",
+    "SpanAutoencoder",
+    "TraceTransformer",
+    "TransformerConfig",
+]

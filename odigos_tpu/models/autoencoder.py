@@ -1,0 +1,125 @@
+"""Span-sequence autoencoder (BASELINE config #4).
+
+Unsupervised trace model with a **trace-level bottleneck**: a small
+transformer encodes the span sequence, the masked mean-pool is projected to a
+single latent vector per trace, and per-position decoder heads reconstruct
+each span's (service, name, kind, log-duration) from *only* the latent plus a
+positional embedding. Because no per-span skip path exists, the model cannot
+learn the identity map — reconstruction quality is bounded by what the trace
+latent can encode, so spans that don't fit the trace's learned structure
+(wrong service at a position, off-distribution latency) reconstruct poorly
+and score high. Trained on normal traffic only — no fault labels needed (the
+production-realistic regime; the transformer classifier is the supervised
+counterpart).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .layers import Encoder
+
+
+@dataclass(frozen=True)
+class AutoencoderConfig:
+    service_vocab: int = 512
+    name_vocab: int = 2048
+    attr_vocab: int = 4096
+    attr_slots: int = 0  # must match FeaturizerConfig.attr_slots
+    d_model: int = 128
+    d_latent: int = 64   # trace bottleneck width (the anti-identity-map lever)
+    n_heads: int = 2
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 64
+    dtype: Any = jnp.bfloat16
+    # reconstruction-loss weights: service CE, name CE, kind CE, duration MSE
+    w_service: float = 1.0
+    w_name: float = 1.0
+    w_kind: float = 0.5
+    w_duration: float = 1.0
+
+
+class _AutoencoderModule(nn.Module):
+    cfg: AutoencoderConfig
+
+    @nn.compact
+    def __call__(self, categorical, continuous, mask, deterministic=True):
+        c = self.cfg
+        h = Encoder(c.service_vocab, c.name_vocab, c.attr_vocab, c.d_model,
+                    c.n_heads, c.n_layers, c.d_ff, c.max_len, c.dtype,
+                    name="encoder")(categorical, continuous, mask,
+                                    deterministic)
+        # bottleneck: one latent per trace — no per-span skip path survives
+        denom = jnp.maximum(mask.sum(-1, keepdims=True), 1).astype(h.dtype)
+        pooled = (h * mask[..., None].astype(h.dtype)).sum(-2) / denom
+        z = nn.Dense(c.d_latent, dtype=self.cfg.dtype, name="bottleneck")(pooled)
+        # decode each position from latent + position only
+        L = categorical.shape[-2]
+        pos = nn.Embed(c.max_len, c.d_model, dtype=c.dtype,
+                       name="dec_pos_embed")(jnp.arange(L))
+        d = nn.Dense(c.d_model, dtype=c.dtype, name="latent_proj")(z)
+        dec = d[..., None, :] + pos
+        dec = nn.Dense(c.d_ff, dtype=c.dtype, name="dec_ff1")(dec)
+        dec = nn.gelu(dec)
+        dec = nn.Dense(c.d_model, dtype=c.dtype, name="dec_ff2")(dec)
+        dec = nn.LayerNorm(dtype=c.dtype, name="dec_ln")(dec)
+        return {
+            "service": nn.Dense(c.service_vocab, dtype=jnp.float32,
+                                name="service_head")(dec),
+            "name": nn.Dense(c.name_vocab, dtype=jnp.float32,
+                             name="name_head")(dec),
+            "kind": nn.Dense(8, dtype=jnp.float32, name="kind_head")(dec),
+            "duration": nn.Dense(1, dtype=jnp.float32,
+                                 name="duration_head")(dec)[..., 0],
+        }
+
+
+def _ce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+class SpanAutoencoder:
+    def __init__(self, config: AutoencoderConfig | None = None):
+        self.cfg = config or AutoencoderConfig()
+        self.module = _AutoencoderModule(self.cfg)
+
+    def init(self, rng: jax.Array):
+        from ..features.featurizer import CAT_FIELDS, CONT_FIELDS
+        c = self.cfg
+        width = len(CAT_FIELDS) + c.attr_slots
+        cat = jnp.zeros((1, c.max_len, width), jnp.int32)
+        cont = jnp.zeros((1, c.max_len, len(CONT_FIELDS)), jnp.float32)
+        mask = jnp.ones((1, c.max_len), bool)
+        return self.module.init(rng, cat, cont, mask)
+
+    def _errors(self, variables, categorical, continuous, mask):
+        """(T, L) weighted reconstruction error per span."""
+        c = self.cfg
+        out = self.module.apply(variables, categorical, continuous, mask)
+        err = c.w_service * _ce(out["service"], categorical[..., 0])
+        err += c.w_name * _ce(out["name"], categorical[..., 1])
+        err += c.w_kind * _ce(out["kind"], categorical[..., 2])
+        err += c.w_duration * (out["duration"] - continuous[..., 0]) ** 2
+        return err * mask.astype(jnp.float32)
+
+    @partial(jax.jit, static_argnums=0)
+    def score_spans(self, variables, categorical, continuous, mask):
+        """(T, L) anomaly scores (recon error), (T,) per-trace mean error."""
+        err = self._errors(variables, categorical, continuous, mask)
+        denom = jnp.maximum(mask.sum(-1), 1.0)
+        return err, err.sum(-1) / denom
+
+    def loss_fn(self, variables, categorical, continuous, mask,
+                span_labels=None, trace_labels=None, rngs=None):
+        """Mean masked reconstruction error (labels ignored: unsupervised)."""
+        err = self._errors(variables, categorical, continuous, mask)
+        m = mask.astype(jnp.float32)
+        return err.sum() / jnp.maximum(m.sum(), 1.0)

@@ -1,0 +1,111 @@
+"""Trace transformer classifier — the flagship model (BASELINE config #5).
+
+DeepTraLog-style: a bidirectional transformer over the span sequence of one
+trace, emitting a per-span anomaly logit and a per-trace logit (masked
+mean-pool head). Trained supervised on injected-fault traces
+(odigos_tpu.train.faults), served by the scoring engine at ≥1M spans/s/chip
+in bfloat16, data-parallel across the mesh (odigos_tpu.parallel).
+
+Default dims are MXU-shaped: d_model 256, d_ff 1024, heads 4 — all multiples
+of the 128-lane tile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .layers import Encoder
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    service_vocab: int = 512
+    name_vocab: int = 2048
+    attr_vocab: int = 4096
+    attr_slots: int = 0  # must match FeaturizerConfig.attr_slots
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 1024
+    max_len: int = 64
+    dtype: Any = jnp.bfloat16
+
+
+class _TraceTransformerModule(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, categorical, continuous, mask, deterministic=True):
+        c = self.cfg
+        h = Encoder(c.service_vocab, c.name_vocab, c.attr_vocab, c.d_model,
+                    c.n_heads, c.n_layers, c.d_ff, c.max_len, c.dtype,
+                    name="encoder")(categorical, continuous, mask,
+                                    deterministic)
+        span_logit = nn.Dense(1, dtype=jnp.float32,
+                              name="span_head")(h)[..., 0]
+        denom = jnp.maximum(mask.sum(-1, keepdims=True), 1)
+        pooled = (h * mask[..., None].astype(h.dtype)).sum(-2) / denom.astype(h.dtype)
+        trace_logit = nn.Dense(1, dtype=jnp.float32,
+                               name="trace_head")(pooled)[..., 0]
+        return span_logit, trace_logit
+
+
+class TraceTransformer:
+    """Functional wrapper: init / apply / score / loss, all jit-friendly.
+
+    The scoring entrypoint ``score_spans`` is what __graft_entry__.entry()
+    exposes to the driver.
+    """
+
+    def __init__(self, config: TransformerConfig | None = None):
+        self.cfg = config or TransformerConfig()
+        self.module = _TraceTransformerModule(self.cfg)
+
+    def init(self, rng: jax.Array, sample_cat=None, sample_cont=None,
+             sample_mask=None):
+        c = self.cfg
+        if sample_cat is None:
+            from ..features.featurizer import CAT_FIELDS, CONT_FIELDS
+            width = len(CAT_FIELDS) + c.attr_slots
+            sample_cat = jnp.zeros((1, c.max_len, width), jnp.int32)
+            sample_cont = jnp.zeros((1, c.max_len, len(CONT_FIELDS)),
+                                    jnp.float32)
+            sample_mask = jnp.ones((1, c.max_len), bool)
+        return self.module.init(rng, sample_cat, sample_cont, sample_mask)
+
+    def apply(self, variables, categorical, continuous, mask,
+              deterministic=True):
+        return self.module.apply(variables, categorical, continuous, mask,
+                                 deterministic)
+
+    @partial(jax.jit, static_argnums=0)
+    def score_spans(self, variables, categorical, continuous, mask):
+        """(T, L) per-span anomaly probability + (T,) per-trace probability."""
+        span_logit, trace_logit = self.apply(
+            variables, categorical, continuous, mask)
+        return jax.nn.sigmoid(span_logit), jax.nn.sigmoid(trace_logit)
+
+    def loss_fn(self, variables, categorical, continuous, mask,
+                span_labels, trace_labels, rngs=None):
+        """Masked BCE on spans + BCE on traces (equal weight)."""
+        span_logit, trace_logit = self.module.apply(
+            variables, categorical, continuous, mask, deterministic=rngs is None,
+            rngs=rngs)
+        span_bce = optax_sigmoid_bce(span_logit, span_labels)
+        m = mask.astype(jnp.float32)
+        span_loss = (span_bce * m).sum() / jnp.maximum(m.sum(), 1.0)
+        trace_loss = optax_sigmoid_bce(trace_logit, trace_labels).mean()
+        return span_loss + trace_loss
+
+
+def optax_sigmoid_bce(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Numerically-stable sigmoid binary cross-entropy."""
+    labels = labels.astype(jnp.float32)
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
