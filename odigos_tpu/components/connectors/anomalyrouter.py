@@ -1,0 +1,77 @@
+"""anomalyrouter connector — routes tagged spans to dedicated pipelines.
+
+Companion of the tpuanomaly processor (north-star BASELINE.json): the shape of
+odigosrouterconnector (connector.go:175 ConsumeTraces) but keyed on the
+anomaly flag attribute instead of source identity.
+
+Modes:
+* ``span``  — anomalous spans go to anomaly pipelines, the rest to default.
+* ``trace`` — if any span of a trace is flagged, the whole trace goes to the
+  anomaly pipelines (the analog of whole-trace tail-sampling decisions, which
+  the reference guarantees via loadbalancing consistent routing; SURVEY.md
+  §5.7). Context stays intact for the investigating human.
+
+``mirror: true`` additionally keeps sending everything to the default
+pipelines (anomaly destinations become a copy, not a split).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch
+from ..api import ComponentKind, Connector, Factory, register
+from ..processors.tpuanomaly import FLAG_ATTR
+
+
+class AnomalyRouterConnector(Connector):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.anomaly_pipelines = list(config.get("anomaly_pipelines", []))
+        self.default_pipelines = list(config.get("default_pipelines", []))
+        self.mode = config.get("mode", "trace")
+        if self.mode not in ("span", "trace"):
+            raise ValueError(f"{name}: mode must be 'span' or 'trace'")
+        self.mirror = bool(config.get("mirror", False))
+        self.flag_attr = config.get("flag_attr", FLAG_ATTR)
+
+    def consume(self, batch: SpanBatch) -> None:
+        flag = self.flag_attr
+        flagged = np.fromiter((flag in a for a in batch.span_attrs),
+                              bool, len(batch))
+        if self.mode == "trace" and flagged.any():
+            # expand to whole traces: flag every span sharing a trace id with
+            # a flagged span (vectorized via structured trace-key match)
+            hi = batch.col("trace_id_hi")
+            lo = batch.col("trace_id_lo")
+            keys = np.empty(len(batch),
+                            dtype=[("hi", np.uint64), ("lo", np.uint64)])
+            keys["hi"], keys["lo"] = hi, lo
+            flagged = np.isin(keys, np.unique(keys[flagged]))
+
+        anomalous = batch.filter(flagged) if not flagged.all() else batch
+        normal = batch.filter(~flagged) if flagged.any() else batch
+
+        if flagged.any():
+            for p in self.anomaly_pipelines:
+                consumer = self.outputs.get(p)
+                if consumer is not None:
+                    consumer.consume(anomalous)
+        rest = batch if self.mirror else normal
+        if len(rest):
+            for p in self.default_pipelines:
+                consumer = self.outputs.get(p)
+                if consumer is not None:
+                    consumer.consume(rest)
+
+
+register(Factory(
+    type_name="anomalyrouter",
+    kind=ComponentKind.CONNECTOR,
+    create=AnomalyRouterConnector,
+    default_config=lambda: {
+        "anomaly_pipelines": [], "default_pipelines": [],
+        "mode": "trace", "mirror": False},
+))

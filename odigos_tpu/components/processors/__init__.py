@@ -1,1 +1,1 @@
-from . import batch, memory_limiter, attributes, traffic_metrics  # noqa: F401
+from . import batch, memory_limiter, attributes, traffic_metrics, tpuanomaly  # noqa: F401

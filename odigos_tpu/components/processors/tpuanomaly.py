@@ -1,0 +1,114 @@
+"""tpuanomaly processor — the north-star component.
+
+The TPU-backed anomaly stage behind the stock processor Factory boundary
+(modeled on odigossamplingprocessor/factory.go:13's WithTraces registration):
+featurizes incoming span batches, scores them against the ScoringEngine
+within a strict latency budget, and tags anomalous spans with score/flag
+attributes for the anomalyrouter to route. On timeout or queue-full the batch
+passes through unscored — the pipeline never blocks on the TPU (north-star
+<5 ms p99 requirement).
+
+Non-TPU installs simply never put ``tpuanomaly`` in a pipeline; nothing else
+changes (byte-identical requirement).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ...features.featurizer import FeaturizerConfig, featurize
+from ...pdata.spans import SpanBatch
+from ...serving.engine import EngineConfig, ScoringEngine
+from ...utils.telemetry import meter
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+SCORE_ATTR = "odigos.anomaly.score"
+FLAG_ATTR = "odigos.anomaly"
+FLAGGED_METRIC = "odigos_anomaly_flagged_spans_total"
+
+# engines shared across processor instances (one TPU sidecar per collector,
+# like the reference's one gateway-adjacent model server), keyed by config
+_shared_engines: dict[tuple, ScoringEngine] = {}
+_shared_lock = threading.Lock()
+
+
+def _engine_for(cfg: EngineConfig, shared: bool) -> ScoringEngine:
+    if not shared:
+        return ScoringEngine(cfg)
+    key = (cfg.model, cfg.max_len, cfg.trace_bucket, cfg.featurizer,
+           cfg.checkpoint_path, cfg.seed)
+    with _shared_lock:
+        eng = _shared_engines.get(key)
+        if eng is None:
+            eng = _shared_engines[key] = ScoringEngine(cfg)
+        return eng
+
+
+class TpuAnomalyProcessor(Processor):
+    """Config:
+    model: zscore | transformer | autoencoder | mock
+    threshold: score in [0,1] above which a span is tagged (default 0.8)
+    timeout_ms: scoring latency budget before pass-through (default 5.0)
+    attr_slots / max_len / trace_bucket / online_update / checkpoint_path:
+        forwarded to EngineConfig
+    shared_engine: reuse one engine across processor instances (default True)
+    """
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        fz = FeaturizerConfig(attr_slots=int(config.get("attr_slots", 0)))
+        self.engine_cfg = EngineConfig(
+            model=config.get("model", "zscore"),
+            max_len=int(config.get("max_len", 64)),
+            trace_bucket=int(config.get("trace_bucket", 256)),
+            online_update=bool(config.get("online_update", True)),
+            featurizer=fz,
+            checkpoint_path=config.get("checkpoint_path"),
+            seed=int(config.get("seed", 0)),
+        )
+        self.engine = _engine_for(self.engine_cfg,
+                                  bool(config.get("shared_engine", True)))
+        self.threshold = float(config.get("threshold", 0.8))
+        self.timeout_s = float(config.get("timeout_ms", 5.0)) / 1000.0
+
+    def start(self) -> None:
+        super().start()
+        self.engine.start()
+
+    def shutdown(self) -> None:
+        # shared engines outlive individual processors; private ones stop
+        if not self.config.get("shared_engine", True):
+            self.engine.shutdown()
+        super().shutdown()
+
+    def process(self, batch: SpanBatch) -> Optional[SpanBatch]:
+        features = featurize(batch, self.engine_cfg.featurizer)
+        scores = self.engine.score_sync(batch, features,
+                                        timeout_s=self.timeout_s)
+        if scores is None:  # timeout / queue full: pass through untagged
+            return batch
+        mask = scores >= self.threshold
+        n_flagged = int(mask.sum())
+        if n_flagged == 0:
+            return batch
+        meter.add(FLAGGED_METRIC, n_flagged)
+        return batch.with_span_attrs({
+            SCORE_ATTR: np.round(scores[mask], 4).tolist(),
+            FLAG_ATTR: [True] * n_flagged,
+        }, mask)
+
+
+register(Factory(
+    type_name="tpuanomaly",
+    kind=ComponentKind.PROCESSOR,
+    create=TpuAnomalyProcessor,
+    default_config=lambda: {
+        "model": "zscore", "threshold": 0.8, "timeout_ms": 5.0,
+        "attr_slots": 0, "max_len": 64, "trace_bucket": 256,
+        "online_update": True, "shared_engine": True},
+))

@@ -1,5 +1,7 @@
 from .featurizer import (
     FeaturizerConfig,
+    PackedSequences,
+    pack_sequences,
     SpanFeatures,
     TraceSequences,
     featurize,
@@ -10,6 +12,8 @@ from .featurizer import (
 
 __all__ = [
     "FeaturizerConfig",
+    "PackedSequences",
+    "pack_sequences",
     "SpanFeatures",
     "TraceSequences",
     "featurize",

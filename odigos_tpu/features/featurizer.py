@@ -234,3 +234,115 @@ def assemble_sequences(batch: SpanBatch,
     span_index[t_idx, l_idx] = rows.astype(np.int32)
 
     return TraceSequences(cat, cont, mask, span_index, n_truncated)
+
+
+@dataclass(frozen=True)
+class PackedSequences:
+    """Traces packed multiple-per-row (high MXU density, no truncation).
+
+    Rows of length ``max_len`` are filled greedily with whole traces; traces
+    longer than ``max_len`` are split into chunks (attention then only spans
+    the chunk — acceptable for scoring, chunks are rare at sane max_len).
+    Attention must be block-diagonal per segment: ``segments`` holds a
+    row-local segment id (0 = padding, 1..k = trace chunk), ``positions`` the
+    within-trace span position (feeds positional embedding).
+
+    categorical: (R, L, C) int32   continuous: (R, L, D) float32
+    segments:    (R, L) int32      positions:  (R, L) int32
+    span_index:  (R, L) int32 — row in source batch, -1 at padding
+    """
+
+    categorical: np.ndarray
+    continuous: np.ndarray
+    segments: np.ndarray
+    positions: np.ndarray
+    span_index: np.ndarray
+
+    @property
+    def mask(self) -> np.ndarray:
+        return self.segments > 0
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.segments.shape[0])
+
+    def density(self) -> float:
+        m = self.mask
+        return float(m.sum()) / max(m.size, 1)
+
+
+def pack_sequences(batch: SpanBatch,
+                   features: Optional[SpanFeatures] = None,
+                   *,
+                   max_len: int = 64,
+                   config: Optional[FeaturizerConfig] = None,
+                   pad_rows_to: Optional[int] = None) -> PackedSequences:
+    """Pack whole traces (time-ordered) into rows, first-fit in arrival order.
+
+    Host-side cost is one lexsort + one pass over traces (not spans).
+    """
+    features = features if features is not None else featurize(batch, config)
+    n = len(batch)
+    # featurize() returns correctly-shaped (0, C) arrays even when empty
+    C = features.categorical.shape[1]
+    D = features.continuous.shape[1]
+    if n == 0:
+        R = pad_rows_to or 0
+        return PackedSequences(
+            np.zeros((R, max_len, C), np.int32),
+            np.zeros((R, max_len, D), np.float32),
+            np.zeros((R, max_len), np.int32),
+            np.zeros((R, max_len), np.int32),
+            np.full((R, max_len), -1, np.int32))
+
+    composite = np.empty(n, dtype=[("hi", np.uint64), ("lo", np.uint64)])
+    composite["hi"] = batch.col("trace_id_hi")
+    composite["lo"] = batch.col("trace_id_lo")
+    _, inverse = np.unique(composite, return_inverse=True)
+    order = np.lexsort((batch.col("start_unix_nano"), inverse))
+    inv_sorted = inverse[order]
+    boundaries = np.nonzero(np.diff(inv_sorted))[0] + 1
+    trace_slices = np.split(order, boundaries)  # list of row-index arrays
+
+    rows: list[list[np.ndarray]] = []   # per row: list of chunk arrays
+    row_fill: list[int] = []
+    for rows_of_trace in trace_slices:
+        # split over-long traces into max_len chunks
+        for lo in range(0, len(rows_of_trace), max_len):
+            chunk = rows_of_trace[lo:lo + max_len]
+            placed = False
+            # first-fit over the last few open rows (bounded lookback keeps
+            # packing O(traces))
+            for ri in range(len(rows) - 1, max(len(rows) - 8, -1), -1):
+                if row_fill[ri] + len(chunk) <= max_len:
+                    rows[ri].append(chunk)
+                    row_fill[ri] += len(chunk)
+                    placed = True
+                    break
+            if not placed:
+                rows.append([chunk])
+                row_fill.append(len(chunk))
+
+    R_real = len(rows)
+    if pad_rows_to:
+        R = ((R_real + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
+    else:
+        R = R_real
+    cat = np.zeros((R, max_len, C), np.int32)
+    cont = np.zeros((R, max_len, D), np.float32)
+    segments = np.zeros((R, max_len), np.int32)
+    positions = np.zeros((R, max_len), np.int32)
+    span_index = np.full((R, max_len), -1, np.int32)
+
+    for ri, chunks in enumerate(rows):
+        off = 0
+        for si, chunk in enumerate(chunks):
+            k = len(chunk)
+            sl = slice(off, off + k)
+            cat[ri, sl] = features.categorical[chunk]
+            cont[ri, sl] = features.continuous[chunk]
+            segments[ri, sl] = si + 1
+            positions[ri, sl] = np.arange(k)
+            span_index[ri, sl] = chunk.astype(np.int32)
+            off += k
+    return PackedSequences(cat, cont, segments, positions, span_index)

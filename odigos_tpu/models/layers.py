@@ -65,10 +65,9 @@ class EncoderBlock(nn.Module):
     dropout: float = 0.0
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, mask: jnp.ndarray,
+    def __call__(self, x: jnp.ndarray, attn_mask: jnp.ndarray,
                  deterministic: bool = True) -> jnp.ndarray:
-        # mask: (T, L) bool -> attention bias (T, 1, L, L)
-        attn_mask = mask[:, None, None, :] & mask[:, None, :, None]
+        # attn_mask: (T, 1, L, L) bool, True where attention is allowed
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.MultiHeadDotProductAttention(
             num_heads=self.n_heads, dtype=self.dtype,
@@ -97,17 +96,30 @@ class Encoder(nn.Module):
 
     @nn.compact
     def __call__(self, categorical, continuous, mask,
-                 deterministic: bool = True) -> jnp.ndarray:
+                 deterministic: bool = True,
+                 positions: jnp.ndarray | None = None,
+                 segments: jnp.ndarray | None = None) -> jnp.ndarray:
+        """``segments`` (row-local trace ids, 0 = padding) switches attention
+        to block-diagonal — the packed-sequences path (features.pack_sequences)
+        that keeps MXU density high regardless of trace length distribution.
+        ``positions`` overrides the positional-embedding index (within-trace
+        position for packed rows)."""
         x = SpanEmbedder(self.service_vocab, self.name_vocab, self.attr_vocab,
                          self.d_model, self.dtype, name="embed")(
             categorical, continuous)
         L = categorical.shape[-2]
+        pos_ids = positions if positions is not None else jnp.arange(L)
         pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
-                       name="pos_embed")(jnp.arange(L))
+                       name="pos_embed")(pos_ids)
         x = x + pos
         x = x * mask[..., None].astype(self.dtype)
+        if segments is not None:
+            attn_mask = ((segments[..., None] == segments[..., None, :])
+                         & mask[..., None] & mask[..., None, :])[:, None]
+        else:
+            attn_mask = (mask[:, None, None, :] & mask[:, None, :, None])
         for i in range(self.n_layers):
             x = EncoderBlock(self.d_model, self.n_heads, self.d_ff,
                              self.dtype, name=f"block_{i}")(
-                x, mask, deterministic)
+                x, attn_mask, deterministic)
         return nn.LayerNorm(dtype=self.dtype, name="final_ln")(x)

@@ -41,12 +41,14 @@ class _TraceTransformerModule(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, categorical, continuous, mask, deterministic=True):
+    def __call__(self, categorical, continuous, mask, deterministic=True,
+                 positions=None, segments=None):
         c = self.cfg
         h = Encoder(c.service_vocab, c.name_vocab, c.attr_vocab, c.d_model,
                     c.n_heads, c.n_layers, c.d_ff, c.max_len, c.dtype,
                     name="encoder")(categorical, continuous, mask,
-                                    deterministic)
+                                    deterministic, positions=positions,
+                                    segments=segments)
         span_logit = nn.Dense(1, dtype=jnp.float32,
                               name="span_head")(h)[..., 0]
         denom = jnp.maximum(mask.sum(-1, keepdims=True), 1)
@@ -91,6 +93,18 @@ class TraceTransformer:
             variables, categorical, continuous, mask)
         return jax.nn.sigmoid(span_logit), jax.nn.sigmoid(trace_logit)
 
+    @partial(jax.jit, static_argnums=0)
+    def score_packed(self, variables, categorical, continuous, segments,
+                     positions):
+        """Packed-rows scoring (features.pack_sequences): block-diagonal
+        attention per trace chunk; returns (R, L) span probabilities. The
+        per-row trace head is meaningless under packing and skipped."""
+        mask = segments > 0
+        span_logit, _ = self.module.apply(
+            variables, categorical, continuous, mask,
+            positions=positions, segments=segments)
+        return jax.nn.sigmoid(span_logit)
+
     def loss_fn(self, variables, categorical, continuous, mask,
                 span_labels, trace_labels, rngs=None):
         """Masked BCE on spans + BCE on traces (equal weight)."""
@@ -100,7 +114,11 @@ class TraceTransformer:
         span_bce = optax_sigmoid_bce(span_logit, span_labels)
         m = mask.astype(jnp.float32)
         span_loss = (span_bce * m).sum() / jnp.maximum(m.sum(), 1.0)
-        trace_loss = optax_sigmoid_bce(trace_logit, trace_labels).mean()
+        # all-padding rows (dp padding, trace-count buckets) must not train
+        # the trace head: weight by per-trace validity
+        valid = mask.any(-1).astype(jnp.float32)
+        trace_bce = optax_sigmoid_bce(trace_logit, trace_labels)
+        trace_loss = (trace_bce * valid).sum() / jnp.maximum(valid.sum(), 1.0)
         return span_loss + trace_loss
 
 

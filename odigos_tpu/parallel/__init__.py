@@ -1,0 +1,20 @@
+from .mesh import make_mesh, mesh_axes
+from .sharding import (
+    transformer_param_spec,
+    shard_variables,
+    batch_spec,
+    make_sharded_score_fn,
+    make_sharded_train_step,
+)
+from .ring_attention import ring_attention
+
+__all__ = [
+    "make_mesh",
+    "mesh_axes",
+    "transformer_param_spec",
+    "shard_variables",
+    "batch_spec",
+    "make_sharded_score_fn",
+    "make_sharded_train_step",
+    "ring_attention",
+]

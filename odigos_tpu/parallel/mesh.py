@@ -1,0 +1,57 @@
+"""Device mesh construction.
+
+The reference scales by shared-nothing replica fan-out (DaemonSet node
+collectors + HPA'd gateway replicas, SURVEY.md §2.7); our TPU scoring stage
+scales inside the accelerator domain instead: a `jax.sharding.Mesh` over the
+slice, with XLA collectives riding ICI (BASELINE config #5: data-parallel
+across v5e-8). Axes:
+
+    data  — batch (trace) dimension; pure DP scoring/training
+    model — tensor parallelism (attention heads / ffn shards)
+    seq   — sequence parallelism (ring attention for very long traces)
+
+Multi-host meshes come from jax.distributed + the same axis names over DCN
+(data axis outermost so cross-host traffic is gradient/allreduce only).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DEFAULT_AXES = ("data", "model")
+
+
+def mesh_axes(mesh: Mesh) -> tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def make_mesh(shape: Optional[dict[str, int]] = None,
+              *,
+              n_devices: Optional[int] = None,
+              axes: Sequence[str] = DEFAULT_AXES,
+              devices=None) -> Mesh:
+    """Build a mesh.
+
+    make_mesh()                          -> all devices on the data axis
+    make_mesh({"data": 4, "model": 2})   -> explicit 4x2
+    make_mesh(n_devices=8)               -> 8 devices, all data-parallel
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if shape is None:
+        shape = {axes[0]: n}
+        for a in axes[1:]:
+            shape[a] = 1
+    total = math.prod(shape.values())
+    if total > n:
+        raise ValueError(
+            f"mesh shape {shape} needs {total} devices, have {n}")
+    arr = np.asarray(devices[:total]).reshape(tuple(shape.values()))
+    return Mesh(arr, tuple(shape.keys()))

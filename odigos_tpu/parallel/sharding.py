@@ -1,0 +1,123 @@
+"""Sharding rules + sharded score/train step factories.
+
+Megatron-style layout for the trace transformer (odigos_tpu.models), expressed
+as PartitionSpecs over the mesh from parallel.mesh:
+
+* attention q/k/v kernels (d_model, n_heads, head_dim): heads on "model"
+* attention out kernel (n_heads, head_dim, d_model): heads on "model"
+* mlp up kernel (d_model, d_ff): d_ff on "model"; down kernel transposed
+* embedding tables + layernorms + heads: replicated
+* batch (trace) axis of inputs: "data"
+
+XLA inserts the all-reduces (psum over "model" after attention-out and
+mlp-down) — we only annotate placements, per the scaling-book recipe cited in
+the build brief.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def transformer_param_spec(path: tuple, leaf: Any) -> P:
+    """Map a flax param path (tuple of str keys) to a PartitionSpec."""
+    names = [str(p) for p in path]
+    joined = "/".join(names)
+    ndim = getattr(leaf, "ndim", 0)
+    if "attention" in joined or any(n in ("query", "key", "value", "out")
+                                    for n in names):
+        if any(n in ("query", "key", "value") for n in names) and ndim == 3:
+            return P(None, "model", None)  # (d_model, heads, head_dim)
+        if "out" in names and ndim == 3:
+            return P("model", None, None)  # (heads, head_dim, d_model)
+    # transformer mlp: first Dense grows to d_ff (shard cols), second shrinks
+    if ndim == 2 and names[-1] == "kernel":
+        in_dim, out_dim = leaf.shape
+        if out_dim > in_dim:
+            return P(None, "model")
+        if in_dim > out_dim:
+            return P("model", None)
+    return P()  # replicate embeddings, norms, biases, heads
+
+
+def shard_variables(variables: Any, mesh: Mesh,
+                    spec_fn: Callable[[tuple, Any], P] = transformer_param_spec,
+                    ) -> Any:
+    """Place a variable pytree onto the mesh per spec_fn."""
+    def place(path, leaf):
+        spec = spec_fn(tuple(k.key for k in path), leaf)
+        # axes must divide; fall back to replication when they don't
+        for axis_name, dim in zip(spec, getattr(leaf, "shape", ())):
+            if axis_name is None:
+                continue
+            if dim % mesh.shape[axis_name] != 0:
+                spec = P()
+                break
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, variables)
+
+
+def batch_spec(mesh: Mesh) -> P:
+    return P("data")
+
+
+def _shard_inputs(mesh: Mesh, arrays: tuple) -> tuple:
+    """Place batch-leading arrays on the data axis, padding the leading dim
+    up to a multiple of the data-axis size (mask rows stay False)."""
+    dp = mesh.shape["data"]
+    sharded = []
+    for a in arrays:
+        n = a.shape[0]
+        pad = (-n) % dp
+        if pad:
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            a = np.pad(np.asarray(a), widths)
+        sharded.append(jax.device_put(
+            a, NamedSharding(mesh, P("data", *([None] * (a.ndim - 1))))))
+    return tuple(sharded)
+
+
+def make_sharded_score_fn(model, mesh: Mesh):
+    """Data/tensor-parallel scoring: variables pre-sharded per the rules,
+    inputs split on "data". Returns fn(variables, cat, cont, mask) ->
+    (span_scores, trace_scores) gathered to host-replicated arrays."""
+
+    def score(variables, cat, cont, mask):
+        n = np.asarray(mask).shape[0]
+        cat, cont, mask = _shard_inputs(mesh, (cat, cont, mask))
+        # model.score_spans is jitted; XLA propagates the dp/tp shardings
+        # from argument placements and inserts the collectives
+        span_p, trace_p = model.score_spans(variables, cat, cont, mask)
+        return np.asarray(span_p)[:n], np.asarray(trace_p)[:n]
+
+    return score
+
+
+def make_sharded_train_step(model, tx, mesh: Mesh):
+    """Full sharded train step (used by __graft_entry__.dryrun_multichip and
+    train.loop): grads computed under dp(batch) x tp(params) sharding; optax
+    update applied in the same placement; loss replicated.
+    """
+
+    @jax.jit
+    def step(variables, opt_state, cat, cont, mask, span_labels, trace_labels):
+        loss, grads = jax.value_and_grad(model.loss_fn)(
+            variables, cat, cont, mask, span_labels, trace_labels)
+        updates, opt_state = tx.update(grads, opt_state, params=variables)
+        import optax
+
+        variables = optax.apply_updates(variables, updates)
+        return variables, opt_state, loss
+
+    def run(variables, opt_state, cat, cont, mask, span_labels, trace_labels):
+        cat, cont, mask, span_labels, trace_labels = _shard_inputs(
+            mesh, (cat, cont, mask, span_labels, trace_labels))
+        return step(variables, opt_state, cat, cont, mask, span_labels,
+                    trace_labels)
+
+    return run

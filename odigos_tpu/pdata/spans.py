@@ -164,6 +164,29 @@ class SpanBatch:
             new_attrs[i] = d
         return replace(self, span_attrs=tuple(new_attrs))
 
+    def with_span_attrs(self, updates: dict[str, Sequence[Any]],
+                        mask: np.ndarray) -> "SpanBatch":
+        """Set several attributes on masked spans in one pass (one dict copy
+        per touched span regardless of key count — the anomaly processor's
+        hot-path tagging primitive). Every values list must have one entry
+        per masked span."""
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (len(self),):
+            raise ValueError(f"mask shape {mask.shape} != ({len(self)},)")
+        idxs = np.nonzero(mask)[0]
+        for key, values in updates.items():
+            if len(values) != len(idxs):
+                raise ValueError(
+                    f"values for {key!r} have length {len(values)}, "
+                    f"expected masked count {len(idxs)}")
+        new_attrs = list(self.span_attrs)
+        for j, i in enumerate(idxs):
+            d = dict(new_attrs[i])
+            for key, values in updates.items():
+                d[key] = values[j]
+            new_attrs[i] = d
+        return replace(self, span_attrs=tuple(new_attrs))
+
     def group_key_by_resource(self, attr_keys: Sequence[str]) -> list[tuple]:
         """Per-span grouping key from resource attributes (used by routers).
 

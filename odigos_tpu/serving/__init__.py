@@ -1,0 +1,3 @@
+from .engine import ScoringEngine, EngineConfig, ScoreRequest
+
+__all__ = ["ScoringEngine", "EngineConfig", "ScoreRequest"]

@@ -8,11 +8,19 @@ set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The session env pins JAX to the TPU tunnel ("axon" platform, registered by a
+# sitecustomize that imports jax at interpreter startup). Tests always run on
+# the virtual CPU mesh: XLA_FLAGS must be set before backend init, and the
+# platform override must go through jax.config (env vars were already read).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,184 @@
+"""End-to-end anomaly slice (SURVEY.md §7 minimum slice; BASELINE configs
+#1+#3): synthetic spans → batch → tpuanomaly → anomalyrouter → exporters.
+"""
+
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from odigos_tpu.components.processors.tpuanomaly import (
+    FLAG_ATTR, SCORE_ATTR, TpuAnomalyProcessor)
+from odigos_tpu.pdata import SpanKind, synthesize_traces
+from odigos_tpu.pipeline import Collector
+from odigos_tpu.serving import EngineConfig, ScoringEngine
+from odigos_tpu.utils.telemetry import meter
+
+
+def spike_batch(seed=99, factor=50):
+    """Fresh traffic with one SERVER span's duration multiplied."""
+    batch = synthesize_traces(10, seed=seed)
+    i = int(np.argmax(batch.col("kind") == int(SpanKind.SERVER)))
+    cols = dict(batch.columns)
+    end = cols["end_unix_nano"].copy()
+    end[i] = cols["start_unix_nano"][i] + int(batch.duration_ns[i]) * factor
+    cols["end_unix_nano"] = end
+    return replace(batch, columns=cols), i
+
+
+# ------------------------------------------------------------ engine unit
+def test_engine_scores_and_passthrough():
+    eng = ScoringEngine(EngineConfig(model="mock")).start()
+    try:
+        batch = synthesize_traces(5, seed=0)
+        scores = eng.score_sync(batch, timeout_s=2.0)
+        assert scores is not None and scores.shape == (len(batch),)
+    finally:
+        eng.shutdown()
+    # engine not started -> worker never sets event -> pass-through
+    meter.reset()
+    eng2 = ScoringEngine(EngineConfig(model="mock"))
+    assert eng2.score_sync(synthesize_traces(1, seed=0),
+                           timeout_s=0.01) is None
+    assert meter.counter("odigos_anomaly_passthrough_total") > 0
+
+
+def test_engine_unknown_model():
+    with pytest.raises(ValueError, match="unknown scoring model"):
+        ScoringEngine(EngineConfig(model="nope"))
+
+
+def test_engine_coalesces_requests():
+    meter.reset()
+    eng = ScoringEngine(EngineConfig(model="mock"))
+    b1 = synthesize_traces(3, seed=1)
+    b2 = synthesize_traces(4, seed=2)
+    r1 = eng.submit(b1)
+    r2 = eng.submit(b2)
+    eng.start()
+    assert r1.done.wait(5) and r2.done.wait(5)
+    assert len(r1.scores) == len(b1) and len(r2.scores) == len(b2)
+    eng.shutdown()
+    assert meter.counter("odigos_anomaly_scored_spans_total") == len(b1) + len(b2)
+
+
+def test_engine_queue_full_admission_control():
+    meter.reset()
+    eng = ScoringEngine(EngineConfig(model="mock", max_queue=1))  # not started
+    assert eng.submit(synthesize_traces(1, seed=0)) is not None
+    assert eng.submit(synthesize_traces(1, seed=1)) is None
+    assert meter.counter("odigos_anomaly_queue_full_total") == 1
+
+
+# -------------------------------------------------------------- e2e slice
+def e2e_config(processor_cfg=None, router_cfg=None):
+    return {
+        "receivers": {"synthetic": {"traces_per_batch": 5, "n_batches": 2}},
+        "processors": {
+            "batch": {"send_batch_size": 10000, "timeout_s": 0.05},
+            "tpuanomaly": processor_cfg or {
+                "model": "zscore", "threshold": 0.6, "timeout_ms": 3000,
+                "shared_engine": False},
+        },
+        "connectors": {"anomalyrouter": router_cfg or {
+            "anomaly_pipelines": ["traces/anomaly"],
+            "default_pipelines": ["traces/normal"],
+            "mode": "trace"}},
+        "exporters": {"debug/anomaly": {"keep": True},
+                      "debug/normal": {"keep": True}},
+        "service": {"pipelines": {
+            "traces/in": {"receivers": ["synthetic"],
+                          "processors": ["batch", "tpuanomaly"],
+                          "exporters": ["anomalyrouter"]},
+            "traces/anomaly": {"receivers": ["anomalyrouter"],
+                               "exporters": ["debug/anomaly"]},
+            "traces/normal": {"receivers": ["anomalyrouter"],
+                              "exporters": ["debug/normal"]},
+        }},
+    }
+
+
+def test_e2e_zscore_slice_flags_injected_spike():
+    cfg = e2e_config()
+    with Collector(cfg) as c:
+        proc = c.component("tpuanomaly")
+        assert isinstance(proc, TpuAnomalyProcessor)
+        # warm the detector on plenty of normal traffic (out of band)
+        proc.engine.warmup(synthesize_traces(400, seed=7))
+        c.drain_receivers()
+
+        spiked, i = spike_batch()
+        entry = c.graph.pipeline_entries["traces/in"]
+        entry.consume(spiked)
+        # flush the batch processor so the spiked batch reaches the router
+        c.drain_receivers()
+
+        anomaly = c.component("debug/anomaly")
+        normal = c.component("debug/normal")
+        assert anomaly.span_count > 0
+        spans = anomaly.all_spans()
+        tagged = [d for d in spans if FLAG_ATTR in d["attributes"]]
+        assert tagged, "no tagged spans reached the anomaly pipeline"
+        assert all(d["attributes"][SCORE_ATTR] >= 0.6 for d in tagged)
+        # trace mode: the whole trace of the spiked span arrived
+        spiked_trace = spiked.span_dict(i)["trace_id"]
+        anomaly_traces = {d["trace_id"] for d in spans}
+        assert spiked_trace in anomaly_traces
+        trace_size = sum(1 for d in spiked.iter_spans()
+                         if d["trace_id"] == spiked_trace)
+        got = sum(1 for d in spans if d["trace_id"] == spiked_trace)
+        assert got == trace_size
+        # normal traffic did not leak into the anomaly pipeline wholesale
+        assert normal.span_count > anomaly.span_count
+
+
+def test_e2e_span_mode_and_mirror():
+    cfg = e2e_config(router_cfg={
+        "anomaly_pipelines": ["traces/anomaly"],
+        "default_pipelines": ["traces/normal"],
+        "mode": "span", "mirror": True})
+    with Collector(cfg) as c:
+        proc = c.component("tpuanomaly")
+        proc.engine.warmup(synthesize_traces(400, seed=7))
+        c.drain_receivers()
+        spiked, i = spike_batch()
+        c.graph.pipeline_entries["traces/in"].consume(spiked)
+        c.drain_receivers()
+        anomaly = c.component("debug/anomaly")
+        normal = c.component("debug/normal")
+        # span mode: only tagged spans (not whole traces)
+        assert 0 < anomaly.span_count < 10
+        assert all(FLAG_ATTR in d["attributes"] for d in anomaly.all_spans())
+        # mirror: default pipeline saw everything
+        total = sum(len(synthesize_traces(5, seed=s)) for s in range(2))
+        assert normal.span_count == total + len(spiked)
+
+
+def test_e2e_mock_backend_no_tpu():
+    # mock backend: spans with mock.anomaly attr are always flagged
+    cfg = e2e_config(processor_cfg={
+        "model": "mock", "threshold": 0.9, "timeout_ms": 3000,
+        "shared_engine": False})
+    with Collector(cfg) as c:
+        batch = synthesize_traces(3, seed=1)
+        forced = batch.with_span_attr("mock.anomaly", [1],
+                                      np.arange(len(batch)) == 0)
+        c.graph.pipeline_entries["traces/in"].consume(forced)
+        c.drain_receivers()
+        assert c.component("debug/anomaly").span_count > 0
+
+
+def test_processor_timeout_passes_through():
+    meter.reset()
+    cfg = e2e_config(processor_cfg={
+        "model": "zscore", "threshold": 0.6, "timeout_ms": 0.001,
+        "shared_engine": False})
+    with Collector(cfg) as c:
+        # engine worker alive but budget absurdly small -> pass-through
+        spiked, _ = spike_batch()
+        c.graph.pipeline_entries["traces/in"].consume(spiked)
+        c.drain_receivers()
+        normal = c.component("debug/normal")
+        anomaly = c.component("debug/anomaly")
+        assert anomaly.span_count == 0  # nothing tagged
+        assert normal.span_count >= len(spiked)  # everything flowed through
