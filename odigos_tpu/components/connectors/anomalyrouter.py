@@ -44,11 +44,9 @@ class AnomalyRouterConnector(Connector):
         if self.mode == "trace" and flagged.any():
             # expand to whole traces: flag every span sharing a trace id with
             # a flagged span (vectorized via structured trace-key match)
-            hi = batch.col("trace_id_hi")
-            lo = batch.col("trace_id_lo")
-            keys = np.empty(len(batch),
-                            dtype=[("hi", np.uint64), ("lo", np.uint64)])
-            keys["hi"], keys["lo"] = hi, lo
+            from ...pdata.traces import trace_keys
+
+            keys = trace_keys(batch)
             flagged = np.isin(keys, np.unique(keys[flagged]))
 
         anomalous = batch.filter(flagged) if not flagged.all() else batch

@@ -1,1 +1,3 @@
-from . import batch, memory_limiter, attributes, traffic_metrics, tpuanomaly  # noqa: F401
+from . import (  # noqa: F401
+    batch, memory_limiter, attributes, traffic_metrics, tpuanomaly,
+    groupbytrace, sampling)

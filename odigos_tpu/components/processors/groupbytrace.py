@@ -1,0 +1,137 @@
+"""groupbytrace processor — bounded whole-trace buffering.
+
+The reference requires `groupbytrace` ahead of tail sampling so decisions see
+complete traces (odigossamplingprocessor/README.md "it is mandatory to use the
+groupbytrace processor beforehand"; upstream component listed in
+collector/builder-config.yaml). Spans of one trace arrive spread across many
+incoming batches; this processor holds them until ``wait_duration_s`` has
+elapsed since the trace was FIRST seen, then releases all of the trace's spans
+downstream in one batch. Memory is bounded by ``num_traces``: when exceeded,
+the oldest traces are released early (upstream groupbytrace's ring-buffer
+eviction behaves the same way).
+
+Columnar twist: we never keep per-trace span lists. Buffered batches are
+stored as-is; a flush concatenates them once (cheap columnar merge), computes
+the expired-trace mask via TraceView, and splits with two filters. First-seen
+times live in one dict keyed by structured trace key — the only per-trace
+Python state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch, concat_batches
+from ...pdata.traces import TraceView, trace_keys
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+class GroupByTraceProcessor(Processor):
+    capabilities = Capabilities(mutates_data=False)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.wait_duration_s = float(config.get("wait_duration_s", 10.0))
+        self.num_traces = int(config.get("num_traces", 100_000))
+        self._clock: Callable[[], float] = config.get("clock", time.monotonic)
+        tick = config.get("tick_interval_s")
+        self.tick_interval_s = float(
+            tick if tick is not None else max(self.wait_duration_s / 4, 0.05))
+        self._lock = threading.Lock()
+        self._pending: list[SpanBatch] = []
+        self._first_seen: dict[bytes, float] = {}  # trace key bytes → time
+        self._timer: Optional[threading.Timer] = None
+
+    # ------------------------------------------------------------- intake
+    def consume(self, batch: SpanBatch) -> None:
+        if not batch:
+            return
+        now = self._clock()
+        evict: Optional[SpanBatch] = None
+        with self._lock:
+            self._pending.append(batch)
+            for key in np.unique(trace_keys(batch)):
+                self._first_seen.setdefault(key.tobytes(), now)
+            if len(self._first_seen) > self.num_traces:
+                evict = self._release_locked(self._evict_cutoff_locked())
+        if evict:
+            self.next_consumer.consume(evict)
+
+    def _evict_cutoff_locked(self) -> float:
+        """First-seen cutoff that keeps the newest ``num_traces`` traces."""
+        times = sorted(self._first_seen.values())
+        return times[len(times) - self.num_traces]
+
+    # -------------------------------------------------------------- flush
+    def _release_locked(self, cutoff: float) -> Optional[SpanBatch]:
+        """Release every trace first seen at or before ``cutoff``."""
+        if not self._pending:
+            return None
+        merged = concat_batches(self._pending)
+        view = TraceView.of(merged)
+        expired = np.fromiter(
+            (self._first_seen.get(k.tobytes(), 0.0) <= cutoff
+             for k in view.keys),
+            dtype=bool, count=view.n_traces)
+        if not expired.any():
+            self._pending = [merged]
+            return None
+        span_mask = view.span_mask_for(expired)
+        out = merged.filter(span_mask)
+        rest = merged.filter(~span_mask)
+        self._pending = [rest] if rest else []
+        for k in view.keys[expired]:
+            self._first_seen.pop(k.tobytes(), None)
+        return out
+
+    def tick(self) -> None:
+        """Release traces older than wait_duration_s. Called by the internal
+        timer; tests call it directly with an injected clock."""
+        with self._lock:
+            out = self._release_locked(self._clock() - self.wait_duration_s)
+        if out:
+            self.next_consumer.consume(out)
+
+    def flush(self) -> None:
+        """Release everything (shutdown path)."""
+        with self._lock:
+            out = self._release_locked(np.inf)
+        if out:
+            self.next_consumer.consume(out)
+
+    # ---------------------------------------------------------- lifecycle
+    def _schedule(self) -> None:
+        self._timer = threading.Timer(self.tick_interval_s, self._on_timer)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _on_timer(self) -> None:
+        try:
+            self.tick()
+        finally:
+            if self._started:
+                self._schedule()
+
+    def start(self) -> None:
+        super().start()
+        if self.tick_interval_s > 0:
+            self._schedule()
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self.flush()
+
+
+register(Factory(
+    type_name="groupbytrace",
+    kind=ComponentKind.PROCESSOR,
+    create=GroupByTraceProcessor,
+    default_config=lambda: {"wait_duration_s": 10.0, "num_traces": 100_000},
+))

@@ -1,0 +1,432 @@
+"""Tail-sampling processor (the odigossampling equivalent).
+
+Reproduces the reference rule engine's semantics
+(collector/processors/odigossamplingprocessor/rule_engine.go:19-32 — rules in
+three priority levels evaluated Global → Service → Endpoint; a satisfied level
+decides with the max satisfied ratio, otherwise the min fallback ratio across
+matched rules applies, otherwise the trace is kept) and its four rule types
+(internal/sampling/{error,latency,servicename,spanattribute}.go), with one
+structural change: the reference evaluates ONE trace per call behind a
+groupbytrace processor; we evaluate EVERY trace in the batch in a single
+vectorized pass over TraceView segment reductions, then filter spans with one
+mask. Must sit behind ``groupbytrace`` so decisions see whole traces
+(README.md of the reference processor makes the same demand).
+
+Deviation (documented): rule_engine.go's evaluateLevel mixes an
+order-dependent fallback into the satisfied max (its running ``ratio`` starts
+from a matched rule's fallback if that rule is evaluated first). We implement
+the clean reading: a level's ratio is the max over *satisfied* rules when any
+rule is satisfied, else the min over matched fallbacks.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch, StatusCode
+from ...pdata.traces import TraceView, service_span_mask
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+@dataclass(frozen=True)
+class RuleResult:
+    """Per-trace arrays mirroring sampling.SamplingDecision.Evaluate's
+    (matched, satisfied, samplingRatio) triple."""
+
+    matched: np.ndarray  # [T] bool
+    satisfied: np.ndarray  # [T] bool
+    ratio: np.ndarray  # [T] float, 0-100
+
+    @staticmethod
+    def nowhere(n: int) -> "RuleResult":
+        z = np.zeros(n, dtype=bool)
+        return RuleResult(z, z, np.zeros(n, dtype=np.float64))
+
+
+class SamplingRule:
+    name: str = ""
+
+    def validate(self) -> None:
+        raise NotImplementedError
+
+    def evaluate(self, view: TraceView) -> RuleResult:
+        raise NotImplementedError
+
+
+def _check_ratio(value: float, field: str) -> None:
+    if not 0.0 <= value <= 100.0:
+        raise ValueError(f"{field} must be between 0 and 100, got {value}")
+
+
+@dataclass
+class ErrorRule(SamplingRule):
+    """Keep every trace containing an error span; sample the rest at
+    ``fallback_sampling_ratio`` (error.go Evaluate)."""
+
+    fallback_sampling_ratio: float = 0.0
+    name: str = ""
+
+    def validate(self) -> None:
+        _check_ratio(self.fallback_sampling_ratio, "fallback_sampling_ratio")
+
+    def evaluate(self, view: TraceView) -> RuleResult:
+        has_error = view.any_per_trace(
+            view.batch.col("status_code") == StatusCode.ERROR)
+        matched = np.ones(view.n_traces, dtype=bool)  # global: always in scope
+        ratio = np.where(has_error, 100.0, self.fallback_sampling_ratio)
+        return RuleResult(matched, has_error, ratio)
+
+
+@dataclass
+class LatencyRule(SamplingRule):
+    """http_latency: traces of ``service_name`` touching ``http_route`` (prefix
+    match) slower than ``threshold`` ms are kept; faster ones fall back
+    (latency.go Evaluate — duration measured over the matching service's spans
+    only, as the reference does)."""
+
+    service_name: str = ""
+    http_route: str = ""
+    threshold: float = 0.0  # milliseconds
+    fallback_sampling_ratio: float = 0.0
+    name: str = ""
+
+    def validate(self) -> None:
+        if self.threshold <= 0:
+            raise ValueError("threshold must be a positive number")
+        if not self.service_name:
+            raise ValueError("service_name cannot be empty")
+        if not self.http_route:
+            raise ValueError("http_route cannot be empty")
+        if not self.http_route.startswith("/"):
+            raise ValueError("http_route must start with '/'")
+        _check_ratio(self.fallback_sampling_ratio, "fallback_sampling_ratio")
+
+    def evaluate(self, view: TraceView) -> RuleResult:
+        batch = view.batch
+        svc = service_span_mask(batch, self.service_name)
+        if not svc.any():
+            return RuleResult.nowhere(view.n_traces)
+        # attribute read only for spans of the target service
+        route_span = np.zeros(len(batch), dtype=bool)
+        for i in np.nonzero(svc)[0]:
+            route = batch.span_attrs[i].get("http.route")
+            if isinstance(route, str) and route.startswith(self.http_route):
+                route_span[i] = True
+        matched = (view.any_per_trace(svc)
+                   & view.any_per_trace(route_span))
+        start = view.min_per_trace(batch.col("start_unix_nano"), where=svc)
+        end = view.max_per_trace(batch.col("end_unix_nano"), where=svc)
+        duration_ms = np.where(matched, np.maximum(end - start, 0.0) / 1e6, 0.0)
+        satisfied = matched & (duration_ms >= self.threshold)
+        ratio = np.where(satisfied, 100.0, self.fallback_sampling_ratio)
+        return RuleResult(matched, satisfied, ratio)
+
+
+@dataclass
+class ServiceNameRule(SamplingRule):
+    """Traces containing ``service_name`` sampled at ``sampling_ratio``;
+    others out of scope (servicename.go Evaluate — matched==satisfied)."""
+
+    service_name: str = ""
+    sampling_ratio: float = 100.0
+    fallback_sampling_ratio: float = 0.0
+    name: str = ""
+
+    def validate(self) -> None:
+        if not self.service_name:
+            raise ValueError("service name cannot be empty")
+        _check_ratio(self.sampling_ratio, "sampling_ratio")
+        _check_ratio(self.fallback_sampling_ratio, "fallback_sampling_ratio")
+
+    def evaluate(self, view: TraceView) -> RuleResult:
+        present = view.any_per_trace(
+            service_span_mask(view.batch, self.service_name))
+        ratio = np.where(present, self.sampling_ratio,
+                         self.fallback_sampling_ratio)
+        return RuleResult(present, present, ratio)
+
+
+_STRING_OPS = ("exists", "equals", "not_equals", "contains", "not_contains",
+               "regex")
+_NUMBER_OPS = ("exists", "equals", "not_equals", "greater_than", "less_than",
+               "greater_than_or_equal", "less_than_or_equal")
+_BOOLEAN_OPS = ("exists", "equals")
+_JSON_OPS = ("exists", "is_valid_json", "is_invalid_json", "jsonpath_exists",
+             "contains_key", "not_contains_key", "key_equals",
+             "key_not_equals")
+
+
+def _jsonpath_get(path: str, value: Any) -> tuple[bool, Any]:
+    """Minimal "$.a.b[0]" subset of the reference's jsonpath dependency
+    (spanattribute.go uses PaesslerAG/jsonpath). Returns (found, value)."""
+    if not path.startswith("$"):
+        return False, None
+    tokens = re.findall(r"\.([^.\[\]]+)|\[(\d+)\]", path[1:])
+    cur = value
+    for key, idx in tokens:
+        if key:
+            if not isinstance(cur, dict) or key not in cur:
+                return False, None
+            cur = cur[key]
+        else:
+            i = int(idx)
+            if not isinstance(cur, list) or i >= len(cur):
+                return False, None
+            cur = cur[i]
+    return True, cur
+
+
+def _json_value_str(v: Any) -> str:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(float(v)) if isinstance(v, float) and not float(v).is_integer() else str(int(v))
+    if v is None:
+        return "null"
+    return json.dumps(v)
+
+
+@dataclass
+class SpanAttributeRule(SamplingRule):
+    """Sample traces of ``service_name`` whose spans carry ``attribute_key``
+    meeting a typed condition (spanattribute.go; matched==satisfied, fallback
+    only reported when out of scope and therefore ignored by the engine)."""
+
+    service_name: str = ""
+    attribute_key: str = ""
+    condition_type: str = "string"  # string | number | boolean | json
+    operation: str = "exists"
+    expected_value: str = ""
+    json_path: str = ""
+    sampling_ratio: float = 100.0
+    fallback_sampling_ratio: float = 0.0
+    name: str = ""
+
+    def validate(self) -> None:
+        _check_ratio(self.sampling_ratio, "sampling_ratio")
+        _check_ratio(self.fallback_sampling_ratio, "fallback_sampling_ratio")
+        if not self.service_name:
+            raise ValueError("service_name cannot be empty")
+        if not self.attribute_key:
+            raise ValueError("attribute_key cannot be empty")
+        ops = {"string": _STRING_OPS, "number": _NUMBER_OPS,
+               "boolean": _BOOLEAN_OPS, "json": _JSON_OPS}.get(
+                   self.condition_type)
+        if ops is None:
+            raise ValueError(
+                f"unsupported condition type: {self.condition_type!r}")
+        if self.operation not in ops:
+            raise ValueError(
+                f"invalid {self.condition_type} operation {self.operation!r}")
+        needs_value = (
+            (self.condition_type == "string" and self.operation != "exists")
+            or (self.condition_type == "number" and self.operation != "exists")
+            or (self.condition_type == "boolean" and self.operation == "equals")
+            or self.operation in ("key_equals", "key_not_equals"))
+        if needs_value and not self.expected_value:
+            raise ValueError(
+                f"expected_value required for {self.operation} operation")
+        if (self.condition_type == "json"
+                and self.operation not in ("exists", "is_valid_json",
+                                           "is_invalid_json")
+                and not self.json_path):
+            raise ValueError("json_path required for json operations")
+
+    # per-span condition; only called for spans of the matching service
+    def _span_satisfies(self, value: Any) -> bool:
+        op, expected = self.operation, self.expected_value
+        if self.condition_type == "string":
+            if not isinstance(value, str):
+                return False
+            if op == "exists":
+                return value != ""
+            if op == "equals":
+                return value == expected
+            if op == "not_equals":
+                return value != expected
+            if op == "contains":
+                return expected in value
+            if op == "not_contains":
+                return expected not in value
+            if op == "regex":
+                try:
+                    return re.search(expected, value) is not None
+                except re.error:
+                    return False
+        elif self.condition_type == "number":
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                return False
+            if op == "exists":
+                return True
+            try:
+                num = float(expected)
+            except ValueError:
+                return False
+            return {"equals": value == num,
+                    "not_equals": value != num,
+                    "greater_than": value > num,
+                    "less_than": value < num,
+                    "greater_than_or_equal": value >= num,
+                    "less_than_or_equal": value <= num}[op]
+        elif self.condition_type == "boolean":
+            if not isinstance(value, bool):
+                return False
+            if op == "exists":
+                return True
+            return value == (expected.lower() == "true")
+        elif self.condition_type == "json":
+            if not isinstance(value, str):
+                return False
+            try:
+                parsed = json.loads(value)
+                valid = True
+            except ValueError:
+                parsed, valid = None, False
+            if op == "is_valid_json":
+                return valid
+            if op == "is_invalid_json":
+                return not valid
+            if not valid:
+                return False
+            found, sub = _jsonpath_get(self.json_path, parsed)
+            if op in ("exists", "jsonpath_exists", "contains_key"):
+                return found and sub is not None
+            if op == "not_contains_key":
+                return not found
+            if op == "key_equals":
+                return found and _json_value_str(sub) == expected
+            if op == "key_not_equals":
+                return found and _json_value_str(sub) != expected
+        return False
+
+    def evaluate(self, view: TraceView) -> RuleResult:
+        batch = view.batch
+        svc = service_span_mask(batch, self.service_name)
+        if not svc.any():
+            return RuleResult.nowhere(view.n_traces)
+        hit = np.zeros(len(batch), dtype=bool)
+        for i in np.nonzero(svc)[0]:
+            attrs = batch.span_attrs[i]
+            if self.attribute_key in attrs:
+                hit[i] = self._span_satisfies(attrs[self.attribute_key])
+        satisfied = view.any_per_trace(hit)
+        ratio = np.where(satisfied, self.sampling_ratio,
+                         self.fallback_sampling_ratio)
+        return RuleResult(satisfied, satisfied, ratio)
+
+
+_RULE_TYPES = {
+    "error": ErrorRule,
+    "http_latency": LatencyRule,
+    "latency": LatencyRule,
+    "service_name": ServiceNameRule,
+    "span_attribute": SpanAttributeRule,
+}
+
+
+def parse_rule(spec: dict[str, Any]) -> SamplingRule:
+    """config.go Rule.Validate equivalent: {name, type, rule_details}."""
+    name = spec.get("name", "")
+    rule_type = spec.get("type", "")
+    details = spec.get("rule_details")
+    if not name:
+        raise ValueError("rule name cannot be empty")
+    if not rule_type:
+        raise ValueError("rule type cannot be empty")
+    if details is None:
+        raise ValueError("rule details cannot be nil")
+    cls = _RULE_TYPES.get(rule_type)
+    if cls is None:
+        raise ValueError(f"unknown rule type: {rule_type}")
+    known = {f for f in cls.__dataclass_fields__}
+    rule = cls(**{k: v for k, v in details.items() if k in known}, name=name)
+    rule.validate()
+    return rule
+
+
+class RuleEngine:
+    """Vectorized rule_engine.go ShouldSample over all traces in a batch."""
+
+    def __init__(self, global_rules: list[SamplingRule],
+                 service_rules: list[SamplingRule],
+                 endpoint_rules: list[SamplingRule],
+                 *, seed: Optional[int] = None):
+        self.levels = [global_rules, service_rules, endpoint_rules]
+        self._rng = np.random.default_rng(seed)
+
+    def keep_traces(self, view: TraceView) -> np.ndarray:
+        T = view.n_traces
+        decided = np.zeros(T, dtype=bool)
+        decided_ratio = np.zeros(T, dtype=np.float64)
+        min_fallback = np.full(T, np.inf, dtype=np.float64)
+        any_matched = np.zeros(T, dtype=bool)
+
+        for rules in self.levels:
+            if not rules:
+                continue
+            results = [r.evaluate(view) for r in rules]
+            sat = np.stack([r.satisfied for r in results])
+            mat = np.stack([r.matched for r in results])
+            ratio = np.stack([r.ratio for r in results])
+
+            level_sat = sat.any(axis=0)
+            sat_ratio = np.where(sat, ratio, -np.inf).max(axis=0)
+            newly = ~decided & level_sat
+            decided_ratio[newly] = sat_ratio[newly]
+            decided |= newly
+
+            # levels without a satisfied rule contribute their matched
+            # fallbacks (min across rules, then min across levels)
+            fb_scope = mat & ~sat
+            level_matched = fb_scope.any(axis=0) & ~level_sat
+            level_fb = np.where(fb_scope, ratio, np.inf).min(axis=0)
+            upd = ~decided & level_matched
+            min_fallback[upd] = np.minimum(min_fallback[upd], level_fb[upd])
+            any_matched |= upd
+
+        draw = self._rng.random(T) * 100.0
+        keep = np.ones(T, dtype=bool)  # no rule matched → keep
+        keep[decided] = draw[decided] < decided_ratio[decided]
+        fb = ~decided & any_matched
+        keep[fb] = draw[fb] < min_fallback[fb]
+        return keep
+
+
+class SamplingProcessor(Processor):
+    """Drop non-sampled traces (processor.go removeAllSpans — the reference
+    drops the whole td; ours filters the per-trace spans out of the batch)."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        rules_cfg = config.get("rules", config)
+        self.engine = RuleEngine(
+            [parse_rule(r) for r in rules_cfg.get("global_rules", [])],
+            [parse_rule(r) for r in rules_cfg.get("service_rules", [])],
+            [parse_rule(r) for r in rules_cfg.get("endpoint_rules", [])],
+            seed=config.get("seed"))
+
+    def process(self, batch: SpanBatch) -> Optional[SpanBatch]:
+        if not batch:
+            return None
+        view = TraceView.of(batch)
+        keep = self.engine.keep_traces(view)
+        if keep.all():
+            return batch
+        return batch.filter(view.span_mask_for(keep))
+
+
+register(Factory(
+    type_name="odigossampling",
+    kind=ComponentKind.PROCESSOR,
+    create=SamplingProcessor,
+    default_config=lambda: {"rules": {}},
+))

@@ -38,8 +38,11 @@ _shared_lock = threading.Lock()
 def _engine_for(cfg: EngineConfig, shared: bool) -> ScoringEngine:
     if not shared:
         return ScoringEngine(cfg)
-    key = (cfg.model, cfg.max_len, cfg.trace_bucket, cfg.featurizer,
-           cfg.checkpoint_path, cfg.seed)
+    try:
+        hash(cfg)  # every behavioral field participates in the key
+    except TypeError:  # unhashable model_config → can't dedupe safely
+        return ScoringEngine(cfg)
+    key = cfg
     with _shared_lock:
         eng = _shared_engines.get(key)
         if eng is None:

@@ -190,12 +190,9 @@ def assemble_sequences(batch: SpanBatch,
             np.zeros((T, max_len), bool),
             np.full((T, max_len), -1, np.int32), 0)
 
-    hi = batch.col("trace_id_hi")
-    lo = batch.col("trace_id_lo")
-    # structured dtype keeps (hi, lo) exact — no xor-collision risk
-    composite = np.empty(n, dtype=[("hi", np.uint64), ("lo", np.uint64)])
-    composite["hi"], composite["lo"] = hi, lo
-    uniq, inverse = np.unique(composite, return_inverse=True)
+    from ..pdata.traces import trace_keys
+
+    uniq, inverse = np.unique(trace_keys(batch), return_inverse=True)
     T_real = len(uniq)
 
     start = batch.col("start_unix_nano")
@@ -295,10 +292,9 @@ def pack_sequences(batch: SpanBatch,
             np.zeros((R, max_len), np.int32),
             np.full((R, max_len), -1, np.int32))
 
-    composite = np.empty(n, dtype=[("hi", np.uint64), ("lo", np.uint64)])
-    composite["hi"] = batch.col("trace_id_hi")
-    composite["lo"] = batch.col("trace_id_lo")
-    _, inverse = np.unique(composite, return_inverse=True)
+    from ..pdata.traces import trace_keys
+
+    _, inverse = np.unique(trace_keys(batch), return_inverse=True)
     order = np.lexsort((batch.col("start_unix_nano"), inverse))
     inv_sorted = inverse[order]
     boundaries = np.nonzero(np.diff(inv_sorted))[0] + 1

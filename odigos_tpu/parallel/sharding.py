@@ -34,13 +34,16 @@ def transformer_param_spec(path: tuple, leaf: Any) -> P:
             return P(None, "model", None)  # (d_model, heads, head_dim)
         if "out" in names and ndim == 3:
             return P("model", None, None)  # (heads, head_dim, d_model)
-    # transformer mlp: first Dense grows to d_ff (shard cols), second shrinks
+    # transformer mlp: first Dense grows to d_ff (shard cols), second
+    # shrinks. Size gate keeps tiny matmuls (span/trace heads, embedder
+    # projections) replicated — sharding them only buys per-call collectives.
     if ndim == 2 and names[-1] == "kernel":
         in_dim, out_dim = leaf.shape
-        if out_dim > in_dim:
-            return P(None, "model")
-        if in_dim > out_dim:
-            return P("model", None)
+        if min(in_dim, out_dim) >= 64:
+            if out_dim > in_dim:
+                return P(None, "model")
+            if in_dim > out_dim:
+                return P("model", None)
     return P()  # replicate embeddings, norms, biases, heads
 
 

@@ -20,8 +20,12 @@ from .spans import (
     concat_batches,
 )
 from .gen import TraceShape, synthesize_traces
+from .traces import TraceView, service_span_mask, trace_keys
 
 __all__ = [
+    "TraceView",
+    "service_span_mask",
+    "trace_keys",
     "SpanKind",
     "StatusCode",
     "SpanBatch",

@@ -150,19 +150,14 @@ class SpanBatch:
             raise ValueError(f"mask shape {mask.shape} != ({n},)")
         idxs = np.nonzero(mask)[0]
         if len(values) == len(idxs):
-            per_masked = True
+            masked_values = values
         elif len(values) == n:
-            per_masked = False
+            masked_values = [values[i] for i in idxs]
         else:
             raise ValueError(
                 f"values length {len(values)} matches neither masked count "
                 f"{len(idxs)} nor batch size {n}")
-        new_attrs = list(self.span_attrs)
-        for j, i in enumerate(idxs):
-            d = dict(new_attrs[i])
-            d[key] = values[j] if per_masked else values[i]
-            new_attrs[i] = d
-        return replace(self, span_attrs=tuple(new_attrs))
+        return self.with_span_attrs({key: masked_values}, mask)
 
     def with_span_attrs(self, updates: dict[str, Sequence[Any]],
                         mask: np.ndarray) -> "SpanBatch":

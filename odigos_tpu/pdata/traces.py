@@ -1,0 +1,102 @@
+"""Per-trace views over a SpanBatch.
+
+Whole-trace operations (tail sampling, groupbytrace buffering, trace-level
+anomaly scoring) need "for each trace: aggregate over its spans". The
+reference walks ResourceSpans per trace per rule
+(odigossamplingprocessor/internal/sampling/error.go Evaluate,
+latency.go Evaluate); our batches hold many traces at once, so we compute a
+span→trace index once and answer every aggregate as a vectorized segment
+reduction — no Python per span.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+
+import numpy as np
+
+from .spans import SpanBatch
+
+
+def trace_keys(batch: SpanBatch) -> np.ndarray:
+    """Structured (hi, lo) key per span — exact, no xor-collision risk."""
+    n = len(batch)
+    composite = np.empty(n, dtype=[("hi", np.uint64), ("lo", np.uint64)])
+    composite["hi"] = batch.col("trace_id_hi")
+    composite["lo"] = batch.col("trace_id_lo")
+    return composite
+
+
+@dataclass(frozen=True)
+class TraceView:
+    """Span→trace mapping for one batch plus vectorized per-trace reductions.
+
+    ``trace_index[i]`` is the dense trace ordinal of span ``i``;
+    ``keys[t]`` the structured (hi, lo) trace id of ordinal ``t``.
+    """
+
+    batch: SpanBatch
+    keys: np.ndarray  # [T] structured (hi, lo)
+    trace_index: np.ndarray  # [N] int64
+
+    @staticmethod
+    def of(batch: SpanBatch) -> "TraceView":
+        keys, inverse = np.unique(trace_keys(batch), return_inverse=True)
+        return TraceView(batch=batch, keys=keys,
+                         trace_index=inverse.reshape(-1))
+
+    @property
+    def n_traces(self) -> int:
+        return len(self.keys)
+
+    # -------------------------------------------------- segment reductions
+    def any_per_trace(self, span_mask: np.ndarray) -> np.ndarray:
+        out = np.zeros(self.n_traces, dtype=np.uint8)
+        np.bitwise_or.at(out, self.trace_index,
+                         np.asarray(span_mask, dtype=np.uint8))
+        return out.astype(bool)
+
+    def min_per_trace(self, values: np.ndarray, *,
+                      where: np.ndarray | None = None,
+                      empty: float = np.inf) -> np.ndarray:
+        vals = np.asarray(values, dtype=np.float64)
+        if where is not None:
+            vals = np.where(where, vals, empty)
+        out = np.full(self.n_traces, empty, dtype=np.float64)
+        np.minimum.at(out, self.trace_index, vals)
+        return out
+
+    def max_per_trace(self, values: np.ndarray, *,
+                      where: np.ndarray | None = None,
+                      empty: float = -np.inf) -> np.ndarray:
+        vals = np.asarray(values, dtype=np.float64)
+        if where is not None:
+            vals = np.where(where, vals, empty)
+        out = np.full(self.n_traces, empty, dtype=np.float64)
+        np.maximum.at(out, self.trace_index, vals)
+        return out
+
+    def count_per_trace(self) -> np.ndarray:
+        return np.bincount(self.trace_index, minlength=self.n_traces)
+
+    # ------------------------------------------------------- derived stats
+    @cached_property
+    def duration_ms(self) -> np.ndarray:
+        """Whole-trace wall duration (max end − min start) in milliseconds."""
+        start = self.min_per_trace(self.batch.col("start_unix_nano"))
+        end = self.max_per_trace(self.batch.col("end_unix_nano"))
+        return np.maximum(end - start, 0.0) / 1e6
+
+    def span_mask_for(self, trace_mask: np.ndarray) -> np.ndarray:
+        """Lift a per-trace mask back to a per-span mask."""
+        return np.asarray(trace_mask, dtype=bool)[self.trace_index]
+
+
+def service_span_mask(batch: SpanBatch, service_name: str) -> np.ndarray:
+    """Per-span mask "span belongs to service X" via the string table —
+    one table scan, then a vectorized isin on the interned column."""
+    idxs = [i for i, s in enumerate(batch.strings) if s == service_name]
+    if not idxs:
+        return np.zeros(len(batch), dtype=bool)
+    return np.isin(batch.col("service"), np.asarray(idxs, dtype=np.int32))

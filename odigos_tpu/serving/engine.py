@@ -155,7 +155,10 @@ class SequenceBackend:
         span_scores, _ = self.model.score_spans(
             self.variables, jnp.asarray(seqs.categorical),
             jnp.asarray(seqs.continuous), jnp.asarray(seqs.mask))
-        span_scores = np.asarray(span_scores, dtype=np.float32)
+        # raw reconstruction error is unbounded; squash to (0, 1) so the
+        # processor's threshold contract (score in [0,1]) holds for both
+        # sequence models (the transformer path is already a sigmoid)
+        span_scores = 1.0 - np.exp(-np.asarray(span_scores, dtype=np.float32))
         out = np.zeros(len(batch), np.float32)
         m = seqs.mask
         out[seqs.span_index[m]] = span_scores[m]

@@ -1,0 +1,284 @@
+"""Tail-sampling rule engine + groupbytrace buffering tests (the analog of
+the reference's rule_engine_test.go and internal/sampling/*_test.go)."""
+
+import numpy as np
+import pytest
+
+from odigos_tpu.components.processors.groupbytrace import GroupByTraceProcessor
+from odigos_tpu.components.processors.sampling import (
+    ErrorRule, LatencyRule, RuleEngine, SamplingProcessor, ServiceNameRule,
+    SpanAttributeRule, parse_rule)
+from odigos_tpu.pdata import (
+    SpanBatchBuilder, SpanKind, StatusCode, TraceView, concat_batches)
+
+
+def make_trace(builder, trace_id, service="svc", n=3, *, error=False,
+               duration_ms=10.0, attrs=None, route=None):
+    """n spans, one root; trace wall time = duration_ms."""
+    start = 1_000_000_000
+    end = start + int(duration_ms * 1e6)
+    for i in range(n):
+        span_attrs = dict(attrs or {})
+        if route is not None and i == 0:
+            span_attrs["http.route"] = route
+        builder.add_span(
+            trace_id=trace_id, span_id=trace_id * 100 + i + 1,
+            parent_span_id=0 if i == 0 else trace_id * 100 + 1,
+            name=f"op-{i}", service=service,
+            kind=SpanKind.SERVER if i == 0 else SpanKind.INTERNAL,
+            status_code=StatusCode.ERROR if (error and i == n - 1)
+            else StatusCode.UNSET,
+            start_unix_nano=start + i, end_unix_nano=end - i,
+            attrs=span_attrs)
+
+
+def build(*specs):
+    b = SpanBatchBuilder()
+    for spec in specs:
+        make_trace(b, **spec)
+    return b.build()
+
+
+def kept_trace_ids(batch, keep_mask=None):
+    if keep_mask is not None:
+        view = TraceView.of(batch)
+        batch = batch.filter(view.span_mask_for(keep_mask))
+    return sorted(set(batch.col("trace_id_lo").tolist()))
+
+
+# ------------------------------------------------------------- TraceView
+def test_trace_view_reductions():
+    batch = build({"trace_id": 1, "n": 4, "duration_ms": 50},
+                  {"trace_id": 2, "n": 2, "duration_ms": 5, "error": True})
+    view = TraceView.of(batch)
+    assert view.n_traces == 2
+    assert view.count_per_trace().tolist() == [4, 2]
+    err = view.any_per_trace(batch.col("status_code") == StatusCode.ERROR)
+    assert err.tolist() == [False, True]
+    assert view.duration_ms[0] == pytest.approx(50, abs=1e-3)
+    assert view.duration_ms[1] == pytest.approx(5, abs=1e-3)
+
+
+# ----------------------------------------------------------------- rules
+def test_error_rule_keeps_errors_drops_rest():
+    batch = build({"trace_id": 1, "error": True}, {"trace_id": 2})
+    engine = RuleEngine([ErrorRule(fallback_sampling_ratio=0.0)], [], [],
+                        seed=0)
+    keep = engine.keep_traces(TraceView.of(batch))
+    assert kept_trace_ids(batch, keep) == [1]
+
+
+def test_error_rule_fallback_ratio_statistical():
+    b = SpanBatchBuilder()
+    for t in range(1, 401):
+        make_trace(b, t, n=1)
+    batch = b.build()
+    engine = RuleEngine([ErrorRule(fallback_sampling_ratio=50.0)], [], [],
+                        seed=0)
+    keep = engine.keep_traces(TraceView.of(batch))
+    assert 0.35 < keep.mean() < 0.65  # ~50%
+
+
+def test_latency_rule_threshold_and_scope():
+    batch = build(
+        {"trace_id": 1, "service": "frontend", "route": "/buy",
+         "duration_ms": 2000},  # slow → keep
+        {"trace_id": 2, "service": "frontend", "route": "/buy/item",
+         "duration_ms": 10},    # fast, prefix match → fallback (0) → drop
+        {"trace_id": 3, "service": "frontend", "route": "/sell",
+         "duration_ms": 9000},  # route mismatch → unmatched → keep
+        {"trace_id": 4, "service": "backend", "route": "/buy",
+         "duration_ms": 9000})  # service mismatch → unmatched → keep
+    rule = LatencyRule(service_name="frontend", http_route="/buy",
+                       threshold=1000, fallback_sampling_ratio=0.0)
+    engine = RuleEngine([], [], [rule], seed=0)
+    keep = engine.keep_traces(TraceView.of(batch))
+    assert kept_trace_ids(batch, keep) == [1, 3, 4]
+
+
+def test_service_name_rule():
+    batch = build({"trace_id": 1, "service": "a"},
+                  {"trace_id": 2, "service": "b"})
+    engine = RuleEngine([], [ServiceNameRule(
+        service_name="a", sampling_ratio=100.0)], [], seed=0)
+    keep = engine.keep_traces(TraceView.of(batch))
+    assert kept_trace_ids(batch, keep) == [1, 2]  # b unmatched → kept
+    engine = RuleEngine([], [ServiceNameRule(
+        service_name="a", sampling_ratio=0.0)], [], seed=0)
+    keep = engine.keep_traces(TraceView.of(batch))
+    assert kept_trace_ids(batch, keep) == [2]  # a matched at 0% → dropped
+
+
+@pytest.mark.parametrize("ctype,op,expected,attrs,hit", [
+    ("string", "equals", "x", {"k": "x"}, True),
+    ("string", "equals", "x", {"k": "y"}, False),
+    ("string", "contains", "bc", {"k": "abcd"}, True),
+    ("string", "regex", r"^a\d+$", {"k": "a123"}, True),
+    ("number", "greater_than", "10", {"k": 11}, True),
+    ("number", "greater_than", "10", {"k": 9.5}, False),
+    ("boolean", "equals", "true", {"k": True}, True),
+    ("json", "key_equals", "1", {"k": '{"a": {"b": 1}}'}, True),
+    ("json", "contains_key", "", {"k": '{"a": {"b": 1}}'}, True),
+    ("json", "is_invalid_json", "", {"k": "{nope"}, True),
+])
+def test_span_attribute_rule(ctype, op, expected, attrs, hit):
+    batch = build({"trace_id": 1, "attrs": attrs})
+    rule = SpanAttributeRule(
+        service_name="svc", attribute_key="k", condition_type=ctype,
+        operation=op, expected_value=expected,
+        json_path="$.a.b" if ctype == "json" else "",
+        sampling_ratio=100.0, fallback_sampling_ratio=0.0)
+    rule.validate()
+    res = rule.evaluate(TraceView.of(batch))
+    assert bool(res.satisfied[0]) is hit
+
+
+def test_level_priority_global_decides_first():
+    # error rule (global) satisfied at 100 beats endpoint latency fallback 0
+    batch = build({"trace_id": 1, "service": "frontend", "route": "/buy",
+                   "duration_ms": 1, "error": True})
+    engine = RuleEngine(
+        [ErrorRule(fallback_sampling_ratio=0.0)], [],
+        [LatencyRule(service_name="frontend", http_route="/buy",
+                     threshold=1000, fallback_sampling_ratio=0.0)], seed=0)
+    keep = engine.keep_traces(TraceView.of(batch))
+    assert keep.tolist() == [True]
+
+
+def test_min_fallback_across_levels():
+    # no rule satisfied; matched fallbacks 40 (global) and 10 (endpoint):
+    # min = 10 applies
+    batch = build({"trace_id": 1, "service": "frontend", "route": "/buy",
+                   "duration_ms": 1})
+    engine = RuleEngine(
+        [ErrorRule(fallback_sampling_ratio=40.0)], [],
+        [LatencyRule(service_name="frontend", http_route="/buy",
+                     threshold=1000, fallback_sampling_ratio=10.0)], seed=0)
+    T = 2000
+    rng_keep = []
+    for seed in range(3):
+        engine._rng = np.random.default_rng(seed)
+        b = SpanBatchBuilder()
+        for t in range(1, T + 1):
+            make_trace(b, t, service="frontend", route="/buy", duration_ms=1)
+        keep = engine.keep_traces(TraceView.of(b.build()))
+        rng_keep.append(keep.mean())
+    assert 0.05 < np.mean(rng_keep) < 0.16  # ~10%, not ~40%
+
+
+def test_parse_rule_validation():
+    with pytest.raises(ValueError, match="unknown rule type"):
+        parse_rule({"name": "x", "type": "nope", "rule_details": {}})
+    with pytest.raises(ValueError, match="threshold"):
+        parse_rule({"name": "x", "type": "http_latency",
+                    "rule_details": {"service_name": "a", "http_route": "/"}})
+    with pytest.raises(ValueError, match="must start with"):
+        parse_rule({"name": "x", "type": "http_latency",
+                    "rule_details": {"service_name": "a", "http_route": "buy",
+                                     "threshold": 10}})
+    rule = parse_rule({"name": "e", "type": "error",
+                       "rule_details": {"fallback_sampling_ratio": 20}})
+    assert isinstance(rule, ErrorRule)
+
+
+def test_sampling_processor_end_to_end():
+    proc = SamplingProcessor("odigossampling", {
+        "rules": {"global_rules": [
+            {"name": "errors-only", "type": "error",
+             "rule_details": {"fallback_sampling_ratio": 0.0}}]},
+        "seed": 0})
+    sink = []
+    proc.set_consumer(type("S", (), {"consume": lambda self, b: sink.append(b)})())
+    batch = build({"trace_id": 1, "error": True}, {"trace_id": 2},
+                  {"trace_id": 3, "error": True})
+    proc.consume(batch)
+    assert len(sink) == 1
+    assert kept_trace_ids(sink[0]) == [1, 3]
+
+
+# ------------------------------------------------------------ groupbytrace
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_groupbytrace_holds_until_wait_elapses():
+    clock = FakeClock()
+    proc = GroupByTraceProcessor("groupbytrace", {
+        "wait_duration_s": 10.0, "clock": clock, "tick_interval_s": 0})
+    sink = []
+    proc.set_consumer(type("S", (), {"consume": lambda self, b: sink.append(b)})())
+
+    proc.consume(build({"trace_id": 1, "n": 2}))
+    clock.t += 5
+    proc.consume(build({"trace_id": 1, "n": 1}, {"trace_id": 2, "n": 2}))
+    proc.tick()
+    assert sink == []  # nothing expired yet
+
+    clock.t += 6  # trace 1 first seen 11s ago, trace 2 only 6s
+    proc.tick()
+    assert len(sink) == 1
+    assert kept_trace_ids(sink[0]) == [1]
+    assert len(sink[0]) == 3  # spans from both arrival batches, regrouped
+
+    clock.t += 5
+    proc.tick()
+    assert kept_trace_ids(sink[1]) == [2]
+
+
+def test_groupbytrace_eviction_bounds_memory():
+    clock = FakeClock()
+    proc = GroupByTraceProcessor("groupbytrace", {
+        "wait_duration_s": 1000.0, "num_traces": 3, "clock": clock,
+        "tick_interval_s": 0})
+    sink = []
+    proc.set_consumer(type("S", (), {"consume": lambda self, b: sink.append(b)})())
+    for t in range(1, 6):  # 5 traces, cap 3 → oldest evicted early
+        clock.t += 1
+        proc.consume(build({"trace_id": t, "n": 1}))
+    assert sum(len(b) for b in sink) == 2
+    released = sorted(i for b in sink for i in kept_trace_ids(b))
+    assert released == [1, 2]
+
+
+def test_groupbytrace_shutdown_flushes_all():
+    clock = FakeClock()
+    proc = GroupByTraceProcessor("groupbytrace", {
+        "wait_duration_s": 1000.0, "clock": clock, "tick_interval_s": 0})
+    sink = []
+    proc.set_consumer(type("S", (), {"consume": lambda self, b: sink.append(b)})())
+    proc.consume(build({"trace_id": 1}, {"trace_id": 2}))
+    proc.shutdown()
+    assert sum(len(b) for b in sink) == 6
+
+
+def test_groupbytrace_then_sampling_pipeline():
+    """The mandated composition: groupbytrace → odigossampling."""
+    clock = FakeClock()
+    gbt = GroupByTraceProcessor("groupbytrace", {
+        "wait_duration_s": 1.0, "clock": clock, "tick_interval_s": 0})
+    samp = SamplingProcessor("odigossampling", {
+        "rules": {"global_rules": [
+            {"name": "errors", "type": "error",
+             "rule_details": {"fallback_sampling_ratio": 0.0}}]},
+        "seed": 0})
+    sink = []
+    gbt.set_consumer(samp)
+    samp.set_consumer(type("S", (), {"consume": lambda self, b: sink.append(b)})())
+
+    # error span of trace 1 arrives in a LATER batch than its root: a head
+    # sampler would have dropped the trace; tail sampling must keep it.
+    b1 = SpanBatchBuilder()
+    make_trace(b1, 1, n=1)
+    make_trace(b1, 2, n=1)
+    gbt.consume(b1.build())
+    b2 = SpanBatchBuilder()
+    make_trace(b2, 1, n=2, error=True)
+    gbt.consume(b2.build())
+    clock.t += 2
+    gbt.tick()
+    assert len(sink) == 1
+    assert kept_trace_ids(sink[0]) == [1]
