@@ -1,1 +1,1 @@
-from . import forward, router, anomalyrouter  # noqa: F401
+from . import forward, router, anomalyrouter, spanmetrics, servicegraph  # noqa: F401

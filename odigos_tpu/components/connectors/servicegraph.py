@@ -1,0 +1,111 @@
+"""servicegraph connector: traces in → service-edge metrics out.
+
+Upstream's servicegraph connector (given a dedicated gateway pipeline by
+common/pipelinegen/config_builder.go:231 insertServiceGraphPipeline) derives
+caller→callee edges and per-edge request/latency metrics; BASELINE config #2
+uses it as the edge-latency baseline. Needs whole traces on one instance —
+the same loadbalancing guarantee tail sampling relies on (SURVEY.md §5.7).
+
+Edge detection is a vectorized parent join over the columnar batch: map
+span_id → row via np.searchsorted on the sorted id column, then an edge is
+any span whose parent lives in a *different service* (covers both the
+CLIENT→SERVER pair and direct cross-service parenthood). Emits per edge
+(client service, server service):
+
+* ``traces.service.graph.request.total`` (SUM)
+* ``traces.service.graph.request.failed.total`` (SUM, server side errors)
+* ``traces.service.graph.request.duration`` (HISTOGRAM, ms of callee span)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from ...pdata.metrics import MetricBatchBuilder, MetricType, group_histograms
+from ...pdata.spans import SpanBatch, StatusCode
+from ..api import ComponentKind, Connector, Factory, register
+
+_DEFAULT_BOUNDS_MS = (2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+                      1024.0, 2048.0, 4096.0, 8192.0)
+
+
+class ServiceGraphConnector(Connector):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.bounds = np.asarray(
+            config.get("histogram_bounds_ms", _DEFAULT_BOUNDS_MS),
+            dtype=np.float64)
+
+    def consume(self, batch: SpanBatch) -> None:
+        if not batch:
+            return
+        out = self.aggregate(batch)
+        if len(out):
+            for consumer in self.outputs.values():
+                consumer.consume(out)
+
+    def aggregate(self, batch: SpanBatch):
+        span_ids = batch.col("span_id")
+        parent_ids = batch.col("parent_span_id")
+        services = batch.col("service").astype(np.int64)
+
+        order = np.argsort(span_ids, kind="stable")
+        sorted_ids = span_ids[order]
+        pos = np.searchsorted(sorted_ids, parent_ids)
+        pos = np.clip(pos, 0, len(batch) - 1)
+        parent_row = order[pos]
+        has_parent = (parent_ids != 0) & (sorted_ids[pos] == parent_ids)
+
+        cross = has_parent & (services[parent_row] != services)
+        rows = np.nonzero(cross)[0]
+        if len(rows) == 0:
+            from ...pdata.metrics import MetricBatch
+
+            return MetricBatch.empty()
+
+        client = services[parent_row[rows]]
+        server = services[rows]
+        failed = (batch.col("status_code")[rows] == StatusCode.ERROR)
+        dur_ms = batch.duration_ns[rows] / 1e6
+
+        edges = np.stack([client, server], axis=1)
+        uniq, inverse = np.unique(edges, axis=0, return_inverse=True)
+        G = len(uniq)
+        total = np.bincount(inverse, minlength=G)
+        fails = np.bincount(inverse, weights=failed.astype(np.float64),
+                            minlength=G)
+        flat, dur_sum = group_histograms(inverse, dur_ms, self.bounds, G)
+
+        now = time.time_ns()
+        mb = MetricBatchBuilder()
+        for g in range(G):
+            attrs = {"client": batch.string_at(int(uniq[g, 0])),
+                     "server": batch.string_at(int(uniq[g, 1]))}
+            mb.add_point(name="traces.service.graph.request.total",
+                         metric_type=MetricType.SUM, value=float(total[g]),
+                         time_unix_nano=now, attrs=attrs)
+            if fails[g]:
+                mb.add_point(
+                    name="traces.service.graph.request.failed.total",
+                    metric_type=MetricType.SUM, value=float(fails[g]),
+                    time_unix_nano=now, attrs=attrs)
+            mb.add_point(name="traces.service.graph.request.duration",
+                         metric_type=MetricType.HISTOGRAM,
+                         value=float(dur_sum[g]), time_unix_nano=now,
+                         attrs=attrs,
+                         histogram={"bounds": tuple(self.bounds.tolist()),
+                                    "counts": flat[g].copy(),
+                                    "sum": float(dur_sum[g]),
+                                    "count": int(total[g])})
+        return mb.build()
+
+
+register(Factory(
+    type_name="servicegraph",
+    kind=ComponentKind.CONNECTOR,
+    create=ServiceGraphConnector,
+    default_config=lambda: {"histogram_bounds_ms": list(_DEFAULT_BOUNDS_MS)},
+))

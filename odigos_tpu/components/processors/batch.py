@@ -13,7 +13,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from ...pdata.spans import SpanBatch, concat_batches
+from ...pdata import concat_any
+from ...pdata.spans import SpanBatch
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
 
@@ -61,7 +62,7 @@ class BatchProcessor(Processor):
             self._send(taken)
 
     def _send(self, batches: list[SpanBatch]) -> None:
-        merged = concat_batches(batches)
+        merged = concat_any(batches)
         if not merged:
             return
         max_size = self.send_batch_max_size

@@ -1,0 +1,150 @@
+"""Conditional attributes processor (the odigosconditionalattributes
+equivalent).
+
+Adds new attributes to spans (and metric points) based on the value of an
+existing attribute, per collector/processors/odigosconditionalattributes/
+processor.go: each rule names a ``field_to_check`` (span attrs → scope name →
+resource attrs lookup order; the special key ``instrumentation_scope.name``
+reads the scope), maps observed values to actions (static ``value`` or copy
+``from_field``), and a ``global_default`` fills every configured new
+attribute that no rule set.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ...pdata.metrics import MetricBatch
+from ...pdata.spans import SpanBatch
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+SCOPE_NAME_KEY = "instrumentation_scope.name"
+
+
+class ConditionalAttributesProcessor(Processor):
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.rules = config.get("rules", [])
+        self.global_default = config.get("global_default", "")
+        self.new_attribute_names = {
+            action.get("new_attribute")
+            for rule in self.rules
+            for actions in rule.get(
+                "new_attribute_value_configurations", {}).values()
+            for action in actions
+            if action.get("new_attribute")}
+
+    # --------------------------------------------------------------- spans
+    def _span_updates(self, batch: SpanBatch, i: int,
+                      scope_name: str) -> Optional[dict[str, str]]:
+        attrs = batch.span_attrs[i]
+        res = batch.resources[int(batch.col("resource_index")[i])]
+        added: dict[str, str] = {}
+        for rule in self.rules:
+            field = rule.get("field_to_check", "")
+            if field == SCOPE_NAME_KEY:
+                checked = scope_name
+            else:
+                v = attrs.get(field)
+                if v is None:
+                    v = res.get(field)
+                checked = "" if v is None else str(v)
+            actions = rule.get(
+                "new_attribute_value_configurations", {}).get(checked)
+            if not actions:
+                continue
+            for action in actions:
+                new_key = action.get("new_attribute")
+                if not new_key or new_key in attrs or new_key in added:
+                    continue
+                if action.get("value"):
+                    added[new_key] = action["value"]
+                elif action.get("from_field"):
+                    src = action["from_field"]
+                    if src == SCOPE_NAME_KEY:
+                        added[new_key] = scope_name
+                    else:
+                        v = attrs.get(src, res.get(src))
+                        if v is not None:
+                            added[new_key] = str(v)
+        for new_key in self.new_attribute_names:
+            if new_key not in attrs and new_key not in added \
+                    and self.global_default:
+                added[new_key] = self.global_default
+        return added or None
+
+    def process(self, batch):
+        if isinstance(batch, MetricBatch):
+            return self._process_metrics(batch)
+        scope_col = batch.col("scope")
+        out = batch
+        updates: list[tuple[int, dict[str, str]]] = []
+        for i in range(len(batch)):
+            scope_name = batch.string_at(int(scope_col[i])) \
+                if scope_col[i] >= 0 else ""
+            added = self._span_updates(batch, i, scope_name)
+            if added:
+                updates.append((i, added))
+        if not updates:
+            return out
+        # group rows by identical update payloads → one vectorized pass each
+        import numpy as np
+        by_payload: dict[tuple, tuple[dict[str, str], list[int]]] = {}
+        for i, added in updates:
+            key = tuple(sorted(added.items()))
+            by_payload.setdefault(key, (added, []))[1].append(i)
+        for added, rows in by_payload.values():
+            mask = np.zeros(len(batch), dtype=bool)
+            mask[rows] = True
+            out = out.with_span_attrs(
+                {k: [v] * len(rows) for k, v in added.items()}, mask)
+        return out
+
+    # ------------------------------------------------------------- metrics
+    def _process_metrics(self, batch: MetricBatch) -> MetricBatch:
+        from dataclasses import replace
+
+        new_attrs = list(batch.point_attrs)
+        changed = False
+        for i, attrs in enumerate(new_attrs):
+            added: dict[str, str] = {}
+            for rule in self.rules:
+                field = rule.get("field_to_check_metrics")
+                if not field:
+                    continue  # rule skipped for metrics (README contract)
+                checked = attrs.get(field)
+                actions = rule.get(
+                    "new_attribute_value_configurations", {}).get(
+                        "" if checked is None else str(checked))
+                if not actions:
+                    continue
+                for action in actions:
+                    new_key = action.get("new_attribute")
+                    if not new_key or new_key in attrs or new_key in added:
+                        continue
+                    if action.get("value"):
+                        added[new_key] = action["value"]
+                    elif action.get("from_field"):
+                        v = attrs.get(action["from_field"])
+                        if v is not None:
+                            added[new_key] = str(v)
+            for new_key in self.new_attribute_names:
+                if new_key not in attrs and new_key not in added \
+                        and self.global_default:
+                    added[new_key] = self.global_default
+            if added:
+                new_attrs[i] = {**attrs, **added}
+                changed = True
+        if not changed:
+            return batch
+        return replace(batch, point_attrs=tuple(new_attrs))
+
+
+register(Factory(
+    type_name="odigosconditionalattributes",
+    kind=ComponentKind.PROCESSOR,
+    create=ConditionalAttributesProcessor,
+    default_config=lambda: {"rules": [], "global_default": ""},
+))

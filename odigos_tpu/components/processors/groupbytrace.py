@@ -62,9 +62,11 @@ class GroupByTraceProcessor(Processor):
             self.next_consumer.consume(evict)
 
     def _evict_cutoff_locked(self) -> float:
-        """First-seen cutoff that keeps the newest ``num_traces`` traces."""
+        """First-seen cutoff that keeps the newest ``num_traces`` traces:
+        release the oldest ``len - num_traces`` (cutoff is the newest of
+        those — _release_locked releases first_seen <= cutoff)."""
         times = sorted(self._first_seen.values())
-        return times[len(times) - self.num_traces]
+        return times[len(times) - self.num_traces - 1]
 
     # -------------------------------------------------------------- flush
     def _release_locked(self, cutoff: float) -> Optional[SpanBatch]:

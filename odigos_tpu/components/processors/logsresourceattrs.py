@@ -1,0 +1,129 @@
+"""Logs resource-attributes processor (the odigoslogsresourceattrsprocessor
+equivalent).
+
+Enriches filelog-collected log records with workload metadata, per
+collector/processors/odigoslogsresourceattrsprocessor/processor.go: the pod
+UID is read from the ``k8s.pod.uid`` resource attribute or parsed out of the
+filelog receiver's ``log.file.path``
+(``/var/log/pods/{ns}_{pod}_{uid}/{container}/x.log``), then resolved to
+workload identity and written back as ``service.name`` / ``k8s.pod.name`` /
+``k8s.namespace.name`` / ``k8s.<kind>.name``.
+
+The reference resolves UIDs via a node-local kube metadata watch; ours
+resolves through a pluggable ``PodMetadataResolver`` — in-cluster that's the
+control plane's workload store (controlplane.store), in tests a dict. The
+enrichment itself is one pass over the *resource table*, not the records
+(columnar: O(distinct resources)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Protocol
+
+from ...pdata.logs import LogBatch
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+LOG_FILE_PATH_ATTR = "log.file.path"
+
+_KIND_TO_ATTR = {
+    "deployment": "k8s.deployment.name",
+    "daemonset": "k8s.daemonset.name",
+    "statefulset": "k8s.statefulset.name",
+    "job": "k8s.job.name",
+    "cronjob": "k8s.cronjob.name",
+    "deploymentconfig": "k8s.deployment.name",
+    "argorollout": "k8s.argoproj.rollout.name",
+    "staticpod": "k8s.pod.name",
+}
+
+
+@dataclass(frozen=True)
+class PodWorkloadMeta:
+    namespace: str
+    pod_name: str
+    workload_kind: str  # lowercase kind, key of _KIND_TO_ATTR
+    workload_name: str
+
+
+class PodMetadataResolver(Protocol):
+    def resolve_pod_uid(self, uid: str) -> Optional[PodWorkloadMeta]: ...
+
+
+class DictResolver:
+    """Test/static resolver: {uid: PodWorkloadMeta}."""
+
+    def __init__(self, table: dict[str, PodWorkloadMeta]):
+        self.table = dict(table)
+
+    def resolve_pod_uid(self, uid: str) -> Optional[PodWorkloadMeta]:
+        return self.table.get(uid)
+
+
+def extract_pod_uid_from_path(path: str) -> Optional[str]:
+    """/var/log/pods/{ns}_{pod}_{uid}/{container}/x.log → uid."""
+    for i, segment in enumerate(path.split("/")):
+        if segment == "pods":
+            parts = path.split("/")
+            if i + 1 < len(parts):
+                pieces = parts[i + 1].rsplit("_", 2)
+                if len(pieces) == 3:
+                    return pieces[2]
+    return None
+
+
+class LogsResourceAttrsProcessor(Processor):
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        resolver = config.get("resolver")
+        if resolver is None:
+            resolver = DictResolver(config.get("pod_metadata", {}))
+        self.resolver: PodMetadataResolver = resolver
+
+    def process(self, batch: LogBatch) -> Optional[LogBatch]:
+        if not isinstance(batch, LogBatch) or not batch.resources:
+            return batch
+        # the filelog receiver records log.file.path per *record*; fall back
+        # to the first record path seen for each resource
+        record_paths: dict[int, str] = {}
+        res_col = batch.col("resource_index")
+        for i, attrs in enumerate(batch.record_attrs):
+            ri = int(res_col[i])
+            if ri >= 0 and ri not in record_paths:
+                path = attrs.get(LOG_FILE_PATH_ATTR)
+                if isinstance(path, str):
+                    record_paths[ri] = path
+        new_resources = []
+        changed = False
+        for ridx, res in enumerate(batch.resources):
+            uid = res.get("k8s.pod.uid")
+            if not uid:
+                path = res.get(LOG_FILE_PATH_ATTR, record_paths.get(ridx))
+                if isinstance(path, str):
+                    uid = extract_pod_uid_from_path(path)
+            meta = self.resolver.resolve_pod_uid(uid) if uid else None
+            if meta is None:
+                new_resources.append(res)
+                continue
+            enriched = dict(res)
+            enriched.setdefault("service.name", meta.workload_name)
+            enriched["k8s.pod.name"] = meta.pod_name
+            enriched["k8s.namespace.name"] = meta.namespace
+            kind_attr = _KIND_TO_ATTR.get(meta.workload_kind)
+            if kind_attr:
+                enriched[kind_attr] = meta.workload_name
+            new_resources.append(enriched)
+            changed = True
+        if not changed:
+            return batch
+        return batch.with_resources(new_resources)
+
+
+register(Factory(
+    type_name="odigoslogsresourceattrs",
+    kind=ComponentKind.PROCESSOR,
+    create=LogsResourceAttrsProcessor,
+    default_config=dict,
+))

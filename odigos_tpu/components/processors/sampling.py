@@ -295,6 +295,8 @@ class SpanAttributeRule(SamplingRule):
                 return not valid
             if not valid:
                 return False
+            if op == "exists" and not self.json_path:
+                return True  # attribute present and parses as JSON
             found, sub = _jsonpath_get(self.json_path, parsed)
             if op in ("exists", "jsonpath_exists", "contains_key"):
                 return found and sub is not None

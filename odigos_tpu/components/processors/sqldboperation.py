@@ -1,0 +1,84 @@
+"""SQL DB operation processor (the odigossqldboperationprocessor equivalent).
+
+Derives ``db.operation.name`` from ``db.query.text`` and appends it to the
+span name, mirroring collector/processors/odigossqldboperationprocessor/
+processor.go: spans that already carry ``db.operation.name`` are untouched,
+unknown operations are left unset, and resources whose language is in the
+exclusion list are skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+_OPERATIONS = ("SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
+               "ALTER")
+
+
+def detect_sql_operation(query: str) -> Optional[str]:
+    """First keyword match at the start of the (whitespace-trimmed) query;
+    falls back to a scan for the first operation keyword anywhere (CTEs like
+    "WITH x AS (SELECT ...)" resolve to SELECT)."""
+    q = query.lstrip().upper()
+    for op in _OPERATIONS:
+        if q.startswith(op):
+            return op
+    best: tuple[int, str] | None = None
+    for op in _OPERATIONS:
+        pos = q.find(op)
+        if pos >= 0 and (best is None or pos < best[0]):
+            best = (pos, op)
+    return best[1] if best else None
+
+
+class SqlDbOperationProcessor(Processor):
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.excluded_languages = {
+            str(lang).lower()
+            for lang in config.get("excluded_languages", [])}
+
+    def process(self, batch: SpanBatch) -> Optional[SpanBatch]:
+        res_ok = np.fromiter(
+            (str(r.get("telemetry.sdk.language", "")).lower()
+             not in self.excluded_languages
+             for r in batch.resources),
+            bool, len(batch.resources))
+        span_ok = res_ok[batch.col("resource_index")] if len(batch) else \
+            np.zeros(0, bool)
+        names = batch.span_names()
+        new_names: dict[int, str] = {}
+        rows: list[int] = []
+        ops: list[str] = []
+        for i in np.nonzero(span_ok)[0]:
+            attrs = batch.span_attrs[i]
+            query = attrs.get("db.query.text")
+            if not isinstance(query, str) or "db.operation.name" in attrs:
+                continue
+            op = detect_sql_operation(query)
+            if op is None:
+                continue
+            rows.append(int(i))
+            ops.append(op)
+            new_names[int(i)] = f"{names[i]} {op}"
+        if not rows:
+            return batch
+        mask = np.zeros(len(batch), dtype=bool)
+        mask[rows] = True
+        return (batch.with_names(new_names)
+                .with_span_attr("db.operation.name", ops, mask))
+
+
+register(Factory(
+    type_name="odigossqldboperation",
+    kind=ComponentKind.PROCESSOR,
+    create=SqlDbOperationProcessor,
+    default_config=lambda: {"excluded_languages": []},
+))

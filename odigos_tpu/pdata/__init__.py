@@ -21,8 +21,41 @@ from .spans import (
 )
 from .gen import TraceShape, synthesize_traces
 from .traces import TraceView, service_span_mask, trace_keys
+from .metrics import (
+    MetricBatch,
+    MetricBatchBuilder,
+    MetricType,
+    concat_metric_batches,
+)
+from .logs import LogBatch, LogBatchBuilder, Severity, concat_log_batches
+
+
+def concat_any(batches):
+    """Concatenate same-signal batches, dispatching on batch type (the batch
+    processor is signal-agnostic, like the upstream collector's)."""
+    batches = list(batches)
+    if not batches:
+        return SpanBatch.empty()
+    first = batches[0]
+    if isinstance(first, SpanBatch):
+        return concat_batches(batches)
+    if isinstance(first, MetricBatch):
+        return concat_metric_batches(batches)
+    if isinstance(first, LogBatch):
+        return concat_log_batches(batches)
+    raise TypeError(f"cannot concat batches of type {type(first).__name__}")
+
 
 __all__ = [
+    "MetricBatch",
+    "MetricBatchBuilder",
+    "MetricType",
+    "concat_metric_batches",
+    "LogBatch",
+    "LogBatchBuilder",
+    "Severity",
+    "concat_log_batches",
+    "concat_any",
     "TraceView",
     "service_span_mask",
     "trace_keys",

@@ -1,0 +1,165 @@
+"""Columnar log batches.
+
+One row per log record, mirroring the plog shapes the reference's filelog
+pipeline carries (node collector `filelog` receiver →
+odigoslogsresourceattrsprocessor → exporters; SURVEY.md §2.3). Bodies are
+kept in a side list (full fidelity, exporter-only); severity/timestamps/trace
+correlation are numpy columns so filters stay vectorized.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class Severity(enum.IntEnum):
+    """OTLP severity numbers (coarse buckets)."""
+
+    UNSPECIFIED = 0
+    TRACE = 1
+    DEBUG = 5
+    INFO = 9
+    WARN = 13
+    ERROR = 17
+    FATAL = 21
+
+
+_COLUMNS: dict[str, np.dtype] = {
+    "time_unix_nano": np.dtype(np.uint64),
+    "severity": np.dtype(np.int8),
+    "trace_id_hi": np.dtype(np.uint64),
+    "trace_id_lo": np.dtype(np.uint64),
+    "span_id": np.dtype(np.uint64),
+    "resource_index": np.dtype(np.int32),
+}
+
+_EMPTY_DICT: dict[str, Any] = {}
+
+
+@dataclass(frozen=True)
+class LogBatch:
+    resources: tuple[dict[str, Any], ...]
+    bodies: tuple[str, ...]
+    record_attrs: tuple[dict[str, Any], ...]
+    columns: dict[str, np.ndarray] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.bodies)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def col(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def filter(self, mask: np.ndarray) -> "LogBatch":
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (len(self),):
+            raise ValueError(f"mask shape {mask.shape} != ({len(self)},)")
+        cols = {k: v[mask] for k, v in self.columns.items()}
+        bodies = tuple(b for b, keep in zip(self.bodies, mask) if keep)
+        attrs = tuple(a for a, keep in zip(self.record_attrs, mask) if keep)
+        return replace(self, columns=cols, bodies=bodies, record_attrs=attrs)
+
+    def take(self, indices: np.ndarray) -> "LogBatch":
+        indices = np.asarray(indices)
+        cols = {k: v[indices] for k, v in self.columns.items()}
+        bodies = tuple(self.bodies[int(i)] for i in indices)
+        attrs = tuple(self.record_attrs[int(i)] for i in indices)
+        return replace(self, columns=cols, bodies=bodies, record_attrs=attrs)
+
+    def with_resources(self, resources: Sequence[dict[str, Any]]) -> "LogBatch":
+        """Replace the resource table (the enrichment primitive —
+        odigoslogsresourceattrsprocessor rewrites resource attrs in place)."""
+        if len(resources) != len(self.resources):
+            raise ValueError("resource table length must be preserved")
+        return replace(self, resources=tuple(dict(r) for r in resources))
+
+    def iter_records(self) -> Iterator[dict[str, Any]]:
+        c = self.columns
+        for i in range(len(self)):
+            ri = int(c["resource_index"][i])
+            yield {
+                "time_unix_nano": int(c["time_unix_nano"][i]),
+                "severity": Severity(int(c["severity"][i])).name
+                if int(c["severity"][i]) in Severity._value2member_map_
+                else int(c["severity"][i]),
+                "body": self.bodies[i],
+                "trace_id": f"{int(c['trace_id_hi'][i]):016x}"
+                            f"{int(c['trace_id_lo'][i]):016x}",
+                "span_id": f"{int(c['span_id'][i]):016x}",
+                "attributes": dict(self.record_attrs[i]),
+                "resource": dict(self.resources[ri])
+                if 0 <= ri < len(self.resources) else {},
+            }
+
+    @staticmethod
+    def empty() -> "LogBatch":
+        cols = {k: np.empty(0, dtype=dt) for k, dt in _COLUMNS.items()}
+        return LogBatch(resources=(), bodies=(), record_attrs=(), columns=cols)
+
+
+class LogBatchBuilder:
+    def __init__(self) -> None:
+        self._resources: list[dict[str, Any]] = []
+        self._bodies: list[str] = []
+        self._attrs: list[dict[str, Any]] = []
+        self._cols: dict[str, list] = {k: [] for k in _COLUMNS}
+
+    def add_resource(self, attrs: dict[str, Any]) -> int:
+        self._resources.append(dict(attrs))
+        return len(self._resources) - 1
+
+    def add_record(self, *, body: str, time_unix_nano: int = 0,
+                   severity: int = Severity.INFO,
+                   trace_id: int = 0, span_id: int = 0,
+                   resource_index: int = -1,
+                   attrs: Optional[dict[str, Any]] = None) -> None:
+        c = self._cols
+        c["time_unix_nano"].append(int(time_unix_nano))
+        c["severity"].append(int(severity))
+        c["trace_id_hi"].append((trace_id >> 64) & 0xFFFFFFFFFFFFFFFF)
+        c["trace_id_lo"].append(trace_id & 0xFFFFFFFFFFFFFFFF)
+        c["span_id"].append(span_id & 0xFFFFFFFFFFFFFFFF)
+        c["resource_index"].append(int(resource_index))
+        self._bodies.append(body)
+        self._attrs.append(attrs if attrs else _EMPTY_DICT)
+
+    def __len__(self) -> int:
+        return len(self._bodies)
+
+    def build(self) -> LogBatch:
+        cols = {k: np.asarray(v, dtype=_COLUMNS[k])
+                for k, v in self._cols.items()}
+        return LogBatch(resources=tuple(self._resources),
+                        bodies=tuple(self._bodies),
+                        record_attrs=tuple(self._attrs), columns=cols)
+
+
+def concat_log_batches(batches: Sequence[LogBatch]) -> LogBatch:
+    batches = [b for b in batches if len(b) > 0]
+    if not batches:
+        return LogBatch.empty()
+    if len(batches) == 1:
+        return batches[0]
+    resources: list[dict[str, Any]] = []
+    bodies: list[str] = []
+    attrs: list[dict[str, Any]] = []
+    out_cols: dict[str, list[np.ndarray]] = {k: [] for k in _COLUMNS}
+    for b in batches:
+        res_base = len(resources)
+        resources.extend(b.resources)
+        for k in _COLUMNS:
+            colv = b.columns[k]
+            if k == "resource_index":
+                colv = np.where(colv >= 0, colv + res_base, -1)
+            out_cols[k].append(colv.astype(_COLUMNS[k], copy=False))
+        bodies.extend(b.bodies)
+        attrs.extend(b.record_attrs)
+    cols = {k: np.concatenate(v) for k, v in out_cols.items()}
+    return LogBatch(resources=tuple(resources), bodies=tuple(bodies),
+                    record_attrs=tuple(attrs), columns=cols)

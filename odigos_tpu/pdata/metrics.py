@@ -1,0 +1,216 @@
+"""Columnar metric batches.
+
+Same structure-of-arrays discipline as SpanBatch (spans.py): one row per data
+point, numpy columns for fixed-width fields, interned names, side lists for
+attributes. Covers what the data plane produces and consumes — spanmetrics /
+servicegraph connector outputs, odigostrafficmetrics own-telemetry, and the
+gateway's metrics pipelines (reference shapes: pmetric in
+collector/processors/odigostrafficmetrics/processor.go and the spanmetrics /
+servicegraph connectors wired by common/pipelinegen/config_builder.go:231).
+
+Histogram points carry their buckets in a side list (`histograms`): per-point
+``{"bounds": tuple, "counts": np.ndarray, "sum": float, "count": int}``.
+Gauge/sum points use the ``value`` column and a None histogram entry.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class MetricType(enum.IntEnum):
+    GAUGE = 0
+    SUM = 1  # monotonic cumulative sum
+    HISTOGRAM = 2
+
+
+_COLUMNS: dict[str, np.dtype] = {
+    "name": np.dtype(np.int32),          # string-table index
+    "type": np.dtype(np.int8),           # MetricType
+    "value": np.dtype(np.float64),       # gauge/sum value; histogram: sum
+    "time_unix_nano": np.dtype(np.uint64),
+    "resource_index": np.dtype(np.int32),
+}
+
+_EMPTY_DICT: dict[str, Any] = {}
+
+
+@dataclass(frozen=True)
+class MetricBatch:
+    strings: tuple[str, ...]
+    resources: tuple[dict[str, Any], ...]
+    point_attrs: tuple[dict[str, Any], ...]
+    histograms: tuple[Optional[dict[str, Any]], ...]
+    columns: dict[str, np.ndarray] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        if not self.columns:
+            return 0
+        return int(self.columns["name"].shape[0])
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def col(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def string_at(self, index: int) -> str:
+        return self.strings[index] if 0 <= index < len(self.strings) else ""
+
+    def metric_names(self) -> list[str]:
+        return [self.string_at(i) for i in self.columns["name"]]
+
+    def filter(self, mask: np.ndarray) -> "MetricBatch":
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (len(self),):
+            raise ValueError(f"mask shape {mask.shape} != ({len(self)},)")
+        cols = {k: v[mask] for k, v in self.columns.items()}
+        attrs = tuple(a for a, keep in zip(self.point_attrs, mask) if keep)
+        hists = tuple(h for h, keep in zip(self.histograms, mask) if keep)
+        return replace(self, columns=cols, point_attrs=attrs, histograms=hists)
+
+    def take(self, indices: np.ndarray) -> "MetricBatch":
+        indices = np.asarray(indices)
+        cols = {k: v[indices] for k, v in self.columns.items()}
+        attrs = tuple(self.point_attrs[int(i)] for i in indices)
+        hists = tuple(self.histograms[int(i)] for i in indices)
+        return replace(self, columns=cols, point_attrs=attrs, histograms=hists)
+
+    def iter_points(self) -> Iterator[dict[str, Any]]:
+        """Debug/exporter-only per-point dict view. NOT for the hot path."""
+        c = self.columns
+        for i in range(len(self)):
+            d = {
+                "name": self.string_at(int(c["name"][i])),
+                "type": MetricType(int(c["type"][i])).name,
+                "value": float(c["value"][i]),
+                "time_unix_nano": int(c["time_unix_nano"][i]),
+                "attributes": dict(self.point_attrs[i]),
+                "resource": dict(self.resources[int(c["resource_index"][i])])
+                if 0 <= int(c["resource_index"][i]) < len(self.resources)
+                else {},
+            }
+            h = self.histograms[i]
+            if h is not None:
+                d["histogram"] = {"bounds": list(h["bounds"]),
+                                  "counts": np.asarray(h["counts"]).tolist(),
+                                  "sum": float(h["sum"]),
+                                  "count": int(h["count"])}
+            yield d
+
+    @staticmethod
+    def empty() -> "MetricBatch":
+        cols = {k: np.empty(0, dtype=dt) for k, dt in _COLUMNS.items()}
+        return MetricBatch(strings=(), resources=(), point_attrs=(),
+                           histograms=(), columns=cols)
+
+
+class MetricBatchBuilder:
+    def __init__(self) -> None:
+        self._strings: list[str] = []
+        self._intern: dict[str, int] = {}
+        self._resources: list[dict[str, Any]] = []
+        self._point_attrs: list[dict[str, Any]] = []
+        self._histograms: list[Optional[dict[str, Any]]] = []
+        self._cols: dict[str, list] = {k: [] for k in _COLUMNS}
+
+    def intern(self, s: str) -> int:
+        idx = self._intern.get(s)
+        if idx is None:
+            idx = len(self._strings)
+            self._strings.append(s)
+            self._intern[s] = idx
+        return idx
+
+    def add_resource(self, attrs: dict[str, Any]) -> int:
+        self._resources.append(dict(attrs))
+        return len(self._resources) - 1
+
+    def add_point(self, *, name: str, value: float = 0.0,
+                  metric_type: int = MetricType.GAUGE,
+                  time_unix_nano: int = 0,
+                  attrs: Optional[dict[str, Any]] = None,
+                  resource_index: int = -1,
+                  histogram: Optional[dict[str, Any]] = None) -> None:
+        c = self._cols
+        c["name"].append(self.intern(name))
+        c["type"].append(int(metric_type))
+        c["value"].append(float(value))
+        c["time_unix_nano"].append(int(time_unix_nano))
+        c["resource_index"].append(int(resource_index))
+        self._point_attrs.append(attrs if attrs else _EMPTY_DICT)
+        self._histograms.append(histogram)
+
+    def __len__(self) -> int:
+        return len(self._point_attrs)
+
+    def build(self) -> MetricBatch:
+        cols = {k: np.asarray(v, dtype=_COLUMNS[k])
+                for k, v in self._cols.items()}
+        return MetricBatch(strings=tuple(self._strings),
+                           resources=tuple(self._resources),
+                           point_attrs=tuple(self._point_attrs),
+                           histograms=tuple(self._histograms),
+                           columns=cols)
+
+
+def group_histograms(inverse: np.ndarray, values: np.ndarray,
+                     bounds: np.ndarray, n_groups: int,
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Per-group explicit-bucket histograms in one vectorized pass.
+
+    ``inverse`` assigns each value a group id < n_groups. Returns
+    ``(counts, sums)`` with counts of shape (n_groups, len(bounds)+1) — the
+    flat (group, bucket) bincount trick shared by the spanmetrics and
+    servicegraph connectors. Bucket b holds values <= bounds[b] (upper
+    inclusive), the last bucket is overflow.
+    """
+    bucket = np.searchsorted(bounds, values, side="left")
+    n_buckets = len(bounds) + 1
+    counts = np.bincount(inverse * n_buckets + bucket,
+                         minlength=n_groups * n_buckets
+                         ).reshape(n_groups, n_buckets)
+    sums = np.bincount(inverse, weights=values, minlength=n_groups)
+    return counts, sums
+
+
+def concat_metric_batches(batches: Sequence[MetricBatch]) -> MetricBatch:
+    batches = [b for b in batches if len(b) > 0]
+    if not batches:
+        return MetricBatch.empty()
+    if len(batches) == 1:
+        return batches[0]
+    strings: list[str] = []
+    intern: dict[str, int] = {}
+    resources: list[dict[str, Any]] = []
+    point_attrs: list[dict[str, Any]] = []
+    histograms: list[Optional[dict[str, Any]]] = []
+    out_cols: dict[str, list[np.ndarray]] = {k: [] for k in _COLUMNS}
+    for b in batches:
+        remap = np.empty(max(len(b.strings), 1), dtype=np.int32)
+        for i, s in enumerate(b.strings):
+            j = intern.get(s)
+            if j is None:
+                j = len(strings)
+                strings.append(s)
+                intern[s] = j
+            remap[i] = j
+        res_base = len(resources)
+        resources.extend(b.resources)
+        for k in _COLUMNS:
+            colv = b.columns[k]
+            if k == "name":
+                colv = remap[colv]
+            elif k == "resource_index":
+                colv = np.where(colv >= 0, colv + res_base, -1)
+            out_cols[k].append(colv.astype(_COLUMNS[k], copy=False))
+        point_attrs.extend(b.point_attrs)
+        histograms.extend(b.histograms)
+    cols = {k: np.concatenate(v) for k, v in out_cols.items()}
+    return MetricBatch(strings=tuple(strings), resources=tuple(resources),
+                       point_attrs=tuple(point_attrs),
+                       histograms=tuple(histograms), columns=cols)

@@ -182,6 +182,27 @@ class SpanBatch:
             new_attrs[i] = d
         return replace(self, span_attrs=tuple(new_attrs))
 
+    def with_names(self, new_names: dict[int, str]) -> "SpanBatch":
+        """Return a batch where span ``i``'s name is ``new_names[i]`` for the
+        given rows (span-name rewrites: urltemplate, sqldboperation). New
+        names are interned into an extended string table; untouched rows share
+        the original column data."""
+        if not new_names:
+            return self
+        strings = list(self.strings)
+        intern = {s: i for i, s in enumerate(strings)}
+        name_col = self.columns["name"].copy()
+        for row, s in new_names.items():
+            idx = intern.get(s)
+            if idx is None:
+                idx = len(strings)
+                strings.append(s)
+                intern[s] = idx
+            name_col[row] = idx
+        cols = dict(self.columns)
+        cols["name"] = name_col
+        return replace(self, strings=tuple(strings), columns=cols)
+
     def group_key_by_resource(self, attr_keys: Sequence[str]) -> list[tuple]:
         """Per-span grouping key from resource attributes (used by routers).
 

@@ -1,0 +1,354 @@
+"""Data-plane enrichment processors + metric-deriving connectors tests
+(urltemplate, sqldboperation, conditionalattributes, logsresourceattrs,
+spanmetrics, servicegraph, metric/log pdata)."""
+
+import numpy as np
+import pytest
+
+from odigos_tpu.components.connectors.servicegraph import ServiceGraphConnector
+from odigos_tpu.components.connectors.spanmetrics import SpanMetricsConnector
+from odigos_tpu.components.processors.conditionalattributes import (
+    ConditionalAttributesProcessor)
+from odigos_tpu.components.processors.logsresourceattrs import (
+    DictResolver, LogsResourceAttrsProcessor, PodWorkloadMeta,
+    extract_pod_uid_from_path)
+from odigos_tpu.components.processors.sqldboperation import (
+    SqlDbOperationProcessor, detect_sql_operation)
+from odigos_tpu.components.processors.urltemplate import (
+    UrlTemplateProcessor, parse_rule)
+from odigos_tpu.pdata import (
+    LogBatchBuilder, MetricBatch, MetricBatchBuilder, MetricType,
+    SpanBatchBuilder, SpanKind, StatusCode, concat_any, concat_log_batches,
+    concat_metric_batches, synthesize_traces)
+
+
+def span_batch(rows):
+    """rows: list of dicts with name/kind/attrs/... overrides."""
+    b = SpanBatchBuilder()
+    for i, row in enumerate(rows):
+        b.add_span(
+            trace_id=row.get("trace_id", 1), span_id=i + 1,
+            parent_span_id=row.get("parent", 0),
+            name=row.get("name", f"op{i}"),
+            service=row.get("service", "svc"),
+            kind=row.get("kind", SpanKind.SERVER),
+            status_code=row.get("status", StatusCode.UNSET),
+            start_unix_nano=1_000_000_000,
+            end_unix_nano=1_000_000_000 + row.get("dur_ms", 10) * 1_000_000,
+            attrs=row.get("attrs"))
+    return b.build()
+
+
+class Sink:
+    def __init__(self):
+        self.batches = []
+
+    def consume(self, batch):
+        self.batches.append(batch)
+
+
+# ------------------------------------------------------------ urltemplate
+def test_urltemplate_heuristics():
+    p = UrlTemplateProcessor("u", {})
+    assert p.templatize("/user/1234567")[0] == "/user/{id}"
+    assert p.templatize(
+        "/o/123e4567-e89b-12d3-a456-426614174000")[0] == "/o/{id}"
+    assert p.templatize("/h/deadbeefdeadbeef")[0] == "/h/{id}"
+    assert p.templatize("/d/2025-12-04")[0] == "/d/{id}"
+    assert p.templatize("/m/bob@example.com")[0] == "/m/{id}"
+    assert p.templatize("/users/profile")[0] == "/users/profile"  # static kept
+    assert p.templatize("/a/42/b")[0] == "/a/{id}/b"
+
+
+def test_urltemplate_rules_and_custom_ids():
+    p = UrlTemplateProcessor("u", {
+        "templatization_rules": [r"/v1/{userId:\d+}/friends"],
+        "custom_ids": [{"regexp": r"^inc_\d+$", "template_name": "incident"}],
+    })
+    assert p.templatize("/v1/123/friends")[0] == "/v1/{userId}/friends"
+    # rule doesn't match (letters) → heuristics (no hit)
+    assert p.templatize("/v1/abc/friends")[0] == "/v1/abc/friends"
+    assert p.templatize("/x/inc_123")[0] == "/x/{incident}"
+    with pytest.raises(ValueError):
+        parse_rule("no-slash")
+
+
+def test_urltemplate_process_server_and_client():
+    batch = span_batch([
+        {"name": "GET", "kind": SpanKind.SERVER,
+         "attrs": {"http.request.method": "GET", "url.path": "/user/999999999"}},
+        {"name": "POST /checkout", "kind": SpanKind.CLIENT,
+         "attrs": {"http.method": "POST",
+                   "http.url": "http://shop/cart/12345678"}},
+        {"name": "GET", "kind": SpanKind.SERVER,  # already templated → skip
+         "attrs": {"http.request.method": "GET", "http.route": "/u/{id}",
+                   "url.path": "/u/4"}},
+        {"name": "work"},  # not http → skip
+    ])
+    out = UrlTemplateProcessor("u", {}).process(batch)
+    assert out.span_names()[0] == "GET /user/{id}"
+    assert out.span_attrs[0]["http.route"] == "/user/{id}"
+    # client span: url.template set, name NOT rewritten (≠ method)
+    assert out.span_attrs[1]["url.template"] == "/cart/{id}"
+    assert out.span_names()[1] == "POST /checkout"
+    assert out.span_attrs[2]["http.route"] == "/u/{id}"
+    assert "url.template" not in out.span_attrs[3]
+
+
+def test_urltemplate_include_exclude():
+    b = SpanBatchBuilder()
+    ri = b.add_resource({"service.name": "a", "k8s.namespace.name": "default",
+                         "k8s.deployment.name": "noisy"})
+    b.add_span(trace_id=1, span_id=1, name="GET", service="a",
+               kind=SpanKind.SERVER, start_unix_nano=0, end_unix_nano=1,
+               resource_index=ri,
+               attrs={"http.method": "GET", "url.path": "/u/1234567"})
+    batch = b.build()
+    excl = UrlTemplateProcessor("u", {"exclude": {"k8s_workloads": [
+        {"namespace": "default", "kind": "deployment", "name": "noisy"}]}})
+    assert "http.route" not in excl.process(batch).span_attrs[0]
+    incl = UrlTemplateProcessor("u", {"include": {"k8s_workloads": [
+        {"namespace": "default", "kind": "deployment", "name": "noisy"}]}})
+    assert incl.process(batch).span_attrs[0]["http.route"] == "/u/{id}"
+
+
+# --------------------------------------------------------- sqldboperation
+def test_detect_sql_operation():
+    assert detect_sql_operation("SELECT * FROM t") == "SELECT"
+    assert detect_sql_operation("  insert into t values (1)") == "INSERT"
+    assert detect_sql_operation("WITH x AS (SELECT 1) SELECT * FROM x") == "SELECT"
+    assert detect_sql_operation("EXPLAIN nothing here") is None
+
+
+def test_sqldboperation_process():
+    batch = span_batch([
+        {"name": "query", "attrs": {"db.query.text": "SELECT * FROM users"}},
+        {"name": "query", "attrs": {"db.query.text": "UPDATE t SET a=1",
+                                    "db.operation.name": "CUSTOM"}},
+        {"name": "other"},
+    ])
+    out = SqlDbOperationProcessor("s", {}).process(batch)
+    assert out.span_attrs[0]["db.operation.name"] == "SELECT"
+    assert out.span_names()[0] == "query SELECT"
+    assert out.span_attrs[1]["db.operation.name"] == "CUSTOM"  # untouched
+    assert out.span_names()[1] == "query"
+    assert "db.operation.name" not in out.span_attrs[2]
+
+
+def test_sqldboperation_language_exclusion():
+    b = SpanBatchBuilder()
+    ri = b.add_resource({"service.name": "a", "telemetry.sdk.language": "go"})
+    b.add_span(trace_id=1, span_id=1, name="q", service="a",
+               start_unix_nano=0, end_unix_nano=1, resource_index=ri,
+               attrs={"db.query.text": "SELECT 1"})
+    out = SqlDbOperationProcessor(
+        "s", {"excluded_languages": ["go"]}).process(b.build())
+    assert "db.operation.name" not in out.span_attrs[0]
+
+
+# -------------------------------------------------- conditionalattributes
+def test_conditional_attributes_static_copy_default():
+    proc = ConditionalAttributesProcessor("c", {
+        "global_default": "other",
+        "rules": [{
+            "field_to_check": "http.route",
+            "new_attribute_value_configurations": {
+                "/checkout": [{"new_attribute": "category",
+                               "value": "revenue"},
+                              {"new_attribute": "who",
+                               "from_field": "user.id"}],
+            }}],
+    })
+    batch = span_batch([
+        {"attrs": {"http.route": "/checkout", "user.id": "u-7"}},
+        {"attrs": {"http.route": "/health"}},
+        {"attrs": {"category": "preset"}},
+    ])
+    out = proc.process(batch)
+    assert out.span_attrs[0]["category"] == "revenue"
+    assert out.span_attrs[0]["who"] == "u-7"
+    assert out.span_attrs[1]["category"] == "other"  # global default
+    assert out.span_attrs[2]["category"] == "preset"  # existing preserved
+
+
+def test_conditional_attributes_scope_name_and_metrics():
+    b = SpanBatchBuilder()
+    b.add_span(trace_id=1, span_id=1, name="n", service="s",
+               start_unix_nano=0, end_unix_nano=1, scope="io.odigos.gin")
+    proc = ConditionalAttributesProcessor("c", {
+        "rules": [{
+            "field_to_check": "instrumentation_scope.name",
+            "field_to_check_metrics": "lib",
+            "new_attribute_value_configurations": {
+                "io.odigos.gin": [{"new_attribute": "framework",
+                                   "value": "gin"}]},
+        }]})
+    out = proc.process(b.build())
+    assert out.span_attrs[0]["framework"] == "gin"
+
+    mb = MetricBatchBuilder()
+    mb.add_point(name="m", value=1.0, attrs={"lib": "io.odigos.gin"})
+    mout = proc.process(mb.build())
+    assert mout.point_attrs[0]["framework"] == "gin"
+
+
+# ------------------------------------------------------ logsresourceattrs
+def test_extract_pod_uid():
+    assert extract_pod_uid_from_path(
+        "/var/log/pods/default_mypod_abc-123/app/0.log") == "abc-123"
+    assert extract_pod_uid_from_path("/tmp/whatever.log") is None
+
+
+def test_logsresourceattrs_enrichment():
+    meta = PodWorkloadMeta(namespace="default", pod_name="web-55-xyz",
+                           workload_kind="deployment", workload_name="web")
+    proc = LogsResourceAttrsProcessor(
+        "l", {"resolver": DictResolver({"uid-1": meta})})
+    lb = LogBatchBuilder()
+    ri = lb.add_resource({})
+    lb.add_record(body="hello", resource_index=ri,
+                  attrs={"log.file.path":
+                         "/var/log/pods/default_web-55-xyz_uid-1/app/0.log"})
+    out = proc.process(lb.build())
+    res = out.resources[0]
+    assert res["service.name"] == "web"
+    assert res["k8s.deployment.name"] == "web"
+    assert res["k8s.pod.name"] == "web-55-xyz"
+    assert res["k8s.namespace.name"] == "default"
+
+
+# ------------------------------------------------------------ spanmetrics
+def test_spanmetrics_red_aggregation():
+    batch = span_batch([
+        {"name": "GET /a", "service": "front", "dur_ms": 10},
+        {"name": "GET /a", "service": "front", "dur_ms": 30},
+        {"name": "GET /a", "service": "front", "dur_ms": 500,
+         "status": StatusCode.ERROR},
+        {"name": "GET /b", "service": "back", "dur_ms": 5},
+    ])
+    conn = SpanMetricsConnector("spanmetrics", {})
+    sink = Sink()
+    conn.set_outputs({"metrics/out": sink})
+    conn.consume(batch)
+    [mb] = sink.batches
+    points = list(mb.iter_points())
+    calls = {(p["attributes"]["service.name"], p["attributes"]["span.name"],
+              p["attributes"]["status.code"]): p["value"]
+             for p in points if p["name"] == "traces.span.metrics.calls"}
+    assert calls[("front", "GET /a", "UNSET")] == 2
+    assert calls[("front", "GET /a", "ERROR")] == 1
+    assert calls[("back", "GET /b", "UNSET")] == 1
+    hists = [p for p in points
+             if p["name"] == "traces.span.metrics.duration"
+             and p["attributes"]["service.name"] == "front"
+             and p["attributes"]["status.code"] == "UNSET"]
+    assert hists[0]["histogram"]["count"] == 2
+    assert hists[0]["histogram"]["sum"] == pytest.approx(40.0)
+    assert sum(hists[0]["histogram"]["counts"]) == 2
+
+
+def test_servicegraph_edges():
+    batch = span_batch([
+        {"name": "GET /", "service": "front", "trace_id": 9},
+        {"name": "charge", "service": "pay", "trace_id": 9, "parent": 1,
+         "dur_ms": 20},
+        {"name": "store", "service": "db", "trace_id": 9, "parent": 2,
+         "dur_ms": 4, "status": StatusCode.ERROR},
+        {"name": "inner", "service": "pay", "trace_id": 9, "parent": 2},
+    ])
+    conn = ServiceGraphConnector("servicegraph", {})
+    sink = Sink()
+    conn.set_outputs({"metrics/sg": sink})
+    conn.consume(batch)
+    [mb] = sink.batches
+    points = list(mb.iter_points())
+    totals = {(p["attributes"]["client"], p["attributes"]["server"]):
+              p["value"] for p in points
+              if p["name"] == "traces.service.graph.request.total"}
+    assert totals == {("front", "pay"): 1, ("pay", "db"): 1}
+    fails = [p for p in points
+             if p["name"] == "traces.service.graph.request.failed.total"]
+    assert len(fails) == 1 and fails[0]["attributes"]["server"] == "db"
+
+
+def test_servicegraph_on_synthetic_topology():
+    batch = synthesize_traces(32, seed=3)
+    conn = ServiceGraphConnector("servicegraph", {})
+    out = conn.aggregate(batch)
+    edges = {(p["attributes"]["client"], p["attributes"]["server"])
+             for p in out.iter_points()
+             if p["name"] == "traces.service.graph.request.total"}
+    assert len(edges) >= 3  # the otel-demo-style mesh has many edges
+    assert all(c != s for c, s in edges)
+
+
+# ------------------------------------------------------------- pdata misc
+def test_metric_batch_concat_and_filter():
+    b1 = MetricBatchBuilder()
+    b1.add_point(name="a", value=1.0)
+    b2 = MetricBatchBuilder()
+    b2.add_point(name="a", value=2.0)
+    b2.add_point(name="b", value=3.0, metric_type=MetricType.SUM)
+    merged = concat_metric_batches([b1.build(), b2.build()])
+    assert len(merged) == 3
+    assert merged.metric_names() == ["a", "a", "b"]
+    only_a = merged.filter(np.array([n == "a" for n in merged.metric_names()]))
+    assert len(only_a) == 2
+    assert isinstance(concat_any([merged]), MetricBatch)
+
+
+def test_log_batch_concat_roundtrip():
+    b1 = LogBatchBuilder()
+    r = b1.add_resource({"service.name": "x"})
+    b1.add_record(body="one", resource_index=r, trace_id=5, span_id=6)
+    b2 = LogBatchBuilder()
+    b2.add_record(body="two")
+    merged = concat_log_batches([b1.build(), b2.build()])
+    recs = list(merged.iter_records())
+    assert [r["body"] for r in recs] == ["one", "two"]
+    assert recs[0]["resource"] == {"service.name": "x"}
+    assert recs[1]["resource"] == {}
+
+
+def test_traces_to_metrics_pipeline_integration():
+    """Full collector graph: traces → spanmetrics + servicegraph connectors
+    → metrics pipeline → debug (the pipelinegen topology from SURVEY §3.4)."""
+    from odigos_tpu.pipeline import Collector
+
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 20, "n_batches": 2}},
+        "processors": {"batch": {"send_batch_size": 10_000,
+                                 "timeout_s": 0.05}},
+        "connectors": {"spanmetrics": {}, "servicegraph": {}},
+        "exporters": {"debug": {"keep": True}},
+        "service": {"pipelines": {
+            "traces/in": {"receivers": ["synthetic"], "processors": [],
+                          "exporters": ["spanmetrics", "servicegraph"]},
+            "metrics/derived": {"receivers": ["spanmetrics", "servicegraph"],
+                                "processors": ["batch"],
+                                "exporters": ["debug"]},
+        }},
+    }
+    with Collector(cfg) as c:
+        c.drain_receivers()
+        dbg = c.component("debug")
+        merged = concat_any(dbg.batches)
+        assert isinstance(merged, MetricBatch)
+        names = set(merged.metric_names())
+        assert "traces.span.metrics.calls" in names
+        assert "traces.service.graph.request.total" in names
+
+
+def test_spanmetrics_extra_dimensions_emitted():
+    batch = span_batch([
+        {"name": "GET", "service": "front", "dur_ms": 10,
+         "attrs": {"http.route": "/a"}},
+        {"name": "GET", "service": "front", "dur_ms": 20,
+         "attrs": {"http.route": "/b"}},
+    ])
+    conn = SpanMetricsConnector("spanmetrics", {"dimensions": ["http.route"]})
+    out = conn.aggregate(batch)
+    calls = {p["attributes"]["http.route"]: p["value"]
+             for p in out.iter_points()
+             if p["name"] == "traces.span.metrics.calls"}
+    assert calls == {"/a": 1.0, "/b": 1.0}

@@ -282,3 +282,27 @@ def test_groupbytrace_then_sampling_pipeline():
     gbt.tick()
     assert len(sink) == 1
     assert kept_trace_ids(sink[0]) == [1]
+
+
+def test_groupbytrace_num_traces_one_still_buffers_newest():
+    """Eviction keeps the newest num_traces traces (off-by-one regression):
+    with num_traces=1, arrival of trace 2 releases only trace 1."""
+    clock = FakeClock()
+    proc = GroupByTraceProcessor("groupbytrace", {
+        "wait_duration_s": 1000.0, "num_traces": 1, "clock": clock,
+        "tick_interval_s": 0})
+    sink = []
+    proc.set_consumer(type("S", (), {"consume": lambda self, b: sink.append(b)})())
+    proc.consume(build({"trace_id": 1, "n": 1}))
+    clock.t += 1
+    proc.consume(build({"trace_id": 2, "n": 1}))
+    assert [kept_trace_ids(b) for b in sink] == [[1]]  # trace 2 still held
+
+
+def test_span_attribute_json_exists_without_path():
+    batch = build({"trace_id": 1, "attrs": {"k": '{"any": 1}'}})
+    rule = SpanAttributeRule(
+        service_name="svc", attribute_key="k", condition_type="json",
+        operation="exists", sampling_ratio=100.0)
+    rule.validate()
+    assert bool(rule.evaluate(TraceView.of(batch)).satisfied[0])
