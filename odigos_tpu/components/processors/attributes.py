@@ -71,3 +71,27 @@ register(Factory(
     create=AttributesProcessor,
     default_config=lambda: {"actions": []},
 ))
+
+
+class ResourceProcessor(AttributesProcessor):
+    """``resource`` processor: same action set, always resource-scoped
+    (the upstream collector's resourceprocessor; pipelinegen emits
+    ``resource/odigos-version``, config_builder.go:186)."""
+
+    def process(self, batch: SpanBatch) -> SpanBatch:
+        # upstream resourceprocessor config key is "attributes"
+        actions = self.config.get("attributes") or self.config.get("actions", [])
+        if not actions:
+            return batch
+        resources = [dict(r) for r in batch.resources]
+        for a in actions:
+            _apply(resources, a)
+        return replace(batch, resources=tuple(resources))
+
+
+register(Factory(
+    type_name="resource",
+    kind=ComponentKind.PROCESSOR,
+    create=ResourceProcessor,
+    default_config=lambda: {"attributes": []},
+))

@@ -35,6 +35,25 @@ _shared_engines: dict[tuple, ScoringEngine] = {}
 _shared_lock = threading.Lock()
 
 
+def _shutdown_shared_engines() -> None:
+    """Drain shared engines at interpreter exit — a live scoring thread at
+    teardown aborts the TPU runtime client (pthread cancel during PJRT
+    destruction)."""
+    with _shared_lock:
+        engines = list(_shared_engines.values())
+        _shared_engines.clear()
+    for eng in engines:
+        try:
+            eng.shutdown()
+        except Exception:
+            pass
+
+
+import atexit  # noqa: E402  (registration belongs next to the registry)
+
+atexit.register(_shutdown_shared_engines)
+
+
 def _engine_for(cfg: EngineConfig, shared: bool) -> ScoringEngine:
     if not shared:
         return ScoringEngine(cfg)
@@ -67,6 +86,7 @@ class TpuAnomalyProcessor(Processor):
         fz = FeaturizerConfig(attr_slots=int(config.get("attr_slots", 0)))
         self.engine_cfg = EngineConfig(
             model=config.get("model", "zscore"),
+            max_batch_spans=int(config.get("max_batch", 65536)),
             max_len=int(config.get("max_len", 64)),
             trace_bucket=int(config.get("trace_bucket", 256)),
             online_update=bool(config.get("online_update", True)),

@@ -1,0 +1,43 @@
+"""Cluster-wide configuration system.
+
+Our equivalent of the reference's single-ConfigMap config model
+(common/odigos_config.go:362 OdigosConfiguration): one declarative
+``Configuration`` is authored by the operator; the **scheduler** resolves
+profiles (with tier gating + dependencies) and sizing presets into an
+*effective* configuration that every other component reads
+(scheduler/controllers/odigosconfiguration/odigosconfiguration_controller.go:44-112).
+"""
+
+from .model import (
+    Configuration,
+    CollectorGatewayConfiguration,
+    CollectorNodeConfiguration,
+    RolloutConfiguration,
+    EnvInjectionMethod,
+    MountMethod,
+    Tier,
+    UiMode,
+)
+from .profiles import Profile, ALL_PROFILES, PROFILES_BY_NAME, available_profiles_for_tier
+from .sizing import SizingPreset, SIZING_PRESETS, gateway_resources, node_resources
+from .effective import calculate_effective_config
+
+__all__ = [
+    "Configuration",
+    "CollectorGatewayConfiguration",
+    "CollectorNodeConfiguration",
+    "RolloutConfiguration",
+    "EnvInjectionMethod",
+    "MountMethod",
+    "Tier",
+    "UiMode",
+    "Profile",
+    "ALL_PROFILES",
+    "PROFILES_BY_NAME",
+    "available_profiles_for_tier",
+    "SizingPreset",
+    "SIZING_PRESETS",
+    "gateway_resources",
+    "node_resources",
+    "calculate_effective_config",
+]

@@ -1,0 +1,49 @@
+"""Effective-config computation (the scheduler's core pure function).
+
+Reference: scheduler/controllers/odigosconfiguration/
+odigosconfiguration_controller.go:44-112 — take the authored configuration,
+resolve profiles (dependencies :73-110, tier gating) and apply each profile's
+config mutation, merge the sizing preset (:112), and emit the effective
+configuration all other components read.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+
+from .model import Configuration, Tier
+from .profiles import Profile, resolve_profiles
+from .sizing import SIZING_PRESETS, ResolvedResources, gateway_resources, node_resources
+
+
+@dataclass
+class EffectiveConfig:
+    config: Configuration
+    applied_profiles: list[str] = field(default_factory=list)
+    problems: list[str] = field(default_factory=list)
+    gateway: ResolvedResources | None = None
+    node: ResolvedResources | None = None
+
+
+def calculate_effective_config(authored: Configuration,
+                               tier: Tier = Tier.COMMUNITY) -> EffectiveConfig:
+    cfg = copy.deepcopy(authored)
+    profiles, problems = resolve_profiles(cfg.profiles, tier)
+    for p in profiles:
+        if p.modify_config is not None:
+            p.modify_config(cfg)
+
+    preset = None
+    if cfg.resource_size_preset:
+        preset = SIZING_PRESETS.get(cfg.resource_size_preset)
+        if preset is None:
+            problems.append(f"unknown resource size preset {cfg.resource_size_preset!r}")
+
+    return EffectiveConfig(
+        config=cfg,
+        applied_profiles=[p.name for p in profiles],
+        problems=problems,
+        gateway=gateway_resources(cfg.collector_gateway, preset),
+        node=node_resources(cfg.collector_node, preset),
+    )

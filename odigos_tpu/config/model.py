@@ -1,0 +1,207 @@
+"""Configuration model.
+
+Mirrors the capability surface of ``common.OdigosConfiguration``
+(common/odigos_config.go:362-402: ~40 fields covering namespaces to ignore,
+gateway/node collector tuning, profiles, rollout/rollback knobs, mount and
+env-injection methods, metrics sources) re-shaped for this framework: the
+TPU anomaly stage gets its own first-class section (``anomaly``) instead of
+being bolted on, and collector resource settings carry the memory-limiter
+derivation inputs (scheduler/controllers/clustercollectorsgroup/
+resource_config.go:8-39).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import MISSING, asdict, dataclass, field, fields, is_dataclass
+from typing import Any, Optional
+
+
+class Tier(str, enum.Enum):
+    COMMUNITY = "community"
+    CLOUD = "cloud"
+    ONPREM = "onprem"
+
+
+class UiMode(str, enum.Enum):
+    NORMAL = "normal"
+    READONLY = "readonly"
+
+
+class MountMethod(str, enum.Enum):
+    """How agent files reach the workload (reference: k8s-host-path vs
+    k8s-virtual-device, common/odigos_config.go MountMethod)."""
+
+    HOST_PATH = "k8s-host-path"
+    VIRTUAL_DEVICE = "k8s-virtual-device"
+
+
+class EnvInjectionMethod(str, enum.Enum):
+    """Reference: loader (LD_PRELOAD), pod-manifest, loader-fallback-to-pod-manifest."""
+
+    LOADER = "loader"
+    POD_MANIFEST = "pod-manifest-env-var-injection"
+    LOADER_FALLBACK = "loader-fallback-to-pod-manifest"
+
+
+@dataclass
+class CollectorGatewayConfiguration:
+    """Gateway (cluster collector) tuning. Defaults resolved by sizing
+    presets; memory-limiter values derived in sizing.gateway_resources."""
+
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    request_memory_mib: Optional[int] = None
+    limit_memory_mib: Optional[int] = None
+    request_cpu_m: Optional[int] = None
+    limit_cpu_m: Optional[int] = None
+    memory_limiter_limit_mib: Optional[int] = None
+    memory_limiter_spike_limit_mib: Optional[int] = None
+    gomemlimit_mib: Optional[int] = None
+    service_graph_disabled: Optional[bool] = None
+    cluster_metrics_enabled: Optional[bool] = None
+    # TPU co-scheduling: how many gateway replicas should be co-located with
+    # a TPU device for the anomaly stage (north-star extension).
+    tpu_replicas: Optional[int] = None
+
+
+@dataclass
+class CollectorNodeConfiguration:
+    """Node collector (daemonset) tuning (common/odigos_config.go
+    CollectorNodeConfiguration)."""
+
+    collector_owner_metrics_port: Optional[int] = None
+    request_memory_mib: Optional[int] = None
+    limit_memory_mib: Optional[int] = None
+    request_cpu_m: Optional[int] = None
+    limit_cpu_m: Optional[int] = None
+    memory_limiter_limit_mib: Optional[int] = None
+    memory_limiter_spike_limit_mib: Optional[int] = None
+    gomemlimit_mib: Optional[int] = None
+    k8s_node_logs_directory: Optional[str] = None
+
+
+@dataclass
+class RolloutConfiguration:
+    """Automatic-rollout knobs (common/odigos_config.go Rollout*,
+    :389-391 rollback grace/stability)."""
+
+    automatic_rollout_disabled: Optional[bool] = None
+    rollback_disabled: Optional[bool] = None
+    rollback_grace_time_s: float = 300.0
+    rollback_stability_window_s: float = 3600.0
+
+
+@dataclass
+class AnomalyStageConfiguration:
+    """First-class config for the TPU anomaly-detection stage (north star:
+    tpuanomalyprocessor + anomalyrouter + TPU sidecar)."""
+
+    enabled: bool = False
+    model: str = "zscore"  # zscore | autoencoder | transformer
+    threshold: float = 0.8  # score in [0,1] (ScoringEngine contract)
+    max_batch: int = 4096
+    timeout_ms: float = 5.0  # pass-through-on-timeout budget (<5ms p99)
+    route_to_stream: str = "anomalies"
+    devices: int = 1  # data-parallel chips for the scoring sidecar
+
+
+@dataclass
+class MetricsSourcesConfiguration:
+    """Which metrics feeds are enabled (common/odigos_config.go
+    MetricsSourceConfiguration: spanMetrics/hostMetrics/kubeletStats/
+    odigosOwnMetrics/agentMetrics)."""
+
+    span_metrics: bool = False
+    host_metrics: bool = False
+    kubelet_stats: bool = False
+    own_metrics: bool = True
+    agent_metrics: bool = False
+
+
+@dataclass
+class OidcConfiguration:
+    tenant_url: str = ""
+    client_id: str = ""
+    client_secret: str = ""
+
+
+@dataclass
+class UserInstrumentationEnvs:
+    """Per-language extra env for agents (common/odigos_config.go
+    UserInstrumentationEnvs)."""
+
+    languages: dict[str, dict[str, str]] = field(default_factory=dict)
+
+
+@dataclass
+class Configuration:
+    """The single authored configuration object (ConfigMap analog)."""
+
+    config_version: int = 1
+    telemetry_enabled: bool = False
+    ignored_namespaces: list[str] = field(default_factory=list)
+    ignored_containers: list[str] = field(default_factory=list)
+    ignore_odigos_namespace: bool = True
+    image_prefix: str = ""
+    cluster_name: str = ""
+    ui_mode: UiMode = UiMode.NORMAL
+    ui_pagination_limit: int = 0
+    collector_gateway: CollectorGatewayConfiguration = field(
+        default_factory=CollectorGatewayConfiguration)
+    collector_node: CollectorNodeConfiguration = field(
+        default_factory=CollectorNodeConfiguration)
+    profiles: list[str] = field(default_factory=list)
+    allow_concurrent_agents: Optional[bool] = None
+    mount_method: Optional[MountMethod] = None
+    agent_env_vars_injection_method: Optional[EnvInjectionMethod] = None
+    user_instrumentation_envs: UserInstrumentationEnvs = field(
+        default_factory=UserInstrumentationEnvs)
+    rollout: RolloutConfiguration = field(default_factory=RolloutConfiguration)
+    oidc: Optional[OidcConfiguration] = None
+    resource_size_preset: str = ""  # "", size_s, size_m, size_l
+    metrics_sources: MetricsSourcesConfiguration = field(
+        default_factory=MetricsSourcesConfiguration)
+    anomaly: AnomalyStageConfiguration = field(
+        default_factory=AnomalyStageConfiguration)
+    # Free-form bag for profile-applied settings without a dedicated field
+    # (reference profiles patch arbitrary config, e.g. disable-gin).
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "Configuration":
+        return _from_dict(cls, data)
+
+
+# Optional nested-dataclass fields (default=None, so no default_factory to
+# infer the type from at runtime under `from __future__ import annotations`)
+_OPTIONAL_NESTED: dict[str, type] = {"oidc": OidcConfiguration}
+
+
+def _from_dict(cls, data):
+    """Tolerant nested-dataclass hydration (unknown keys land in extra)."""
+    if not is_dataclass(cls):
+        return data
+    known = {f.name: f for f in fields(cls)}
+    kwargs = {}
+    extra = {}
+    for k, v in (data or {}).items():
+        if k not in known:
+            extra[k] = v
+            continue
+        f = known[k]
+        # resolve nested dataclass types by default_factory class
+        if isinstance(v, dict) and f.default_factory is not MISSING \
+                and f.default_factory is not dict and is_dataclass(f.default_factory):
+            kwargs[k] = _from_dict(f.default_factory, v)
+        elif isinstance(v, dict) and k in _OPTIONAL_NESTED:
+            kwargs[k] = _from_dict(_OPTIONAL_NESTED[k], v)
+        else:
+            kwargs[k] = v
+    obj = cls(**kwargs)
+    if extra and hasattr(obj, "extra"):
+        obj.extra.update(extra)
+    return obj

@@ -1,0 +1,181 @@
+"""Named configuration profiles.
+
+Reference: profiles/ — 22 profiles in 4 categories (aggregators, attributes,
+instrumentation, pipeline), each a ``Profile`` with a minimum tier, optional
+dependencies (aggregator profiles are bundles of other profiles:
+profiles/aggregators/{greatwall,kratos}.go) and a config-mutation function
+(profiles/profile/profile.go:7). The registry and tier filtering mirror
+profiles/allprofiles.go:41 ProfilesByName / GetAvailableProfilesForTier.
+
+Profiles are applied by the scheduler when computing the effective config
+(see effective.py); dependency resolution is transitive and cycle-safe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .model import Configuration, EnvInjectionMethod, MountMethod, Tier
+
+ModifyFn = Callable[[Configuration], None]
+
+
+@dataclass(frozen=True)
+class Profile:
+    name: str
+    minimum_tier: Tier
+    short_description: str
+    category: str  # aggregators | attributes | instrumentation | pipeline
+    dependencies: tuple[str, ...] = ()
+    modify_config: Optional[ModifyFn] = None
+
+
+def _set_extra(key: str, value) -> ModifyFn:
+    def fn(cfg: Configuration) -> None:
+        cfg.extra[key] = value
+    return fn
+
+
+def _allow_concurrent(cfg: Configuration) -> None:
+    cfg.allow_concurrent_agents = True
+
+
+def _mount_host_path(cfg: Configuration) -> None:
+    cfg.mount_method = MountMethod.HOST_PATH
+
+
+def _mount_virtual_device(cfg: Configuration) -> None:
+    cfg.mount_method = MountMethod.VIRTUAL_DEVICE
+
+
+def _pod_manifest_env(cfg: Configuration) -> None:
+    cfg.agent_env_vars_injection_method = EnvInjectionMethod.POD_MANIFEST
+
+
+def _small_batches(cfg: Configuration) -> None:
+    # pipeline/smallbatches.go: destination traces pipelines get a
+    # low-latency batch processor (send_batch_size 100, timeout 100ms).
+    cfg.extra["small_batches"] = {"send_batch_size": 100, "timeout_ms": 100}
+
+
+ALL_PROFILES: list[Profile] = [
+    # --- aggregators (bundles; onprem tier) ---
+    Profile("kratos", Tier.ONPREM, "bundle: payload collection + code attributes + "
+            "query-operation detection + concurrent agents", "aggregators",
+            dependencies=("full-payload-collection", "code-attributes",
+                          "query-operation-detector", "allow_concurrent_agents",
+                          "category-attributes", "copy-scope")),
+    Profile("greatwall", Tier.ONPREM, "bundle: kratos + small batches", "aggregators",
+            dependencies=("kratos", "small-batches")),
+    # --- attributes ---
+    Profile("category-attributes", Tier.ONPREM,
+            "add category attributes to spans", "attributes",
+            modify_config=_set_extra("category_attributes", True)),
+    Profile("code-attributes", Tier.ONPREM,
+            "collect code.* attributes (file, line, function)", "attributes",
+            modify_config=_set_extra("code_attributes", True)),
+    Profile("copy-scope", Tier.ONPREM,
+            "copy instrumentation scope to span attributes", "attributes",
+            modify_config=_set_extra("copy_scope", True)),
+    Profile("hostname-as-podname", Tier.COMMUNITY,
+            "rewrite host.name to the pod name", "attributes",
+            modify_config=_set_extra("hostname_as_podname", True)),
+    Profile("full-payload-collection", Tier.ONPREM,
+            "collect request/response payloads for all libraries", "attributes",
+            modify_config=_set_extra("payload_collection", "full")),
+    Profile("db-payload-collection", Tier.ONPREM,
+            "collect db query payloads", "attributes",
+            modify_config=_set_extra("payload_collection", "db")),
+    Profile("query-operation-detector", Tier.ONPREM,
+            "derive db operation from query text", "attributes",
+            modify_config=_set_extra("query_operation_detector", True)),
+    Profile("semconv", Tier.COMMUNITY,
+            "upgrade semantic conventions of recorded attributes", "attributes",
+            modify_config=_set_extra("semconv_upgrade", True)),
+    Profile("semconvdynamo", Tier.ONPREM,
+            "dynamodb semconv normalization", "attributes",
+            modify_config=_set_extra("semconv_dynamo", True)),
+    Profile("semconvredis", Tier.ONPREM,
+            "redis semconv normalization", "attributes",
+            modify_config=_set_extra("semconv_redis", True)),
+    Profile("reduce-span-name-cardinality", Tier.ONPREM,
+            "templatize high-cardinality span names (url templatization)",
+            "attributes", modify_config=_set_extra("url_templatization", True)),
+    # --- instrumentation ---
+    Profile("allow_concurrent_agents", Tier.COMMUNITY,
+            "allow odigos alongside other APM agents", "instrumentation",
+            modify_config=_allow_concurrent),
+    Profile("java-ebpf-instrumentations", Tier.ONPREM,
+            "use eBPF java instrumentation distro", "instrumentation",
+            modify_config=_set_extra("java_distro", "ebpf")),
+    Profile("java-native-instrumentations", Tier.COMMUNITY,
+            "use native java agent distro", "instrumentation",
+            modify_config=_set_extra("java_distro", "native")),
+    Profile("legacy-dotnet-instrumentation", Tier.COMMUNITY,
+            "use legacy .NET instrumentation", "instrumentation",
+            modify_config=_set_extra("dotnet_distro", "legacy")),
+    Profile("mount-method-k8s-host-path", Tier.COMMUNITY,
+            "mount agents via hostPath volumes", "instrumentation",
+            modify_config=_mount_host_path),
+    Profile("mount-method-k8s-virtual-device", Tier.COMMUNITY,
+            "mount agents via virtual device plugin", "instrumentation",
+            modify_config=_mount_virtual_device),
+    Profile("pod-manifest-env-var-injection", Tier.COMMUNITY,
+            "inject agent env vars via pod manifest (webhook)", "instrumentation",
+            modify_config=_pod_manifest_env),
+    Profile("disable-gin", Tier.COMMUNITY,
+            "disable gin framework instrumentation", "instrumentation",
+            modify_config=_set_extra("disable_gin", True)),
+    # --- pipeline ---
+    Profile("small-batches", Tier.ONPREM,
+            "low-latency small batch processor on destination traces pipelines",
+            "pipeline", modify_config=_small_batches),
+]
+
+PROFILES_BY_NAME: dict[str, Profile] = {p.name: p for p in ALL_PROFILES}
+
+
+_TIER_RANK = {Tier.COMMUNITY: 0, Tier.CLOUD: 1, Tier.ONPREM: 2}
+
+
+def available_profiles_for_tier(tier: Tier) -> list[Profile]:
+    """profiles/allprofiles.go:62 GetAvailableProfilesForTier — a profile is
+    available when the install tier is at least its minimum tier (community
+    profiles everywhere; onprem-only profiles need onprem)."""
+    rank = _TIER_RANK.get(tier)
+    if rank is None:
+        return []
+    return [p for p in ALL_PROFILES if _TIER_RANK[p.minimum_tier] <= rank]
+
+
+def resolve_profiles(names: list[str], tier: Tier) -> tuple[list[Profile], list[str]]:
+    """Transitively expand dependencies, preserving first-seen order and
+    dropping profiles above the tier or unknown. Returns (profiles, problems).
+    Mirrors scheduler/controllers/odigosconfiguration_controller.go:73-110."""
+    allowed = {p.name for p in available_profiles_for_tier(tier)}
+    out: list[Profile] = []
+    seen: set[str] = set()
+    problems: list[str] = []
+
+    def visit(name: str, chain: tuple[str, ...]) -> None:
+        if name in seen:
+            return
+        if name in chain:
+            problems.append(f"profile dependency cycle: {' -> '.join(chain + (name,))}")
+            return
+        prof = PROFILES_BY_NAME.get(name)
+        if prof is None:
+            problems.append(f"unknown profile {name!r}")
+            return
+        if name not in allowed:
+            problems.append(f"profile {name!r} requires tier {prof.minimum_tier.value}")
+            return
+        seen.add(name)
+        out.append(prof)
+        for dep in prof.dependencies:
+            visit(dep, chain + (name,))
+
+    for n in names:
+        visit(n, ())
+    return out, problems

@@ -1,0 +1,116 @@
+"""Collector sizing presets and resource derivation.
+
+Reference: k8sutils/pkg/sizing/sizing.go (size_s/m/l presets) and
+scheduler/controllers/clustercollectorsgroup/resource_config.go:8-39 —
+gateway defaults 500Mi/500m request, 1000m CPU limit, 1-10 replicas, memory
+limit = 1.25x request, memory-limiter hard limit = limit - 50MiB, spike =
+20% of hard limit, GOMEMLIMIT = 80% of hard limit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .model import CollectorGatewayConfiguration, CollectorNodeConfiguration
+
+# resource_config.go constants
+DEFAULT_REQUEST_MEMORY_MIB = 500
+DEFAULT_REQUEST_CPU_M = 500
+DEFAULT_LIMIT_CPU_M = 1000
+DEFAULT_MIN_REPLICAS = 1
+DEFAULT_MAX_REPLICAS = 10
+MEMORY_LIMITER_LIMIT_DIFF_MIB = 50
+MEMORY_LIMITER_SPIKE_PERCENTAGE = 20.0
+GOMEMLIMIT_PERCENTAGE = 80.0
+MEMORY_LIMIT_ABOVE_REQUEST_FACTOR = 1.25
+
+
+@dataclass(frozen=True)
+class SizingPreset:
+    name: str
+    gateway_min_replicas: int
+    gateway_max_replicas: int
+    gateway_request_memory_mib: int
+    gateway_request_cpu_m: int
+    gateway_limit_cpu_m: int
+    node_request_memory_mib: int
+    node_limit_memory_mib: int
+    node_request_cpu_m: int
+    node_limit_cpu_m: int
+
+
+# k8sutils/pkg/sizing/sizing.go presets (small/medium/large clusters)
+SIZING_PRESETS: dict[str, SizingPreset] = {
+    "size_s": SizingPreset("size_s", 1, 5, 300, 150, 300, 150, 300, 150, 300),
+    "size_m": SizingPreset("size_m", 2, 8, 500, 500, 1000, 250, 500, 250, 500),
+    "size_l": SizingPreset("size_l", 3, 12, 750, 750, 1250, 500, 750, 500, 750),
+}
+
+
+@dataclass(frozen=True)
+class ResolvedResources:
+    min_replicas: int
+    max_replicas: int
+    request_memory_mib: int
+    limit_memory_mib: int
+    request_cpu_m: int
+    limit_cpu_m: int
+    memory_limiter_limit_mib: int
+    memory_limiter_spike_limit_mib: int
+    gomemlimit_mib: int
+
+
+def _derive(request_mem: int, limit_mem: int | None,
+            hard_override: int | None, spike_override: int | None,
+            gomem_override: int | None) -> tuple[int, int, int, int]:
+    limit = limit_mem if limit_mem is not None else int(
+        request_mem * MEMORY_LIMIT_ABOVE_REQUEST_FACTOR)
+    hard = hard_override if hard_override is not None else max(
+        1, limit - MEMORY_LIMITER_LIMIT_DIFF_MIB)
+    spike = spike_override if spike_override is not None else int(
+        hard * MEMORY_LIMITER_SPIKE_PERCENTAGE / 100.0)
+    gomem = gomem_override if gomem_override is not None else int(
+        hard * GOMEMLIMIT_PERCENTAGE / 100.0)
+    return limit, hard, spike, gomem
+
+
+def gateway_resources(cfg: CollectorGatewayConfiguration,
+                      preset: SizingPreset | None = None) -> ResolvedResources:
+    """resource_config.go getGatewayResourceSettings: explicit config wins,
+    then sizing preset, then hardcoded defaults; memory-limiter math derived."""
+    p = preset
+    req_mem = cfg.request_memory_mib or (p.gateway_request_memory_mib if p else DEFAULT_REQUEST_MEMORY_MIB)
+    limit, hard, spike, gomem = _derive(
+        req_mem, cfg.limit_memory_mib, cfg.memory_limiter_limit_mib,
+        cfg.memory_limiter_spike_limit_mib, cfg.gomemlimit_mib)
+    return ResolvedResources(
+        min_replicas=cfg.min_replicas or (p.gateway_min_replicas if p else DEFAULT_MIN_REPLICAS),
+        max_replicas=cfg.max_replicas or (p.gateway_max_replicas if p else DEFAULT_MAX_REPLICAS),
+        request_memory_mib=req_mem,
+        limit_memory_mib=limit,
+        request_cpu_m=cfg.request_cpu_m or (p.gateway_request_cpu_m if p else DEFAULT_REQUEST_CPU_M),
+        limit_cpu_m=cfg.limit_cpu_m or (p.gateway_limit_cpu_m if p else DEFAULT_LIMIT_CPU_M),
+        memory_limiter_limit_mib=hard,
+        memory_limiter_spike_limit_mib=spike,
+        gomemlimit_mib=gomem,
+    )
+
+
+def node_resources(cfg: CollectorNodeConfiguration,
+                   preset: SizingPreset | None = None) -> ResolvedResources:
+    p = preset
+    req_mem = cfg.request_memory_mib or (p.node_request_memory_mib if p else 250)
+    limit_mem = cfg.limit_memory_mib or (p.node_limit_memory_mib if p else None)
+    limit, hard, spike, gomem = _derive(
+        req_mem, limit_mem, cfg.memory_limiter_limit_mib,
+        cfg.memory_limiter_spike_limit_mib, cfg.gomemlimit_mib)
+    return ResolvedResources(
+        min_replicas=1, max_replicas=1,  # daemonset: one per node
+        request_memory_mib=req_mem,
+        limit_memory_mib=limit,
+        request_cpu_m=cfg.request_cpu_m or (p.node_request_cpu_m if p else 250),
+        limit_cpu_m=cfg.limit_cpu_m or (p.node_limit_cpu_m if p else 500),
+        memory_limiter_limit_mib=hard,
+        memory_limiter_spike_limit_mib=spike,
+        gomemlimit_mib=gomem,
+    )

@@ -1,0 +1,33 @@
+"""Destination registry + collector-config generators.
+
+Reference: destinations/ (63 declarative backend YAMLs: signal support, UI
+field schema, secret flags — destinations/data/*.yaml, loaded at
+destinations/load.go:19) and common/config/*.go (~75 per-backend configers
+implementing ModifyConfig, dispatched from
+common/pipelinegen/config_builder.go:92).
+
+Our design folds both into one table-driven module: ``DestinationSpec``
+carries the declarative schema *and* the exporter-generation recipe, so a
+new backend is one table entry instead of a YAML file + a Go file. Secrets
+stay out of generated configs via ``${ENV_VAR}`` placeholders, same
+convention as the reference.
+"""
+
+from .registry import (
+    DestinationSpec,
+    Destination,
+    SPECS,
+    get_spec,
+    validate_destination,
+)
+from .configers import modify_config, ConfigerError
+
+__all__ = [
+    "DestinationSpec",
+    "Destination",
+    "SPECS",
+    "get_spec",
+    "validate_destination",
+    "modify_config",
+    "ConfigerError",
+]

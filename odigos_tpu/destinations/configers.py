@@ -1,0 +1,593 @@
+"""Per-destination collector-config generation (common/config/*.go analog).
+
+The reference has ~75 Go configer structs, each implementing
+``ModifyConfig(dest, currentConfig) -> []pipelineName``
+(common/config/datadog.go:19 is the canonical shape: add exporter(s) keyed
+``<type>/<dest-id>``, add a ``<signal>/<type>-<dest-id>`` pipeline per
+enabled signal, reference secrets as ``${ENV_VAR}``). Ours is table-driven:
+a recipe function per backend produces exporters + per-signal exporter
+assignments, and a single shared routine materializes the pipelines. The
+return contract matches pipelinegen's expectations exactly (pipeline names
+are later wired to forward connectors, config_builder.go:99-108).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..components.api import Signal
+from .registry import Destination, get_spec
+
+GenericMap = dict[str, Any]
+
+T, M, L = Signal.TRACES, Signal.METRICS, Signal.LOGS
+
+
+class ConfigerError(Exception):
+    """A destination cannot be configured (missing field, no signals...)."""
+
+
+# A recipe inspects the destination and mutates config["exporters"] /
+# config["connectors"]; it returns {signal: [exporter names]} for the
+# signals it can serve (subset of dest.signals).
+Recipe = Callable[[Destination, GenericMap], dict[Signal, list[str]]]
+
+
+def _require(dest: Destination, key: str) -> str:
+    v = dest.get(key)
+    if not v:
+        raise ConfigerError(f"{dest.dest_type} destination {dest.id}: "
+                            f"required field {key} not set")
+    return v
+
+
+def _secret(name: str) -> str:
+    return "${%s}" % name
+
+
+def _grpc_endpoint(raw: str, tls: bool = False) -> str:
+    """parseOtlpGrpcUrl behavior (common/config/utils.go:11): accept
+    host:port or scheme://host:port, strip scheme, default port 4317."""
+    raw = raw.strip()
+    for scheme in ("grpcs://", "https://", "grpc://", "http://"):
+        if raw.startswith(scheme):
+            raw = raw[len(scheme):]
+            break
+    if ":" not in raw.rsplit("/", 1)[-1]:
+        raw = raw + ":4317"
+    return raw
+
+
+def _http_endpoint(raw: str) -> str:
+    raw = raw.strip().rstrip("/")
+    if "://" not in raw:
+        raw = "https://" + raw
+    return raw
+
+
+def _all(dest: Destination, names: list[str]) -> dict[Signal, list[str]]:
+    return {sig: list(names) for sig in dest.signals}
+
+
+def _single(exporter_type: str,
+            settings: Callable[[Destination], GenericMap]) -> Recipe:
+    """Recipe: one exporter of ``exporter_type`` serving every enabled
+    signal — the majority shape (dash0, dynatrace, honeycomb, ...)."""
+
+    def recipe(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+        name = f"{exporter_type}/{dest.dest_type}-{dest.id}"
+        config["exporters"][name] = settings(dest)
+        return _all(dest, [name])
+
+    return recipe
+
+
+def _otlp_grpc(endpoint_field: str,
+               headers: Callable[[Destination], GenericMap] | None = None,
+               tls_insecure: bool | None = None,
+               endpoint_fn: Callable[[Destination], str] | None = None) -> Recipe:
+    def settings(dest: Destination) -> GenericMap:
+        ep = endpoint_fn(dest) if endpoint_fn else _grpc_endpoint(
+            _require(dest, endpoint_field))
+        s: GenericMap = {"endpoint": ep}
+        if headers:
+            h = headers(dest)
+            if h:
+                s["headers"] = h
+        if tls_insecure is not None:
+            s["tls"] = {"insecure": tls_insecure}
+        return s
+    return _single("otlp", settings)
+
+
+def _otlp_http(endpoint_field: str,
+               headers: Callable[[Destination], GenericMap] | None = None,
+               endpoint_fn: Callable[[Destination], str] | None = None) -> Recipe:
+    def settings(dest: Destination) -> GenericMap:
+        ep = endpoint_fn(dest) if endpoint_fn else _http_endpoint(
+            _require(dest, endpoint_field))
+        s: GenericMap = {"endpoint": ep}
+        if headers:
+            h = headers(dest)
+            if h:
+                s["headers"] = h
+        return s
+    return _single("otlphttp", settings)
+
+
+def _bearer(token_env: str) -> Callable[[Destination], GenericMap]:
+    return lambda dest: {"Authorization": f"Bearer {_secret(token_env)}"}
+
+
+# ---------------------------------------------------------------- specials
+
+
+def _datadog(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    # common/config/datadog.go: one datadog exporter; a datadog connector
+    # bridges traces->metrics APM stats when both signals are on.
+    site = _require(dest, "DATADOG_SITE")
+    name = f"datadog/{dest.id}"
+    config["exporters"][name] = {
+        "hostname": "odigos-tpu-gateway",
+        "api": {"key": _secret("DATADOG_API_KEY"), "site": site},
+    }
+    out = _all(dest, [name])
+    if T in dest.signals and M in dest.signals:
+        # APM-stats bridge: connector is an exporter of the traces pipeline
+        # and a *receiver* of the metrics pipeline.
+        conn = f"datadog/connector-{dest.id}"
+        config["connectors"][conn] = {}
+        out[T] = [name, conn]
+        out[M] = [f"receiver:{conn}", name]
+    return out
+
+
+def _logzio(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    region = dest.get("LOGZIO_REGION", "us")
+    out: dict[Signal, list[str]] = {}
+    if T in dest.signals:
+        n = f"logzio/tracing-{dest.id}"
+        config["exporters"][n] = {
+            "region": region, "account_token": _secret("LOGZIO_TRACING_TOKEN")}
+        out[T] = [n]
+    if M in dest.signals:
+        n = f"prometheusremotewrite/logzio-{dest.id}"
+        config["exporters"][n] = {
+            "endpoint": f"https://listener.logz.io:8053",
+            "headers": {"Authorization": f"Bearer {_secret('LOGZIO_METRICS_TOKEN')}"}}
+        out[M] = [n]
+    if L in dest.signals:
+        n = f"logzio/logs-{dest.id}"
+        config["exporters"][n] = {
+            "region": region, "account_token": _secret("LOGZIO_LOGS_TOKEN")}
+        out[L] = [n]
+    return out
+
+
+def _googlecloud(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"googlecloud/{dest.id}"
+    s: GenericMap = {}
+    if dest.get("GCP_PROJECT_ID"):
+        s["project"] = dest.get("GCP_PROJECT_ID")
+    config["exporters"][name] = s
+    return _all(dest, [name])
+
+
+def _prometheus_rw(url_field: str, auth: Callable[[Destination], GenericMap]) -> Recipe:
+    def settings(dest: Destination) -> GenericMap:
+        s: GenericMap = {"endpoint": _http_endpoint(_require(dest, url_field))}
+        s.update(auth(dest))
+        labels = dest.get("PROMETHEUS_RESOURCE_ATTRIBUTES_LABELS")
+        if labels:
+            s["resource_to_telemetry_conversion"] = {"enabled": True}
+        return s
+    return _single("prometheusremotewrite", settings)
+
+
+def _coralogix(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"coralogix/{dest.id}"
+    config["exporters"][name] = {
+        "domain": _require(dest, "CORALOGIX_DOMAIN"),
+        "private_key": _secret("CORALOGIX_PRIVATE_KEY"),
+        "application_name": dest.get("CORALOGIX_APPLICATION_NAME", "odigos"),
+        "subsystem_name": dest.get("CORALOGIX_SUBSYSTEM_NAME", "odigos"),
+    }
+    return _all(dest, [name])
+
+
+def _kafka(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"kafka/{dest.id}"
+    brokers = [b.strip() for b in _require(dest, "KAFKA_BROKERS").split(",")]
+    s: GenericMap = {"brokers": brokers,
+                     "topic": dest.get("KAFKA_TOPIC", "otlp_spans"),
+                     "protocol_version": dest.get("KAFKA_PROTOCOL_VERSION", "2.0.0")}
+    if dest.get("KAFKA_USERNAME"):
+        s["auth"] = {"sasl": {"username": dest.get("KAFKA_USERNAME"),
+                              "password": _secret("KAFKA_PASSWORD"),
+                              "mechanism": dest.get("KAFKA_AUTH_METHOD", "PLAIN")}}
+    config["exporters"][name] = s
+    return _all(dest, [name])
+
+
+def _s3(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"awss3/{dest.id}"
+    config["exporters"][name] = {
+        "s3uploader": {
+            "region": dest.get("S3_REGION", "us-east-1"),
+            "s3_bucket": _require(dest, "S3_BUCKET"),
+            "s3_partition": dest.get("S3_PARTITION", "minute"),
+        },
+        "marshaler": dest.get("S3_MARSHALER", "otlp_json"),
+    }
+    return _all(dest, [name])
+
+
+def _clickhouse(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"clickhouse/{dest.id}"
+    s: GenericMap = {
+        "endpoint": _require(dest, "CLICKHOUSE_ENDPOINT"),
+        "database": dest.get("CLICKHOUSE_DATABASE_NAME", "otel"),
+        "create_schema": dest.get("CLICKHOUSE_CREATE_SCHEME", "true") in ("true", "Create"),
+    }
+    if dest.get("CLICKHOUSE_USERNAME"):
+        s["username"] = dest.get("CLICKHOUSE_USERNAME")
+        s["password"] = _secret("CLICKHOUSE_PASSWORD")
+    if dest.get("CLICKHOUSE_TRACES_TABLE"):
+        s["traces_table_name"] = dest.get("CLICKHOUSE_TRACES_TABLE")
+    if dest.get("CLICKHOUSE_LOGS_TABLE"):
+        s["logs_table_name"] = dest.get("CLICKHOUSE_LOGS_TABLE")
+    config["exporters"][name] = s
+    return _all(dest, [name])
+
+
+def _elasticsearch(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"elasticsearch/{dest.id}"
+    s: GenericMap = {
+        "endpoints": [_http_endpoint(_require(dest, "ELASTICSEARCH_URL"))],
+        "traces_index": dest.get("ES_TRACES_INDEX", "trace_index"),
+        "logs_index": dest.get("ES_LOGS_INDEX", "log_index"),
+    }
+    if dest.get("ELASTICSEARCH_USERNAME"):
+        s["user"] = dest.get("ELASTICSEARCH_USERNAME")
+        s["password"] = _secret("ELASTICSEARCH_PASSWORD")
+    config["exporters"][name] = s
+    return _all(dest, [name])
+
+
+def _loki(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"loki/{dest.id}"
+    config["exporters"][name] = {
+        "endpoint": _http_endpoint(_require(dest, "LOKI_URL")),
+        "labels": {"attributes": dest.get(
+            "LOKI_LABELS", '["k8s.container.name","k8s.pod.name","k8s.namespace.name"]')},
+    }
+    return _all(dest, [name])
+
+
+def _jaeger(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"otlp/jaeger-{dest.id}"
+    s: GenericMap = {"endpoint": _grpc_endpoint(_require(dest, "JAEGER_URL"))}
+    if dest.get("JAEGER_TLS_ENABLED", "false") != "true":
+        s["tls"] = {"insecure": True}
+    config["exporters"][name] = s
+    return _all(dest, [name])
+
+
+def _azureblob(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    # collector/exporters/azureblobstorageexporter — our custom exporter
+    name = f"azureblobstorage/{dest.id}"
+    config["exporters"][name] = {
+        "account_name": _require(dest, "AZURE_BLOB_ACCOUNT_NAME"),
+        "container_name": _require(dest, "AZURE_BLOB_CONTAINER_NAME"),
+    }
+    return _all(dest, [name])
+
+
+def _cloudwatch(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    out: dict[Signal, list[str]] = {}
+    if L in dest.signals:
+        n = f"awscloudwatchlogs/{dest.id}"
+        config["exporters"][n] = {
+            "log_group_name": _require(dest, "AWS_CLOUDWATCH_LOG_GROUP_NAME"),
+            "log_stream_name": _require(dest, "AWS_CLOUDWATCH_LOG_STREAM_NAME"),
+            "region": dest.get("AWS_CLOUDWATCH_REGION", ""),
+        }
+        out[L] = [n]
+    if M in dest.signals:
+        n = f"awsemf/{dest.id}"
+        config["exporters"][n] = {
+            "namespace": dest.get("AWS_CLOUDWATCH_METRICS_NAMESPACE", "odigos"),
+            "region": dest.get("AWS_CLOUDWATCH_REGION", ""),
+        }
+        out[M] = [n]
+    return out
+
+
+def _xray(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"awsxray/{dest.id}"
+    s: GenericMap = {}
+    for field, key in (("AWS_XRAY_REGION", "region"),
+                       ("AWS_XRAY_ENDPOINT", "endpoint"),
+                       ("AWS_XRAY_PROXY_ADDRESS", "proxy_address")):
+        if dest.get(field):
+            s[key] = dest.get(field)
+    config["exporters"][name] = s
+    return _all(dest, [name])
+
+
+def _splunk(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"sapm/{dest.id}"
+    config["exporters"][name] = {
+        "access_token": _secret("SPLUNK_ACCESS_TOKEN"),
+        "endpoint": f"https://ingest.{_require(dest, 'SPLUNK_REALM')}.signalfx.com/v2/trace",
+    }
+    return _all(dest, [name])
+
+
+def _signalfx(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"signalfx/{dest.id}"
+    config["exporters"][name] = {
+        "access_token": _secret("SIGNALFX_ACCESS_TOKEN"),
+        "realm": _require(dest, "SIGNALFX_REALM"),
+    }
+    return _all(dest, [name])
+
+
+def _azuremonitor(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"azuremonitor/{dest.id}"
+    s: GenericMap = {}
+    if dest.get("AZURE_MONITOR_CONNECTION_STRING"):
+        s["connection_string"] = dest.get("AZURE_MONITOR_CONNECTION_STRING")
+    config["exporters"][name] = s
+    return _all(dest, [name])
+
+
+def _dynamic(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    # common/config/dynamic.go: raw exporter config pass-through
+    import json
+    dtype = _require(dest, "DYNAMIC_DESTINATION_TYPE")
+    raw = dest.get("DYNAMIC_CONFIGURATION_DATA", "{}")
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ConfigerError(f"dynamic destination {dest.id}: bad config json: {e}")
+    name = f"{dtype}/{dest.id}"
+    config["exporters"][name] = data
+    return _all(dest, [name])
+
+
+def _mock(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"mockdestination/{dest.id}"
+    config["exporters"][name] = {
+        "reject_fraction": float(dest.get("MOCK_REJECT_FRACTION", "0")),
+        "response_duration_ms": float(dest.get("MOCK_RESPONSE_DURATION", "0")),
+    }
+    return _all(dest, [name])
+
+
+def _add_extension(config: GenericMap, name: str, settings: GenericMap) -> None:
+    """Define an extension AND enable it in service.extensions — an
+    authenticator that is defined but not listed there fails resolution at
+    collector startup."""
+    config.setdefault("extensions", {})[name] = settings
+    enabled = config.setdefault("service", {}).setdefault("extensions", [])
+    if name not in enabled:
+        enabled.append(name)
+
+
+def _grafana_tempo(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    endpoint = _grpc_endpoint(_require(dest, "GRAFANA_CLOUD_TEMPO_ENDPOINT"))
+    username = _require(dest, "GRAFANA_CLOUD_TEMPO_USERNAME")
+    name = f"otlp/grafanacloudtempo-{dest.id}"
+    auth_name = f"basicauth/grafana-tempo-{dest.id}"
+    config["exporters"][name] = {
+        "endpoint": endpoint,
+        "auth": {"authenticator": auth_name},
+    }
+    _add_extension(config, auth_name, {
+        "client_auth": {"username": username,
+                        "password": _secret("GRAFANA_CLOUD_TEMPO_PASSWORD")}})
+    return _all(dest, [name])
+
+
+def _grafana_prometheus(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    endpoint = _http_endpoint(_require(dest, "GRAFANA_CLOUD_PROMETHEUS_RW_ENDPOINT"))
+    username = _require(dest, "GRAFANA_CLOUD_PROMETHEUS_USERNAME")
+    name = f"prometheusremotewrite/grafana-{dest.id}"
+    auth_name = f"basicauth/grafana-prom-{dest.id}"
+    s: GenericMap = {"endpoint": endpoint,
+                     "auth": {"authenticator": auth_name}}
+    if dest.get("PROMETHEUS_RESOURCE_ATTRIBUTES_LABELS"):
+        s["resource_to_telemetry_conversion"] = {"enabled": True}
+    config["exporters"][name] = s
+    _add_extension(config, auth_name, {
+        "client_auth": {"username": username,
+                        "password": _secret("GRAFANA_CLOUD_PROMETHEUS_PASSWORD")}})
+    return _all(dest, [name])
+
+
+def _grafana_loki(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    name = f"loki/grafana-{dest.id}"
+    config["exporters"][name] = {
+        "endpoint": _http_endpoint(_require(dest, "GRAFANA_CLOUD_LOKI_ENDPOINT")),
+        "labels": {"attributes": dest.get("GRAFANA_CLOUD_LOKI_LABELS", "")},
+    }
+    return _all(dest, [name])
+
+
+_CONFIGERS: dict[str, Recipe] = {
+    "alibabacloud": _otlp_grpc("ALIBABA_ENDPOINT",
+                               headers=_bearer("ALIBABA_TOKEN")),
+    "appdynamics": _otlp_http("APPDYNAMICS_ENDPOINT_URL",
+                              headers=_bearer("APPDYNAMICS_API_KEY")),
+    "cloudwatch": _cloudwatch,
+    "s3": _s3,
+    "xray": _xray,
+    "axiom": _otlp_http(
+        "AXIOM_DATASET",
+        endpoint_fn=lambda d: "https://api.axiom.co",
+        headers=lambda d: {"Authorization": f"Bearer {_secret('AXIOM_API_TOKEN')}",
+                           "X-Axiom-Dataset": _require(d, "AXIOM_DATASET")}),
+    "azureblob": _azureblob,
+    "azuremonitor": _azuremonitor,
+    "betterstack": _otlp_http(
+        "BETTERSTACK_TOKEN", endpoint_fn=lambda d: "https://in-otel.logs.betterstack.com",
+        headers=_bearer("BETTERSTACK_TOKEN")),
+    "bonree": _otlp_http("BONREE_ENDPOINT"),
+    "causely": _otlp_grpc("CAUSELY_URL", tls_insecure=True),
+    "checkly": _otlp_grpc("CHECKLY_ENDOINT",
+                          headers=_bearer("CHECKLY_API_KEY")),
+    "chronosphere": _otlp_grpc(
+        "CHRONOSPHERE_DOMAIN",
+        endpoint_fn=lambda d: _grpc_endpoint(
+            _require(d, "CHRONOSPHERE_DOMAIN") + ".chronosphere.io:443"),
+        headers=lambda d: {"API-Token": _secret("CHRONOSPHERE_API_TOKEN")}),
+    "clickhouse": _clickhouse,
+    "coralogix": _coralogix,
+    "dash0": _otlp_grpc("DASH0_ENDPOINT", headers=_bearer("DASH0_TOKEN")),
+    "datadog": _datadog,
+    "dynamic": _dynamic,
+    "dynatrace": _otlp_http(
+        "DYNATRACE_URL",
+        endpoint_fn=lambda d: _http_endpoint(_require(d, "DYNATRACE_URL")) + "/api/v2/otlp",
+        headers=lambda d: {"Authorization": f"Api-Token {_secret('DYNATRACE_API_TOKEN')}"}),
+    "elasticapm": _otlp_grpc("ELASTIC_APM_SERVER_ENDPOINT",
+                             headers=_bearer("ELASTIC_APM_SECRET_TOKEN")),
+    "elasticsearch": _elasticsearch,
+    "qryn": _otlp_http(
+        "QRYN_URL",
+        headers=lambda d: {"X-API-Key": _secret("QRYN_API_SECRET"),
+                           "X-Scope-OrgID": d.get("QRYN_API_KEY", "")}),
+    "googlecloud": _googlecloud,
+    "googlecloudotlp": _otlp_grpc(
+        "GCP_PROJECT_ID",
+        endpoint_fn=lambda d: "telemetry.googleapis.com:443"),
+    "grafanacloudloki": _grafana_loki,
+    "grafanacloudprometheus": _grafana_prometheus,
+    "grafanacloudtempo": _grafana_tempo,
+    "greptime": _otlp_http(
+        "GREPTIME_ENDPOINT",
+        headers=lambda d: {"X-Greptime-DB-Name": d.get("GREPTIME_DB_NAME", "public")}),
+    "groundcover": _otlp_grpc("GROUNDCOVER_ENDPOINT",
+                              headers=_bearer("GROUNDCOVER_API_KEY")),
+    "honeycomb": _otlp_grpc(
+        "HONEYCOMB_ENDPOINT",
+        endpoint_fn=lambda d: _grpc_endpoint(
+            d.get("HONEYCOMB_ENDPOINT") or "api.honeycomb.io:443"),
+        headers=lambda d: {"x-honeycomb-team": _secret("HONEYCOMB_API_KEY")}),
+    "hyperdx": _otlp_grpc(
+        "HYPERDX_API_KEY", endpoint_fn=lambda d: "in-otel.hyperdx.io:4317",
+        headers=lambda d: {"authorization": _secret("HYPERDX_API_KEY")}),
+    "instana": _otlp_grpc(
+        "INSTANA_ENDPOINT",
+        headers=lambda d: {"x-instana-key": _secret("INSTANA_AGENT_KEY"),
+                           "x-instana-host": "odigos-tpu-gateway"}),
+    "jaeger": _jaeger,
+    "kafka": _kafka,
+    "kloudmate": _otlp_http(
+        "KLOUDMATE_API_KEY", endpoint_fn=lambda d: "https://otel.kloudmate.com:4318",
+        headers=lambda d: {"Authorization": _secret("KLOUDMATE_API_KEY")}),
+    "last9": _otlp_grpc(
+        "LAST9_OTLP_ENDPOINT",
+        headers=lambda d: {"Authorization": _secret("LAST9_OTLP_BASIC_AUTH_HEADER")}),
+    "lightstep": _otlp_grpc(
+        "LIGHTSTEP_ACCESS_TOKEN", endpoint_fn=lambda d: "ingest.lightstep.com:443",
+        headers=lambda d: {"lightstep-access-token": _secret("LIGHTSTEP_ACCESS_TOKEN")}),
+    "logzio": _logzio,
+    "loki": _loki,
+    "lumigo": _otlp_http("LUMIGO_ENDPOINT",
+                         headers=lambda d: {"Authorization": f"LumigoToken {_secret('LUMIGO_TOKEN')}"}),
+    "middleware": _otlp_grpc("MW_TARGET",
+                             headers=lambda d: {"authorization": _secret("MW_API_KEY")}),
+    "newrelic": _otlp_grpc(
+        "NEWRELIC_ENDPOINT",
+        endpoint_fn=lambda d: _grpc_endpoint(
+            d.get("NEWRELIC_ENDPOINT") or "otlp.nr-data.net:4317"),
+        headers=lambda d: {"api-key": _secret("NEWRELIC_API_KEY")}),
+    "observe": _otlp_http(
+        "OBSERVE_CUSTOMER_ID",
+        endpoint_fn=lambda d: f"https://{_require(d, 'OBSERVE_CUSTOMER_ID')}.collect.observeinc.com/v2/otel",
+        headers=_bearer("OBSERVE_TOKEN")),
+    "oneuptime": _otlp_http(
+        "ONEUPTIME_INGESTION_KEY", endpoint_fn=lambda d: "https://otlp.oneuptime.com",
+        headers=lambda d: {"x-oneuptime-token": _secret("ONEUPTIME_INGESTION_KEY")}),
+    "openobserve": _otlp_http(
+        "OPEN_OBSERVE_ENDPOINT",
+        headers=lambda d: {"Authorization": _secret("OPEN_OBSERVE_API_KEY"),
+                           "organization": d.get("OPEN_OBSERVE_STREAM_NAME", "default")}),
+    "oracle": _otlp_http("ORACLE_ENDPOINT",
+                         headers=lambda d: {"Authorization": _secret("ORACLE_DATA_KEY")}),
+    "otlp": _otlp_grpc("OTLP_GRPC_ENDPOINT", tls_insecure=True),
+    "otlphttp": _otlp_http("OTLP_HTTP_ENDPOINT"),
+    "prometheus": _prometheus_rw(
+        "PROMETHEUS_REMOTEWRITE_URL", lambda d: {}),
+    "qryn-oss": _otlp_http(
+        "QRYN_OSS_URL",
+        headers=lambda d: {"X-Scope-OrgID": d.get("QRYN_OSS_USERNAME", "")}),
+    "quickwit": _otlp_grpc("QUICKWIT_URL", tls_insecure=True),
+    "seq": _otlp_http("SEQ_ENDPOINT",
+                      headers=lambda d: {"X-Seq-ApiKey": _secret("SEQ_API_KEY")}),
+    "signalfx": _signalfx,
+    "signoz": _otlp_grpc("SIGNOZ_URL", tls_insecure=True),
+    "splunk": _splunk,
+    "splunkotlp": _otlp_grpc(
+        "SPLUNK_REALM",
+        endpoint_fn=lambda d: f"ingest.{_require(d, 'SPLUNK_REALM')}.signalfx.com:443",
+        headers=lambda d: {"X-SF-TOKEN": _secret("SPLUNK_ACCESS_TOKEN")}),
+    "sumologic": _otlp_http(
+        "SUMOLOGIC_COLLECTION_URL",
+        endpoint_fn=lambda d: _secret("SUMOLOGIC_COLLECTION_URL")),
+    "telemetryhub": _otlp_grpc(
+        "TELEMETRY_HUB_API_KEY", endpoint_fn=lambda d: "otlp.telemetryhub.com:4317",
+        headers=lambda d: {"x-telemetryhub-key": _secret("TELEMETRY_HUB_API_KEY")}),
+    "tempo": _otlp_grpc("TEMPO_URL", tls_insecure=True),
+    "tingyun": _otlp_grpc("TINGYUN_ENDPOINT",
+                          headers=lambda d: {"licenseKey": _secret("TINGYUN_LICENSE_KEY")}),
+    "traceloop": _otlp_grpc("TRACELOOP_ENDPOINT",
+                            headers=_bearer("TRACELOOP_API_KEY")),
+    "uptrace": _otlp_grpc(
+        "UPTRACE_ENDPOINT",
+        endpoint_fn=lambda d: _grpc_endpoint(
+            d.get("UPTRACE_ENDPOINT") or "otlp.uptrace.dev:4317"),
+        headers=lambda d: {"uptrace-dsn": _require(d, "UPTRACE_DSN")}),
+    "victoriametricscloud": _prometheus_rw(
+        "VICTORIA_METRICS_CLOUD_ENDPOINT",
+        lambda d: {"headers": {"Authorization": f"Bearer {_secret('VICTORIA_METRICS_CLOUD_TOKEN')}"}}),
+    "debug": _single("debug", lambda d: {"verbosity": "basic"}),
+    "nop": _single("nop", lambda d: {}),
+    "mock": _mock,
+}
+
+
+def modify_config(dest: Destination, config: GenericMap) -> list[str]:
+    """ModifyConfig contract (common/config): add this destination's
+    exporters to ``config`` and create one ``<signal>/<type>-<id>`` pipeline
+    per enabled+supported signal (exporters only; pipelinegen attaches the
+    forward-connector receiver and generic batch processor,
+    config_builder.go:99-118). Returns created pipeline names."""
+    spec = get_spec(dest.dest_type)
+    usable = [s for s in dest.signals if spec.supports(s)]
+    if not usable:
+        raise ConfigerError(
+            f"destination {dest.id} ({dest.dest_type}) has no supported signals to export")
+
+    recipe = _CONFIGERS.get(dest.dest_type)
+    if recipe is None:
+        raise ConfigerError(f"no configer for destination type {dest.dest_type!r}")
+
+    assignments = recipe(dest, config)
+    pipeline_names: list[str] = []
+    for sig in usable:
+        entries = assignments.get(sig)
+        if not entries:
+            continue
+        # a "receiver:<name>" entry wires a connector as the pipeline's
+        # receiver instead (e.g. datadog's traces->metrics APM-stats bridge)
+        receivers = [e.split(":", 1)[1] for e in entries
+                     if e.startswith("receiver:")]
+        exporters = [e for e in entries if not e.startswith("receiver:")]
+        pname = f"{sig.value}/{dest.dest_type}-{dest.id}"
+        config["service"]["pipelines"][pname] = {
+            "receivers": receivers, "processors": [], "exporters": exporters}
+        pipeline_names.append(pname)
+    return pipeline_names

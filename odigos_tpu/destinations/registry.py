@@ -1,0 +1,244 @@
+"""Declarative destination specs (destinations/data/*.yaml analog).
+
+Each spec records: signal support (which of T/M/L the backend accepts),
+the field schema with secret flags (the UI renders these; secret fields are
+delivered via env, never inlined into generated config), and the category
+(managed vs self-hosted). Field lists carry the same env-var names the
+reference uses so existing user secrets transfer 1:1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+from ..components.api import Signal
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    name: str
+    secret: bool = False
+    required: bool = False
+
+
+@dataclass(frozen=True)
+class DestinationSpec:
+    dest_type: str
+    display_name: str
+    category: str  # "managed" | "self hosted"
+    signals: frozenset[Signal]
+    fields: tuple[FieldSpec, ...] = ()
+
+    def supports(self, signal: Signal) -> bool:
+        return signal in self.signals
+
+
+@dataclass
+class Destination:
+    """A configured destination instance (Destination CR analog,
+    api/odigos/v1alpha1/destination_types.go): which backend, which signals
+    the user enabled (intersected with spec support), field values."""
+
+    id: str
+    dest_type: str
+    signals: list[Signal]
+    config: dict[str, str] = dc_field(default_factory=dict)
+    # names of fields whose values live in the secret store; generated
+    # configs reference them as ${NAME}
+    secret_fields: list[str] = dc_field(default_factory=list)
+    data_stream_names: list[str] = dc_field(default_factory=list)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self.config.get(key, default)
+
+    def enabled(self, signal: Signal) -> bool:
+        return signal in self.signals
+
+
+T, M, L = Signal.TRACES, Signal.METRICS, Signal.LOGS
+
+
+def _spec(dest_type: str, display: str, category: str, signals: str,
+          *fields) -> DestinationSpec:
+    sigmap = {"T": T, "M": M, "L": L}
+    fs = tuple(FieldSpec(f, secret=False) if isinstance(f, str)
+               else FieldSpec(f[0], secret=bool(f[1])) for f in fields)
+    return DestinationSpec(dest_type, display, category,
+                           frozenset(sigmap[c] for c in signals), fs)
+
+
+# The 63-backend registry (parity list with destinations/data/; signals and
+# env-var names match the reference so migrating users keep their secrets).
+_ALL = [
+    _spec("alibabacloud", "Alibaba Cloud", "managed", "T",
+          "ALIBABA_ENDPOINT", ("ALIBABA_TOKEN", 1)),
+    _spec("appdynamics", "AppDynamics", "managed", "TML",
+          "APPDYNAMICS_APPLICATION_NAME", "APPDYNAMICS_ACCOUNT_NAME",
+          "APPDYNAMICS_ENDPOINT_URL", ("APPDYNAMICS_API_KEY", 1)),
+    _spec("cloudwatch", "AWS CloudWatch", "managed", "ML",
+          "AWS_CLOUDWATCH_LOG_GROUP_NAME", "AWS_CLOUDWATCH_LOG_STREAM_NAME",
+          "AWS_CLOUDWATCH_REGION", "AWS_CLOUDWATCH_ENDPOINT",
+          "AWS_CLOUDWATCH_METRICS_NAMESPACE"),
+    _spec("s3", "AWS S3", "managed", "TML",
+          "S3_BUCKET", "S3_REGION", "S3_PARTITION", "S3_MARSHALER"),
+    _spec("xray", "AWS X-Ray", "managed", "T",
+          "AWS_XRAY_REGION", "AWS_XRAY_ENDPOINT", "AWS_XRAY_PROXY_ADDRESS"),
+    _spec("axiom", "Axiom", "managed", "TL",
+          "AXIOM_DATASET", ("AXIOM_API_TOKEN", 1)),
+    _spec("azureblob", "Azure Blob Storage", "managed", "TL",
+          "AZURE_BLOB_ACCOUNT_NAME", "AZURE_BLOB_CONTAINER_NAME"),
+    _spec("azuremonitor", "Azure Monitor", "managed", "TML",
+          "AZURE_MONITOR_CONNECTION_STRING", "AZURE_MONITOR_ENDPOINT"),
+    _spec("betterstack", "Better Stack", "managed", "ML",
+          ("BETTERSTACK_TOKEN", 1)),
+    _spec("bonree", "Bonree", "managed", "TM",
+          "BONREE_ENDPOINT", ("BONREE_ACCOUNT_ID", 1), ("BONREE_ENVIRONMENT_ID", 1)),
+    _spec("causely", "Causely", "managed", "TM", "CAUSELY_URL"),
+    _spec("checkly", "Checkly", "managed", "T",
+          "CHECKLY_ENDOINT", ("CHECKLY_API_KEY", 1)),
+    _spec("chronosphere", "Chronosphere", "managed", "TM",
+          "CHRONOSPHERE_DOMAIN", ("CHRONOSPHERE_API_TOKEN", 1)),
+    _spec("clickhouse", "ClickHouse", "self hosted", "TML",
+          "CLICKHOUSE_ENDPOINT", "CLICKHOUSE_USERNAME", ("CLICKHOUSE_PASSWORD", 1),
+          "CLICKHOUSE_DATABASE_NAME", "CLICKHOUSE_TRACES_TABLE",
+          "CLICKHOUSE_LOGS_TABLE"),
+    _spec("coralogix", "Coralogix", "managed", "TML",
+          ("CORALOGIX_PRIVATE_KEY", 1), "CORALOGIX_DOMAIN",
+          "CORALOGIX_APPLICATION_NAME", "CORALOGIX_SUBSYSTEM_NAME"),
+    _spec("dash0", "Dash0", "managed", "TML",
+          "DASH0_ENDPOINT", ("DASH0_TOKEN", 1)),
+    _spec("datadog", "Datadog", "managed", "TML",
+          ("DATADOG_API_KEY", 1), "DATADOG_SITE"),
+    _spec("dynamic", "Dynamic", "self hosted", "TML",
+          "DYNAMIC_DESTINATION_TYPE", "DYNAMIC_CONFIGURATION_DATA"),
+    _spec("dynatrace", "Dynatrace", "managed", "TML",
+          "DYNATRACE_URL", ("DYNATRACE_API_TOKEN", 1)),
+    _spec("elasticapm", "Elastic APM", "managed", "TML",
+          "ELASTIC_APM_SERVER_ENDPOINT", ("ELASTIC_APM_SECRET_TOKEN", 1)),
+    _spec("elasticsearch", "Elasticsearch", "self hosted", "TL",
+          "ELASTICSEARCH_URL", "ES_TRACES_INDEX", "ES_LOGS_INDEX",
+          "ELASTICSEARCH_USERNAME", ("ELASTICSEARCH_PASSWORD", 1)),
+    _spec("qryn", "Gigapipe", "managed", "TML",
+          ("QRYN_API_SECRET", 1), "QRYN_API_KEY", "QRYN_URL"),
+    _spec("googlecloud", "Google Cloud Monitoring", "managed", "TL",
+          "GCP_PROJECT_ID", ("GCP_APPLICATION_CREDENTIALS", 1)),
+    _spec("googlecloudotlp", "Google Cloud OTLP", "managed", "T",
+          "GCP_PROJECT_ID", ("GCP_APPLICATION_CREDENTIALS", 1)),
+    _spec("grafanacloudloki", "Grafana Cloud Loki", "managed", "L",
+          "GRAFANA_CLOUD_LOKI_ENDPOINT", "GRAFANA_CLOUD_LOKI_USERNAME",
+          ("GRAFANA_CLOUD_LOKI_PASSWORD", 1), "GRAFANA_CLOUD_LOKI_LABELS"),
+    _spec("grafanacloudprometheus", "Grafana Cloud Prometheus", "managed", "M",
+          "GRAFANA_CLOUD_PROMETHEUS_RW_ENDPOINT", "GRAFANA_CLOUD_PROMETHEUS_USERNAME",
+          ("GRAFANA_CLOUD_PROMETHEUS_PASSWORD", 1),
+          "PROMETHEUS_RESOURCE_ATTRIBUTES_LABELS"),
+    _spec("grafanacloudtempo", "Grafana Cloud Tempo", "managed", "T",
+          "GRAFANA_CLOUD_TEMPO_ENDPOINT", "GRAFANA_CLOUD_TEMPO_USERNAME",
+          ("GRAFANA_CLOUD_TEMPO_PASSWORD", 1)),
+    _spec("greptime", "Greptime", "managed", "M",
+          "GREPTIME_ENDPOINT", "GREPTIME_DB_NAME",
+          "GREPTIME_BASIC_USERNAME", ("GREPTIME_BASIC_PASSWORD", 1)),
+    _spec("groundcover", "Groundcover inCloud", "managed", "TML",
+          "GROUNDCOVER_ENDPOINT", ("GROUNDCOVER_API_KEY", 1)),
+    _spec("honeycomb", "Honeycomb", "managed", "TML",
+          ("HONEYCOMB_API_KEY", 1), "HONEYCOMB_ENDPOINT"),
+    _spec("hyperdx", "HyperDX", "managed", "TML", ("HYPERDX_API_KEY", 1)),
+    _spec("instana", "IBM Instana", "managed", "TML",
+          "INSTANA_ENDPOINT", ("INSTANA_AGENT_KEY", 1)),
+    _spec("jaeger", "Jaeger", "self hosted", "T",
+          "JAEGER_URL", "JAEGER_TLS_ENABLED", "JAEGER_CA_PEM"),
+    _spec("kafka", "Kafka", "self hosted", "TML",
+          "KAFKA_BROKERS", "KAFKA_TOPIC", "KAFKA_PROTOCOL_VERSION",
+          "KAFKA_CLIENT_ID", "KAFKA_AUTH_METHOD", "KAFKA_USERNAME",
+          ("KAFKA_PASSWORD", 1)),
+    _spec("kloudmate", "KloudMate", "managed", "TML", ("KLOUDMATE_API_KEY", 1)),
+    _spec("last9", "Last9", "managed", "TML",
+          "LAST9_OTLP_ENDPOINT", ("LAST9_OTLP_BASIC_AUTH_HEADER", 1)),
+    _spec("lightstep", "Lightstep", "managed", "T", ("LIGHTSTEP_ACCESS_TOKEN", 1)),
+    _spec("logzio", "Logz.io", "managed", "TML",
+          "LOGZIO_REGION", ("LOGZIO_TRACING_TOKEN", 1),
+          ("LOGZIO_METRICS_TOKEN", 1), ("LOGZIO_LOGS_TOKEN", 1)),
+    _spec("loki", "Loki", "self hosted", "L",
+          "LOKI_URL", "LOKI_USERNAME", ("LOKI_PASSWORD", 1), "LOKI_LABELS"),
+    _spec("lumigo", "Lumigo", "managed", "TML",
+          "LUMIGO_ENDPOINT", ("LUMIGO_TOKEN", 1)),
+    _spec("middleware", "Middleware", "managed", "TML",
+          "MW_TARGET", ("MW_API_KEY", 1)),
+    _spec("newrelic", "New Relic", "managed", "TML",
+          ("NEWRELIC_API_KEY", 1), "NEWRELIC_ENDPOINT"),
+    _spec("observe", "Observe", "managed", "TML",
+          "OBSERVE_CUSTOMER_ID", ("OBSERVE_TOKEN", 1)),
+    _spec("oneuptime", "OneUptime", "managed", "TML",
+          ("ONEUPTIME_INGESTION_KEY", 1)),
+    _spec("openobserve", "OpenObserve", "managed", "TL",
+          "OPEN_OBSERVE_ENDPOINT", ("OPEN_OBSERVE_API_KEY", 1),
+          "OPEN_OBSERVE_STREAM_NAME"),
+    _spec("oracle", "Oracle Cloud", "managed", "TM",
+          "ORACLE_ENDPOINT", ("ORACLE_DATA_KEY", 1)),
+    _spec("otlp", "OTLP gRPC", "self hosted", "TML",
+          "OTLP_GRPC_ENDPOINT", "OTLP_GRPC_COMPRESSION", "OTLP_GRPC_HEADERS",
+          "OTLP_GRPC_TLS_ENABLED", "OTLP_GRPC_CA_PEM"),
+    _spec("otlphttp", "OTLP HTTP", "self hosted", "TML",
+          "OTLP_HTTP_ENDPOINT", "OTLP_HTTP_BASIC_AUTH_USERNAME",
+          ("OTLP_HTTP_BASIC_AUTH_PASSWORD", 1), "OTLP_HTTP_COMPRESSION",
+          "OTLP_HTTP_HEADERS", "OTLP_HTTP_TLS_ENABLED"),
+    _spec("prometheus", "Prometheus", "self hosted", "M",
+          "PROMETHEUS_REMOTEWRITE_URL", "PROMETHEUS_RESOURCE_ATTRIBUTES_LABELS",
+          ("PROMETHEUS_BEARER_TOKEN", 1), "PROMETHEUS_BASIC_AUTH_USERNAME",
+          ("PROMETHEUS_BASIC_AUTH_PASSWORD", 1)),
+    _spec("qryn-oss", "qryn OSS", "self hosted", "TML",
+          "QRYN_OSS_URL", ("QRYN_OSS_PASSWORD", 1), "QRYN_OSS_USERNAME"),
+    _spec("quickwit", "Quickwit", "self hosted", "TL", "QUICKWIT_URL"),
+    _spec("seq", "Seq", "self hosted", "TL",
+          "SEQ_ENDPOINT", ("SEQ_API_KEY", 1)),
+    _spec("signalfx", "Splunk SignalFx", "managed", "TM",
+          ("SIGNALFX_ACCESS_TOKEN", 1), "SIGNALFX_REALM"),
+    _spec("signoz", "SigNoz", "self hosted", "TML", "SIGNOZ_URL"),
+    _spec("splunk", "Splunk SAPM", "managed", "T",
+          ("SPLUNK_ACCESS_TOKEN", 1), "SPLUNK_REALM"),
+    _spec("splunkotlp", "Splunk OTLP", "managed", "T",
+          ("SPLUNK_ACCESS_TOKEN", 1), "SPLUNK_REALM"),
+    _spec("sumologic", "Sumo Logic", "managed", "TML",
+          ("SUMOLOGIC_COLLECTION_URL", 1)),
+    _spec("telemetryhub", "TelemetryHub", "managed", "TML",
+          ("TELEMETRY_HUB_API_KEY", 1)),
+    _spec("tempo", "Tempo", "self hosted", "T", "TEMPO_URL"),
+    _spec("tingyun", "Tingyun", "managed", "TM",
+          "TINGYUN_ENDPOINT", ("TINGYUN_LICENSE_KEY", 1)),
+    _spec("traceloop", "Traceloop", "managed", "TM",
+          "TRACELOOP_ENDPOINT", ("TRACELOOP_API_KEY", 1)),
+    _spec("uptrace", "Uptrace", "managed", "TML",
+          "UPTRACE_DSN", "UPTRACE_ENDPOINT"),
+    _spec("victoriametricscloud", "VictoriaMetrics Cloud", "managed", "M",
+          "VICTORIA_METRICS_CLOUD_ENDPOINT", ("VICTORIA_METRICS_CLOUD_TOKEN", 1)),
+    # test doubles (collector/exporters/mockdestinationexporter, config/debug.go, nop.go)
+    _spec("debug", "Debug", "self hosted", "TML"),
+    _spec("nop", "Nop", "self hosted", "TML"),
+    _spec("mock", "Mock Destination", "self hosted", "TML",
+          "MOCK_REJECT_FRACTION", "MOCK_RESPONSE_DURATION"),
+]
+
+SPECS: dict[str, DestinationSpec] = {s.dest_type: s for s in _ALL}
+
+
+def get_spec(dest_type: str) -> DestinationSpec:
+    try:
+        return SPECS[dest_type]
+    except KeyError:
+        raise KeyError(f"unknown destination type {dest_type!r} "
+                       f"(known: {len(SPECS)} types)") from None
+
+
+def validate_destination(dest: Destination) -> list[str]:
+    """Schema validation: type exists, enabled signals are supported."""
+    problems = []
+    spec = SPECS.get(dest.dest_type)
+    if spec is None:
+        return [f"unknown destination type {dest.dest_type!r}"]
+    for sig in dest.signals:
+        if not spec.supports(sig):
+            problems.append(
+                f"destination {dest.id}: {dest.dest_type} does not support {sig.value}")
+    if not dest.signals:
+        problems.append(f"destination {dest.id}: no signals enabled")
+    return problems

@@ -1,0 +1,31 @@
+"""Collector config generation (common/pipelinegen analog).
+
+Assembles the full gateway collector config from destinations + processors +
+data streams, and the node collector configs per signal. This is the
+subtlest pure-logic code in the reference (SURVEY.md §7 "hard parts") —
+connector fan-in/out, per-signal enablement, self-telemetry insertion — so
+it carries the same golden-test discipline (tests/test_pipelinegen.py).
+"""
+
+from .builder import (
+    DataStream,
+    DataStreamDestination,
+    GatewayOptions,
+    ResourceStatuses,
+    SourceRef,
+    build_gateway_config,
+    signals_root_pipeline_names,
+)
+from .nodecollector import build_node_collector_config, NodeCollectorOptions
+
+__all__ = [
+    "DataStream",
+    "DataStreamDestination",
+    "GatewayOptions",
+    "ResourceStatuses",
+    "SourceRef",
+    "build_gateway_config",
+    "signals_root_pipeline_names",
+    "build_node_collector_config",
+    "NodeCollectorOptions",
+]

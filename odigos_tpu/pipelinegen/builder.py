@@ -1,0 +1,338 @@
+"""Gateway collector config assembly.
+
+Reference behavior being reproduced (common/pipelinegen/config_builder.go):
+
+* ``GetBasicConfig`` (:272): otlp receiver + ``resource/odigos-version``
+  processor + generic batch processor + memory_limiter.
+* ``CalculateGatewayConfig`` (:34): run every destination's configer
+  (ModifyConfig) to create destination pipelines; wire a ``forward/<pipe>``
+  connector into each (:99-108) and append the generic batch processor
+  (:110); track per-signal enablement (:118-141); build data-stream
+  pipelines fed by the router connector (pipeline_builder.go:13); insert
+  root pipelines per enabled signal (:184 — receivers [otlp], processors
+  [memory_limiter, resource/odigos-version, user processors...], exporter =
+  router connector); optional servicegraph pipeline (:231); self-telemetry
+  (odigostrafficmetrics appended to every pipeline,
+  autoscaler/controllers/clustercollector/configmap.go:86-126).
+
+North-star extension (not in the reference): when the anomaly stage is
+enabled, the root traces pipeline gets ``tpuanomaly`` before the router and
+an ``anomalyrouter`` connector routes tagged spans to a dedicated
+``traces/<anomaly-stream>`` pipeline — behind the same factory seam, so a
+config generated with ``anomaly.enabled=False`` is byte-identical to a
+build without the TPU components registered.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..components.api import Signal
+from ..config.model import AnomalyStageConfiguration
+from ..destinations.configers import ConfigerError, modify_config
+from ..destinations.registry import Destination
+
+GenericMap = dict[str, Any]
+
+SIGNALS = (Signal.TRACES, Signal.METRICS, Signal.LOGS)
+GENERIC_BATCH = "batch"
+VERSION_RESOURCE_PROCESSOR = "resource/odigos-version"
+SMALL_BATCHES_PROCESSOR = "batch/small-batches"
+TRAFFIC_METRICS = "odigostrafficmetrics"
+SERVICEGRAPH_CONNECTOR = "servicegraph"
+
+
+@dataclass(frozen=True)
+class DataStreamDestination:
+    destination_id: str
+
+
+@dataclass(frozen=True)
+class SourceRef:
+    """A workload identity routed to a stream (Source CR analog)."""
+
+    namespace: str
+    kind: str  # deployment | statefulset | daemonset | cronjob
+    name: str
+
+    def as_dict(self) -> dict[str, str]:
+        return {"namespace": self.namespace, "kind": self.kind,
+                "name": self.name}
+
+
+@dataclass(frozen=True)
+class DataStream:
+    """A named routing group (datastreams.go:21): sources are mapped to
+    streams; each stream fans out to its member destinations. A stream
+    named ``default`` receives telemetry from unmapped sources (router's
+    default_pipelines)."""
+
+    name: str
+    destinations: tuple[DataStreamDestination, ...] = ()
+    sources: tuple[SourceRef, ...] = ()
+
+
+@dataclass
+class GatewayOptions:
+    service_graph_disabled: bool = False
+    cluster_metrics_enabled: bool = False
+    small_batches: Optional[GenericMap] = None  # small-batches profile config
+    anomaly: Optional[AnomalyStageConfiguration] = None
+    self_telemetry: bool = True
+    # extra processor ids (already configured in `processors`) to run in the
+    # root pipeline per signal, e.g. compiled Actions.
+    root_processors: dict[Signal, list[str]] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceStatuses:
+    """Per-CR reconcile outcome (config.ResourceStatuses analog): None =
+    success, str = error message surfaced on the Destination/Processor CR."""
+
+    destination: dict[str, Optional[str]] = field(default_factory=dict)
+    processor: dict[str, Optional[str]] = field(default_factory=dict)
+
+
+def router_connector_name(signal: Signal) -> str:
+    return f"odigosrouter/{signal.value}"
+
+
+def root_pipeline_name(signal: Signal) -> str:
+    return f"{signal.value}/in"
+
+
+def signals_root_pipeline_names() -> list[str]:
+    return [root_pipeline_name(s) for s in SIGNALS]
+
+
+def basic_config() -> GenericMap:
+    """GetBasicConfig (:272): the invariant prefix of every gateway config."""
+    return {
+        "receivers": {
+            "otlp": {
+                "protocols": {
+                    "grpc": {"endpoint": "0.0.0.0:4317",
+                             "max_recv_msg_size_mib": 128},
+                    "http": {"endpoint": "0.0.0.0:4318"},
+                },
+            },
+        },
+        "processors": {
+            VERSION_RESOURCE_PROCESSOR: {
+                "attributes": [{"key": "odigos.version",
+                                "value": "${ODIGOS_VERSION}",
+                                "action": "upsert"}],
+            },
+            GENERIC_BATCH: {},
+            "memory_limiter": {},
+        },
+        "exporters": {},
+        "connectors": {},
+        "extensions": {},
+        "service": {
+            "extensions": [],
+            "pipelines": {},
+        },
+    }
+
+
+def build_gateway_config(
+    destinations: list[Destination],
+    processors: list[GenericMap] | None = None,
+    data_streams: list[DataStream] | None = None,
+    options: GatewayOptions | None = None,
+) -> tuple[GenericMap, ResourceStatuses, list[Signal]]:
+    """The CalculateGatewayConfig analog. ``processors`` entries are dicts:
+    {"id": str, "type": str, "signals": [..], "config": {...}} (compiled from
+    Processor/Action CRs by the autoscaler). Returns (config, statuses,
+    enabled_signals)."""
+    options = options or GatewayOptions()
+    processors = processors or []
+    data_streams = list(data_streams or [])
+    if not data_streams:
+        # every install has a default stream catching unmapped sources and
+        # fanning out to all destinations (datastreams.go default stream)
+        data_streams = [DataStream("default", tuple(
+            DataStreamDestination(d.id) for d in destinations))]
+    config = basic_config()
+    status = ResourceStatuses()
+
+    # --- user/action processors -> config + per-signal root chains
+    signal_processors: dict[Signal, list[str]] = {s: [] for s in SIGNALS}
+    for proc in processors:
+        pid = proc.get("id") or proc.get("type")
+        ptype = proc.get("type")
+        if not pid or not ptype:
+            status.processor[str(pid)] = "processor missing id/type"
+            continue
+        key = pid if pid.split("/", 1)[0] == ptype else f"{ptype}/{pid}"
+        config["processors"][key] = dict(proc.get("config") or {})
+        for sig_name in proc.get("signals", [s.value for s in SIGNALS]):
+            try:
+                sig = Signal(sig_name)
+            except ValueError:
+                status.processor[pid] = f"unknown signal {sig_name}"
+                continue
+            signal_processors[sig].append(key)
+        status.processor.setdefault(pid, None)
+    for sig, extra in (options.root_processors or {}).items():
+        signal_processors[sig].extend(extra)
+
+    # --- destinations -> exporters + destination pipelines + forward conns
+    dest_forward_connectors: dict[str, list[str]] = {}
+    enabled: set[Signal] = set()
+    small_batches = options.small_batches
+    if small_batches:
+        config["processors"][SMALL_BATCHES_PROCESSOR] = {
+            "send_batch_size": small_batches.get("send_batch_size", 100),
+            "timeout_ms": small_batches.get("timeout_ms", 100),
+        }
+    for dest in destinations:
+        # configers run against a scratch copy: a recipe that fails after
+        # partially mutating the config must leave no orphan exporters or
+        # extensions behind (the destination is reported failed instead)
+        scratch = copy.deepcopy(config)
+        try:
+            pipeline_names = modify_config(dest, scratch)
+        except (ConfigerError, KeyError) as e:
+            status.destination[dest.id] = str(e)
+            continue
+        config = scratch
+        for pname in pipeline_names:
+            conn = f"forward/{pname}"
+            dest_forward_connectors.setdefault(dest.id, []).append(conn)
+            config["connectors"][conn] = {}
+            pipe = config["service"]["pipelines"][pname]
+            pipe["receivers"].append(conn)
+            pipe["processors"].append(GENERIC_BATCH)
+            sig = Signal(pname.split("/", 1)[0])
+            if sig == Signal.TRACES and small_batches:
+                pipe["processors"].append(SMALL_BATCHES_PROCESSOR)
+            enabled.add(sig)
+        status.destination[dest.id] = None
+
+    enabled_signals = [s for s in SIGNALS if s in enabled]
+
+    # --- data-stream pipelines: router connector -> forward connectors
+    # (pipeline_builder.go:13 buildDataStreamPipelines)
+    anomaly = options.anomaly
+    anomaly_on = bool(anomaly and anomaly.enabled and Signal.TRACES in enabled)
+    stream_pipelines: dict[Signal, list[str]] = {s: [] for s in SIGNALS}
+    for stream in data_streams:
+        for sig in SIGNALS:
+            exporters = []
+            for sd in stream.destinations:
+                for conn in dest_forward_connectors.get(sd.destination_id, []):
+                    if conn.startswith(f"forward/{sig.value}/"):
+                        exporters.append(conn)
+            if not exporters:
+                continue
+            pname = f"{sig.value}/{stream.name}"
+            config["service"]["pipelines"][pname] = {
+                "receivers": [router_connector_name(sig)],
+                "processors": [GENERIC_BATCH],
+                "exporters": exporters,
+            }
+            stream_pipelines[sig].append(pname)
+
+    # --- anomaly stream pipeline (north star): receives whole traces whose
+    # spans were flagged by tpuanomaly, via the anomalyrouter connector. If
+    # the operator defined a stream with that name, the anomalyrouter feeds
+    # the existing (scoped) pipeline; otherwise a dedicated pipeline fans
+    # out to every traces destination.
+    if anomaly_on:
+        anomaly_pipeline = f"traces/{anomaly.route_to_stream}"
+        if anomaly_pipeline in config["service"]["pipelines"]:
+            config["service"]["pipelines"][anomaly_pipeline]["receivers"] \
+                .append("anomalyrouter")
+        else:
+            all_traces_forwards = sorted(
+                conn for conns in dest_forward_connectors.values()
+                for conn in conns if conn.startswith("forward/traces/"))
+            config["service"]["pipelines"][anomaly_pipeline] = {
+                "receivers": ["anomalyrouter"],
+                "processors": [GENERIC_BATCH],
+                "exporters": all_traces_forwards,
+            }
+        config["connectors"]["anomalyrouter"] = {
+            "mode": "trace",
+            "mirror": False,
+            "anomaly_pipelines": [anomaly_pipeline],
+            "default_pipelines": [],
+        }
+
+    # --- root pipelines per enabled signal (:184); router connector config
+    # uses the odigosrouter schema: source identity -> stream pipelines,
+    # with the `default` stream catching unmapped sources.
+    for sig in enabled_signals:
+        conn = router_connector_name(sig)
+        default_pipeline = f"{sig.value}/default"
+        config["connectors"][conn] = {
+            "data_streams": [
+                {"name": ds.name,
+                 "sources": [s.as_dict() for s in ds.sources],
+                 "pipelines": [f"{sig.value}/{ds.name}"]}
+                for ds in data_streams
+                if f"{sig.value}/{ds.name}" in stream_pipelines[sig]],
+            "default_pipelines": (
+                [default_pipeline]
+                if default_pipeline in stream_pipelines[sig] else []),
+        }
+        procs = ["memory_limiter", VERSION_RESOURCE_PROCESSOR]
+        procs.extend(signal_processors[sig])
+        exporters = [conn]
+        if sig == Signal.TRACES and anomaly_on:
+            # north star: score spans on TPU before routing; flagged traces
+            # additionally flow through the anomalyrouter.
+            config["processors"]["tpuanomaly"] = {
+                "model": anomaly.model,
+                "threshold": anomaly.threshold,
+                "max_batch": anomaly.max_batch,
+                "timeout_ms": anomaly.timeout_ms,
+                "devices": anomaly.devices,
+            }
+            procs.append("tpuanomaly")
+            exporters.append("anomalyrouter")
+        config["service"]["pipelines"][root_pipeline_name(sig)] = {
+            "receivers": ["otlp"],
+            "processors": procs,
+            "exporters": exporters,
+        }
+
+    # --- servicegraph (:231): root traces pipeline also feeds the
+    # servicegraph connector; its metrics surface on a dedicated pipeline.
+    if Signal.TRACES in enabled and not options.service_graph_disabled:
+        config["connectors"][SERVICEGRAPH_CONNECTOR] = {
+            "store": {"ttl_s": 15}, "store_expiration_loop_s": 5,
+            "dimensions": ["service.name"],
+        }
+        config["exporters"]["prometheus/servicegraph"] = {
+            "namespace": "servicegraph"}
+        config["service"]["pipelines"]["metrics/servicegraph"] = {
+            "receivers": [SERVICEGRAPH_CONNECTOR],
+            "processors": [],
+            "exporters": ["prometheus/servicegraph"],
+        }
+        root = config["service"]["pipelines"][root_pipeline_name(Signal.TRACES)]
+        root["exporters"].append(SERVICEGRAPH_CONNECTOR)
+
+    # --- self telemetry (configmap.go:42,86-126): traffic metrics on every
+    # data pipeline + an own-metrics pipeline to the internal store.
+    if options.self_telemetry:
+        config["processors"][TRAFFIC_METRICS] = {}
+        for pname, pipe in config["service"]["pipelines"].items():
+            if pname == "metrics/servicegraph":
+                continue
+            pipe["processors"] = list(pipe["processors"]) + [TRAFFIC_METRICS]
+        config["receivers"]["prometheus/self-metrics"] = {
+            "scrape_interval_s": 10}
+        config["exporters"]["otlp/ui"] = {"endpoint": "ui.odigos-system:4317"}
+        config["service"]["pipelines"]["metrics/otelcol"] = {
+            "receivers": ["prometheus/self-metrics"],
+            "processors": [VERSION_RESOURCE_PROCESSOR],
+            "exporters": ["otlp/ui"],
+        }
+
+    return config, status, enabled_signals

@@ -1,0 +1,149 @@
+"""Node collector config assembly.
+
+Reference: autoscaler/controllers/nodecollector/collectorconfig/
+{traces,metrics,logs,spanmetrics,ownmetrics}.go — the per-node (DaemonSet)
+collector reads spans from the in-process transport (the reference reads
+eBPF maps via odigosebpfreceiver; our analog is the shared-memory span
+ring), enriches with node/workload resource attributes, batches, and ships
+to the gateway. Traces use a **consistent-routing loadbalancing exporter**
+(traces.go:18-94) so whole-trace operations on the gateway (tail sampling,
+servicegraph, trace-tree anomaly models) see complete traces on one
+replica.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..components.api import Signal
+
+GenericMap = dict[str, Any]
+
+
+@dataclass
+class NodeCollectorOptions:
+    gateway_service: str = "odigos-gateway.odigos-system"
+    # which signals the cluster collector accepts (from CollectorsGroup
+    # status; a signal disabled there is not collected on the node at all)
+    enabled_signals: tuple[Signal, ...] = (Signal.TRACES,)
+    load_balancing: bool = True  # consistent routing across gateway replicas
+    compression: str = "none"
+    retry_on_failure: GenericMap = field(default_factory=lambda: {
+        "enabled": True, "initial_interval_s": 5, "max_interval_s": 30,
+        "max_elapsed_time_s": 300})
+    span_metrics_enabled: bool = False
+    host_metrics_enabled: bool = False
+    kubelet_stats_enabled: bool = False
+    log_collection_enabled: bool = False
+    own_metrics_port: int = 55682
+
+
+def build_node_collector_config(opts: NodeCollectorOptions) -> GenericMap:
+    config: GenericMap = {
+        "receivers": {}, "processors": {}, "exporters": {},
+        "connectors": {}, "extensions": {},
+        "service": {"extensions": [], "pipelines": {}},
+    }
+    pipelines = config["service"]["pipelines"]
+
+    # shared enrichment + batching (common.go): workload resource attrs are
+    # stamped on-node so the gateway never needs a k8s watch per span.
+    config["processors"]["resource/node"] = {
+        "attributes": [{"key": "k8s.node.name", "value": "${NODE_NAME}",
+                        "action": "upsert"}]}
+    config["processors"]["odigosresourcename"] = {}
+    config["processors"]["batch"] = {}
+    config["processors"]["memory_limiter"] = {}
+
+    otlp_exporter: GenericMap = {
+        "endpoint": f"{opts.gateway_service}:4317",
+        "compression": opts.compression,
+        "tls": {"insecure": True},
+        "retry_on_failure": dict(opts.retry_on_failure),
+    }
+
+    if Signal.TRACES in opts.enabled_signals:
+        # spanring is our odigosebpfreceiver: reads the shared-memory span
+        # ring whose FD is handed over by the node agent (unixfd analog).
+        config["receivers"]["spanring"] = {"socket": "${SPANRING_SOCKET}"}
+        config["receivers"].setdefault("otlp", {"protocols": {
+            "grpc": {"endpoint": "0.0.0.0:4317"},
+            "http": {"endpoint": "0.0.0.0:4318"}}})
+        if opts.load_balancing:
+            # traces.go:26: consistent trace->replica routing
+            config["exporters"]["loadbalancing/traces"] = {
+                "protocol": {"otlp": dict(otlp_exporter)},
+                "resolver": {"k8s": {"service": opts.gateway_service}},
+                "routing_key": "traceID",
+            }
+            traces_exporter = "loadbalancing/traces"
+        else:
+            config["exporters"]["otlp/gateway"] = dict(otlp_exporter)
+            traces_exporter = "otlp/gateway"
+        pipelines["traces"] = {
+            "receivers": ["spanring", "otlp"],
+            "processors": ["memory_limiter", "resource/node",
+                           "odigosresourcename", "batch"],
+            "exporters": [traces_exporter],
+        }
+        if opts.span_metrics_enabled:
+            # spanmetrics.go: derive RED metrics on-node to offload gateway
+            config["connectors"]["spanmetrics"] = {
+                "histogram": {"explicit_bucket_boundaries_ms":
+                              [2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500]}}
+            pipelines["traces"]["exporters"].append("spanmetrics")
+
+    metrics_receivers: list[str] = []
+    if opts.span_metrics_enabled and Signal.TRACES in opts.enabled_signals:
+        # the spanmetrics connector only exists when the traces pipeline
+        # (its upstream) is built
+        metrics_receivers.append("spanmetrics")
+    if opts.host_metrics_enabled:
+        config["receivers"]["hostmetrics"] = {
+            "collection_interval_s": 10,
+            "scrapers": ["cpu", "memory", "disk", "network", "filesystem"]}
+        metrics_receivers.append("hostmetrics")
+    if opts.kubelet_stats_enabled:
+        config["receivers"]["kubeletstats"] = {
+            "collection_interval_s": 10,
+            "metric_groups": ["pod", "container"]}
+        metrics_receivers.append("kubeletstats")
+    if Signal.METRICS in opts.enabled_signals and metrics_receivers:
+        config["exporters"].setdefault("otlp/gateway", dict(otlp_exporter))
+        pipelines["metrics"] = {
+            "receivers": metrics_receivers,
+            "processors": ["memory_limiter", "resource/node", "batch"],
+            "exporters": ["otlp/gateway"],
+        }
+
+    if Signal.LOGS in opts.enabled_signals and opts.log_collection_enabled:
+        # logs.go: filelog tailing of container stdout with workload attrs
+        config["receivers"]["filelog"] = {
+            "include": ["/var/log/pods/*/*/*.log"],
+            "exclude": ["/var/log/pods/odigos-system_*/**"],
+        }
+        config["processors"]["odigoslogsresourceattrs"] = {}
+        config["exporters"].setdefault("otlp/gateway", dict(otlp_exporter))
+        pipelines["logs"] = {
+            "receivers": ["filelog"],
+            "processors": ["memory_limiter", "odigoslogsresourceattrs",
+                           "resource/node", "batch"],
+            "exporters": ["otlp/gateway"],
+        }
+
+    # own-metrics pipeline (ownmetrics.go): the collector's own prometheus
+    # metrics stream to the gateway, tagged with the node collector role.
+    config["receivers"]["prometheus/self-metrics"] = {
+        "scrape_interval_s": 10,
+        "endpoint": f"0.0.0.0:{opts.own_metrics_port}"}
+    config["processors"]["resource/self"] = {
+        "attributes": [{"key": "odigos.collector.role",
+                        "value": "NODE_COLLECTOR", "action": "upsert"}]}
+    config["exporters"].setdefault("otlp/gateway", dict(otlp_exporter))
+    pipelines["metrics/otelcol"] = {
+        "receivers": ["prometheus/self-metrics"],
+        "processors": ["resource/self", "resource/node"],
+        "exporters": ["otlp/gateway"],
+    }
+    return config

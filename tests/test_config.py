@@ -1,0 +1,152 @@
+"""Config system tests: profiles (tier gating, dependency expansion),
+sizing derivation (memory-limiter math of resource_config.go:22-32),
+effective-config computation."""
+
+import pytest
+
+from odigos_tpu.config import (
+    ALL_PROFILES,
+    Configuration,
+    PROFILES_BY_NAME,
+    Tier,
+    available_profiles_for_tier,
+    calculate_effective_config,
+)
+from odigos_tpu.config.model import EnvInjectionMethod, MountMethod
+from odigos_tpu.config.profiles import resolve_profiles
+from odigos_tpu.config.sizing import (
+    SIZING_PRESETS,
+    gateway_resources,
+)
+from odigos_tpu.config.model import CollectorGatewayConfiguration
+
+
+class TestProfiles:
+    def test_registry_size_and_categories(self):
+        # parity with the reference's 22 profiles in 4 categories
+        assert len(ALL_PROFILES) == 22
+        cats = {p.category for p in ALL_PROFILES}
+        assert cats == {"aggregators", "attributes", "instrumentation", "pipeline"}
+
+    def test_tier_gating(self):
+        community = available_profiles_for_tier(Tier.COMMUNITY)
+        assert all(p.minimum_tier == Tier.COMMUNITY for p in community)
+        assert len(available_profiles_for_tier(Tier.ONPREM)) == len(ALL_PROFILES)
+
+    def test_aggregator_expands_dependencies(self):
+        profiles, problems = resolve_profiles(["kratos"], Tier.ONPREM)
+        names = [p.name for p in profiles]
+        assert "kratos" in names
+        assert "full-payload-collection" in names
+        assert "allow_concurrent_agents" in names
+        assert not problems
+
+    def test_greatwall_is_kratos_plus_small_batches(self):
+        profiles, _ = resolve_profiles(["greatwall"], Tier.ONPREM)
+        names = {p.name for p in profiles}
+        assert "kratos" in names and "small-batches" in names
+
+    def test_tier_violation_reported(self):
+        profiles, problems = resolve_profiles(["kratos"], Tier.COMMUNITY)
+        assert profiles == []
+        assert any("requires tier" in p for p in problems)
+
+    def test_unknown_profile_reported(self):
+        _, problems = resolve_profiles(["no-such-profile"], Tier.ONPREM)
+        assert any("unknown profile" in p for p in problems)
+
+    def test_duplicate_application_is_idempotent(self):
+        profiles, _ = resolve_profiles(["kratos", "kratos"], Tier.ONPREM)
+        names = [p.name for p in profiles]
+        assert len(names) == len(set(names))
+
+
+class TestSizing:
+    def test_default_gateway_memory_limiter_math(self):
+        # resource_config.go: 500Mi request -> 625Mi limit (1.25x) ->
+        # hard 575 (limit-50), spike 115 (20%), gomem 460 (80%)
+        r = gateway_resources(CollectorGatewayConfiguration())
+        assert r.request_memory_mib == 500
+        assert r.limit_memory_mib == 625
+        assert r.memory_limiter_limit_mib == 575
+        assert r.memory_limiter_spike_limit_mib == 115
+        assert r.gomemlimit_mib == 460
+        assert (r.min_replicas, r.max_replicas) == (1, 10)
+        assert (r.request_cpu_m, r.limit_cpu_m) == (500, 1000)
+
+    def test_explicit_overrides_win_over_preset(self):
+        cfg = CollectorGatewayConfiguration(request_memory_mib=1000,
+                                            min_replicas=4)
+        r = gateway_resources(cfg, SIZING_PRESETS["size_s"])
+        assert r.request_memory_mib == 1000
+        assert r.min_replicas == 4
+        # unset field falls back to the preset
+        assert r.max_replicas == SIZING_PRESETS["size_s"].gateway_max_replicas
+
+    def test_presets_monotonic(self):
+        s, m, l = (SIZING_PRESETS[k] for k in ("size_s", "size_m", "size_l"))
+        assert s.gateway_request_memory_mib < m.gateway_request_memory_mib \
+            < l.gateway_request_memory_mib
+
+
+class TestEffectiveConfig:
+    def test_profiles_mutate_config(self):
+        cfg = Configuration(profiles=["kratos", "mount-method-k8s-host-path"])
+        eff = calculate_effective_config(cfg, Tier.ONPREM)
+        assert eff.config.allow_concurrent_agents is True
+        assert eff.config.mount_method == MountMethod.HOST_PATH
+        assert eff.config.extra.get("payload_collection") == "full"
+        assert not eff.problems
+
+    def test_authored_config_not_mutated(self):
+        cfg = Configuration(profiles=["allow_concurrent_agents"])
+        calculate_effective_config(cfg, Tier.COMMUNITY)
+        assert cfg.allow_concurrent_agents is None
+
+    def test_small_batches_profile_surfaces_in_extra(self):
+        cfg = Configuration(profiles=["greatwall"])
+        eff = calculate_effective_config(cfg, Tier.ONPREM)
+        assert eff.config.extra["small_batches"]["send_batch_size"] == 100
+
+    def test_sizing_preset_applied(self):
+        cfg = Configuration(resource_size_preset="size_l")
+        eff = calculate_effective_config(cfg)
+        assert eff.gateway.min_replicas == 3
+
+    def test_unknown_preset_reported(self):
+        cfg = Configuration(resource_size_preset="size_xxl")
+        eff = calculate_effective_config(cfg)
+        assert any("preset" in p for p in eff.problems)
+
+    def test_roundtrip_dict(self):
+        cfg = Configuration(profiles=["semconv"], cluster_name="c1")
+        d = cfg.to_dict()
+        back = Configuration.from_dict(d)
+        assert back.cluster_name == "c1"
+        assert back.profiles == ["semconv"]
+        assert back.collector_gateway.min_replicas is None
+
+    def test_env_injection_profile(self):
+        cfg = Configuration(profiles=["pod-manifest-env-var-injection"])
+        eff = calculate_effective_config(cfg)
+        assert eff.config.agent_env_vars_injection_method == \
+            EnvInjectionMethod.POD_MANIFEST
+
+
+class TestReviewRegressions:
+    def test_cloud_tier_excludes_onprem_profiles(self):
+        from odigos_tpu.config.profiles import resolve_profiles
+        profiles, problems = resolve_profiles(["kratos"], Tier.CLOUD)
+        assert profiles == []
+        assert any("requires tier" in p for p in problems)
+
+    def test_optional_oidc_hydrated(self):
+        from odigos_tpu.config.model import OidcConfiguration
+        cfg = Configuration.from_dict(
+            {"oidc": {"tenant_url": "https://t", "client_id": "c"}})
+        assert isinstance(cfg.oidc, OidcConfiguration)
+        assert cfg.oidc.tenant_url == "https://t"
+
+    def test_anomaly_threshold_within_score_contract(self):
+        from odigos_tpu.config.model import AnomalyStageConfiguration
+        assert 0.0 <= AnomalyStageConfiguration().threshold <= 1.0

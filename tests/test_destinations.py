@@ -1,0 +1,193 @@
+"""Destination registry + configer tests (the reference's golden-test
+discipline for common/config/*.go, e.g. otlphttp_test.go)."""
+
+import pytest
+
+from odigos_tpu.components.api import Signal
+from odigos_tpu.destinations import (
+    ConfigerError,
+    Destination,
+    SPECS,
+    get_spec,
+    modify_config,
+    validate_destination,
+)
+from odigos_tpu.destinations.configers import _CONFIGERS
+from odigos_tpu.pipelinegen.builder import basic_config
+
+T, M, L = Signal.TRACES, Signal.METRICS, Signal.LOGS
+
+
+def fresh():
+    return basic_config()
+
+
+class TestRegistry:
+    def test_every_spec_has_a_configer(self):
+        missing = [t for t in SPECS if t not in _CONFIGERS]
+        assert not missing, f"specs without configers: {missing}"
+
+    def test_registry_covers_reference_count(self):
+        # 63 reference backends + debug/nop/mock test doubles
+        assert len(SPECS) >= 63
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(KeyError):
+            get_spec("doesnotexist")
+
+    def test_validate_signal_support(self):
+        d = Destination(id="j", dest_type="jaeger", signals=[T, M])
+        problems = validate_destination(d)
+        assert any("does not support metrics" in p for p in problems)
+
+    def test_secret_fields_flagged(self):
+        spec = get_spec("datadog")
+        secrets = {f.name for f in spec.fields if f.secret}
+        assert "DATADOG_API_KEY" in secrets
+
+
+class TestConfigers:
+    def test_datadog_golden(self):
+        cfg = fresh()
+        d = Destination(id="dd1", dest_type="datadog", signals=[T, M, L],
+                        config={"DATADOG_SITE": "datadoghq.com"})
+        names = modify_config(d, cfg)
+        assert sorted(names) == ["logs/datadog-dd1", "metrics/datadog-dd1",
+                                 "traces/datadog-dd1"]
+        exp = cfg["exporters"]["datadog/dd1"]
+        assert exp["api"]["site"] == "datadoghq.com"
+        # secret must be an env placeholder, never inline
+        assert exp["api"]["key"] == "${DATADOG_API_KEY}"
+        # traces+metrics both on -> APM stats connector bridging them
+        assert "datadog/connector-dd1" in cfg["connectors"]
+        assert "datadog/connector-dd1" in \
+            cfg["service"]["pipelines"]["traces/datadog-dd1"]["exporters"]
+
+    def test_datadog_missing_site_errors(self):
+        d = Destination(id="dd", dest_type="datadog", signals=[T])
+        with pytest.raises(ConfigerError):
+            modify_config(d, fresh())
+
+    def test_jaeger_grpc_endpoint_normalization(self):
+        cfg = fresh()
+        d = Destination(id="j1", dest_type="jaeger", signals=[T],
+                        config={"JAEGER_URL": "jaeger.tracing:4317"})
+        modify_config(d, cfg)
+        exp = cfg["exporters"]["otlp/jaeger-j1"]
+        assert exp["endpoint"] == "jaeger.tracing:4317"
+        assert exp["tls"] == {"insecure": True}
+
+    def test_grpc_scheme_stripped_and_port_defaulted(self):
+        cfg = fresh()
+        d = Destination(id="x", dest_type="otlp", signals=[T],
+                        config={"OTLP_GRPC_ENDPOINT": "grpc://collector.ns"})
+        modify_config(d, cfg)
+        assert cfg["exporters"]["otlp/otlp-x"]["endpoint"] == "collector.ns:4317"
+
+    def test_unsupported_signals_skipped(self):
+        cfg = fresh()
+        # jaeger is traces-only; metrics request is dropped silently after
+        # validation (configer only creates supported pipelines)
+        d = Destination(id="j2", dest_type="jaeger", signals=[T],
+                        config={"JAEGER_URL": "j:4317"})
+        names = modify_config(d, cfg)
+        assert names == ["traces/jaeger-j2"]
+
+    def test_no_supported_signals_errors(self):
+        d = Destination(id="p1", dest_type="prometheus", signals=[T])
+        with pytest.raises(ConfigerError):
+            modify_config(d, fresh())
+
+    def test_logzio_per_signal_exporters(self):
+        cfg = fresh()
+        d = Destination(id="lz", dest_type="logzio", signals=[T, M, L],
+                        config={"LOGZIO_REGION": "eu"})
+        names = modify_config(d, cfg)
+        assert len(names) == 3
+        assert cfg["exporters"]["logzio/tracing-lz"]["account_token"] == \
+            "${LOGZIO_TRACING_TOKEN}"
+        assert cfg["exporters"]["logzio/logs-lz"]["account_token"] == \
+            "${LOGZIO_LOGS_TOKEN}"
+        assert "prometheusremotewrite/logzio-lz" in cfg["exporters"]
+
+    def test_kafka_brokers_split(self):
+        cfg = fresh()
+        d = Destination(id="k", dest_type="kafka", signals=[T],
+                        config={"KAFKA_BROKERS": "b1:9092, b2:9092"})
+        modify_config(d, cfg)
+        assert cfg["exporters"]["kafka/k"]["brokers"] == ["b1:9092", "b2:9092"]
+
+    def test_all_configers_run_without_crashing(self):
+        """Smoke: every destination type generates config when all its
+        declared fields are populated."""
+        import json
+        for dest_type, spec in SPECS.items():
+            cfg = fresh()
+            values = {f.name: "test-value" for f in spec.fields}
+            # type-specific field values that must parse
+            values.update({
+                "DYNAMIC_CONFIGURATION_DATA": json.dumps({"endpoint": "x"}),
+                "MOCK_REJECT_FRACTION": "0.5",
+                "MOCK_RESPONSE_DURATION": "1",
+                "KAFKA_BROKERS": "b:9092",
+            })
+            d = Destination(id=f"t-{dest_type}", dest_type=dest_type,
+                            signals=sorted(spec.signals, key=lambda s: s.value),
+                            config={k: v for k, v in values.items()
+                                    if any(f.name == k for f in spec.fields)})
+            names = modify_config(d, cfg)
+            assert names, f"{dest_type}: no pipelines created"
+            for n in names:
+                pipe = cfg["service"]["pipelines"][n]
+                assert pipe["exporters"], f"{dest_type}: pipeline {n} has no exporters"
+                for e in pipe["exporters"]:
+                    assert e in cfg["exporters"] or e in cfg["connectors"], \
+                        f"{dest_type}: pipeline {n} references undeclared {e}"
+
+    def test_no_secret_value_ever_inlined(self):
+        """Secrets appear only as ${VAR} placeholders in generated config."""
+        import json
+        secret_value = "sUpErSeCrEt-12345"
+        for dest_type, spec in SPECS.items():
+            secret_names = [f.name for f in spec.fields if f.secret]
+            if not secret_names:
+                continue
+            cfg = fresh()
+            values = {f.name: (secret_value if f.secret else "v")
+                      for f in spec.fields}
+            values.setdefault("KAFKA_BROKERS", "b:9092")
+            if dest_type == "dynamic":
+                continue  # dynamic passes raw config through by design
+            d = Destination(id="s", dest_type=dest_type,
+                            signals=sorted(spec.signals, key=lambda s: s.value),
+                            config=values)
+            try:
+                modify_config(d, cfg)
+            except ConfigerError:
+                continue
+            assert secret_value not in json.dumps(cfg), \
+                f"{dest_type} inlined a secret value into generated config"
+
+
+class TestExtensionsWiring:
+    def test_grafana_tempo_authenticator_enabled(self):
+        cfg = fresh()
+        d = Destination(id="g1", dest_type="grafanacloudtempo", signals=[T],
+                        config={"GRAFANA_CLOUD_TEMPO_ENDPOINT": "tempo.grafana.net:443",
+                                "GRAFANA_CLOUD_TEMPO_USERNAME": "u"})
+        modify_config(d, cfg)
+        auth = "basicauth/grafana-tempo-g1"
+        assert auth in cfg["extensions"]
+        assert auth in cfg["service"]["extensions"]
+
+    def test_grafana_prometheus_authenticator_defined_and_enabled(self):
+        cfg = fresh()
+        d = Destination(id="g2", dest_type="grafanacloudprometheus", signals=[M],
+                        config={"GRAFANA_CLOUD_PROMETHEUS_RW_ENDPOINT": "https://prom",
+                                "GRAFANA_CLOUD_PROMETHEUS_USERNAME": "u"})
+        modify_config(d, cfg)
+        auth = "basicauth/grafana-prom-g2"
+        exp = cfg["exporters"]["prometheusremotewrite/grafana-g2"]
+        assert exp["auth"]["authenticator"] == auth
+        assert auth in cfg["extensions"]
+        assert auth in cfg["service"]["extensions"]

@@ -1,0 +1,288 @@
+"""pipelinegen golden tests — the generated-config assertion discipline of
+the reference (tests/e2e/data-streams/expected-datastreams-config.yaml and
+common/config golden tests)."""
+
+import pytest
+
+from odigos_tpu.components.api import Signal
+from odigos_tpu.config.model import AnomalyStageConfiguration
+from odigos_tpu.destinations import Destination
+from odigos_tpu.pipelinegen import (
+    SourceRef,
+    DataStream,
+    DataStreamDestination,
+    GatewayOptions,
+    NodeCollectorOptions,
+    build_gateway_config,
+    build_node_collector_config,
+)
+from odigos_tpu.pipeline.graph import validate_config
+
+T, M, L = Signal.TRACES, Signal.METRICS, Signal.LOGS
+
+
+def dd(id="dd1", signals=(T, M, L)):
+    return Destination(id=id, dest_type="datadog", signals=list(signals),
+                       config={"DATADOG_SITE": "datadoghq.com"})
+
+
+def jaeger(id="j1"):
+    return Destination(id=id, dest_type="jaeger", signals=[T],
+                       config={"JAEGER_URL": "jaeger:4317"})
+
+
+def mock(id="m1", signals=(T,)):
+    return Destination(id=id, dest_type="mock", signals=list(signals),
+                       config={"MOCK_REJECT_FRACTION": "0",
+                               "MOCK_RESPONSE_DURATION": "0"})
+
+
+class TestGatewayConfig:
+    def test_single_destination_shape(self):
+        cfg, status, signals = build_gateway_config([jaeger()])
+        assert signals == [T]
+        assert status.destination["j1"] is None
+        pipes = cfg["service"]["pipelines"]
+        # root pipeline: otlp -> [memory_limiter, version] -> router
+        root = pipes["traces/in"]
+        assert root["receivers"] == ["otlp"]
+        assert root["processors"][:2] == ["memory_limiter",
+                                          "resource/odigos-version"]
+        assert "odigosrouter/traces" in root["exporters"]
+        # destination pipeline: forward connector -> batch -> exporter
+        destp = pipes["traces/jaeger-j1"]
+        assert destp["receivers"] == ["forward/traces/jaeger-j1"]
+        assert "batch" in destp["processors"]
+        assert destp["exporters"] == ["otlp/jaeger-j1"]
+
+    def test_no_destinations_no_root_pipelines(self):
+        cfg, _, signals = build_gateway_config([])
+        assert signals == []
+        assert "traces/in" not in cfg["service"]["pipelines"]
+
+    def test_signals_enabled_per_destination_support(self):
+        _, _, signals = build_gateway_config([jaeger()])
+        assert signals == [T]
+        _, _, signals = build_gateway_config([dd()])
+        assert signals == [T, M, L]
+
+    def test_data_stream_pipelines(self):
+        streams = [DataStream("prod", (DataStreamDestination("dd1"),)),
+                   DataStream("dev", (DataStreamDestination("j1"),))]
+        cfg, _, _ = build_gateway_config([dd(), jaeger()], data_streams=streams)
+        pipes = cfg["service"]["pipelines"]
+        # prod stream: all three datadog signals
+        assert pipes["traces/prod"]["receivers"] == ["odigosrouter/traces"]
+        assert pipes["traces/prod"]["exporters"] == ["forward/traces/datadog-dd1"]
+        assert pipes["metrics/prod"]["exporters"] == ["forward/metrics/datadog-dd1"]
+        # dev stream: jaeger is traces-only -> no metrics/dev pipeline
+        assert pipes["traces/dev"]["exporters"] == ["forward/traces/jaeger-j1"]
+        assert "metrics/dev" not in pipes
+
+    def test_router_carries_datastream_details(self):
+        streams = [DataStream("prod", (DataStreamDestination("j1"),),
+                              (SourceRef("ns1", "deployment", "frontend"),))]
+        cfg, _, _ = build_gateway_config([jaeger()], data_streams=streams)
+        conn = cfg["connectors"]["odigosrouter/traces"]
+        assert conn["data_streams"] == [{
+            "name": "prod",
+            "sources": [{"namespace": "ns1", "kind": "deployment",
+                         "name": "frontend"}],
+            "pipelines": ["traces/prod"]}]
+        assert conn["default_pipelines"] == []
+
+    def test_default_stream_synthesized(self):
+        cfg, _, _ = build_gateway_config([jaeger()])
+        conn = cfg["connectors"]["odigosrouter/traces"]
+        assert conn["default_pipelines"] == ["traces/default"]
+        assert cfg["service"]["pipelines"]["traces/default"]["exporters"] == \
+            ["forward/traces/jaeger-j1"]
+
+    def test_failed_destination_reported_not_fatal(self):
+        bad = Destination(id="dd-bad", dest_type="datadog", signals=[T])  # no site
+        cfg, status, signals = build_gateway_config([bad, jaeger()])
+        assert status.destination["dd-bad"] is not None
+        assert status.destination["j1"] is None
+        assert signals == [T]
+        assert "traces/datadog-dd-bad" not in cfg["service"]["pipelines"]
+
+    def test_servicegraph_insertion(self):
+        cfg, _, _ = build_gateway_config([jaeger()])
+        assert "servicegraph" in cfg["connectors"]
+        root = cfg["service"]["pipelines"]["traces/in"]
+        assert "servicegraph" in root["exporters"]
+        sg = cfg["service"]["pipelines"]["metrics/servicegraph"]
+        assert sg["receivers"] == ["servicegraph"]
+
+    def test_servicegraph_disabled(self):
+        cfg, _, _ = build_gateway_config(
+            [jaeger()], options=GatewayOptions(service_graph_disabled=True))
+        assert "servicegraph" not in cfg["connectors"]
+        assert "metrics/servicegraph" not in cfg["service"]["pipelines"]
+
+    def test_self_telemetry_appended_everywhere(self):
+        cfg, _, _ = build_gateway_config([jaeger()])
+        for pname, pipe in cfg["service"]["pipelines"].items():
+            if pname in ("metrics/servicegraph", "metrics/otelcol"):
+                continue
+            assert pipe["processors"][-1] == "odigostrafficmetrics", pname
+        assert "metrics/otelcol" in cfg["service"]["pipelines"]
+
+    def test_small_batches_profile(self):
+        cfg, _, _ = build_gateway_config(
+            [dd()], options=GatewayOptions(
+                small_batches={"send_batch_size": 100, "timeout_ms": 100}))
+        tp = cfg["service"]["pipelines"]["traces/datadog-dd1"]
+        assert "batch/small-batches" in tp["processors"]
+        # metrics pipelines unaffected (traces-only behavior)
+        mp = cfg["service"]["pipelines"]["metrics/datadog-dd1"]
+        assert "batch/small-batches" not in mp["processors"]
+
+    def test_user_processors_in_root_chain(self):
+        procs = [{"id": "odigossampling/tail", "type": "odigossampling",
+                  "signals": ["traces"], "config": {"rules": []}}]
+        cfg, status, _ = build_gateway_config([jaeger()], processors=procs)
+        assert status.processor["odigossampling/tail"] is None
+        root = cfg["service"]["pipelines"]["traces/in"]
+        assert "odigossampling/tail" in root["processors"]
+        assert "odigossampling/tail" in cfg["processors"]
+
+
+class TestAnomalyStage:
+    def anomaly_opts(self, **kw):
+        a = AnomalyStageConfiguration(enabled=True, **kw)
+        return GatewayOptions(anomaly=a)
+
+    def test_anomaly_disabled_is_byte_identical(self):
+        """North-star hard requirement: anomaly off == stage absent."""
+        base, _, _ = build_gateway_config([jaeger()])
+        off, _, _ = build_gateway_config(
+            [jaeger()], options=GatewayOptions(
+                anomaly=AnomalyStageConfiguration(enabled=False)))
+        assert base == off
+
+    def test_anomaly_enabled_inserts_processor_and_router(self):
+        cfg, _, _ = build_gateway_config([jaeger()], options=self.anomaly_opts())
+        root = cfg["service"]["pipelines"]["traces/in"]
+        assert "tpuanomaly" in root["processors"]
+        # processor runs before the router hands data off
+        assert "anomalyrouter" in root["exporters"]
+        assert cfg["processors"]["tpuanomaly"]["model"] == "zscore"
+        # anomaly stream pipeline fed by the anomalyrouter, fanning out to
+        # every traces destination
+        ap = cfg["service"]["pipelines"]["traces/anomalies"]
+        assert ap["receivers"] == ["anomalyrouter"]
+        assert "forward/traces/jaeger-j1" in ap["exporters"]
+        assert cfg["connectors"]["anomalyrouter"]["anomaly_pipelines"] == \
+            ["traces/anomalies"]
+        assert cfg["connectors"]["anomalyrouter"]["mode"] == "trace"
+
+    def test_anomaly_respects_existing_stream(self):
+        streams = [DataStream("anomalies", (DataStreamDestination("j1"),)),
+                   DataStream("default", (DataStreamDestination("j1"),
+                                          DataStreamDestination("m9")))]
+        cfg, _, _ = build_gateway_config(
+            [jaeger(), mock("m9")], data_streams=streams,
+            options=self.anomaly_opts())
+        ap = cfg["service"]["pipelines"]["traces/anomalies"]
+        # operator scoped the stream to jaeger only; mock not added
+        assert ap["exporters"] == ["forward/traces/jaeger-j1"]
+        # the scoped pipeline gains the anomalyrouter as a second receiver
+        assert "anomalyrouter" in ap["receivers"]
+
+
+class TestGeneratedConfigBuildable:
+    def test_mock_only_config_is_graph_valid(self):
+        """A config whose components all exist in our registry must pass
+        static graph validation (receivers resolved, DAG acyclic)."""
+        cfg, _, _ = build_gateway_config(
+            [mock()], options=GatewayOptions(self_telemetry=False,
+                                             service_graph_disabled=True))
+        # swap the external otlp receiver for the in-process synthetic one
+        cfg["receivers"] = {"synthetic": {}}
+        for pipe in cfg["service"]["pipelines"].values():
+            pipe["receivers"] = ["synthetic" if r == "otlp" else r
+                                 for r in pipe["receivers"]]
+        problems = validate_config(cfg)
+        assert problems == [], problems
+
+    def test_anomaly_config_is_graph_valid(self):
+        cfg, _, _ = build_gateway_config(
+            [mock()], options=GatewayOptions(
+                self_telemetry=False, service_graph_disabled=True,
+                anomaly=AnomalyStageConfiguration(enabled=True)))
+        cfg["receivers"] = {"synthetic": {}}
+        for pipe in cfg["service"]["pipelines"].values():
+            pipe["receivers"] = ["synthetic" if r == "otlp" else r
+                                 for r in pipe["receivers"]]
+        problems = validate_config(cfg)
+        assert problems == [], problems
+
+
+class TestNodeCollectorConfig:
+    def test_traces_loadbalancing(self):
+        cfg = build_node_collector_config(NodeCollectorOptions())
+        lb = cfg["exporters"]["loadbalancing/traces"]
+        assert lb["routing_key"] == "traceID"
+        assert lb["resolver"]["k8s"]["service"] == \
+            "odigos-gateway.odigos-system"
+        assert cfg["service"]["pipelines"]["traces"]["exporters"] == \
+            ["loadbalancing/traces"]
+
+    def test_no_loadbalancing_uses_plain_otlp(self):
+        cfg = build_node_collector_config(
+            NodeCollectorOptions(load_balancing=False))
+        assert "loadbalancing/traces" not in cfg["exporters"]
+        assert cfg["service"]["pipelines"]["traces"]["exporters"] == \
+            ["otlp/gateway"]
+
+    def test_span_metrics_connector(self):
+        cfg = build_node_collector_config(NodeCollectorOptions(
+            span_metrics_enabled=True,
+            enabled_signals=(T, M)))
+        assert "spanmetrics" in cfg["connectors"]
+        assert "spanmetrics" in cfg["service"]["pipelines"]["traces"]["exporters"]
+        assert "spanmetrics" in cfg["service"]["pipelines"]["metrics"]["receivers"]
+
+    def test_logs_pipeline_gated(self):
+        cfg = build_node_collector_config(NodeCollectorOptions(
+            enabled_signals=(T, L), log_collection_enabled=True))
+        logs = cfg["service"]["pipelines"]["logs"]
+        assert "odigoslogsresourceattrs" in logs["processors"]
+        cfg2 = build_node_collector_config(NodeCollectorOptions(
+            enabled_signals=(T,), log_collection_enabled=True))
+        assert "logs" not in cfg2["service"]["pipelines"]
+
+    def test_own_metrics_always_present(self):
+        cfg = build_node_collector_config(NodeCollectorOptions())
+        assert "metrics/otelcol" in cfg["service"]["pipelines"]
+
+
+class TestReviewRegressions:
+    def test_failed_configer_leaves_no_orphans(self):
+        # tempo endpoint set but username missing: recipe fails mid-mutation
+        bad = Destination(id="g9", dest_type="grafanacloudtempo", signals=[T],
+                          config={"GRAFANA_CLOUD_TEMPO_ENDPOINT": "t:443"})
+        cfg, status, _ = build_gateway_config([bad, jaeger()])
+        assert status.destination["g9"] is not None
+        assert not any("g9" in e for e in cfg["exporters"])
+        assert not any("g9" in e for e in cfg.get("extensions", {}))
+
+    def test_node_spanmetrics_requires_traces(self):
+        cfg = build_node_collector_config(NodeCollectorOptions(
+            enabled_signals=(M,), span_metrics_enabled=True,
+            host_metrics_enabled=True))
+        assert "spanmetrics" not in cfg["connectors"]
+        assert "spanmetrics" not in \
+            cfg["service"]["pipelines"]["metrics"]["receivers"]
+
+    def test_tpuanomaly_config_keys_match_processor_contract(self):
+        from odigos_tpu.components.api import registry, ComponentKind
+        cfg, _, _ = build_gateway_config(
+            [jaeger()], options=GatewayOptions(
+                anomaly=AnomalyStageConfiguration(enabled=True)))
+        # the emitted config must build a working processor instance
+        factory = registry.get(ComponentKind.PROCESSOR, "tpuanomaly")
+        proc = factory.build("tpuanomaly", cfg["processors"]["tpuanomaly"])
+        assert proc.engine_cfg.max_batch_spans == 4096
+        assert proc.threshold == 0.8
