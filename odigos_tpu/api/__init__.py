@@ -1,0 +1,62 @@
+"""Typed control-plane resources + watchable store.
+
+Our equivalent of the reference's CRD layer (api/odigos/v1alpha1 +
+api/actions/v1alpha1) and the slice of the k8s API machinery the
+controllers rely on: a namespaced, versioned, watchable object store with
+level-triggered reconcile dispatch (the controller-runtime pattern every
+reference controller is built on — SURVEY.md §2.1).
+
+The resource *types* keep the reference's semantics (same condition types,
+reasons, roles) so operators can map concepts 1:1; the machinery is a small
+in-process store rather than etcd — the framework's control plane is
+embeddable and testable without a cluster, the same role KinD plays in the
+reference's e2e suite.
+"""
+
+from .resources import (
+    Action,
+    AgentEnabledReason,
+    CollectorsGroup,
+    CollectorsGroupRole,
+    Condition,
+    ConditionStatus,
+    DestinationResource,
+    InstrumentationConfig,
+    InstrumentationInstance,
+    InstrumentationRule,
+    MarkedForInstrumentationReason,
+    ObjectMeta,
+    Processor,
+    RuntimeDetails,
+    Source,
+    WorkloadKind,
+    WorkloadRef,
+    condition_logical_order,
+)
+from .store import Event, EventType, Store, Reconciler, ControllerManager
+
+__all__ = [
+    "Action",
+    "AgentEnabledReason",
+    "CollectorsGroup",
+    "CollectorsGroupRole",
+    "Condition",
+    "ConditionStatus",
+    "DestinationResource",
+    "InstrumentationConfig",
+    "InstrumentationInstance",
+    "InstrumentationRule",
+    "MarkedForInstrumentationReason",
+    "ObjectMeta",
+    "Processor",
+    "RuntimeDetails",
+    "Source",
+    "WorkloadKind",
+    "WorkloadRef",
+    "condition_logical_order",
+    "Event",
+    "EventType",
+    "Store",
+    "Reconciler",
+    "ControllerManager",
+]

@@ -1,0 +1,414 @@
+"""Control-plane resource types.
+
+Parity map (reference file -> class here):
+* api/odigos/v1alpha1/source_types.go:42          -> Source
+* api/odigos/v1alpha1/instrumentationconfig_types.go:17 -> InstrumentationConfig
+  (same 4 ordered status conditions, :26-36, and reason enums)
+* api/odigos/v1alpha1/instrumentationinstance_types.go  -> InstrumentationInstance
+* api/odigos/v1alpha1/instrumentationrule_type.go:46    -> InstrumentationRule
+  (6 rule kinds from api/odigos/v1alpha1/instrumentationrules/)
+* api/odigos/v1alpha1/collectorsgroup_types.go:26-37    -> CollectorsGroup
+* api/odigos/v1alpha1/destination_types.go              -> DestinationResource
+* api/odigos/v1alpha1/processor_types.go                -> Processor
+* api/odigos/v1alpha1/action_types.go + api/actions/v1alpha1/*
+  (11 action types)                                     -> Action
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# --------------------------------------------------------------- metadata
+
+
+_uid_counter = itertools.count(1)
+
+
+@dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    uid: int = field(default_factory=lambda: next(_uid_counter))
+    generation: int = 1
+    creation_time: float = field(default_factory=time.time)
+    deletion_time: Optional[float] = None
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+@dataclass
+class Resource:
+    meta: ObjectMeta
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def namespace(self) -> str:
+        return self.meta.namespace
+
+
+# -------------------------------------------------------------- conditions
+
+
+class ConditionStatus(str, enum.Enum):
+    TRUE = "True"
+    FALSE = "False"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class Condition:
+    type: str
+    status: ConditionStatus
+    reason: str = ""
+    message: str = ""
+    last_transition: float = field(default_factory=time.time)
+
+
+# InstrumentationConfig status condition types, in logical order
+# (instrumentationconfig_types.go:26-36, StatusConditionTypeLogicalOrder :39)
+MARKED_FOR_INSTRUMENTATION = "MarkedForInstrumentation"
+RUNTIME_DETECTION = "RuntimeDetection"
+AGENT_ENABLED = "AgentEnabled"
+WORKLOAD_ROLLOUT = "WorkloadRollout"
+
+_CONDITION_ORDER = {
+    MARKED_FOR_INSTRUMENTATION: 1,
+    RUNTIME_DETECTION: 2,
+    AGENT_ENABLED: 3,
+    WORKLOAD_ROLLOUT: 4,
+}
+
+
+def condition_logical_order(cond_type: str) -> int:
+    return _CONDITION_ORDER.get(cond_type, 5)
+
+
+class MarkedForInstrumentationReason(str, enum.Enum):
+    WORKLOAD_SOURCE = "WorkloadSource"
+    NAMESPACE_SOURCE = "NamespaceSource"
+    WORKLOAD_SOURCE_DISABLED = "WorkloadSourceDisabled"
+    NO_SOURCE = "NoSource"
+    RETIRABLE_ERROR = "RetirableError"
+
+
+class RuntimeDetectionReason(str, enum.Enum):
+    DETECTED_SUCCESSFULLY = "DetectedSuccessfully"
+    WAITING_FOR_DETECTION = "WaitingForDetection"
+    NO_RUNNING_PODS = "NoRunningPods"
+    ERROR = "Error"
+
+
+class AgentEnabledReason(str, enum.Enum):
+    ENABLED_SUCCESSFULLY = "EnabledSuccessfully"
+    WAITING_FOR_RUNTIME_INSPECTION = "WaitingForRuntimeInspection"
+    WAITING_FOR_NODE_COLLECTOR = "WaitingForNodeCollector"
+    IGNORED_CONTAINER = "IgnoredContainer"
+    NO_COLLECTED_SIGNALS = "NoCollectedSignals"
+    UNSUPPORTED_PROGRAMMING_LANGUAGE = "UnsupportedProgrammingLanguage"
+    NO_AVAILABLE_AGENT = "NoAvailableAgent"
+    INJECTION_CONFLICT = "InjectionConflict"
+    UNSUPPORTED_RUNTIME_VERSION = "UnsupportedRuntimeVersion"
+    MISSING_DISTRO_PARAMETER = "MissingDistroParameter"
+    OTHER_AGENT_DETECTED = "OtherAgentDetected"
+    RUNTIME_DETAILS_UNAVAILABLE = "RuntimeDetailsUnavailable"
+    CRASH_LOOP_BACK_OFF = "CrashLoopBackOff"
+    IMAGE_PULL_BACK_OFF = "ImagePullBackOff"
+
+
+class WorkloadRolloutReason(str, enum.Enum):
+    TRIGGERED_SUCCESSFULLY = "RolloutTriggeredSuccessfully"
+    FAILED_TO_PATCH = "FailedToPatch"
+    PREVIOUS_ROLLOUT_ONGOING = "PreviousRolloutOngoing"
+    DISABLED = "Disabled"
+    WAITING_FOR_RESTART = "WaitingForRestart"
+    WORKLOAD_NOT_SUPPORTING = "WorkloadNotSupporting"
+
+
+# --------------------------------------------------------------- workloads
+
+
+class WorkloadKind(str, enum.Enum):
+    DEPLOYMENT = "Deployment"
+    STATEFULSET = "StatefulSet"
+    DAEMONSET = "DaemonSet"
+    CRONJOB = "CronJob"
+    NAMESPACE = "Namespace"
+
+
+@dataclass(frozen=True)
+class WorkloadRef:
+    namespace: str
+    kind: WorkloadKind
+    name: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.kind.value.lower()}/{self.name}"
+
+
+# ------------------------------------------------------------------ Source
+
+
+@dataclass
+class ContainerOverride:
+    container_name: str
+    runtime_info: Optional["RuntimeDetails"] = None
+    distro_name: Optional[str] = None
+
+
+@dataclass
+class Source(Resource):
+    """source_types.go:42: marks a workload (or whole namespace) for
+    instrumentation; DisableInstrumentation (:72) excludes instead."""
+
+    workload: WorkloadRef = None  # type: ignore[assignment]
+    disable_instrumentation: bool = False
+    otel_service_name: str = ""
+    data_stream_names: list[str] = field(default_factory=list)
+    container_overrides: list[ContainerOverride] = field(default_factory=list)
+
+    @property
+    def is_namespace_source(self) -> bool:
+        return self.workload.kind == WorkloadKind.NAMESPACE
+
+
+# ------------------------------------------------- InstrumentationConfig
+
+
+@dataclass
+class RuntimeDetails:
+    """Runtime inspection result for one container
+    (RuntimeDetailsByContainer; produced by the agent's runtime detection,
+    odiglet/pkg/kube/runtime_details/inspection.go:98)."""
+
+    container_name: str
+    language: str = "unknown"
+    runtime_version: str = ""
+    libc_type: str = ""  # glibc | musl
+    exe_path: str = ""
+    env_vars: dict[str, str] = field(default_factory=dict)
+    other_agent: Optional[str] = None
+    secure_execution_mode: bool = False
+
+
+@dataclass
+class ContainerAgentConfig:
+    """Per-container agent decision (calculateContainerInstrumentationConfig,
+    instrumentor/controllers/agentenabled/sync.go:500)."""
+
+    container_name: str
+    agent_enabled: bool
+    reason: AgentEnabledReason = AgentEnabledReason.ENABLED_SUCCESSFULLY
+    message: str = ""
+    distro_name: str = ""
+    env_to_inject: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SdkConfig:
+    """Per-language SDK configuration compiled from InstrumentationRules
+    (instrumentor/controllers/instrumentationconfig)."""
+
+    language: str
+    payload_collection: Optional[str] = None  # None | db | full
+    code_attributes: bool = False
+    http_headers: list[str] = field(default_factory=list)
+    trace_config: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class InstrumentationConfig(Resource):
+    """instrumentationconfig_types.go:17 — one per instrumented workload;
+    spec written by the instrumentor, runtime details by the node agent."""
+
+    workload: WorkloadRef = None  # type: ignore[assignment]
+    service_name: str = ""
+    data_stream_names: list[str] = field(default_factory=list)
+    sdk_configs: list[SdkConfig] = field(default_factory=list)
+    containers: list[ContainerAgentConfig] = field(default_factory=list)
+    agents_deployed_hash: str = ""
+    # status
+    runtime_details: list[RuntimeDetails] = field(default_factory=list)
+    conditions: list[Condition] = field(default_factory=list)
+
+    def set_condition(self, cond: Condition) -> bool:
+        """Upsert a condition; returns True when it changed. An identical
+        condition is a no-op that preserves last_transition (k8s
+        lastTransitionTime semantics) — reconcilers key their 'did anything
+        change' status-write decision on the return value, which keeps the
+        level-triggered loop quiescent."""
+        existing = self.condition(cond.type)
+        if existing is not None and (existing.status, existing.reason,
+                                     existing.message) == (
+                cond.status, cond.reason, cond.message):
+            return False
+        self.conditions = [c for c in self.conditions if c.type != cond.type]
+        self.conditions.append(cond)
+        self.conditions.sort(key=lambda c: condition_logical_order(c.type))
+        return True
+
+    def condition(self, cond_type: str) -> Optional[Condition]:
+        for c in self.conditions:
+            if c.type == cond_type:
+                return c
+        return None
+
+
+# ---------------------------------------------- InstrumentationInstance
+
+
+@dataclass
+class InstrumentationInstance(Resource):
+    """instrumentationinstance_types.go — one per instrumented process;
+    written from agent health reports (OpAMP heartbeats,
+    opampserver/pkg/server/handlers.go:147)."""
+
+    workload: WorkloadRef = None  # type: ignore[assignment]
+    pod_name: str = ""
+    container_name: str = ""
+    pid: int = 0
+    healthy: Optional[bool] = None
+    reason: str = ""
+    message: str = ""
+    identifying_attributes: dict[str, str] = field(default_factory=dict)
+    last_status_time: float = field(default_factory=time.time)
+
+
+# ------------------------------------------------- InstrumentationRule
+
+
+class RuleKind(str, enum.Enum):
+    """The 6 rule kinds of api/odigos/v1alpha1/instrumentationrules/."""
+
+    PAYLOAD_COLLECTION = "payload-collection"
+    CODE_ATTRIBUTES = "code-attributes"
+    CUSTOM_INSTRUMENTATION = "custom-instrumentation"
+    HTTP_HEADERS = "http-headers"
+    OTEL_SDK = "otel-sdk"
+    TRACE_CONFIG = "trace-config"
+
+
+@dataclass
+class InstrumentationRule(Resource):
+    """instrumentationrule_type.go:46: scoped SDK behavior tweaks; empty
+    workloads/languages selectors mean 'all'."""
+
+    rule_kind: RuleKind = RuleKind.TRACE_CONFIG
+    disabled: bool = False
+    workloads: list[WorkloadRef] = field(default_factory=list)
+    languages: list[str] = field(default_factory=list)
+    details: dict[str, Any] = field(default_factory=dict)
+
+    def matches(self, workload: WorkloadRef, language: str) -> bool:
+        if self.disabled:
+            return False
+        if self.workloads and workload not in self.workloads:
+            return False
+        if self.languages and language not in self.languages:
+            return False
+        return True
+
+
+# ------------------------------------------------------ CollectorsGroup
+
+
+class CollectorsGroupRole(str, enum.Enum):
+    CLUSTER_GATEWAY = "CLUSTER_GATEWAY"
+    NODE_COLLECTOR = "NODE_COLLECTOR"
+
+
+@dataclass
+class CollectorsGroup(Resource):
+    """collectorsgroup_types.go:26-37: desired state of one collector tier;
+    resources settings resolved by the scheduler from sizing presets."""
+
+    role: CollectorsGroupRole = CollectorsGroupRole.CLUSTER_GATEWAY
+    # ResourcesSettings (resolved; see config.sizing.ResolvedResources)
+    resources: dict[str, int] = field(default_factory=dict)
+    service_graph_disabled: bool = False
+    cluster_metrics_enabled: bool = False
+    # north-star: replicas that must be co-scheduled with a TPU device
+    tpu_replicas: int = 0
+    # status
+    ready: bool = False
+    received_signals: list[str] = field(default_factory=list)
+    conditions: list[Condition] = field(default_factory=list)
+
+
+# ------------------------------------- Destination / Processor / Action
+
+
+@dataclass
+class DestinationResource(Resource):
+    """destination_types.go: a configured destination instance. The
+    embedded ``destinations.Destination`` carries type/signals/fields."""
+
+    dest_type: str = ""
+    signals: list[str] = field(default_factory=list)
+    config: dict[str, str] = field(default_factory=dict)
+    secret_ref: str = ""
+    data_stream_names: list[str] = field(default_factory=list)
+    disabled: bool = False
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class Processor(Resource):
+    """processor_types.go: a raw collector processor the operator injects
+    into pipelines (ordered by ProcessorOrder)."""
+
+    processor_type: str = ""
+    order_hint: int = 0
+    signals: list[str] = field(default_factory=list)
+    processor_config: dict[str, Any] = field(default_factory=dict)
+    disabled: bool = False
+
+
+class ActionKind(str, enum.Enum):
+    """The 11 action types of api/actions/v1alpha1/*_types.go."""
+
+    ADD_CLUSTER_INFO = "AddClusterInfo"
+    DELETE_ATTRIBUTE = "DeleteAttribute"
+    RENAME_ATTRIBUTE = "RenameAttribute"
+    PII_MASKING = "PiiMasking"
+    K8S_ATTRIBUTES = "K8sAttributes"
+    ERROR_SAMPLER = "ErrorSampler"
+    LATENCY_SAMPLER = "LatencySampler"
+    PROBABILISTIC_SAMPLER = "ProbabilisticSampler"
+    SERVICE_NAME_SAMPLER = "ServiceNameSampler"
+    SPAN_ATTRIBUTE_SAMPLER = "SpanAttributeSampler"
+    SAMPLERS = "Samplers"
+
+
+@dataclass
+class Action(Resource):
+    """action_types.go: a high-level telemetry policy the autoscaler
+    compiles into collector processor configs
+    (autoscaler/controllers/actions/*.go)."""
+
+    action_kind: ActionKind = ActionKind.ADD_CLUSTER_INFO
+    signals: list[str] = field(default_factory=list)
+    disabled: bool = False
+    details: dict[str, Any] = field(default_factory=dict)
+    conditions: list[Condition] = field(default_factory=list)
+
+
+@dataclass
+class ConfigMap(Resource):
+    """Generated configuration document (the reference renders collector
+    configs into ConfigMaps, autoscaler/controllers/clustercollector/
+    configmap.go:150; collectors hot-reload via the odigosk8scmprovider)."""
+
+    data: dict[str, Any] = field(default_factory=dict)

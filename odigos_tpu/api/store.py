@@ -1,0 +1,243 @@
+"""Watchable resource store + level-triggered reconcile runtime.
+
+The slice of k8s API machinery the reference's controllers assume
+(controller-runtime: informers, work queues, level-triggered Reconcile):
+
+* ``Store`` — namespaced collections per resource kind; create/update/
+  delete bump ``generation`` and emit ``Event``s to watchers.
+* ``Reconciler`` — ``reconcile(store, key)`` called with the *key* only;
+  it must read current state and converge (level- not edge-triggered, so a
+  restart resumes from stored state exactly like the reference's
+  controllers resume from the k8s API — SURVEY.md §5.4).
+* ``ControllerManager`` — owns the work queue, dedupes keys, maps watch
+  events to interested reconcilers (including cross-kind mappings like
+  "Source event -> reconcile its workload's InstrumentationConfig").
+
+Single dispatch thread by design: the reference serializes each controller
+group's reconciles the same way; safety is structural (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional, Protocol
+
+from .resources import ObjectMeta, Resource
+
+
+class EventType(str, enum.Enum):
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: EventType
+    kind: str
+    key: tuple[str, str]  # (namespace, name)
+    resource: Any
+
+
+WatchFn = Callable[[Event], None]
+
+
+class Store:
+    """Thread-safe namespaced store. Kind names are the class names of the
+    resources (``Source``, ``InstrumentationConfig``...)."""
+
+    def __init__(self) -> None:
+        self._objects: dict[str, dict[tuple[str, str], Resource]] = {}
+        self._watchers: list[tuple[Optional[str], WatchFn]] = []
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------- access
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[Resource]:
+        with self._lock:
+            return self._objects.get(kind, {}).get((namespace, name))
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             labels: Optional[dict[str, str]] = None) -> list[Resource]:
+        with self._lock:
+            items = list(self._objects.get(kind, {}).values())
+        if namespace is not None:
+            items = [o for o in items if o.meta.namespace == namespace]
+        if labels:
+            items = [o for o in items
+                     if all(o.meta.labels.get(k) == v for k, v in labels.items())]
+        return items
+
+    # ---------------------------------------------------------- mutations
+
+    def apply(self, resource: Resource) -> Resource:
+        """Create-or-update (server-side apply semantics: the stored object
+        is replaced; generation increments on update)."""
+        kind = type(resource).__name__
+        key = resource.meta.key
+        with self._lock:
+            existing = self._objects.setdefault(kind, {}).get(key)
+            if existing is not None:
+                resource.meta.uid = existing.meta.uid
+                resource.meta.generation = existing.meta.generation + 1
+                resource.meta.creation_time = existing.meta.creation_time
+                event_type = EventType.MODIFIED
+            else:
+                event_type = EventType.ADDED
+            self._objects[kind][key] = resource
+        self._notify(Event(event_type, kind, key, resource))
+        return resource
+
+    def update_status(self, resource: Resource) -> Resource:
+        """Status-subresource write: replaces the object WITHOUT bumping
+        generation (controllers distinguish spec changes by generation)."""
+        kind = type(resource).__name__
+        key = resource.meta.key
+        with self._lock:
+            if key not in self._objects.get(kind, {}):
+                raise KeyError(f"{kind} {key} not found")
+            self._objects[kind][key] = resource
+        self._notify(Event(EventType.MODIFIED, kind, key, resource))
+        return resource
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        key = (namespace, name)
+        with self._lock:
+            obj = self._objects.get(kind, {}).pop(key, None)
+        if obj is None:
+            return False
+        self._notify(Event(EventType.DELETED, kind, key, obj))
+        return True
+
+    # ------------------------------------------------------------ watches
+
+    def watch(self, fn: WatchFn, kind: Optional[str] = None) -> None:
+        with self._lock:
+            self._watchers.append((kind, fn))
+
+    def _notify(self, event: Event) -> None:
+        with self._lock:
+            watchers = list(self._watchers)
+        for kind, fn in watchers:
+            if kind is None or kind == event.kind:
+                fn(event)
+
+
+class Reconciler(Protocol):
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None: ...
+
+
+# Maps an event on a watched kind to the reconcile keys it implies
+# (controller-runtime's handler.EnqueueRequestsFromMapFunc).
+MapFn = Callable[[Event], Iterable[tuple[str, str]]]
+
+
+@dataclass
+class _Registration:
+    name: str
+    reconciler: Reconciler
+    kinds: dict[str, Optional[MapFn]] = field(default_factory=dict)
+
+
+class ControllerManager:
+    """Work-queue dispatcher: watch events enqueue (controller, key) pairs,
+    deduped while pending; a single worker drains the queue. ``run_once``
+    drains synchronously — the mode tests and the embedded control plane
+    use; ``start`` runs a background worker for live deployments."""
+
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self._registrations: list[_Registration] = []
+        self._pending: set[tuple[int, tuple[str, str]]] = set()
+        self._queue: "queue.Queue[tuple[int, tuple[str, str]]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.errors: list[tuple[str, tuple[str, str], Exception]] = []
+        store.watch(self._on_event)
+
+    def register(self, name: str, reconciler: Reconciler,
+                 watches: dict[str, Optional[MapFn]]) -> None:
+        """``watches``: kind -> optional mapping fn. None mapping means
+        'reconcile the event's own key'."""
+        with self._lock:
+            self._registrations.append(_Registration(name, reconciler, watches))
+
+    # ------------------------------------------------------------- events
+
+    def _on_event(self, event: Event) -> None:
+        with self._lock:
+            regs = list(enumerate(self._registrations))
+        for idx, reg in regs:
+            mapper = reg.kinds.get(event.kind, "__absent__")
+            if mapper == "__absent__":
+                continue
+            keys = [event.key] if mapper is None else list(mapper(event))
+            for key in keys:
+                self._enqueue(idx, key)
+
+    def _enqueue(self, reg_idx: int, key: tuple[str, str]) -> None:
+        item = (reg_idx, key)
+        with self._lock:
+            if item in self._pending:
+                return  # dedupe: level-triggered, one pending pass suffices
+            self._pending.add(item)
+        self._queue.put(item)
+
+    def enqueue_all(self, kind: str) -> None:
+        """Resync: enqueue every stored object of ``kind`` for controllers
+        watching it (informer resync / reconcileAll pattern)."""
+        for obj in self.store.list(kind):
+            self._on_event(Event(EventType.MODIFIED, kind, obj.meta.key, obj))
+
+    # ----------------------------------------------------------- draining
+
+    def _process(self, item: tuple[int, tuple[str, str]]) -> None:
+        reg_idx, key = item
+        with self._lock:
+            self._pending.discard(item)
+            reg = self._registrations[reg_idx]
+        try:
+            reg.reconciler.reconcile(self.store, key)
+        except Exception as e:  # reconcile errors are recorded, not fatal
+            self.errors.append((reg.name, key, e))
+
+    def run_once(self, max_iterations: int = 10_000) -> int:
+        """Drain until quiescent (reconciles may enqueue further work).
+        Returns number of reconcile passes executed."""
+        n = 0
+        while n < max_iterations:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return n
+            self._process(item)
+            n += 1
+        raise RuntimeError(
+            f"reconcile did not quiesce after {max_iterations} passes "
+            "(controllers fighting over a resource?)")
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._worker, name="controller-manager", daemon=True)
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._process(item)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

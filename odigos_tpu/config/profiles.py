@@ -159,10 +159,12 @@ def resolve_profiles(names: list[str], tier: Tier) -> tuple[list[Profile], list[
     problems: list[str] = []
 
     def visit(name: str, chain: tuple[str, ...]) -> None:
-        if name in seen:
-            return
+        # cycle check must precede the seen-dedupe or a revisit via a cycle
+        # is silently swallowed as "already applied"
         if name in chain:
             problems.append(f"profile dependency cycle: {' -> '.join(chain + (name,))}")
+            return
+        if name in seen:
             return
         prof = PROFILES_BY_NAME.get(name)
         if prof is None:

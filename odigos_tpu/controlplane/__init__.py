@@ -1,0 +1,34 @@
+"""Control plane: instrumentor, scheduler, autoscaler.
+
+The three reconciler groups of the reference (SURVEY.md §2.1), built on the
+api.Store/ControllerManager runtime:
+
+* **instrumentor** — decides *what to instrument and how*: Source →
+  InstrumentationConfig lifecycle, per-container agent decisions, pod
+  mutation (webhook analog), automatic rollout + CrashLoopBackOff rollback.
+* **scheduler** — computes the effective configuration (profiles + sizing)
+  and owns the two CollectorsGroup resources.
+* **autoscaler** — renders collector configs (pipelinegen) into ConfigMap
+  resources, compiles Actions into processors, and scales the gateway with
+  a hybrid HPA (cpu+memory+rejection custom metric).
+"""
+
+from .cluster import Cluster, Container, Pod, PodPhase, Workload
+from .instrumentor import Instrumentor
+from .scheduler import Scheduler, EFFECTIVE_CONFIG_NAME
+from .autoscaler import Autoscaler, HpaDecider, GATEWAY_CONFIG_NAME, NODE_CONFIG_NAME
+
+__all__ = [
+    "Cluster",
+    "Container",
+    "Pod",
+    "PodPhase",
+    "Workload",
+    "Instrumentor",
+    "Scheduler",
+    "EFFECTIVE_CONFIG_NAME",
+    "Autoscaler",
+    "HpaDecider",
+    "GATEWAY_CONFIG_NAME",
+    "NODE_CONFIG_NAME",
+]

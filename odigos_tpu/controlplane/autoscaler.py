@@ -1,0 +1,362 @@
+"""Autoscaler: collector config rendering + Action compilation + HPA.
+
+Reference: autoscaler/ (SURVEY.md §2.1) — renders the gateway ConfigMap
+from pipelinegen on every Destination/Processor/Action/Source change
+(clustercollector/configmap.go:150, §3.4 call stack), renders node
+collector configs per signal (nodecollector/collectorconfig/), compiles
+Action resources into sampling/attribute processors
+(controllers/actions/*.go), and scales the gateway with a hybrid HPA
+combining cpu, memory, and the pre-decode rejection custom metric
+(clustercollector/hpa.go:36-68, metricshandler/custom_metrics_handler.go).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..api.resources import (
+    Action,
+    ActionKind,
+    CollectorsGroup,
+    CollectorsGroupRole,
+    ConfigMap,
+    Condition,
+    ConditionStatus,
+    DestinationResource,
+    ObjectMeta,
+    Processor,
+    Source,
+)
+from ..api.store import ControllerManager, Event, Store
+from ..components.api import Signal
+from ..config.model import Configuration
+from ..destinations.registry import Destination
+from ..pipelinegen import (
+    DataStream,
+    DataStreamDestination,
+    GatewayOptions,
+    NodeCollectorOptions,
+    SourceRef,
+    build_gateway_config,
+    build_node_collector_config,
+)
+from .scheduler import EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE
+
+GATEWAY_CONFIG_NAME = "odigos-gateway-config"
+NODE_CONFIG_NAME = "odigos-data-collection-config"
+REJECTION_METRIC = "odigos_gateway_memory_limiter_rejections_total"
+
+
+# ------------------------------------------------------ action compilation
+
+
+def compile_action(action: Action) -> Optional[dict[str, Any]]:
+    """Action CR -> processor entry for pipelinegen (the per-kind compilers
+    of autoscaler/controllers/actions/*.go; sampling kinds target the
+    odigossampling rule engine, attribute kinds the attributes/resource
+    processors, piimasking the conditional-attributes masker)."""
+    if action.disabled:
+        return None
+    d = action.details
+    signals = action.signals or ["traces"]
+    k = action.action_kind
+    if k == ActionKind.ADD_CLUSTER_INFO:
+        attrs = [{"key": a["key"], "value": a.get("value"),
+                  "action": "insert", "scope": "resource"}
+                 for a in d.get("cluster_attributes", [])]
+        return {"id": f"attributes/{action.name}", "type": "attributes",
+                "signals": signals, "config": {"actions": attrs}}
+    if k == ActionKind.DELETE_ATTRIBUTE:
+        attrs = [{"key": key, "action": "delete", "scope": scope}
+                 for key in d.get("attribute_names", [])
+                 for scope in ("span", "resource")]
+        return {"id": f"attributes/{action.name}", "type": "attributes",
+                "signals": signals, "config": {"actions": attrs}}
+    if k == ActionKind.RENAME_ATTRIBUTE:
+        attrs = [{"key": old, "new_key": new, "action": "rename",
+                  "scope": "span"}
+                 for old, new in d.get("renames", {}).items()]
+        return {"id": f"attributes/{action.name}", "type": "attributes",
+                "signals": signals, "config": {"actions": attrs}}
+    if k == ActionKind.PII_MASKING:
+        return {"id": f"odigosconditionalattributes/{action.name}",
+                "type": "odigosconditionalattributes", "signals": signals,
+                "config": {"mask": d.get("pii_categories", ["CREDIT_CARD"])}}
+    if k == ActionKind.K8S_ATTRIBUTES:
+        attrs = [{"key": key, "action": "upsert", "scope": "resource",
+                  "value": d.get("values", {}).get(key)}
+                 for key in d.get("attributes", [])]
+        return {"id": f"resource/{action.name}", "type": "resource",
+                "signals": signals, "config": {"attributes": attrs}}
+    # sampling kinds compile to odigossampling rule-engine configs
+    # (autoscaler/controllers/actions/sampling/*.go)
+    rule_map = {
+        ActionKind.ERROR_SAMPLER: ("global", "error", {
+            "fallback_sampling_ratio": d.get("fallback_sampling_ratio", 0)}),
+        ActionKind.LATENCY_SAMPLER: ("endpoint", "latency", {
+            "rules": d.get("endpoints_filters", [])}),
+        ActionKind.PROBABILISTIC_SAMPLER: ("global", "probabilistic", {
+            "sampling_percentage": d.get("sampling_percentage", 100)}),
+        ActionKind.SERVICE_NAME_SAMPLER: ("service", "service-name", {
+            "services": d.get("services_name_filters", [])}),
+        ActionKind.SPAN_ATTRIBUTE_SAMPLER: ("service", "span-attribute", {
+            "rules": d.get("attribute_filters", [])}),
+        ActionKind.SAMPLERS: ("global", "composite", dict(d)),
+    }
+    if k in rule_map:
+        level, rule_type, details = rule_map[k]
+        return {"id": f"odigossampling/{action.name}",
+                "type": "odigossampling", "signals": ["traces"],
+                "config": {"rules": [{
+                    "level": level, "type": rule_type,
+                    "name": action.name, **details}]}}
+    return None
+
+
+# ----------------------------------------------------------------- HPA
+
+
+@dataclass
+class HpaDecider:
+    """Pure scaling policy of clustercollector/hpa.go:36-68: hybrid
+    cpu+memory+rejection metrics; aggressive up (+2 pods / 15s window),
+    conservative down (max(1 pod, 25%) / 60s, 15 min stabilization)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 10
+    cpu_target_pct: float = 80.0
+    memory_target_pct: float = 80.0
+    rejections_per_pod_target: float = 1.0
+    scale_up_pods: int = 2
+    scale_up_window_s: float = 15.0
+    scale_down_pct: float = 25.0
+    scale_down_window_s: float = 60.0
+    stabilization_s: float = 900.0
+    _last_scale_up: float = field(default=0.0, repr=False)
+    _last_scale_down: float = field(default=0.0, repr=False)
+    _recommendations: list[tuple[float, int]] = field(default_factory=list,
+                                                      repr=False)
+
+    def desired_replicas(self, current: int, cpu_pct: float,
+                         memory_pct: float, rejections_per_pod: float,
+                         now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        # raw desire: max over the three metrics (k8s HPA semantics)
+        ratios = [cpu_pct / self.cpu_target_pct,
+                  memory_pct / self.memory_target_pct,
+                  rejections_per_pod / self.rejections_per_pod_target]
+        import math
+        raw = max(1, math.ceil(current * max(ratios))) if current else 1
+        raw = min(max(raw, self.min_replicas), self.max_replicas)
+
+        if raw > current:
+            if now - self._last_scale_up < self.scale_up_window_s:
+                return current
+            desired = min(raw, current + self.scale_up_pods)
+            self._last_scale_up = now
+            self._recommendations.append((now, desired))
+            return desired
+        if raw < current:
+            # stabilization: use the max recommendation in the window
+            self._recommendations.append((now, raw))
+            cutoff = now - self.stabilization_s
+            self._recommendations = [(t, r) for t, r in self._recommendations
+                                     if t >= cutoff]
+            stabilized = max(r for _, r in self._recommendations)
+            if stabilized >= current:
+                return current
+            if now - self._last_scale_down < self.scale_down_window_s:
+                return current
+            step = max(1, int(current * self.scale_down_pct / 100.0))
+            desired = max(stabilized, current - step, self.min_replicas)
+            self._last_scale_down = now
+            return desired
+        self._recommendations.append((now, raw))
+        return current
+
+
+# -------------------------------------------------------------- autoscaler
+
+
+class Autoscaler:
+    """Watches Destination/Processor/Action/Source/CollectorsGroup and
+    keeps the generated collector ConfigMaps + gateway scale in sync."""
+
+    def __init__(self, store: Store, manager: ControllerManager,
+                 effective_config: Configuration) -> None:
+        self.store = store
+        self.config = effective_config
+        self.hpa = HpaDecider()
+        self.gateway_replicas = 1
+        gateway_key = lambda e: [(ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)]
+        manager.register("cluster-collector", self, {
+            "DestinationResource": gateway_key,
+            "Processor": gateway_key,
+            "Action": gateway_key,
+            "Source": gateway_key,
+            "CollectorsGroup": gateway_key,
+            "ConfigMap": lambda e: (
+                [(ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)]
+                if e.key == (ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME) else []),
+        })
+
+    def set_effective_config(self, cfg: Configuration) -> None:
+        self.config = cfg
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        destinations, dest_resources = self._destinations(store)
+        processors = self._processors(store)
+        data_streams = self._data_streams(store, destinations)
+        gateway_group = self._gateway_group(store)
+
+        eff_cm = store.get("ConfigMap", ODIGOS_NAMESPACE,
+                           EFFECTIVE_CONFIG_NAME)
+        if isinstance(eff_cm, ConfigMap):
+            self.config = Configuration.from_dict(eff_cm.data["config"])
+
+        options = GatewayOptions(
+            service_graph_disabled=bool(
+                gateway_group and gateway_group.service_graph_disabled),
+            cluster_metrics_enabled=bool(
+                gateway_group and gateway_group.cluster_metrics_enabled),
+            small_batches=self.config.extra.get("small_batches"),
+            anomaly=self.config.anomaly,
+        )
+        config, status, enabled_signals = build_gateway_config(
+            destinations, processors, data_streams, options)
+
+        store.apply(ConfigMap(
+            meta=ObjectMeta(name=GATEWAY_CONFIG_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            data={"collector-conf": config,
+                  "enabled_signals": [s.value for s in enabled_signals]}))
+
+        # surface per-destination reconcile outcome on the resources
+        # (change-gated: an identical condition must not re-trigger watches)
+        for dest_res in dest_resources:
+            err = status.destination.get(dest_res.meta.name)
+            cond = Condition(
+                "DestinationConfigured",
+                ConditionStatus.FALSE if err else ConditionStatus.TRUE,
+                "ConfigerError" if err else "TransformedToOtelcolConfig",
+                err or "")
+            prev = next((c for c in dest_res.conditions
+                         if c.type == cond.type), None)
+            if prev is not None and (prev.status, prev.reason, prev.message) \
+                    == (cond.status, cond.reason, cond.message):
+                continue
+            dest_res.conditions = [c for c in dest_res.conditions
+                                   if c.type != cond.type] + [cond]
+            store.update_status(dest_res)
+
+        # node collector config follows the gateway's enabled signals
+        node_cfg = build_node_collector_config(NodeCollectorOptions(
+            enabled_signals=tuple(enabled_signals) or (Signal.TRACES,),
+            span_metrics_enabled=self.config.metrics_sources.span_metrics,
+            host_metrics_enabled=self.config.metrics_sources.host_metrics,
+            kubelet_stats_enabled=self.config.metrics_sources.kubelet_stats,
+            log_collection_enabled=Signal.LOGS in enabled_signals,
+        ))
+        store.apply(ConfigMap(
+            meta=ObjectMeta(name=NODE_CONFIG_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            data={"collector-conf": node_cfg}))
+
+        # update the CollectorsGroup status (collectors hot-reload config
+        # via the watch; the reference's odigosk8scmprovider seam)
+        if gateway_group is not None:
+            new_signals = [s.value for s in enabled_signals]
+            if (not gateway_group.ready
+                    or gateway_group.received_signals != new_signals):
+                gateway_group.ready = True
+                gateway_group.received_signals = new_signals
+                store.update_status(gateway_group)
+            res = gateway_group.resources
+            if res:
+                self.hpa.min_replicas = res.get("min_replicas", 1)
+                self.hpa.max_replicas = res.get("max_replicas", 10)
+
+    # -------------------------------------------------------------- scale
+
+    def observe_metrics(self, cpu_pct: float, memory_pct: float,
+                        rejections_per_pod: float,
+                        now: Optional[float] = None) -> int:
+        """Feed the HPA one metrics sample; returns (and records) the new
+        replica count (custom_metrics_handler.go:251 scrapeGatewayMetric +
+        hpa.go behavior)."""
+        self.gateway_replicas = self.hpa.desired_replicas(
+            self.gateway_replicas, cpu_pct, memory_pct, rejections_per_pod,
+            now)
+        return self.gateway_replicas
+
+    # ------------------------------------------------------------ helpers
+
+    def _destinations(self, store: Store
+                      ) -> tuple[list[Destination], list[DestinationResource]]:
+        dests, resources = [], []
+        for d in store.list("DestinationResource"):
+            assert isinstance(d, DestinationResource)
+            if d.disabled:
+                continue
+            resources.append(d)
+            dests.append(Destination(
+                id=d.meta.name, dest_type=d.dest_type,
+                signals=[Signal(s) for s in d.signals],
+                config=dict(d.config),
+                data_stream_names=list(d.data_stream_names)))
+        return dests, resources
+
+    def _processors(self, store: Store) -> list[dict[str, Any]]:
+        out = []
+        for p in sorted(store.list("Processor"),
+                        key=lambda p: p.order_hint):
+            assert isinstance(p, Processor)
+            if p.disabled:
+                continue
+            entry = {"id": f"{p.processor_type}/{p.meta.name}",
+                     "type": p.processor_type,
+                     "config": p.processor_config}
+            if p.signals:  # omit the key entirely: empty means all signals
+                entry["signals"] = p.signals
+            out.append(entry)
+        for a in store.list("Action"):
+            assert isinstance(a, Action)
+            compiled = compile_action(a)
+            if compiled is not None:
+                out.append(compiled)
+        return out
+
+    def _data_streams(self, store: Store,
+                      destinations: list[Destination]) -> list[DataStream]:
+        """Streams from destination membership + source labels
+        (common/pipelinegen/datastreams.go:21)."""
+        names: dict[str, dict] = {}
+        for d in destinations:
+            for s in (d.data_stream_names or ["default"]):
+                names.setdefault(s, {"dests": [], "sources": []})[
+                    "dests"].append(d.id)
+        for src in store.list("Source"):
+            assert isinstance(src, Source)
+            if src.is_namespace_source:
+                continue
+            for s in (src.data_stream_names or ["default"]):
+                if s in names:
+                    names[s]["sources"].append(SourceRef(
+                        src.workload.namespace,
+                        src.workload.kind.value.lower(),
+                        src.workload.name))
+        return [DataStream(name,
+                           tuple(DataStreamDestination(d) for d in v["dests"]),
+                           tuple(v["sources"]))
+                for name, v in sorted(names.items())]
+
+    def _gateway_group(self, store: Store) -> Optional[CollectorsGroup]:
+        for g in store.list("CollectorsGroup"):
+            assert isinstance(g, CollectorsGroup)
+            if g.role == CollectorsGroupRole.CLUSTER_GATEWAY:
+                return g
+        return None

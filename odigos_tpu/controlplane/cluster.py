@@ -1,0 +1,184 @@
+"""In-process cluster model (workloads, pods, rollouts).
+
+The control plane needs something to act on. In the reference that is the
+k8s API server; here it is this small model — the same role KinD plays for
+the reference's e2e tests (SURVEY.md §4.5) but embeddable in-process. The
+instrumentor's webhook and rollout logic operate on it through the exact
+seams the reference uses: a pod-mutation hook invoked on every new pod
+(pods_webhook.go:76 Handle) and a restart that replaces pods with a new
+template generation (rollout.go:270 rolloutRestartWorkload).
+
+Fault injection for rollback tests: ``fail_next_rollout`` marks pods of the
+next template generation CrashLoopBackOff (the crash-demo service pattern).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.resources import WorkloadKind, WorkloadRef
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    CRASH_LOOP_BACK_OFF = "CrashLoopBackOff"
+    IMAGE_PULL_BACK_OFF = "ImagePullBackOff"
+
+
+@dataclass
+class Container:
+    name: str
+    image: str = ""
+    # what runtime inspection would find for this container (the sim's
+    # ground truth; procdiscovery inspectors read this)
+    language: str = "unknown"
+    runtime_version: str = ""
+    libc_type: str = "glibc"
+    exe_path: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    other_agent: Optional[str] = None
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str
+    workload_name: str
+    node: str
+    template_generation: int
+    containers: list[Container]
+    workload_kind: WorkloadKind = WorkloadKind.DEPLOYMENT
+    phase: PodPhase = PodPhase.RUNNING
+    phase_since: float = field(default_factory=time.time)
+    # mutations applied by the webhook at admission
+    injected_env: dict[str, dict[str, str]] = field(default_factory=dict)
+    injected_devices: dict[str, str] = field(default_factory=dict)
+    injected_mounts: list[str] = field(default_factory=list)
+    resource_attrs: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Workload:
+    ref: WorkloadRef
+    containers: list[Container]
+    replicas: int = 1
+    template_generation: int = 1
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+# admission webhook signature: mutate the pod in place before it "starts"
+AdmissionHook = Callable[[Pod], None]
+
+
+class Cluster:
+    def __init__(self, nodes: int = 1) -> None:
+        self.nodes = [f"node-{i}" for i in range(nodes)]
+        self.workloads: dict[str, Workload] = {}
+        self.pods: dict[str, Pod] = {}
+        self.admission_hooks: list[AdmissionHook] = []
+        self._pod_counter = itertools.count(1)
+        self._node_rr = itertools.count()
+        # fault injection: workload key -> phase new pods enter
+        self._fail_next: dict[str, PodPhase] = {}
+
+    # ---------------------------------------------------------- workloads
+
+    def add_workload(self, namespace: str, name: str,
+                     containers: list[Container],
+                     kind: WorkloadKind = WorkloadKind.DEPLOYMENT,
+                     replicas: int = 1) -> Workload:
+        ref = WorkloadRef(namespace, kind, name)
+        w = Workload(ref, containers, replicas)
+        self.workloads[ref.key] = w
+        self._scale_pods(w)
+        return w
+
+    def remove_workload(self, ref: WorkloadRef) -> None:
+        self.workloads.pop(ref.key, None)
+        for pod in [p for p in self.pods.values()
+                    if (p.namespace, p.workload_name) == (ref.namespace, ref.name)]:
+            del self.pods[pod.name]
+
+    def get_workload(self, ref: WorkloadRef) -> Optional[Workload]:
+        return self.workloads.get(ref.key)
+
+    def workloads_in_namespace(self, namespace: str) -> list[Workload]:
+        return [w for w in self.workloads.values()
+                if w.ref.namespace == namespace
+                and w.ref.kind != WorkloadKind.NAMESPACE]
+
+    # --------------------------------------------------------------- pods
+
+    def pods_of(self, ref: WorkloadRef) -> list[Pod]:
+        return [p for p in self.pods.values()
+                if (p.namespace, p.workload_name) == (ref.namespace, ref.name)]
+
+    def _spawn_pod(self, w: Workload) -> Pod:
+        node = self.nodes[next(self._node_rr) % len(self.nodes)]
+        pod = Pod(
+            name=f"{w.ref.name}-{next(self._pod_counter):05d}",
+            namespace=w.ref.namespace,
+            workload_name=w.ref.name,
+            node=node,
+            template_generation=w.template_generation,
+            containers=[Container(**vars(c)) for c in w.containers],
+            workload_kind=w.ref.kind,
+        )
+        for hook in self.admission_hooks:
+            hook(pod)  # webhook runs BEFORE the pod starts
+        fail_phase = self._fail_next.get(w.ref.key)
+        if fail_phase is not None:
+            pod.phase = fail_phase
+            pod.phase_since = time.time()
+        self.pods[pod.name] = pod
+        return pod
+
+    def _scale_pods(self, w: Workload) -> None:
+        current = self.pods_of(w.ref)
+        for pod in current[w.replicas:]:
+            del self.pods[pod.name]
+        for _ in range(w.replicas - len(current)):
+            self._spawn_pod(w)
+
+    # ------------------------------------------------------------ rollout
+
+    def rollout_restart(self, ref: WorkloadRef) -> bool:
+        """kubectl-rollout-restart semantics: bump template generation and
+        replace all pods (new pods pass through admission hooks again)."""
+        w = self.workloads.get(ref.key)
+        if w is None:
+            return False
+        w.template_generation += 1
+        w.annotations["kubectl.kubernetes.io/restartedAt"] = str(time.time())
+        for pod in self.pods_of(ref):
+            del self.pods[pod.name]
+        for _ in range(w.replicas):
+            self._spawn_pod(w)
+        return True
+
+    def rollout_complete(self, ref: WorkloadRef) -> bool:
+        w = self.workloads.get(ref.key)
+        if w is None:
+            return False
+        pods = self.pods_of(ref)
+        return bool(pods) and all(
+            p.template_generation == w.template_generation
+            and p.phase == PodPhase.RUNNING for p in pods)
+
+    # ----------------------------------------------------- fault injection
+
+    def fail_next_rollout(self, ref: WorkloadRef,
+                          phase: PodPhase = PodPhase.CRASH_LOOP_BACK_OFF) -> None:
+        self._fail_next[ref.key] = phase
+
+    def heal(self, ref: WorkloadRef) -> None:
+        self._fail_next.pop(ref.key, None)
+        for p in self.pods_of(ref):
+            p.phase = PodPhase.RUNNING
+            p.phase_since = time.time()

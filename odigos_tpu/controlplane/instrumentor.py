@@ -1,0 +1,452 @@
+"""Instrumentor: decides what to instrument and how.
+
+Reference: instrumentor/ (~11k LoC; SURVEY.md §2.1). Controller groups
+reproduced here:
+
+* **sourceinstrumentation** — Source/namespace events →
+  create/delete InstrumentationConfig
+  (instrumentor/controllers/sourceinstrumentation/).
+* **instrumentationconfig** — InstrumentationRules → per-language SDK
+  configs on each InstrumentationConfig.
+* **agentenabled** — runtime details + distros → per-container agent
+  decisions (sync.go:50 reconcileAll, :81 reconcileWorkload,
+  :500 calculateContainerInstrumentationConfig), then rollout.
+* **pod webhook** — mutates new pods of instrumented workloads: env,
+  device, mounts, OTel resource attrs (pods_webhook.go:76 Handle,
+  :111 injectOdigos, webhook_env_injector).
+* **rollout + rollback** — restart workloads whose agent config changed
+  (rollout/rollout.go:42 Do, :270 rolloutRestartWorkload); detect
+  CrashLoopBackOff/ImagePullBackOff after instrumentation and roll back
+  with grace time + stability window (:325 podHasBackOff, knobs
+  common/odigos_config.go:389-391).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Optional
+
+from ..api.resources import (
+    AGENT_ENABLED,
+    MARKED_FOR_INSTRUMENTATION,
+    RUNTIME_DETECTION,
+    WORKLOAD_ROLLOUT,
+    AgentEnabledReason,
+    Condition,
+    ConditionStatus,
+    ContainerAgentConfig,
+    InstrumentationConfig,
+    InstrumentationRule,
+    MarkedForInstrumentationReason,
+    ObjectMeta,
+    RuleKind,
+    RuntimeDetails,
+    RuntimeDetectionReason,
+    SdkConfig,
+    Source,
+    WorkloadKind,
+    WorkloadRef,
+    WorkloadRolloutReason,
+)
+from ..api.store import ControllerManager, Event, Store
+from ..config.model import Configuration
+from ..distros.registry import DistroProvider
+from .cluster import Cluster, Pod, PodPhase
+
+OTEL_SERVICE_NAME_ATTR = "service.name"
+WORKLOAD_LABEL = "odigos.io/workload"
+
+
+def ic_name(ref: WorkloadRef) -> str:
+    return f"{ref.kind.value.lower()}-{ref.name}"
+
+
+class Instrumentor:
+    """Wires all instrumentor reconcilers into a ControllerManager and
+    registers the admission webhook on the cluster."""
+
+    def __init__(self, store: Store, manager: ControllerManager,
+                 cluster: Cluster, effective_config: Configuration,
+                 tier: str = "community") -> None:
+        self.store = store
+        self.cluster = cluster
+        self.config = effective_config
+        self.distro_provider = DistroProvider(
+            tier=tier, overrides=effective_config.extra)
+        cluster.admission_hooks.append(self._webhook)
+
+        manager.register(
+            "source-instrumentation", _SourceReconciler(self),
+            {"Source": None})
+        manager.register(
+            "instrumentation-config", _RulesReconciler(self),
+            {"InstrumentationRule": self._all_ic_keys,
+             "InstrumentationConfig": None})
+        manager.register(
+            "agent-enabled", _AgentEnabledReconciler(self),
+            {"InstrumentationConfig": None})
+
+    # ------------------------------------------------------------ helpers
+
+    def _all_ic_keys(self, event: Event):
+        return [ic.meta.key for ic in self.store.list("InstrumentationConfig")]
+
+    def set_effective_config(self, cfg: Configuration) -> None:
+        self.config = cfg
+        self.distro_provider = DistroProvider(
+            tier=self.distro_provider.tier, overrides=cfg.extra)
+
+    # ------------------------------------------------------------ webhook
+
+    def _webhook(self, pod: Pod) -> None:
+        """Pod mutation at admission (pods_webhook.go:111 injectOdigos):
+        only pods of workloads with an agent-enabled InstrumentationConfig
+        are touched — everything else is byte-identical."""
+        ref = WorkloadRef(pod.namespace, pod.workload_kind,
+                          pod.workload_name)
+        ic = self._get_ic(ref)
+        if ic is None:
+            return
+        enabled = {c.container_name: c for c in ic.containers
+                   if c.agent_enabled}
+        if not enabled:
+            return
+        service_name = ic.service_name or ref.name
+        pod.resource_attrs.update({
+            OTEL_SERVICE_NAME_ATTR: service_name,
+            "k8s.namespace.name": pod.namespace,
+            f"k8s.{pod.workload_kind.value.lower()}.name": pod.workload_name,
+            "odigos.io/distro-hash": ic.agents_deployed_hash,
+        })
+        pod.labels[WORKLOAD_LABEL] = ref.key
+        for container in pod.containers:
+            cfg = enabled.get(container.name)
+            if cfg is None:
+                continue
+            pod.injected_env[container.name] = dict(cfg.env_to_inject)
+            distro = self.distro_provider.resolve(
+                next((r.language for r in ic.runtime_details
+                      if r.container_name == container.name), "unknown"))[0]
+            if distro is not None and distro.device:
+                pod.injected_devices[container.name] = distro.device
+        if "agents" not in pod.injected_mounts:
+            pod.injected_mounts.append("agents")
+
+    def _get_ic(self, ref: WorkloadRef) -> Optional[InstrumentationConfig]:
+        obj = self.store.get("InstrumentationConfig", ref.namespace,
+                             ic_name(ref))
+        return obj  # type: ignore[return-value]
+
+
+# ------------------------------------------------- source reconciliation
+
+
+class _SourceReconciler:
+    """Source events -> InstrumentationConfig lifecycle. Namespace sources
+    expand to every workload in the namespace; a workload source with
+    DisableInstrumentation=true excludes even namespace-inherited
+    instrumentation (source_types.go:72)."""
+
+    def __init__(self, instrumentor: Instrumentor):
+        self.i = instrumentor
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        namespace, name = key
+        source = store.get("Source", namespace, name)
+        if source is None:
+            # a deleted Source can both orphan ICs AND un-suppress workloads
+            # (deleting a disable_instrumentation Source under a namespace
+            # Source must resume inheritance) — re-derive every workload.
+            self._cleanup_orphans(store)
+            for w in list(self.i.cluster.workloads.values()):
+                if w.ref.kind != WorkloadKind.NAMESPACE:
+                    self._reconcile_workload(store, w.ref)
+            return
+        assert isinstance(source, Source)
+        if source.is_namespace_source:
+            for w in self.i.cluster.workloads_in_namespace(namespace):
+                self._reconcile_workload(store, w.ref)
+        else:
+            self._reconcile_workload(store, source.workload)
+
+    def _find_sources(self, store: Store, ref: WorkloadRef
+                      ) -> tuple[Optional[Source], Optional[Source]]:
+        workload_src = ns_src = None
+        for s in store.list("Source", ref.namespace):
+            assert isinstance(s, Source)
+            if s.is_namespace_source:
+                ns_src = s
+            elif s.workload == ref:
+                workload_src = s
+        return workload_src, ns_src
+
+    def _reconcile_workload(self, store: Store, ref: WorkloadRef) -> None:
+        cfg = self.i.config
+        if ref.namespace in cfg.ignored_namespaces or (
+                cfg.ignore_odigos_namespace
+                and ref.namespace == "odigos-system"):
+            # ignored namespaces are never instrumented, not even via an
+            # explicit Source (common/odigos_config.go IgnoredNamespaces;
+            # protects the collector's own namespace from self-injection)
+            name = ic_name(ref)
+            if store.get("InstrumentationConfig", ref.namespace, name):
+                store.delete("InstrumentationConfig", ref.namespace, name)
+            return
+        workload_src, ns_src = self._find_sources(store, ref)
+        if workload_src is not None and workload_src.disable_instrumentation:
+            reason = MarkedForInstrumentationReason.WORKLOAD_SOURCE_DISABLED
+            instrumented = False
+        elif workload_src is not None:
+            reason = MarkedForInstrumentationReason.WORKLOAD_SOURCE
+            instrumented = True
+        elif ns_src is not None and not ns_src.disable_instrumentation:
+            reason = MarkedForInstrumentationReason.NAMESPACE_SOURCE
+            instrumented = True
+        else:
+            reason = MarkedForInstrumentationReason.NO_SOURCE
+            instrumented = False
+
+        name = ic_name(ref)
+        existing = store.get("InstrumentationConfig", ref.namespace, name)
+        if not instrumented:
+            if existing is not None:
+                store.delete("InstrumentationConfig", ref.namespace, name)
+            return
+        src = workload_src or ns_src
+        is_new = not isinstance(existing, InstrumentationConfig)
+        ic = existing if not is_new else \
+            InstrumentationConfig(
+                meta=ObjectMeta(name=name, namespace=ref.namespace),
+                workload=ref)
+        changed = is_new
+        service_name = (src.otel_service_name or ref.name) \
+            if src is not None else ref.name
+        streams = list(src.data_stream_names) if src else []
+        if ic.service_name != service_name or ic.data_stream_names != streams:
+            ic.service_name = service_name
+            ic.data_stream_names = streams
+            changed = True
+        changed |= ic.set_condition(Condition(
+            MARKED_FOR_INSTRUMENTATION, ConditionStatus.TRUE,
+            reason.value, f"instrumented via {reason.value}"))
+        if changed:
+            store.apply(ic)
+
+    def _cleanup_orphans(self, store: Store) -> None:
+        """A deleted Source may leave ICs with no backing source."""
+        for ic in store.list("InstrumentationConfig"):
+            assert isinstance(ic, InstrumentationConfig)
+            workload_src, ns_src = self._find_sources(store, ic.workload)
+            keep = (workload_src is not None
+                    and not workload_src.disable_instrumentation) or \
+                   (workload_src is None and ns_src is not None
+                    and not ns_src.disable_instrumentation)
+            if not keep:
+                store.delete("InstrumentationConfig", ic.namespace,
+                             ic.meta.name)
+
+
+# --------------------------------------------------- rules -> sdk config
+
+
+class _RulesReconciler:
+    """InstrumentationRules -> per-language SdkConfig on each IC
+    (instrumentor/controllers/instrumentationconfig)."""
+
+    def __init__(self, instrumentor: Instrumentor):
+        self.i = instrumentor
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        namespace, name = key
+        ic = store.get("InstrumentationConfig", namespace, name)
+        if not isinstance(ic, InstrumentationConfig):
+            return
+        rules = [r for r in store.list("InstrumentationRule")
+                 if isinstance(r, InstrumentationRule)]
+        languages = {rd.language for rd in ic.runtime_details
+                     if rd.language != "unknown"}
+        new_configs = []
+        for lang in sorted(languages):
+            sdk = SdkConfig(language=lang)
+            for rule in rules:
+                if not rule.matches(ic.workload, lang):
+                    continue
+                if rule.rule_kind == RuleKind.PAYLOAD_COLLECTION:
+                    sdk.payload_collection = rule.details.get("mode", "full")
+                elif rule.rule_kind == RuleKind.CODE_ATTRIBUTES:
+                    sdk.code_attributes = True
+                elif rule.rule_kind == RuleKind.HTTP_HEADERS:
+                    sdk.http_headers = list(rule.details.get("headers", []))
+                elif rule.rule_kind == RuleKind.TRACE_CONFIG:
+                    sdk.trace_config.update(rule.details)
+            new_configs.append(sdk)
+        if new_configs != ic.sdk_configs:
+            ic.sdk_configs = new_configs
+            store.update_status(ic)
+
+
+# ------------------------------------------------ agent enablement
+
+
+class _AgentEnabledReconciler:
+    """Runtime details + distro resolution -> per-container agent configs,
+    then rollout; CrashLoopBackOff detection -> rollback
+    (agentenabled/sync.go + rollout/rollout.go)."""
+
+    def __init__(self, instrumentor: Instrumentor):
+        self.i = instrumentor
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        namespace, name = key
+        ic = store.get("InstrumentationConfig", namespace, name)
+        if not isinstance(ic, InstrumentationConfig):
+            return
+        cfg = self.i.config
+
+        if not ic.runtime_details:
+            if ic.set_condition(Condition(
+                    RUNTIME_DETECTION, ConditionStatus.FALSE,
+                    RuntimeDetectionReason.WAITING_FOR_DETECTION.value,
+                    "runtime inspection pending")):
+                store.update_status(ic)
+            return
+        dirty = ic.set_condition(Condition(
+            RUNTIME_DETECTION, ConditionStatus.TRUE,
+            RuntimeDetectionReason.DETECTED_SUCCESSFULLY.value,
+            f"{len(ic.runtime_details)} containers inspected"))
+
+        # rollback check before (re-)enabling (rollout.go:325 podHasBackOff)
+        if self._check_rollback(store, ic):
+            return
+        agent_cond = ic.condition(AGENT_ENABLED)
+        if agent_cond is not None and agent_cond.reason in (
+                AgentEnabledReason.CRASH_LOOP_BACK_OFF.value,
+                AgentEnabledReason.IMAGE_PULL_BACK_OFF.value):
+            # rolled back: stay un-instrumented until the operator heals the
+            # workload and re-applies the Source (rollback stability)
+            if dirty:
+                store.update_status(ic)
+            return
+
+        containers = []
+        any_enabled = False
+        for rd in ic.runtime_details:
+            c = self._container_config(rd, cfg)
+            containers.append(c)
+            any_enabled = any_enabled or c.agent_enabled
+        new_hash = self._hash(containers)
+        changed = (containers != ic.containers
+                   or new_hash != ic.agents_deployed_hash)
+        ic.containers = containers
+        ic.agents_deployed_hash = new_hash
+
+        if any_enabled:
+            dirty |= ic.set_condition(Condition(
+                AGENT_ENABLED, ConditionStatus.TRUE,
+                AgentEnabledReason.ENABLED_SUCCESSFULLY.value,
+                "agents enabled"))
+        else:
+            worst = containers[0].reason if containers else \
+                AgentEnabledReason.RUNTIME_DETAILS_UNAVAILABLE
+            dirty |= ic.set_condition(Condition(
+                AGENT_ENABLED, ConditionStatus.FALSE, worst.value,
+                "; ".join(c.message for c in containers if c.message)))
+
+        if changed:
+            self._rollout(ic)
+        if changed or dirty:
+            store.update_status(ic)
+
+    # -------------------------------------------------------- per-container
+
+    def _container_config(self, rd: RuntimeDetails,
+                          cfg: Configuration) -> ContainerAgentConfig:
+        """calculateContainerInstrumentationConfig (sync.go:500)."""
+        if rd.container_name in cfg.ignored_containers:
+            return ContainerAgentConfig(
+                rd.container_name, False,
+                AgentEnabledReason.IGNORED_CONTAINER,
+                "container in ignoredContainers")
+        if rd.other_agent and not cfg.allow_concurrent_agents:
+            return ContainerAgentConfig(
+                rd.container_name, False,
+                AgentEnabledReason.OTHER_AGENT_DETECTED,
+                f"{rd.other_agent} already instruments this container")
+        distro, problem = self.i.distro_provider.resolve(
+            rd.language, rd.runtime_version, rd.libc_type)
+        if distro is None:
+            return ContainerAgentConfig(
+                rd.container_name, False, AgentEnabledReason(problem),
+                f"language {rd.language}: {problem}")
+        env = dict(distro.environment)
+        # user-provided per-language env (UserInstrumentationEnvs)
+        env.update(cfg.user_instrumentation_envs.languages.get(
+            rd.language, {}))
+        return ContainerAgentConfig(
+            rd.container_name, True,
+            AgentEnabledReason.ENABLED_SUCCESSFULLY,
+            distro_name=distro.name, env_to_inject=env)
+
+    @staticmethod
+    def _hash(containers: list[ContainerAgentConfig]) -> str:
+        blob = "|".join(
+            f"{c.container_name}:{c.agent_enabled}:{c.distro_name}:"
+            f"{sorted(c.env_to_inject.items())}" for c in containers)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    # ------------------------------------------------------------ rollout
+
+    def _rollout(self, ic: InstrumentationConfig) -> None:
+        if self.i.config.rollout.automatic_rollout_disabled:
+            ic.set_condition(Condition(
+                WORKLOAD_ROLLOUT, ConditionStatus.FALSE,
+                WorkloadRolloutReason.DISABLED.value,
+                "automatic rollout disabled"))
+            return
+        ok = self.i.cluster.rollout_restart(ic.workload)
+        ic.set_condition(Condition(
+            WORKLOAD_ROLLOUT,
+            ConditionStatus.TRUE if ok else ConditionStatus.FALSE,
+            (WorkloadRolloutReason.TRIGGERED_SUCCESSFULLY if ok
+             else WorkloadRolloutReason.FAILED_TO_PATCH).value,
+            "rollout restarted" if ok else "workload not found"))
+
+    # ----------------------------------------------------------- rollback
+
+    def _check_rollback(self, store: Store,
+                        ic: InstrumentationConfig) -> bool:
+        """If instrumented pods are backing off, disable agents and restart
+        clean (rollout.go:325). Grace time: backoff must persist; stability
+        window: recently instrumented workloads only."""
+        cfg = self.i.config
+        if cfg.rollout.rollback_disabled:
+            return False
+        agent_cond = ic.condition(AGENT_ENABLED)
+        if agent_cond is None or agent_cond.status != ConditionStatus.TRUE:
+            return False  # nothing deployed to roll back
+        now = time.time()
+        if now - agent_cond.last_transition > \
+                cfg.rollout.rollback_stability_window_s:
+            return False  # instrumented long ago: crash is likely not ours
+        grace = cfg.rollout.rollback_grace_time_s
+        backoff = [p for p in self.i.cluster.pods_of(ic.workload)
+                   if p.phase in (PodPhase.CRASH_LOOP_BACK_OFF,
+                                  PodPhase.IMAGE_PULL_BACK_OFF)
+                   and now - p.phase_since >= grace]
+        if not backoff:
+            return False
+        reason = (AgentEnabledReason.CRASH_LOOP_BACK_OFF
+                  if backoff[0].phase == PodPhase.CRASH_LOOP_BACK_OFF
+                  else AgentEnabledReason.IMAGE_PULL_BACK_OFF)
+        ic.containers = [ContainerAgentConfig(
+            c.container_name, False, reason, "rolled back")
+            for c in ic.containers]
+        ic.agents_deployed_hash = ""
+        ic.set_condition(Condition(
+            AGENT_ENABLED, ConditionStatus.FALSE, reason.value,
+            f"instrumentation rolled back: {len(backoff)} pods backing off"))
+        store.update_status(ic)
+        self.i.cluster.heal(ic.workload)
+        self.i.cluster.rollout_restart(ic.workload)
+        return True

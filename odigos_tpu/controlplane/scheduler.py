@@ -1,0 +1,89 @@
+"""Scheduler: effective config + CollectorsGroup ownership.
+
+Reference: scheduler/ (SURVEY.md §2.1) — reconciles the authored
+configuration into the *effective* config every other component reads
+(odigosconfiguration_controller.go:44: profile deps :73-110, sizing :112)
+and creates/sizes the two CollectorsGroup resources
+(clustercollectorsgroup/resource_config.go, nodecollectorsgroup/).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+
+from ..api.resources import (
+    CollectorsGroup,
+    CollectorsGroupRole,
+    ConfigMap,
+    ObjectMeta,
+)
+from ..api.store import ControllerManager, Store
+from ..config.effective import calculate_effective_config
+from ..config.model import Configuration, Tier
+
+ODIGOS_NAMESPACE = "odigos-system"
+AUTHORED_CONFIG_NAME = "odigos-configuration"
+EFFECTIVE_CONFIG_NAME = "effective-config"
+GATEWAY_GROUP_NAME = "odigos-gateway"
+NODE_GROUP_NAME = "odigos-data-collection"
+
+
+class Scheduler:
+    def __init__(self, store: Store, manager: ControllerManager,
+                 tier: Tier = Tier.COMMUNITY) -> None:
+        self.store = store
+        self.tier = tier
+        manager.register("odigos-configuration", self, {"ConfigMap": None})
+
+    # ------------------------------------------------------------- public
+
+    def apply_authored(self, config: Configuration) -> None:
+        """Write the authored configuration (the odigos-configuration
+        ConfigMap analog); reconcile derives everything else."""
+        self.store.apply(ConfigMap(
+            meta=ObjectMeta(name=AUTHORED_CONFIG_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            data={"config": config.to_dict()}))
+
+    def effective_config(self) -> Configuration | None:
+        cm = self.store.get("ConfigMap", ODIGOS_NAMESPACE,
+                            EFFECTIVE_CONFIG_NAME)
+        if not isinstance(cm, ConfigMap):
+            return None
+        return Configuration.from_dict(cm.data["config"])
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        if key != (ODIGOS_NAMESPACE, AUTHORED_CONFIG_NAME):
+            return
+        cm = store.get("ConfigMap", *key)
+        if not isinstance(cm, ConfigMap):
+            return
+        authored = Configuration.from_dict(cm.data.get("config", {}))
+        eff = calculate_effective_config(authored, self.tier)
+
+        store.apply(ConfigMap(
+            meta=ObjectMeta(name=EFFECTIVE_CONFIG_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            data={"config": eff.config.to_dict(),
+                  "applied_profiles": eff.applied_profiles,
+                  "problems": eff.problems}))
+
+        gw = eff.config.collector_gateway
+        store.apply(CollectorsGroup(
+            meta=ObjectMeta(name=GATEWAY_GROUP_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            role=CollectorsGroupRole.CLUSTER_GATEWAY,
+            resources=asdict(eff.gateway) if eff.gateway else {},
+            service_graph_disabled=bool(gw.service_graph_disabled),
+            cluster_metrics_enabled=bool(gw.cluster_metrics_enabled),
+            tpu_replicas=(gw.tpu_replicas or
+                          (1 if eff.config.anomaly.enabled else 0)),
+        ))
+        store.apply(CollectorsGroup(
+            meta=ObjectMeta(name=NODE_GROUP_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            role=CollectorsGroupRole.NODE_COLLECTOR,
+            resources=asdict(eff.node) if eff.node else {},
+        ))

@@ -152,8 +152,10 @@ def _logzio(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
         out[T] = [n]
     if M in dest.signals:
         n = f"prometheusremotewrite/logzio-{dest.id}"
+        # regional listener: us -> listener.logz.io, else listener-<region>
+        suffix = "" if region in ("us", "") else f"-{region}"
         config["exporters"][n] = {
-            "endpoint": f"https://listener.logz.io:8053",
+            "endpoint": f"https://listener{suffix}.logz.io:8053",
             "headers": {"Authorization": f"Bearer {_secret('LOGZIO_METRICS_TOKEN')}"}}
         out[M] = [n]
     if L in dest.signals:

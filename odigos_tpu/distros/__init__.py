@@ -1,0 +1,27 @@
+"""OTel distribution registry.
+
+Reference: distros/ — per-language/tier distribution manifests
+(distros/yamls/{golang,java,python,nodejs,dotnet,php,ruby}-community.yaml)
+and a runtime ``Provider`` resolving which distro instruments a detected
+runtime (distros/distro/oteldistribution.go, oteldistributions.go). The
+manifest records how the agent reaches the process: environment variables,
+a loader (LD_PRELOAD), an eBPF loader, or a virtual device request
+(golang-community.yaml:15-18 `runtimeAgent.device:
+instrumentation.odigos.io/generic`).
+"""
+
+from .registry import (
+    Distro,
+    ALL_DISTROS,
+    DISTROS_BY_NAME,
+    DistroProvider,
+    VIRTUAL_DEVICE_GENERIC,
+)
+
+__all__ = [
+    "Distro",
+    "ALL_DISTROS",
+    "DISTROS_BY_NAME",
+    "DistroProvider",
+    "VIRTUAL_DEVICE_GENERIC",
+]

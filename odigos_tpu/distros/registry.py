@@ -1,0 +1,125 @@
+"""Distribution manifests + runtime resolution.
+
+Each ``Distro`` is the declarative analog of one distros/yamls/*.yaml:
+which language it instruments, how the agent attaches (env vars, loader,
+eBPF, virtual device), runtime-version constraints, and the env the webhook
+must inject. ``DistroProvider`` resolves the distro for a detected runtime
+the way distros/distro Provider does, honoring profile overrides
+(java-native vs java-ebpf, legacy-dotnet).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+VIRTUAL_DEVICE_GENERIC = "instrumentation.odigos.io/generic"
+
+
+@dataclass(frozen=True)
+class Distro:
+    name: str
+    language: str
+    tier: str = "community"
+    # attachment mechanism: env | loader | ebpf | device
+    mechanism: str = "env"
+    # virtual device requested on the container (device-plugin mount path)
+    device: Optional[str] = None
+    # env vars the webhook injects (values may reference {agent_dir})
+    environment: dict[str, str] = field(default_factory=dict)
+    # minimum runtime version supported, as a (major, minor) tuple
+    min_runtime_version: Optional[tuple[int, int]] = None
+    # libc constraint: None = any, else "glibc"/"musl"
+    libc: Optional[str] = None
+
+
+AGENT_DIR = "/var/odigos"
+
+ALL_DISTROS: list[Distro] = [
+    # golang-community.yaml: eBPF uprobes; agent attaches from outside the
+    # process via the generic virtual device for node affinity (:15-18)
+    Distro("golang-community", "go", mechanism="ebpf",
+           device=VIRTUAL_DEVICE_GENERIC),
+    Distro("java-community", "java", mechanism="env",
+           environment={"JAVA_TOOL_OPTIONS":
+                        f"-javaagent:{AGENT_DIR}/java/javaagent.jar"},
+           min_runtime_version=(8, 0)),
+    Distro("java-ebpf", "java", tier="onprem", mechanism="ebpf",
+           device=VIRTUAL_DEVICE_GENERIC),
+    Distro("python-community", "python", mechanism="env",
+           environment={"PYTHONPATH": f"{AGENT_DIR}/python",
+                        "OTEL_PYTHON_CONFIGURATOR": "odigos"},
+           min_runtime_version=(3, 8)),
+    Distro("nodejs-community", "nodejs", mechanism="env",
+           environment={"NODE_OPTIONS":
+                        f"--require {AGENT_DIR}/nodejs/autoinstrumentation.js"},
+           min_runtime_version=(14, 0)),
+    Distro("dotnet-community", "dotnet", mechanism="loader",
+           environment={"CORECLR_ENABLE_PROFILING": "1",
+                        "CORECLR_PROFILER_PATH":
+                        f"{AGENT_DIR}/dotnet/linux-glibc-x64/OpenTelemetry.AutoInstrumentation.Native.so"},
+           libc="glibc"),
+    Distro("dotnet-community-musl", "dotnet", mechanism="loader",
+           environment={"CORECLR_ENABLE_PROFILING": "1",
+                        "CORECLR_PROFILER_PATH":
+                        f"{AGENT_DIR}/dotnet/linux-musl-x64/OpenTelemetry.AutoInstrumentation.Native.so"},
+           libc="musl"),
+    Distro("dotnet-legacy", "dotnet", mechanism="loader",
+           environment={"CORECLR_ENABLE_PROFILING": "1"}),
+    Distro("php-community", "php", mechanism="env",
+           environment={"PHP_INI_SCAN_DIR": f":{AGENT_DIR}/php/ini"}),
+    Distro("ruby-community", "ruby", mechanism="env",
+           environment={"RUBYOPT": f"-r{AGENT_DIR}/ruby/autoinstrument"}),
+]
+
+DISTROS_BY_NAME: dict[str, Distro] = {d.name: d for d in ALL_DISTROS}
+
+
+def _parse_version(v: str) -> Optional[tuple[int, int]]:
+    parts = v.lstrip("v").split(".")
+    try:
+        return (int(parts[0]), int(parts[1]) if len(parts) > 1 else 0)
+    except (ValueError, IndexError):
+        return None
+
+
+class DistroProvider:
+    """Resolve a distro for a detected runtime.
+
+    ``overrides`` come from the effective config (profiles): e.g.
+    {"java_distro": "ebpf"} picks java-ebpf, {"dotnet_distro": "legacy"}
+    picks dotnet-legacy (profiles/instrumentation/*.go behavior).
+    """
+
+    def __init__(self, tier: str = "community",
+                 overrides: Optional[dict[str, str]] = None):
+        self.tier = tier
+        self.overrides = overrides or {}
+
+    def default_distro_name(self, language: str, libc: str = "") -> Optional[str]:
+        if language == "java" and self.overrides.get("java_distro") == "ebpf":
+            return "java-ebpf"
+        if language == "dotnet":
+            if self.overrides.get("dotnet_distro") == "legacy":
+                return "dotnet-legacy"
+            return "dotnet-community-musl" if libc == "musl" else "dotnet-community"
+        for d in ALL_DISTROS:
+            if d.language == language and d.tier == "community":
+                return d.name
+        return None
+
+    def resolve(self, language: str, runtime_version: str = "",
+                libc: str = "") -> tuple[Optional[Distro], str]:
+        """Returns (distro, problem). problem is "" on success, else an
+        AgentEnabledReason-compatible string."""
+        name = self.default_distro_name(language, libc)
+        if name is None:
+            return None, "UnsupportedProgrammingLanguage"
+        distro = DISTROS_BY_NAME[name]
+        if distro.tier != "community" and self.tier == "community":
+            return None, "NoAvailableAgent"
+        if distro.min_runtime_version and runtime_version:
+            parsed = _parse_version(runtime_version)
+            if parsed is not None and parsed < distro.min_runtime_version:
+                return None, "UnsupportedRuntimeVersion"
+        return distro, ""

@@ -169,7 +169,8 @@ def build_gateway_config(
             continue
         key = pid if pid.split("/", 1)[0] == ptype else f"{ptype}/{pid}"
         config["processors"][key] = dict(proc.get("config") or {})
-        for sig_name in proc.get("signals", [s.value for s in SIGNALS]):
+        # absent/None/empty signals all mean "every signal"
+        for sig_name in (proc.get("signals") or [s.value for s in SIGNALS]):
             try:
                 sig = Signal(sig_name)
             except ValueError:

@@ -87,8 +87,9 @@ def build_node_collector_config(opts: NodeCollectorOptions) -> GenericMap:
                            "odigosresourcename", "batch"],
             "exporters": [traces_exporter],
         }
-        if opts.span_metrics_enabled:
-            # spanmetrics.go: derive RED metrics on-node to offload gateway
+        if opts.span_metrics_enabled and Signal.METRICS in opts.enabled_signals:
+            # spanmetrics.go: derive RED metrics on-node to offload gateway;
+            # requires the metrics pipeline (the connector's consumer) too
             config["connectors"]["spanmetrics"] = {
                 "histogram": {"explicit_bucket_boundaries_ms":
                               [2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500]}}

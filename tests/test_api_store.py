@@ -1,0 +1,172 @@
+"""API store + controller-manager runtime tests."""
+
+import pytest
+
+from odigos_tpu.api import (
+    ControllerManager,
+    Event,
+    EventType,
+    ObjectMeta,
+    Source,
+    Store,
+    WorkloadKind,
+    WorkloadRef,
+)
+from odigos_tpu.api.resources import (
+    Condition,
+    ConditionStatus,
+    InstrumentationConfig,
+    MARKED_FOR_INSTRUMENTATION,
+    RUNTIME_DETECTION,
+    condition_logical_order,
+)
+
+
+def make_source(name="s1", ns="default", workload_name="app"):
+    return Source(meta=ObjectMeta(name=name, namespace=ns),
+                  workload=WorkloadRef(ns, WorkloadKind.DEPLOYMENT,
+                                       workload_name))
+
+
+class TestStore:
+    def test_apply_and_get(self):
+        store = Store()
+        store.apply(make_source())
+        got = store.get("Source", "default", "s1")
+        assert got is not None and got.meta.generation == 1
+
+    def test_update_bumps_generation_keeps_uid(self):
+        store = Store()
+        first = store.apply(make_source())
+        uid = first.meta.uid
+        second = store.apply(make_source())
+        assert second.meta.generation == 2
+        assert second.meta.uid == uid
+
+    def test_update_status_does_not_bump_generation(self):
+        store = Store()
+        store.apply(make_source())
+        src = store.get("Source", "default", "s1")
+        store.update_status(src)
+        assert store.get("Source", "default", "s1").meta.generation == 1
+
+    def test_list_by_namespace_and_labels(self):
+        store = Store()
+        a = make_source("a", ns="ns1")
+        a.meta.labels["team"] = "x"
+        store.apply(a)
+        store.apply(make_source("b", ns="ns2"))
+        assert len(store.list("Source")) == 2
+        assert len(store.list("Source", namespace="ns1")) == 1
+        assert len(store.list("Source", labels={"team": "x"})) == 1
+        assert len(store.list("Source", labels={"team": "y"})) == 0
+
+    def test_watch_events(self):
+        store = Store()
+        events: list[Event] = []
+        store.watch(events.append, kind="Source")
+        store.apply(make_source())
+        store.apply(make_source())
+        store.delete("Source", "default", "s1")
+        assert [e.type for e in events] == [
+            EventType.ADDED, EventType.MODIFIED, EventType.DELETED]
+
+    def test_delete_missing_returns_false(self):
+        assert Store().delete("Source", "x", "y") is False
+
+
+class TestConditions:
+    def test_logical_order(self):
+        ic = InstrumentationConfig(
+            meta=ObjectMeta(name="ic", namespace="d"),
+            workload=WorkloadRef("d", WorkloadKind.DEPLOYMENT, "app"))
+        ic.set_condition(Condition(RUNTIME_DETECTION, ConditionStatus.TRUE))
+        ic.set_condition(Condition(MARKED_FOR_INSTRUMENTATION,
+                                   ConditionStatus.TRUE))
+        assert [c.type for c in ic.conditions] == [
+            MARKED_FOR_INSTRUMENTATION, RUNTIME_DETECTION]
+        assert condition_logical_order("WorkloadRollout") == 4
+
+    def test_set_condition_idempotent(self):
+        ic = InstrumentationConfig(
+            meta=ObjectMeta(name="ic", namespace="d"),
+            workload=WorkloadRef("d", WorkloadKind.DEPLOYMENT, "app"))
+        assert ic.set_condition(
+            Condition(RUNTIME_DETECTION, ConditionStatus.TRUE, "R", "m"))
+        t0 = ic.condition(RUNTIME_DETECTION).last_transition
+        assert not ic.set_condition(
+            Condition(RUNTIME_DETECTION, ConditionStatus.TRUE, "R", "m"))
+        assert ic.condition(RUNTIME_DETECTION).last_transition == t0
+        assert ic.set_condition(
+            Condition(RUNTIME_DETECTION, ConditionStatus.FALSE, "R", "m"))
+
+
+class _Recorder:
+    def __init__(self):
+        self.keys = []
+
+    def reconcile(self, store, key):
+        self.keys.append(key)
+
+
+class TestControllerManager:
+    def test_event_dispatch_and_dedupe(self):
+        store = Store()
+        mgr = ControllerManager(store)
+        rec = _Recorder()
+        mgr.register("r", rec, {"Source": None})
+        store.apply(make_source())
+        store.apply(make_source())  # second event dedupes while pending
+        n = mgr.run_once()
+        assert n == 1
+        assert rec.keys == [("default", "s1")]
+
+    def test_cross_kind_mapping(self):
+        store = Store()
+        mgr = ControllerManager(store)
+        rec = _Recorder()
+        mgr.register("r", rec,
+                     {"Source": lambda e: [("odigos-system", "gateway")]})
+        store.apply(make_source())
+        mgr.run_once()
+        assert rec.keys == [("odigos-system", "gateway")]
+
+    def test_reconcile_errors_recorded_not_fatal(self):
+        store = Store()
+        mgr = ControllerManager(store)
+
+        class Boom:
+            def reconcile(self, store, key):
+                raise RuntimeError("boom")
+
+        mgr.register("boom", Boom(), {"Source": None})
+        store.apply(make_source())
+        mgr.run_once()
+        assert len(mgr.errors) == 1
+        assert mgr.errors[0][0] == "boom"
+
+    def test_enqueue_all_resync(self):
+        store = Store()
+        mgr = ControllerManager(store)
+        store.apply(make_source("a"))
+        store.apply(make_source("b"))
+        mgr.run_once()  # drain creation events (no controllers yet anyway)
+        rec = _Recorder()
+        mgr.register("r", rec, {"Source": None})
+        mgr.enqueue_all("Source")
+        mgr.run_once()
+        assert sorted(rec.keys) == [("default", "a"), ("default", "b")]
+
+    def test_nonquiescent_loop_detected(self):
+        store = Store()
+        mgr = ControllerManager(store)
+
+        class Fighter:
+            def reconcile(self, store, key):
+                src = store.get("Source", *key)
+                store.apply(src)  # always rewrites -> infinite loop
+
+        mgr.register("fighter", Fighter(), {"Source": None})
+        store.apply(make_source())
+        with pytest.raises(RuntimeError, match="quiesce"):
+            mgr.run_once(max_iterations=50)

@@ -150,3 +150,22 @@ class TestReviewRegressions:
     def test_anomaly_threshold_within_score_contract(self):
         from odigos_tpu.config.model import AnomalyStageConfiguration
         assert 0.0 <= AnomalyStageConfiguration().threshold <= 1.0
+
+    def test_profile_cycle_reported(self):
+        import odigos_tpu.config.profiles as profmod
+        from odigos_tpu.config.profiles import Profile, resolve_profiles
+        a = Profile("cycle-a", Tier.COMMUNITY, "", "attributes",
+                    dependencies=("cycle-b",))
+        b = Profile("cycle-b", Tier.COMMUNITY, "", "attributes",
+                    dependencies=("cycle-a",))
+        profmod.PROFILES_BY_NAME["cycle-a"] = a
+        profmod.PROFILES_BY_NAME["cycle-b"] = b
+        profmod.ALL_PROFILES.extend([a, b])
+        try:
+            _, problems = resolve_profiles(["cycle-a"], Tier.COMMUNITY)
+            assert any("cycle" in p for p in problems), problems
+        finally:
+            profmod.ALL_PROFILES.remove(a)
+            profmod.ALL_PROFILES.remove(b)
+            del profmod.PROFILES_BY_NAME["cycle-a"]
+            del profmod.PROFILES_BY_NAME["cycle-b"]

@@ -191,3 +191,11 @@ class TestExtensionsWiring:
         assert exp["auth"]["authenticator"] == auth
         assert auth in cfg["extensions"]
         assert auth in cfg["service"]["extensions"]
+
+    def test_logzio_regional_metrics_listener(self):
+        cfg = fresh()
+        d = Destination(id="lz2", dest_type="logzio", signals=[M],
+                        config={"LOGZIO_REGION": "eu"})
+        modify_config(d, cfg)
+        assert cfg["exporters"]["prometheusremotewrite/logzio-lz2"][
+            "endpoint"] == "https://listener-eu.logz.io:8053"
