@@ -151,6 +151,13 @@ class HpaDecider:
         raw = max(1, math.ceil(current * max(ratios))) if current else 1
         raw = min(max(raw, self.min_replicas), self.max_replicas)
 
+        # prune the stabilization window on every sample, not only in the
+        # scale-down branch — steady load would otherwise grow the list
+        # unboundedly (~one tuple per observe interval, forever)
+        cutoff = now - self.stabilization_s
+        self._recommendations = [(t, r) for t, r in self._recommendations
+                                 if t >= cutoff]
+
         if raw > current:
             if now - self._last_scale_up < self.scale_up_window_s:
                 return current
@@ -161,9 +168,6 @@ class HpaDecider:
         if raw < current:
             # stabilization: use the max recommendation in the window
             self._recommendations.append((now, raw))
-            cutoff = now - self.stabilization_s
-            self._recommendations = [(t, r) for t, r in self._recommendations
-                                     if t >= cutoff]
             stabilized = max(r for _, r in self._recommendations)
             if stabilized >= current:
                 return current

@@ -51,7 +51,7 @@ from ..api.resources import (
 )
 from ..api.store import ControllerManager, Event, Store
 from ..config.model import Configuration
-from ..distros.registry import DistroProvider
+from ..distros.registry import DISTROS_BY_NAME, DistroProvider
 from .cluster import Cluster, Pod, PodPhase
 
 OTEL_SERVICE_NAME_ATTR = "service.name"
@@ -125,9 +125,10 @@ class Instrumentor:
             if cfg is None:
                 continue
             pod.injected_env[container.name] = dict(cfg.env_to_inject)
-            distro = self.distro_provider.resolve(
-                next((r.language for r in ic.runtime_details
-                      if r.container_name == container.name), "unknown"))[0]
+            # device comes from the *recorded* distro decision, never a
+            # fresh resolve — a profile flip between reconcile and admission
+            # must not mix two attach mechanisms on one container
+            distro = DISTROS_BY_NAME.get(cfg.distro_name)
             if distro is not None and distro.device:
                 pod.injected_devices[container.name] = distro.device
         if "agents" not in pod.injected_mounts:
@@ -189,9 +190,7 @@ class _SourceReconciler:
             # ignored namespaces are never instrumented, not even via an
             # explicit Source (common/odigos_config.go IgnoredNamespaces;
             # protects the collector's own namespace from self-injection)
-            name = ic_name(ref)
-            if store.get("InstrumentationConfig", ref.namespace, name):
-                store.delete("InstrumentationConfig", ref.namespace, name)
+            self._delete_ic(store, ref)
             return
         workload_src, ns_src = self._find_sources(store, ref)
         if workload_src is not None and workload_src.disable_instrumentation:
@@ -208,11 +207,10 @@ class _SourceReconciler:
             instrumented = False
 
         name = ic_name(ref)
-        existing = store.get("InstrumentationConfig", ref.namespace, name)
         if not instrumented:
-            if existing is not None:
-                store.delete("InstrumentationConfig", ref.namespace, name)
+            self._delete_ic(store, ref)
             return
+        existing = store.get("InstrumentationConfig", ref.namespace, name)
         src = workload_src or ns_src
         is_new = not isinstance(existing, InstrumentationConfig)
         ic = existing if not is_new else \
@@ -243,8 +241,28 @@ class _SourceReconciler:
                    (workload_src is None and ns_src is not None
                     and not ns_src.disable_instrumentation)
             if not keep:
-                store.delete("InstrumentationConfig", ic.namespace,
-                             ic.meta.name)
+                self._delete_ic(store, ic.workload)
+
+    def _delete_ic(self, store: Store, ref: WorkloadRef) -> None:
+        """Delete the IC and, when agents were actually deployed, restart
+        the workload so running pods lose the injected env/devices — the
+        reference un-instruments by rollout the same way it instruments
+        (rollout.go Do handles both directions); without this, deleted
+        Sources would leave agents attached forever."""
+        name = ic_name(ref)
+        ic = store.get("InstrumentationConfig", ref.namespace, name)
+        if ic is None:
+            return
+        agents_deployed = isinstance(ic, InstrumentationConfig) and (
+            ic.agents_deployed_hash
+            or any(c.agent_enabled for c in ic.containers))
+        store.delete("InstrumentationConfig", ref.namespace, name)
+        if agents_deployed and not (
+                self.i.config.rollout.automatic_rollout_disabled):
+            # the same opt-out that gates instrumenting rollouts gates the
+            # un-instrumenting one; with it set, no restart ever happened,
+            # so there is nothing to strip
+            self.i.cluster.rollout_restart(ref)
 
 
 # --------------------------------------------------- rules -> sdk config

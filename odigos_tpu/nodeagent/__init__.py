@@ -1,0 +1,20 @@
+"""Node agent stack (SURVEY.md §2.2) — the odiglet-equivalent layer.
+
+* ``proc``         — /proc-backed process context (+ simulated contexts)
+* ``inspectors``   — language/runtime detection (procdiscovery equivalent)
+* ``detector``     — process exec/exit event source (runtime-detector equivalent)
+* ``manager``      — generic instrumentation lifecycle manager
+* ``opamp``        — OpAMP-style remote-config/health server
+* ``deviceplugin`` — kubelet device-plugin equivalent (virtual devices)
+* ``odiglet``      — the agent wiring all of the above per node
+"""
+
+from .proc import ProcessContext, SimulatedProcSource, RealProcSource  # noqa: F401
+from .inspectors import detect_language, inspect_process  # noqa: F401
+from .detector import ProcessEvent, ProcessEventType, Detector  # noqa: F401
+from .manager import (  # noqa: F401
+    InstrumentationManager, InstrumentationFactory, Instrumentation,
+    ManagerOptions)
+from .opamp import OpampServer, OpampAgent  # noqa: F401
+from .deviceplugin import DevicePlugin, MuslDevicePlugin, DevicePluginRegistry  # noqa: F401
+from .odiglet import Odiglet, OdigletInitPhase  # noqa: F401

@@ -1,0 +1,80 @@
+"""Process exec/exit event source.
+
+Equivalent of the eBPF runtime-detector the reference wraps behind a small
+interface (instrumentation/detector/detector.go:31 NewDetector over
+github.com/odigos-io/runtime-detector): the manager consumes a stream of
+ProcessEvents and never cares how they were produced. Here the production
+implementation is a poller diffing the proc source's pid set (no eBPF on
+TPU hosts); tests drive events synchronously.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional, Protocol
+
+from .proc import ProcessContext
+
+
+class ProcessEventType(str, enum.Enum):
+    EXEC = "exec"
+    EXIT = "exit"
+
+
+@dataclass(frozen=True)
+class ProcessEvent:
+    type: ProcessEventType
+    pid: int
+    context: Optional[ProcessContext] = None  # None for EXIT
+
+
+EventSink = Callable[[ProcessEvent], None]
+
+
+class Detector(Protocol):
+    def start(self, sink: EventSink) -> None: ...
+    def stop(self) -> None: ...
+
+
+class PollingDetector:
+    """Diffs the pid set every ``interval`` seconds. ``poll_once`` is public
+    so tests and the odiglet sim can step it deterministically."""
+
+    def __init__(self, source, interval: float = 1.0):
+        self.source = source
+        self.interval = interval
+        self._known: set[int] = set()
+        self._sink: Optional[EventSink] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self, sink: EventSink) -> None:
+        self._sink = sink
+        if self.interval > 0:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="process-detector")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def poll_once(self) -> None:
+        if self._sink is None:
+            return
+        current = set(self.source.pids())
+        for pid in sorted(current - self._known):
+            ctx = self.source.context(pid)
+            if ctx is not None:
+                self._sink(ProcessEvent(ProcessEventType.EXEC, pid, ctx))
+        for pid in sorted(self._known - current):
+            self._sink(ProcessEvent(ProcessEventType.EXIT, pid))
+        self._known = current
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.poll_once()

@@ -1,0 +1,140 @@
+"""Kubelet device-plugin equivalent: virtual instrumentation devices.
+
+Equivalent of deviceplugin/ (SURVEY.md §2.2): the reference exposes virtual
+``instrumentation.odigos.io/<lang>`` devices to the kubelet; requesting one
+on a container is how the scheduler/webhook get agent env+mounts injected
+without mutating the image, and how eBPF distros pin pods to instrumented
+nodes. The TPU extension rides the same seam: the gateway collector replica
+requests a ``tpu.odigos.io/v5e`` device so the autoscaler co-schedules it
+with a TPU chip (SURVEY.md §5.8 co-scheduling north star).
+
+* ``IDManager``           — fixed pool of virtual device ids
+  (deviceplugin/pkg/instrumentation/devices/ids_manager.go:17)
+* ``DevicePlugin``        — ListAndWatch + Allocate
+  (deviceplugin/pkg/instrumentation/plugin.go:24)
+* ``MuslDevicePlugin``    — same allocation with musl path rewriting (:34)
+* ``DevicePluginRegistry``— the kubelet role: discovery + allocation calls
+  (deviceplugin/pkg/instrumentation/lister.go:21 Discover)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from ..distros.registry import AGENT_DIR, ALL_DISTROS, Distro
+
+DEFAULT_POOL_SIZE = 100
+
+TPU_DEVICE = "tpu.odigos.io/v5e"
+
+
+class IDManager:
+    """Fixed-size virtual id pool; ids are strings the kubelet echoes back
+    at Allocate time."""
+
+    def __init__(self, resource: str, size: int = DEFAULT_POOL_SIZE):
+        self.resource = resource
+        self._free = [f"{resource}-{i}" for i in range(size)]
+        self._used: set[str] = set()
+
+    def list_ids(self) -> list[str]:
+        return sorted(self._free) + sorted(self._used)
+
+    def allocate(self, n: int = 1) -> list[str]:
+        if n > len(self._free):
+            raise RuntimeError(f"{self.resource}: device pool exhausted")
+        ids = [self._free.pop() for _ in range(n)]
+        self._used.update(ids)
+        return ids
+
+    def release(self, ids: list[str]) -> None:
+        for i in ids:
+            if i in self._used:
+                self._used.remove(i)
+                self._free.append(i)
+
+
+@dataclass
+class AllocateResponse:
+    envs: dict[str, str] = field(default_factory=dict)
+    mounts: list[str] = field(default_factory=list)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+class DevicePlugin:
+    """One plugin per virtual resource. The allocation response carries the
+    env + agent-dir mount the distro declared."""
+
+    def __init__(self, resource: str, distro: Optional[Distro] = None,
+                 pool_size: int = DEFAULT_POOL_SIZE):
+        self.resource = resource
+        self.distro = distro
+        self.ids = IDManager(resource, pool_size)
+        self._watch_version = 0
+
+    def list_and_watch(self) -> Iterator[tuple[int, list[str]]]:
+        """Yields (version, device ids); a real kubelet long-polls this."""
+        self._watch_version += 1
+        yield (self._watch_version, self.ids.list_ids())
+
+    def allocate(self, n: int = 1) -> tuple[list[str], AllocateResponse]:
+        ids = self.ids.allocate(n)
+        resp = AllocateResponse(mounts=[AGENT_DIR])
+        if self.distro is not None:
+            resp.envs = {k: v.format(agent_dir=AGENT_DIR)
+                         for k, v in self.distro.environment.items()}
+        return ids, resp
+
+    def release(self, ids: list[str]) -> None:
+        self.ids.release(ids)
+
+
+class MuslDevicePlugin(DevicePlugin):
+    """musl variant: same devices, allocation env rewritten from glibc agent
+    paths to musl ones (plugin.go:34 NewMuslPlugin)."""
+
+    def allocate(self, n: int = 1) -> tuple[list[str], AllocateResponse]:
+        ids, resp = super().allocate(n)
+        resp.envs = {k: v.replace("linux-glibc", "linux-musl")
+                         .replace("-glibc-", "-musl-")
+                     for k, v in resp.envs.items()}
+        return ids, resp
+
+
+class DevicePluginRegistry:
+    """Discovery (lister.go:21): one plugin per distro that attaches via a
+    virtual device, plus the generic device and the TPU device."""
+
+    def __init__(self, pool_size: int = DEFAULT_POOL_SIZE,
+                 tpu_chips: int = 0):
+        self.plugins: dict[str, DevicePlugin] = {}
+        for distro in ALL_DISTROS:
+            if distro.device:
+                self.plugins.setdefault(
+                    distro.device, DevicePlugin(distro.device, None,
+                                                pool_size))
+            elif distro.environment:
+                resource = f"instrumentation.odigos.io/{distro.name}"
+                cls = (MuslDevicePlugin if distro.libc == "musl"
+                       else DevicePlugin)
+                self.plugins[resource] = cls(resource, distro, pool_size)
+        if tpu_chips > 0:
+            # real-hardware-backed pool: one id per chip, no agent env
+            self.plugins[TPU_DEVICE] = DevicePlugin(TPU_DEVICE, None,
+                                                    tpu_chips)
+
+    def resources(self) -> list[str]:
+        return sorted(self.plugins)
+
+    def allocate(self, resource: str, n: int = 1
+                 ) -> tuple[list[str], AllocateResponse]:
+        plugin = self.plugins.get(resource)
+        if plugin is None:
+            raise KeyError(f"unknown device resource {resource}")
+        return plugin.allocate(n)
+
+    def release(self, resource: str, ids: list[str]) -> None:
+        plugin = self.plugins.get(resource)
+        if plugin is not None:
+            plugin.release(ids)

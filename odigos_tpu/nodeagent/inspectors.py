@@ -1,0 +1,227 @@
+"""Language/runtime detection over ProcessContexts.
+
+Equivalent of procdiscovery/pkg/inspectors (langdetect.go): one inspector per
+runtime, each with a cheap *quick scan* (exe path / cmdline / env) and a
+costlier *deep scan* (mapped libraries, exe contents). Detection runs all
+quick scans first and falls back to deep scans; two different positives is a
+conflict error (ErrLanguageDetectionConflict, langdetect.go:30). The same 13
+runtimes are covered: go, java, python, dotnet, nodejs, php, ruby, rust,
+cplusplus, nginx, mysql, postgres, redis.
+
+Version detection and glibc/musl detection (procdiscovery/pkg/libc) ride on
+the same context.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..distros.registry import AGENT_DIR as _AGENT_DIR
+from .proc import GO_BUILDINFO_MAGIC, ProcessContext
+
+ScanFn = Callable[[ProcessContext], bool]
+
+
+class LanguageConflictError(Exception):
+    def __init__(self, a: str, b: str):
+        super().__init__(f"language detection conflict between {a} and {b}")
+        self.languages = (a, b)
+
+
+@dataclass(frozen=True)
+class Inspector:
+    language: str
+    quick: ScanFn
+    deep: ScanFn
+    version: Callable[[ProcessContext], str] = lambda ctx: ""
+
+
+def _base_in(*names: str) -> ScanFn:
+    names_set = set(names)
+
+    def scan(ctx: ProcessContext) -> bool:
+        return ctx.exe_base in names_set
+    return scan
+
+
+def _base_matches(pattern: str) -> ScanFn:
+    rx = re.compile(pattern)
+
+    def scan(ctx: ProcessContext) -> bool:
+        return bool(rx.match(ctx.exe_base))
+    return scan
+
+
+def _maps_contain(fragment: str) -> ScanFn:
+    def scan(ctx: ProcessContext) -> bool:
+        return any(fragment in m for m in ctx.mapped_files)
+    return scan
+
+
+def _never(_: ProcessContext) -> bool:
+    return False
+
+
+def _version_from_maps(pattern: str) -> Callable[[ProcessContext], str]:
+    rx = re.compile(pattern)
+
+    def version(ctx: ProcessContext) -> str:
+        for m in ctx.mapped_files:
+            hit = rx.search(m)
+            if hit:
+                return hit.group(1)
+        return ""
+    return version
+
+
+def _python_version(ctx: ProcessContext) -> str:
+    hit = re.match(r"python(\d+\.\d+)", ctx.exe_base)
+    if hit:
+        return hit.group(1)
+    return _version_from_maps(r"libpython(\d+\.\d+)")(ctx)
+
+
+def _go_version(ctx: ProcessContext) -> str:
+    idx = ctx.exe_head.find(GO_BUILDINFO_MAGIC)
+    if idx < 0:
+        return ""
+    tail = ctx.exe_head[idx + len(GO_BUILDINFO_MAGIC):idx + 64]
+    hit = re.search(rb"go(\d+\.\d+)", tail)
+    return hit.group(1).decode() if hit else ""
+
+
+ALL_INSPECTORS: list[Inspector] = [
+    Inspector("java", quick=_base_in("java", "javaw"),
+              deep=_maps_contain("libjvm.so"),
+              version=lambda ctx: ctx.environ.get("JAVA_VERSION", "")),
+    Inspector("python", quick=_base_matches(r"python(\d+(\.\d+)?)?$"),
+              deep=_maps_contain("libpython"),
+              version=_python_version),
+    Inspector("nodejs", quick=_base_in("node", "nodejs"),
+              deep=_maps_contain("/node_modules/"),
+              version=lambda ctx: ctx.environ.get("NODE_VERSION", "")),
+    Inspector("dotnet", quick=_base_in("dotnet"),
+              deep=_maps_contain("libcoreclr.so"),
+              version=_version_from_maps(
+                  r"Microsoft\.NETCore\.App/(\d+\.\d+)")),
+    # Go has no reliable exe-name heuristic; detection is buildinfo-in-ELF
+    # (the reference defers to its buildinfo reader in the golang inspector).
+    Inspector("go", quick=_never,
+              deep=lambda ctx: GO_BUILDINFO_MAGIC in ctx.exe_head,
+              version=_go_version),
+    Inspector("php", quick=_base_matches(r"php(-fpm|\d+(\.\d+)?)?$"),
+              deep=_maps_contain("libphp")),
+    Inspector("ruby", quick=_base_in("ruby", "irb", "puma"),
+              deep=_maps_contain("libruby"),
+              version=_version_from_maps(r"libruby\.so\.(\d+\.\d+)")),
+    # Rust leaves no runtime lib; fingerprint is rustc paths / panic strings
+    # in the binary. Must lose to Go when both look plausible (static ELF).
+    Inspector("rust", quick=_never,
+              deep=lambda ctx: b"/rustc/" in ctx.exe_head),
+    Inspector("cplusplus", quick=_never,
+              deep=lambda ctx: (any("libstdc++" in m
+                                    for m in ctx.mapped_files)
+                                and GO_BUILDINFO_MAGIC not in ctx.exe_head)),
+    Inspector("nginx", quick=_base_in("nginx"), deep=_never),
+    Inspector("mysql", quick=_base_in("mysqld"), deep=_never),
+    Inspector("postgres", quick=_base_in("postgres"), deep=_never),
+    Inspector("redis", quick=_base_in("redis-server"), deep=_never),
+]
+
+# Languages that are *markers inside any native binary* rather than distinct
+# runtimes; a positive from them never conflicts with (always loses to) a
+# positive from a real-runtime inspector in the same scan phase.
+_WEAK = {"cplusplus", "rust"}
+
+
+def detect_language(ctx: ProcessContext) -> Optional[str]:
+    """Two-phase scan: quick then deep; conflict between two non-weak
+    positives raises (langdetect.go behavior)."""
+    for phase in ("quick", "deep"):
+        found: Optional[str] = None
+        weak_found: Optional[str] = None
+        for insp in ALL_INSPECTORS:
+            scan = insp.quick if phase == "quick" else insp.deep
+            if not scan(ctx):
+                continue
+            if insp.language in _WEAK:
+                weak_found = weak_found or insp.language
+                continue
+            if found is not None and found != insp.language:
+                raise LanguageConflictError(found, insp.language)
+            found = insp.language
+        if found:
+            return found
+        if weak_found:
+            return weak_found
+    return None
+
+
+def detect_version(ctx: ProcessContext, language: str) -> str:
+    for insp in ALL_INSPECTORS:
+        if insp.language == language:
+            return insp.version(ctx)
+    return ""
+
+
+def detect_libc(ctx: ProcessContext) -> str:
+    """glibc vs musl from the loader/libc mapping (procdiscovery/pkg/libc)."""
+    for m in ctx.mapped_files:
+        if "ld-musl" in m or "libc.musl" in m:
+            return "musl"
+    for m in ctx.mapped_files:
+        if "libc.so.6" in m or "libc-2." in m:
+            return "glibc"
+    return ""
+
+
+_KNOWN_AGENT_ENVS = {
+    "NEW_RELIC_LICENSE_KEY": "newrelic",
+    "DD_TRACE_ENABLED": "datadog",
+    "DT_TENANT": "dynatrace",
+    "ELASTIC_APM_SERVER_URL": "elastic-apm",
+}
+
+
+def detect_other_agent(ctx: ProcessContext) -> Optional[str]:
+    """Pre-existing APM agent detection — the reference refuses to double-
+    instrument (common/envOverwrite + RuntimeDetails.OtherAgent)."""
+    for env_key, agent in _KNOWN_AGENT_ENVS.items():
+        if env_key in ctx.environ:
+            return agent
+    java_opts = ctx.environ.get("JAVA_TOOL_OPTIONS", "")
+    if "-javaagent:" in java_opts and _AGENT_DIR not in java_opts:
+        # our own injected javaagent lives under the odigos agent dir; only
+        # a *foreign* agent blocks instrumentation (otherwise re-creating a
+        # Source over still-instrumented pods would permanently lock out)
+        return "unknown-javaagent"
+    return None
+
+
+@dataclass
+class InspectionResult:
+    language: Optional[str]
+    runtime_version: str = ""
+    libc_type: str = ""
+    exe_path: str = ""
+    other_agent: Optional[str] = None
+    secure_execution_mode: bool = False
+
+
+def inspect_process(ctx: ProcessContext) -> InspectionResult:
+    """Full inspection of one process (runtimeInspection's per-process body,
+    odiglet/pkg/kube/runtime_details/inspection.go:98)."""
+    try:
+        lang = detect_language(ctx)
+    except LanguageConflictError:
+        lang = None
+    res = InspectionResult(language=lang, exe_path=ctx.exe_path)
+    if lang:
+        res.runtime_version = detect_version(ctx, lang)
+        res.libc_type = detect_libc(ctx)
+    res.other_agent = detect_other_agent(ctx)
+    # AT_SECURE processes (setuid etc.) must not get LD_PRELOAD-style agents
+    res.secure_execution_mode = ctx.environ.get("AT_SECURE") == "1"
+    return res

@@ -1,0 +1,200 @@
+"""Generic instrumentation lifecycle manager.
+
+Equivalent of the reference's k8s-agnostic ``instrumentation`` library
+(instrumentation/manager.go:63 ManagerOptions / factory.go): a single event
+loop owns all state (SURVEY.md §5.2 — safety is structural), consuming
+
+* process events from a Detector (exec → maybe instrument, exit → close),
+* config updates (ConfigUpdate → ApplyConfig on every live instrumentation
+  in the config group).
+
+Typing: the reference is generic over ProcessGroup/ConfigGroup/
+ProcessDetails; here those are duck-typed via three callables given in
+``ManagerOptions`` (resolve process → details, details → group key,
+group key → should-instrument + distro name).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Protocol
+
+from .detector import ProcessEvent, ProcessEventType
+from .proc import ProcessContext
+
+
+class Instrumentation(Protocol):
+    """One loaded instrumentation (factory.go Instrumentation interface)."""
+
+    def load(self) -> None: ...
+    def run(self) -> None: ...
+    def apply_config(self, config: dict[str, Any]) -> None: ...
+    def close(self) -> None: ...
+
+
+class InstrumentationFactory(Protocol):
+    """distro-name → factory registration (ManagerOptions.Factories)."""
+
+    def create(self, ctx: ProcessContext, details: Any) -> Instrumentation: ...
+
+
+@dataclass
+class ManagerOptions:
+    # distro name -> factory
+    factories: dict[str, InstrumentationFactory]
+    # pid/context -> opaque process details (pod identity etc.); None = skip
+    resolve_details: Callable[[ProcessContext], Optional[Any]]
+    # details -> hashable config-group key (workload identity)
+    group_of: Callable[[Any], Any]
+    # group key -> (distro_name, config) or None when not instrumented
+    config_for_group: Callable[[Any], Optional[tuple[str, dict[str, Any]]]]
+    # health reporting hook: (pid, details, healthy, message); healthy=None
+    # with message "closed" means the process is gone (retire its record)
+    report_health: Callable[[int, Any, Optional[bool], str], None] = (
+        lambda pid, d, h, m: None)
+
+
+@dataclass
+class _Live:
+    pid: int
+    details: Any
+    group: Any
+    distro: str
+    instrumentation: Instrumentation
+
+
+class InstrumentationManager:
+    """Single-threaded event loop over a queue of process events + config
+    updates (manager.go:39 ConfigUpdate / :46 Request)."""
+
+    def __init__(self, options: ManagerOptions):
+        self.options = options
+        self._queue: "queue.Queue[tuple[str, Any]]" = queue.Queue()
+        self._live: dict[int, _Live] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.errors: list[tuple[int, str]] = []
+
+    # ------------------------------------------------------------- inputs
+
+    def on_process_event(self, event: ProcessEvent) -> None:
+        self._queue.put(("process", event))
+
+    def on_config_update(self, group: Any) -> None:
+        """A config group's desired config changed (re-read lazily in the
+        loop so the update is level- not edge-triggered)."""
+        self._queue.put(("config", group))
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="instrumentation-manager")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._queue.put(("stop", None))
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for live in list(self._live.values()):
+            self._close(live)
+
+    def run_pending(self) -> None:
+        """Drain the queue synchronously (deterministic test mode; no
+        background thread needed)."""
+        while True:
+            try:
+                kind, payload = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if kind != "stop":
+                self._dispatch(kind, payload)
+
+    # ------------------------------------------------------------ internals
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            kind, payload = self._queue.get()
+            if kind == "stop":
+                return
+            self._dispatch(kind, payload)
+
+    def _dispatch(self, kind: str, payload: Any) -> None:
+        if kind == "process":
+            if payload.type == ProcessEventType.EXEC:
+                self._handle_exec(payload)
+            else:
+                self._handle_exit(payload.pid)
+        elif kind == "config":
+            self._handle_config_update(payload)
+
+    def _handle_exec(self, event: ProcessEvent) -> None:
+        opts = self.options
+        if event.pid in self._live or event.context is None:
+            return
+        details = opts.resolve_details(event.context)
+        if details is None:
+            return
+        group = opts.group_of(details)
+        resolved = opts.config_for_group(group)
+        if resolved is None:
+            return
+        distro_name, config = resolved
+        factory = opts.factories.get(distro_name)
+        if factory is None:
+            return
+        try:
+            inst = factory.create(event.context, details)
+            inst.load()
+            inst.apply_config(config)
+            inst.run()
+        except Exception as e:
+            self.errors.append((event.pid, str(e)))
+            opts.report_health(event.pid, details, False, str(e))
+            return
+        self._live[event.pid] = _Live(event.pid, details, group,
+                                      distro_name, inst)
+        opts.report_health(event.pid, details, True, "instrumented")
+
+    def _handle_exit(self, pid: int) -> None:
+        live = self._live.pop(pid, None)
+        if live is not None:
+            self._close(live)
+
+    def _handle_config_update(self, group: Any) -> None:
+        resolved = self.options.config_for_group(group)
+        for live in [l for l in self._live.values() if l.group == group]:
+            if resolved is None:
+                # group no longer instrumented → tear down
+                self._live.pop(live.pid, None)
+                self._close(live)
+                continue
+            _, config = resolved
+            try:
+                live.instrumentation.apply_config(config)
+            except Exception as e:
+                self.errors.append((live.pid, str(e)))
+                self.options.report_health(live.pid, live.details, False,
+                                           str(e))
+
+    def _close(self, live: _Live) -> None:
+        try:
+            live.instrumentation.close()
+        except Exception as e:
+            self.errors.append((live.pid, str(e)))
+        # healthy=None + "closed" tells the health sink to retire the
+        # process's InstrumentationInstance record, not mark it healthy —
+        # the reference deletes instances when their process exits
+        self.options.report_health(live.pid, live.details, None, "closed")
+
+    # -------------------------------------------------------------- state
+
+    @property
+    def live_pids(self) -> list[int]:
+        return sorted(self._live)
+
+    def live_for_group(self, group: Any) -> list[int]:
+        return sorted(l.pid for l in self._live.values() if l.group == group)

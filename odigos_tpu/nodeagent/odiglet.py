@@ -1,0 +1,269 @@
+"""The node agent: odiglet equivalent (SURVEY.md §2.2, odiglet/odiglet.go).
+
+Two run modes, matching the reference's container split
+(odiglet/cmd/main.go:23):
+
+* ``OdigletInitPhase`` — init-container mode (odiglet.go:208): installs the
+  agent file tree onto the host with content-hash-suffixed version dirs so
+  running pods keep the version they mounted while new pods get the new one
+  (fs/agents.go:30 CopyAgentsDirectoryToHost, hash-suffix :206).
+* ``Odiglet.run`` — daemon mode (odiglet.go:51 New / :119 Run): wires
+  - runtime-detection controller: InstrumentationConfigs missing runtime
+    details → inspect this node's processes → persist RuntimeDetails status
+    (pkg/kube/runtime_details/inspection.go:98, :308),
+  - process detector → instrumentation manager (odiglet.go:87-89),
+  - OpAMP server (odiglet.go:157),
+  - device-plugin registry,
+  - the shared-memory span transport handoff (unixfd server analog) is
+    owned by the native transport layer (``odigos_tpu.transport``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..api.resources import InstrumentationConfig, RuntimeDetails, WorkloadRef
+from ..api.store import ControllerManager, Store
+from ..controlplane.cluster import Cluster, Pod
+from ..distros.registry import DistroProvider
+from .detector import PollingDetector, ProcessEvent
+from .deviceplugin import DevicePluginRegistry
+from .inspectors import inspect_process
+from .manager import InstrumentationManager, ManagerOptions
+from .opamp import OpampServer
+from .proc import ProcessContext, SimulatedProcSource
+
+
+# ------------------------------------------------------------ init phase
+
+
+def _dir_content_hash(path: str) -> str:
+    h = hashlib.sha256()
+    for root, dirs, files in sorted(os.walk(path)):
+        dirs.sort()
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            h.update(os.path.relpath(full, path).encode())
+            with open(full, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:12]
+
+
+def OdigletInitPhase(src_dir: str, host_dir: str) -> str:
+    """Install ``src_dir`` (the agents file tree baked into the image) under
+    ``host_dir`` as ``agents-<contenthash>`` and repoint ``current``.
+    Returns the versioned directory. Re-running with identical content is a
+    no-op; old versions are pruned only when unreferenced (we keep them all
+    — the reference leaves pruning to node GC)."""
+    content_hash = _dir_content_hash(src_dir)
+    versioned = os.path.join(host_dir, f"agents-{content_hash}")
+    if not os.path.isdir(versioned):
+        os.makedirs(host_dir, exist_ok=True)
+        shutil.copytree(src_dir, versioned)
+    current = os.path.join(host_dir, "current")
+    tmp = current + ".tmp"
+    if os.path.islink(tmp) or os.path.exists(tmp):
+        os.remove(tmp)
+    os.symlink(versioned, tmp)
+    os.replace(tmp, current)  # atomic repoint
+    return versioned
+
+
+# ------------------------------------------------------------ daemon mode
+
+
+@dataclass
+class _ProcessDetails:
+    """ProcessDetails instantiation for k8s (the reference's
+    K8sProcessDetails generic parameter, odiglet/pkg/ebpf/process_details.go)."""
+
+    pod_name: str
+    namespace: str
+    container_name: str
+    workload: WorkloadRef
+    language: str = ""
+
+
+class _RuntimeDetailsReconciler:
+    """Fills InstrumentationConfig.runtime_details for workloads with pods
+    on this node (runtime_details/instrumentationconfigs_controller.go)."""
+
+    def __init__(self, odiglet: "Odiglet"):
+        self.odiglet = odiglet
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        ic = store.get("InstrumentationConfig", *key)
+        if ic is None or ic.runtime_details:
+            return  # inspected once per workload generation, like :308
+        details = self.odiglet.inspect_workload(ic.workload)
+        if details:
+            ic.runtime_details = details
+            store.update_status(ic)
+
+
+class Odiglet:
+    def __init__(self, store: Store, manager: ControllerManager,
+                 cluster: Cluster, node: str,
+                 proc_source: Optional[SimulatedProcSource] = None,
+                 factories: Optional[dict[str, Any]] = None,
+                 tpu_chips: int = 0):
+        self.store = store
+        self.cluster = cluster
+        self.node = node
+        self.proc_source = proc_source or SimulatedProcSource()
+        self.opamp = OpampServer(store, node=node)
+        self.devices = DevicePluginRegistry(tpu_chips=tpu_chips)
+        self.detector = PollingDetector(self.proc_source, interval=0)
+        self.distro_provider = DistroProvider()
+        self.instrumentation = InstrumentationManager(ManagerOptions(
+            factories=factories or {},
+            resolve_details=self._resolve_details,
+            # per-container groups: the instrumentor's decision is per
+            # container (ignored sidecars, other-agent containers must NOT
+            # inherit the app container's distro)
+            group_of=lambda d: (d.workload, d.container_name),
+            config_for_group=self._config_for_container,
+            report_health=self._report_health,
+        ))
+        self._mgr = manager
+        self._pid_owner: dict[int, tuple[str, str]] = {}  # pid -> (pod, container)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def run(self) -> None:
+        self._mgr.register(
+            f"runtime-details@{self.node}", _RuntimeDetailsReconciler(self),
+            watches={"InstrumentationConfig": None})
+        self.detector.start(self.instrumentation.on_process_event)
+
+    def stop(self) -> None:
+        self.detector.stop()
+        self.instrumentation.stop()
+
+    def poll(self) -> None:
+        """One deterministic step: sync pod churn, detect process churn,
+        drain the manager event loop."""
+        self.sync_pods()
+        self.detector.poll_once()
+        self.instrumentation.run_pending()
+
+    def sync_pods(self) -> None:
+        """Reconcile tracked processes with this node's current pods: pods
+        that went away get their processes killed (rollout restart, scale
+        down); new pods get processes spawned with their injected env —
+        the sim analog of kubelet starting containers."""
+        current = {name: pod for name, pod in self.cluster.pods.items()
+                   if pod.node == self.node}
+        owned = {pod for (pod, _c) in self._pid_owner.values()}
+        for name in owned - set(current):
+            self.kill_pod_processes(name)
+        for name, pod in current.items():
+            if name not in owned:
+                self.spawn_pod_processes(pod)
+
+    # ----------------------------------------------- pod/process plumbing
+
+    def spawn_pod_processes(self, pod: Pod) -> None:
+        """Sim hook: a pod scheduled on this node starts one process per
+        container, with the container's declared runtime ground truth."""
+        if pod.node != self.node:
+            return
+        for c in pod.containers:
+            env = dict(c.env)
+            env.update(pod.injected_env.get(c.name, {}))
+            pid = self.proc_source.spawn(pod.name, c.name, c.language,
+                                         c.runtime_version, c.libc_type, env)
+            self._pid_owner[pid] = (pod.name, c.name)
+
+    def kill_pod_processes(self, pod_name: str) -> None:
+        for pid, (pod, _c) in list(self._pid_owner.items()):
+            if pod == pod_name:
+                self.proc_source.kill(pid)
+                del self._pid_owner[pid]
+
+    def _resolve_details(self, ctx: ProcessContext) -> Optional[_ProcessDetails]:
+        owner = self._pid_owner.get(ctx.pid)
+        if owner is None:
+            return None
+        pod = self.cluster.pods.get(owner[0])
+        if pod is None:
+            return None
+        return _ProcessDetails(
+            pod_name=pod.name, namespace=pod.namespace,
+            container_name=owner[1],
+            workload=WorkloadRef(pod.namespace, pod.workload_kind,
+                                 pod.workload_name))
+
+    def _config_for_container(self, group: tuple[WorkloadRef, str]
+                              ) -> Optional[tuple[str, dict[str, Any]]]:
+        workload, container_name = group
+        ic = self._find_ic(workload)
+        if ic is None:
+            return None
+        cc = next((c for c in ic.containers
+                   if c.container_name == container_name), None)
+        if cc is None or not cc.agent_enabled or not cc.distro_name:
+            return None
+        rd = next((r for r in ic.runtime_details
+                   if r.container_name == container_name), None)
+        sdk = next((s.trace_config for s in ic.sdk_configs
+                    if rd is not None and s.language == rd.language), {})
+        return cc.distro_name, {"service_name": ic.service_name,
+                                "trace_config": dict(sdk)}
+
+    def _report_health(self, pid: int, details: _ProcessDetails,
+                       healthy: Optional[bool], message: str) -> None:
+        from ..api.resources import InstrumentationInstance, ObjectMeta
+        name = f"{details.workload.name}-{details.pod_name}-{pid}"
+        if healthy is None and message == "closed":
+            self.store.delete("InstrumentationInstance", details.namespace,
+                              name)
+            return
+        inst = InstrumentationInstance(
+            meta=ObjectMeta(name=name, namespace=details.namespace),
+            workload=details.workload, pod_name=details.pod_name,
+            container_name=details.container_name, pid=pid,
+            healthy=healthy, message=message)
+        self.store.apply(inst)
+
+    # ------------------------------------------------- runtime inspection
+
+    def inspect_workload(self, workload: WorkloadRef) -> list[RuntimeDetails]:
+        """Inspect the processes of this node's pods of the workload; one
+        RuntimeDetails per container (inspection.go:98 runtimeInspection)."""
+        by_container: dict[str, RuntimeDetails] = {}
+        for pod in self.cluster.pods.values():
+            if (pod.node != self.node
+                    or (pod.namespace, pod.workload_name)
+                    != (workload.namespace, workload.name)):
+                continue
+            for c in pod.containers:
+                if c.name in by_container:
+                    continue
+                for pid in self.proc_source.pids_for(pod.name, c.name):
+                    ctx = self.proc_source.context(pid)
+                    if ctx is None:
+                        continue
+                    res = inspect_process(ctx)
+                    if res.language is None:
+                        continue
+                    by_container[c.name] = RuntimeDetails(
+                        container_name=c.name, language=res.language,
+                        runtime_version=res.runtime_version,
+                        libc_type=res.libc_type, exe_path=res.exe_path,
+                        env_vars=dict(ctx.environ),
+                        other_agent=res.other_agent,
+                        secure_execution_mode=res.secure_execution_mode)
+                    break
+        return list(by_container.values())
+
+    def _find_ic(self, workload: WorkloadRef) -> Optional[InstrumentationConfig]:
+        for ic in self.store.list("InstrumentationConfig",
+                                  namespace=workload.namespace):
+            if ic.workload == workload:
+                return ic
+        return None

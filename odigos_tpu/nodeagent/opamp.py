@@ -1,0 +1,255 @@
+"""OpAMP-style remote-config / health server.
+
+Equivalent of opampserver/ (SURVEY.md §2.2): native-SDK agents open a
+connection, describe themselves (pid + pod identity), and from then on the
+server (a) pushes remote config compiled from the workload's
+InstrumentationConfig, (b) turns health heartbeats into
+InstrumentationInstance status writes, and (c) marks instances unhealthy on
+disconnect/timeout.
+
+Message shape (JSON-dict analog of the reference's protobufs,
+opampserver/protobufs/):
+
+agent → server: {"instance_uid", "agent_description": {...},
+                 "health": {"healthy", "message"},
+                 "remote_config_status": {"hash", "applied"}}
+server → agent: {"remote_config": {"hash", "sections": {...}},
+                 "report_full_state": bool}
+
+Transport is pluggable: ``OpampAgent`` is the in-process client used by the
+sim and tests; a socket transport only needs to deliver the same dicts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..api.resources import (
+    InstrumentationConfig, InstrumentationInstance, ObjectMeta, WorkloadRef)
+from ..api.store import Store
+
+
+@dataclass
+class AgentConnection:
+    """Connection-cache entry (opampserver/pkg/connection/conncache.go)."""
+
+    instance_uid: str
+    workload: WorkloadRef
+    pod_name: str
+    container_name: str
+    pid: int
+    language: str
+    send: Callable[[dict[str, Any]], None]
+    last_heartbeat: float = field(default_factory=time.time)
+    config_hash: str = ""
+
+
+def _config_hash(sections: dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(sections, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def build_remote_config(ic: InstrumentationConfig,
+                        language: str) -> dict[str, Any]:
+    """Compile the per-agent remote-config sections from the workload's
+    InstrumentationConfig (opampserver/pkg/sdkconfig/configsections/):
+    sdk section (service name, trace config), instrumentation-libraries
+    section (payload collection, code attributes, http headers)."""
+    sdk = next((s for s in ic.sdk_configs if s.language == language), None)
+    sections: dict[str, Any] = {
+        "sdk": {
+            "service_name": ic.service_name or ic.workload.name,
+            "data_streams": list(ic.data_stream_names),
+            "trace_config": dict(sdk.trace_config) if sdk else {},
+        },
+        "instrumentation_libraries": {
+            "payload_collection": sdk.payload_collection if sdk else None,
+            "code_attributes": bool(sdk.code_attributes) if sdk else False,
+            "http_headers": list(sdk.http_headers) if sdk else [],
+        },
+    }
+    return sections
+
+
+class OpampServer:
+    """Holds the connection cache and the store-writeback logic
+    (opampserver/pkg/server/server.go:23 StartOpAmpServer,
+    handlers.go:43/:125/:147)."""
+
+    def __init__(self, store: Store, node: str = "",
+                 heartbeat_timeout: float = 30.0):
+        self.store = store
+        self.node = node
+        self.heartbeat_timeout = heartbeat_timeout
+        self._conns: dict[str, AgentConnection] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- transport
+
+    def handle_message(self, msg: dict[str, Any],
+                       send: Callable[[dict[str, Any]], None]
+                       ) -> Optional[dict[str, Any]]:
+        """Process one agent→server message; returns the reply (also pushed
+        through ``send`` for transports that deliver asynchronously)."""
+        uid = msg.get("instance_uid", "")
+        if not uid:
+            return None
+        with self._lock:
+            conn = self._conns.get(uid)
+        is_new = conn is None
+        if is_new:
+            desc = msg.get("agent_description")
+            if not desc:
+                # unknown agent without a description: ask for full state
+                reply = {"report_full_state": True}
+                send(reply)
+                return reply
+            conn = self._on_new_connection(uid, desc, send)
+            if conn is None:
+                return None
+        conn.last_heartbeat = time.time()
+        health = msg.get("health")
+        if health is not None:
+            self._write_instance_status(conn, bool(health.get("healthy")),
+                                        str(health.get("message", "")))
+        if is_new:
+            # first contact always pushes config (the agent may have sent a
+            # full state report — description+health+empty hash — in one
+            # message; keying on 'no health yet' would leave it unconfigured)
+            if health is None:
+                self._write_instance_status(conn, None, "connected")
+            return self._push_config(conn)
+        status = msg.get("remote_config_status")
+        if status is not None and status.get("hash") != conn.config_hash:
+            return self._push_config(conn)
+        return None
+
+    def agent_disconnected(self, instance_uid: str) -> None:
+        with self._lock:
+            conn = self._conns.pop(instance_uid, None)
+        if conn is not None:
+            self._write_instance_status(conn, False, "agent disconnected")
+
+    def expire_stale(self, now: Optional[float] = None) -> list[str]:
+        """Heartbeat-timeout sweep; returns expired uids."""
+        now = time.time() if now is None else now
+        expired = []
+        with self._lock:
+            for uid, conn in list(self._conns.items()):
+                if now - conn.last_heartbeat > self.heartbeat_timeout:
+                    expired.append(uid)
+        for uid in expired:
+            self.agent_disconnected(uid)
+        return expired
+
+    # ----------------------------------------------------------- internals
+
+    def _on_new_connection(self, uid: str, desc: dict[str, Any],
+                           send: Callable[[dict[str, Any]], None]
+                           ) -> Optional[AgentConnection]:
+        """Resolve pod identity → workload (handlers.go:268); refuse agents
+        we can't attribute."""
+        try:
+            workload = WorkloadRef(desc["namespace"], desc["workload_kind"],
+                                   desc["workload_name"])
+        except KeyError:
+            return None
+        conn = AgentConnection(
+            instance_uid=uid, workload=workload,
+            pod_name=desc.get("pod_name", ""),
+            container_name=desc.get("container_name", ""),
+            pid=int(desc.get("pid", 0)),
+            language=desc.get("language", ""), send=send)
+        with self._lock:
+            self._conns[uid] = conn
+        return conn
+
+    def _find_ic(self, workload: WorkloadRef) -> Optional[InstrumentationConfig]:
+        for ic in self.store.list("InstrumentationConfig",
+                                  namespace=workload.namespace):
+            if ic.workload == workload:
+                return ic
+        return None
+
+    def _push_config(self, conn: AgentConnection) -> Optional[dict[str, Any]]:
+        ic = self._find_ic(conn.workload)
+        if ic is None:
+            return None
+        sections = build_remote_config(ic, conn.language)
+        conn.config_hash = _config_hash(sections)
+        reply = {"remote_config": {"hash": conn.config_hash,
+                                   "sections": sections}}
+        conn.send(reply)
+        return reply
+
+    def config_changed(self, workload: WorkloadRef) -> int:
+        """Push updated config to every connected agent of the workload
+        (server.go:220 ProcessInstrumentationUpdates); returns #pushed."""
+        with self._lock:
+            conns = [c for c in self._conns.values() if c.workload == workload]
+        for conn in conns:
+            self._push_config(conn)
+        return len(conns)
+
+    def _write_instance_status(self, conn: AgentConnection,
+                               healthy: Optional[bool], message: str) -> None:
+        name = f"{conn.workload.name}-{conn.pod_name}-{conn.pid}"
+        inst = InstrumentationInstance(
+            meta=ObjectMeta(name=name, namespace=conn.workload.namespace),
+            workload=conn.workload, pod_name=conn.pod_name,
+            container_name=conn.container_name, pid=conn.pid,
+            healthy=healthy, message=message,
+            identifying_attributes={
+                "service.instance.id": conn.instance_uid,
+                "telemetry.sdk.language": conn.language,
+                "k8s.node.name": self.node,
+            },
+            last_status_time=time.time())
+        self.store.apply(inst)
+
+    @property
+    def connected_uids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._conns)
+
+
+class OpampAgent:
+    """In-process agent client (the role the per-language SDK agents play).
+
+    Drives the same message protocol the server expects; the sim's pods use
+    one of these per native-SDK container.
+    """
+
+    def __init__(self, server: OpampServer, instance_uid: str,
+                 description: dict[str, Any]):
+        self.server = server
+        self.instance_uid = instance_uid
+        self.description = description
+        self.remote_config: Optional[dict[str, Any]] = None
+        self._applied_hash = ""
+
+    def _recv(self, msg: dict[str, Any]) -> None:
+        rc = msg.get("remote_config")
+        if rc is not None:
+            self.remote_config = rc["sections"]
+            self._applied_hash = rc["hash"]
+
+    def connect(self) -> None:
+        self.server.handle_message(
+            {"instance_uid": self.instance_uid,
+             "agent_description": self.description}, self._recv)
+
+    def heartbeat(self, healthy: bool = True, message: str = "ok") -> None:
+        self.server.handle_message(
+            {"instance_uid": self.instance_uid,
+             "health": {"healthy": healthy, "message": message},
+             "remote_config_status": {"hash": self._applied_hash,
+                                      "applied": True}}, self._recv)
+
+    def disconnect(self) -> None:
+        self.server.agent_disconnected(self.instance_uid)

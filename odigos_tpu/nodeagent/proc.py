@@ -1,0 +1,185 @@
+"""Process context: the facts inspectors read about a live process.
+
+The reference's procdiscovery inspects /proc/<pid> directly (exe symlink,
+cmdline, environ, maps — procdiscovery/pkg/process). We keep the same fact
+surface behind a dataclass so inspectors are pure functions, with two
+sources:
+
+* ``RealProcSource``      — reads the actual /proc (used by a real node agent)
+* ``SimulatedProcSource`` — fabricates contexts from the cluster sim's
+  ``Container`` ground truth (language/runtime_version/libc), which is how
+  tests exercise the full detection path without root.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+@dataclass
+class ProcessContext:
+    pid: int
+    exe_path: str = ""
+    cmdline: list[str] = field(default_factory=list)
+    environ: dict[str, str] = field(default_factory=dict)
+    # file paths mapped into the process (subset of /proc/pid/maps, deduped)
+    mapped_files: list[str] = field(default_factory=list)
+    # first bytes of the executable (ELF header sniffing, Go buildinfo)
+    exe_head: bytes = b""
+
+    @property
+    def exe_base(self) -> str:
+        return os.path.basename(self.exe_path)
+
+
+class RealProcSource:
+    """Reads live contexts from /proc. Best-effort: unreadable files (no
+    permission, racing exit) yield empty fields, mirroring the reference's
+    tolerance in runtimeInspection (odiglet/pkg/kube/runtime_details/
+    inspection.go:98)."""
+
+    def __init__(self, root: str = "/proc") -> None:
+        self.root = root
+
+    def pids(self) -> Iterator[int]:
+        for entry in os.listdir(self.root):
+            if entry.isdigit():
+                yield int(entry)
+
+    def context(self, pid: int) -> Optional[ProcessContext]:
+        base = os.path.join(self.root, str(pid))
+        if not os.path.isdir(base):
+            return None
+        ctx = ProcessContext(pid=pid)
+        try:
+            ctx.exe_path = os.readlink(os.path.join(base, "exe"))
+        except OSError:
+            pass
+        ctx.cmdline = self._read_nul_list(os.path.join(base, "cmdline"))
+        ctx.environ = dict(
+            item.split("=", 1) for item in
+            self._read_nul_list(os.path.join(base, "environ")) if "=" in item)
+        ctx.mapped_files = self._read_maps(os.path.join(base, "maps"))
+        try:
+            with open(os.path.join(base, "exe"), "rb") as f:
+                ctx.exe_head = f.read(4096)
+        except OSError:
+            pass
+        return ctx
+
+    @staticmethod
+    def _read_nul_list(path: str) -> list[str]:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return []
+        return [s.decode("utf-8", "replace") for s in raw.split(b"\0") if s]
+
+    @staticmethod
+    def _read_maps(path: str) -> list[str]:
+        seen: dict[str, None] = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 6 and parts[5].startswith("/"):
+                        seen.setdefault(parts[5])
+        except OSError:
+            return []
+        return list(seen)
+
+
+# Mapped-file fingerprints a runtime leaves behind, per language. Used by
+# SimulatedProcSource to fabricate realistic contexts AND (inverted) by the
+# deep-scan inspectors — keeping the two in sync is what makes the simulated
+# path a faithful test of the real detection logic.
+_RUNTIME_FOOTPRINT: dict[str, dict] = {
+    "java": {"exe": "/usr/lib/jvm/bin/java",
+             "maps": ["/usr/lib/jvm/lib/server/libjvm.so"]},
+    "python": {"exe": "/usr/local/bin/python{v}",
+               "maps": ["/usr/local/lib/libpython{v}.so.1.0"]},
+    "nodejs": {"exe": "/usr/local/bin/node", "maps": [],
+               "env": {"NODE_VERSION": "{v}"}},
+    "dotnet": {"exe": "/usr/share/dotnet/dotnet",
+               "maps": ["/usr/share/dotnet/shared/Microsoft.NETCore.App/{v}/libcoreclr.so"]},
+    "go": {"exe": "/app/main", "maps": [], "go_buildinfo": True},
+    "php": {"exe": "/usr/local/sbin/php-fpm", "maps": []},
+    "ruby": {"exe": "/usr/local/bin/ruby",
+             "maps": ["/usr/local/lib/libruby.so.{v}"]},
+    "rust": {"exe": "/app/server", "maps": [], "rust_marker": True},
+    "cplusplus": {"exe": "/app/cpp-server",
+                  "maps": ["/usr/lib/x86_64-linux-gnu/libstdc++.so.6"]},
+    "nginx": {"exe": "/usr/sbin/nginx", "maps": []},
+    "mysql": {"exe": "/usr/sbin/mysqld", "maps": []},
+    "postgres": {"exe": "/usr/lib/postgresql/bin/postgres", "maps": []},
+    "redis": {"exe": "/usr/bin/redis-server", "maps": []},
+}
+
+_LIBC_MAPS = {
+    "glibc": "/usr/lib/x86_64-linux-gnu/libc.so.6",
+    "musl": "/lib/ld-musl-x86_64.so.1",
+}
+
+# ELF magic + a fake Go build-info section marker ("\xff Go buildinf:" is the
+# real magic go binaries embed; the golang inspector greps exe_head for it).
+GO_BUILDINFO_MAGIC = b"\xff Go buildinf:"
+_RUST_PANIC_MARKER = b"RUST_BACKTRACE"
+
+
+class SimulatedProcSource:
+    """Fabricates ProcessContexts from declared container runtimes.
+
+    One process per (pod, container); pids are assigned densely. The odiglet
+    runtime-detection path runs the *real* inspectors against these contexts.
+    """
+
+    def __init__(self) -> None:
+        self._contexts: dict[int, ProcessContext] = {}
+        self._by_pod: dict[tuple[str, str], list[int]] = {}
+        self._next_pid = 1000
+
+    def spawn(self, pod_name: str, container_name: str, language: str,
+              runtime_version: str = "", libc: str = "glibc",
+              env: Optional[dict[str, str]] = None) -> int:
+        pid = self._next_pid
+        self._next_pid += 1
+        fp = _RUNTIME_FOOTPRINT.get(language, {"exe": "/bin/app", "maps": []})
+        v = runtime_version or "0"
+        ctx = ProcessContext(
+            pid=pid,
+            exe_path=fp["exe"].format(v=v),
+            cmdline=[fp["exe"].format(v=v)],
+            environ=dict(env or {}),
+        )
+        for key, val in fp.get("env", {}).items():
+            ctx.environ.setdefault(key, val.format(v=v))
+        ctx.mapped_files = [m.format(v=v) for m in fp.get("maps", [])]
+        if libc in _LIBC_MAPS:
+            ctx.mapped_files.append(_LIBC_MAPS[libc])
+        head = b"\x7fELF" + b"\0" * 60
+        if fp.get("go_buildinfo"):
+            head += GO_BUILDINFO_MAGIC + f"go1.22 {v}".encode()
+        if fp.get("rust_marker"):
+            head += _RUST_PANIC_MARKER + b"\0/rustc/1.79.0/library/core"
+        ctx.exe_head = head
+        self._contexts[pid] = ctx
+        self._by_pod.setdefault((pod_name, container_name), []).append(pid)
+        return pid
+
+    def kill(self, pid: int) -> None:
+        self._contexts.pop(pid, None)
+        for pids in self._by_pod.values():
+            if pid in pids:
+                pids.remove(pid)
+
+    def pids(self) -> Iterator[int]:
+        yield from list(self._contexts)
+
+    def context(self, pid: int) -> Optional[ProcessContext]:
+        return self._contexts.get(pid)
+
+    def pids_for(self, pod_name: str, container_name: str) -> list[int]:
+        return list(self._by_pod.get((pod_name, container_name), []))

@@ -127,6 +127,21 @@ class TestSourceLifecycle:
         assert store.get("InstrumentationConfig", "default",
                          ic_name(ref)) is None
 
+    def test_source_deletion_uninstruments_running_pods(self):
+        """Deleting the Source after agents were deployed must rollout the
+        workload so pods lose the injected env (reference: rollout.go Do
+        un-instruments by restart the same way it instruments)."""
+        store, mgr, cluster, _ = make_env()
+        ref = add_python_app(cluster).ref
+        instrument(store, mgr, ref)
+        write_runtime_details(store, mgr, ref)
+        gen_before = cluster.get_workload(ref).template_generation
+        assert any(p.injected_env for p in cluster.pods.values())
+        store.delete("Source", "default", f"src-{ref.name}")
+        mgr.run_once()
+        assert cluster.get_workload(ref).template_generation > gen_before
+        assert all(not p.injected_env for p in cluster.pods.values())
+
 
 class TestAgentEnablement:
     def test_agent_enabled_and_rollout(self):
