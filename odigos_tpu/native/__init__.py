@@ -1,0 +1,75 @@
+"""Native (C++) runtime pieces, built on demand with g++.
+
+The compiled library is cached under ``native/build/`` and rebuilt when the
+source is newer — the ``go build``-like experience the reference gets from
+its toolchain. Import ``lib()`` to get the ctypes handle; the higher-level
+Python API lives in ``odigos_tpu.transport``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "spanring.cpp")
+_BUILD_DIR = os.path.join(_HERE, "build")
+_SO = os.path.join(_BUILD_DIR, "libspanring.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+u64 = ctypes.c_uint64
+i64 = ctypes.c_int64
+u32 = ctypes.c_uint32
+i32 = ctypes.c_int32
+i8 = ctypes.c_int8
+u8 = ctypes.c_uint8
+p = ctypes.POINTER
+
+
+def _build() -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _SO + ".tmp"
+    subprocess.run(
+        ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-march=native",
+         _SRC, "-o", tmp],
+        check=True, capture_output=True)
+    os.replace(tmp, _SO)
+
+
+def _signatures(lib: ctypes.CDLL) -> None:
+    lib.sr_map_len.restype = u64
+    lib.sr_map_len.argtypes = [u64]
+    lib.sr_init.restype = ctypes.c_void_p
+    lib.sr_init.argtypes = [ctypes.c_void_p, u64]
+    lib.sr_attach.restype = ctypes.c_void_p
+    lib.sr_attach.argtypes = [ctypes.c_void_p]
+    lib.sr_close.argtypes = [ctypes.c_void_p]
+    for fn in ("sr_capacity", "sr_dropped", "sr_written", "sr_backlog"):
+        getattr(lib, fn).restype = u64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.sr_write_batch.restype = i64
+    lib.sr_write_batch.argtypes = (
+        [ctypes.c_void_p, u64] + [p(u64)] * 6 + [p(i8)] * 2 + [p(i32)] * 2
+        + [p(u8), p(u32)])
+    lib.sr_drain.restype = i64
+    lib.sr_drain.argtypes = (
+        [ctypes.c_void_p, u64] + [p(u64)] * 6 + [p(i8)] * 2 + [p(i32)] * 2
+        + [p(u8), u64, p(u32), u64, p(u64)])
+
+
+def lib() -> ctypes.CDLL:
+    """The loaded (building if needed) native library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        _lib = ctypes.CDLL(_SO)
+        _signatures(_lib)
+        return _lib

@@ -1,0 +1,264 @@
+// Shared-memory SPSC span ring + columnar codec.
+//
+// TPU-native equivalent of the reference's eBPF map transport (SURVEY.md
+// §2.3 odigosebpfreceiver + §5.8 unixfd): the kernel perf/ring buffer the
+// eBPF probes write spans into becomes a memfd-backed shared-memory ring the
+// in-process agents write into; the FD is handed to the node collector over
+// a unix socket (SCM_RIGHTS — done by the Python layer via socket.send_fds)
+// and the collector drains records in a native hot loop that decodes
+// straight into columnar arrays (the tracesReadLoop role,
+// collector/receivers/odigosebpfreceiver/traces.go:17 — but batch-columnar
+// instead of per-record, because the consumer is a featurizer feeding a TPU,
+// not a pdata pipeline).
+//
+// Concurrency model: single producer, single consumer (one agent process per
+// ring, one collector drain loop), lock-free via acquire/release cursors —
+// the same contract a perf buffer gives the reference. Multiple producers
+// each get their own ring; the collector drains all of them (that is also
+// how per-CPU perf buffers behave).
+//
+// Record wire format (little-endian, after a u32 length prefix):
+//   u64 trace_id_hi, trace_id_lo, span_id, parent_span_id,
+//       start_unix_nano, end_unix_nano        (48 B)
+//   u8  kind, status                          (2 B)
+//   u16 service_len, name_len                 (4 B)
+//   bytes service, name                       (varlen)
+// A length prefix of WRAP_MARKER means "skip to ring start".
+// Strings longer than 65535 bytes are truncated to fit the u16 length
+// (OTLP-attribute-limit-style truncation, never silent modulo corruption).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t MAGIC = 0x5350414e52494e47ULL;  // "SPANRING"
+constexpr uint32_t WRAP_MARKER = 0xffffffffu;
+constexpr uint32_t FIXED_BYTES = 48 + 2 + 4;
+
+struct alignas(64) RingHeader {
+  uint64_t magic;
+  uint64_t capacity;  // data bytes
+  alignas(64) std::atomic<uint64_t> head;     // producer cursor (monotonic)
+  alignas(64) std::atomic<uint64_t> tail;     // consumer cursor (monotonic)
+  alignas(64) std::atomic<uint64_t> dropped;  // producer-side drops
+  alignas(64) std::atomic<uint64_t> written;  // records successfully written
+};
+
+struct Ring {
+  RingHeader* hdr;
+  uint8_t* data;
+  uint64_t map_len;
+};
+
+inline uint64_t ring_pos(const Ring* r, uint64_t cursor) {
+  return cursor % r->hdr->capacity;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- setup
+
+// Size the shared mapping for `capacity` data bytes. Returns total length.
+uint64_t sr_map_len(uint64_t capacity) {
+  return sizeof(RingHeader) + capacity;
+}
+
+// Initialize a freshly ftruncate'd mapping (producer side, once).
+// `mem` must be sr_map_len(capacity) bytes of zeroed shared memory.
+void* sr_init(void* mem, uint64_t capacity) {
+  Ring* r = new Ring();
+  r->hdr = static_cast<RingHeader*>(mem);
+  r->data = static_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  r->map_len = sr_map_len(capacity);
+  r->hdr->capacity = capacity;
+  r->hdr->head.store(0, std::memory_order_relaxed);
+  r->hdr->tail.store(0, std::memory_order_relaxed);
+  r->hdr->dropped.store(0, std::memory_order_relaxed);
+  r->hdr->written.store(0, std::memory_order_relaxed);
+  r->hdr->magic = MAGIC;  // last: marks the ring valid
+  return r;
+}
+
+// Attach to an existing mapping (consumer side, after FD handoff).
+// Returns nullptr if the memory does not hold a valid ring.
+void* sr_attach(void* mem) {
+  RingHeader* hdr = static_cast<RingHeader*>(mem);
+  if (hdr->magic != MAGIC) return nullptr;
+  Ring* r = new Ring();
+  r->hdr = hdr;
+  r->data = static_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  r->map_len = sr_map_len(hdr->capacity);
+  return r;
+}
+
+void sr_close(void* handle) { delete static_cast<Ring*>(handle); }
+
+uint64_t sr_capacity(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->capacity;
+}
+uint64_t sr_dropped(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->dropped.load(
+      std::memory_order_relaxed);
+}
+uint64_t sr_written(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->written.load(
+      std::memory_order_relaxed);
+}
+// Bytes currently buffered (diagnostic; racy by nature).
+uint64_t sr_backlog(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  return r->hdr->head.load(std::memory_order_relaxed) -
+         r->hdr->tail.load(std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------- write
+
+namespace {
+
+// Reserve `need` contiguous bytes; returns write offset or UINT64_MAX when
+// the ring is full. Handles the wrap marker.
+inline uint64_t reserve(Ring* r, uint32_t need, uint64_t& head) {
+  const uint64_t cap = r->hdr->capacity;
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  uint64_t pos = ring_pos(r, head);
+  uint64_t contiguous = cap - pos;
+  if (contiguous < need + 4) {
+    // not enough room before the edge: emit wrap marker (if it fits) and
+    // advance head to the ring start
+    if (head + contiguous - tail > cap) return UINT64_MAX;
+    if (contiguous >= 4)
+      std::memcpy(r->data + pos, &WRAP_MARKER, 4);
+    head += contiguous;
+    pos = 0;
+  }
+  if (head + need + 4 - tail > cap) return UINT64_MAX;
+  return pos;
+}
+
+}  // namespace
+
+// Append one batch of spans in columnar form. Strings come as a table:
+// `strtab` is the concatenated UTF-8 bytes, `str_offs` has n_strings+1
+// offsets; svc_idx/name_idx index into it. Returns records written
+// (the remainder was dropped and counted).
+int64_t sr_write_batch(void* handle, uint64_t n,
+                       const uint64_t* trace_hi, const uint64_t* trace_lo,
+                       const uint64_t* span_id, const uint64_t* parent_id,
+                       const uint64_t* start_ns, const uint64_t* end_ns,
+                       const int8_t* kind, const int8_t* status,
+                       const int32_t* svc_idx, const int32_t* name_idx,
+                       const uint8_t* strtab, const uint32_t* str_offs) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  uint64_t written = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint32_t s0 = str_offs[svc_idx[i]], s1 = str_offs[svc_idx[i] + 1];
+    const uint32_t m0 = str_offs[name_idx[i]], m1 = str_offs[name_idx[i] + 1];
+    const uint16_t svc_len =
+        static_cast<uint16_t>(s1 - s0 > 65535 ? 65535 : s1 - s0);
+    const uint16_t name_len =
+        static_cast<uint16_t>(m1 - m0 > 65535 ? 65535 : m1 - m0);
+    const uint32_t rec_len = FIXED_BYTES + svc_len + name_len;
+    const uint64_t pos = reserve(r, rec_len, head);
+    if (pos == UINT64_MAX) {
+      r->hdr->dropped.fetch_add(n - i, std::memory_order_relaxed);
+      break;
+    }
+    uint8_t* p = r->data + pos;
+    std::memcpy(p, &rec_len, 4); p += 4;
+    const uint64_t fixed[6] = {trace_hi[i], trace_lo[i], span_id[i],
+                               parent_id[i], start_ns[i], end_ns[i]};
+    std::memcpy(p, fixed, 48); p += 48;
+    *p++ = static_cast<uint8_t>(kind[i]);
+    *p++ = static_cast<uint8_t>(status[i]);
+    std::memcpy(p, &svc_len, 2); p += 2;
+    std::memcpy(p, &name_len, 2); p += 2;
+    std::memcpy(p, strtab + s0, svc_len); p += svc_len;
+    std::memcpy(p, strtab + m0, name_len);
+    head += rec_len + 4;
+    ++written;
+  }
+  r->hdr->head.store(head, std::memory_order_release);
+  r->hdr->written.fetch_add(written, std::memory_order_relaxed);
+  return static_cast<int64_t>(written);
+}
+
+// ---------------------------------------------------------------- drain
+
+// Drain up to max_records into caller-allocated columnar arrays, interning
+// service/name strings into strbuf/str_offs (offsets array holds
+// n_strings+1 entries; caller sizes it max_strings+1). Returns records
+// drained; *n_strings_out is the interned-table size. Stops early when the
+// string buffer or table would overflow (those records stay in the ring).
+int64_t sr_drain(void* handle, uint64_t max_records,
+                 uint64_t* trace_hi, uint64_t* trace_lo,
+                 uint64_t* span_id, uint64_t* parent_id,
+                 uint64_t* start_ns, uint64_t* end_ns,
+                 int8_t* kind, int8_t* status,
+                 int32_t* svc_idx, int32_t* name_idx,
+                 uint8_t* strbuf, uint64_t strbuf_cap,
+                 uint32_t* str_offs, uint64_t max_strings,
+                 uint64_t* n_strings_out) {
+  Ring* r = static_cast<Ring*>(handle);
+  const uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+
+  std::unordered_map<std::string, int32_t> interned;
+  uint64_t str_used = 0, n_strings = 0, n = 0;
+  str_offs[0] = 0;
+
+  auto intern = [&](const uint8_t* bytes, uint16_t len, int32_t* out) {
+    std::string key(reinterpret_cast<const char*>(bytes), len);
+    auto it = interned.find(key);
+    if (it != interned.end()) { *out = it->second; return true; }
+    if (n_strings >= max_strings || str_used + len > strbuf_cap) return false;
+    std::memcpy(strbuf + str_used, bytes, len);
+    str_used += len;
+    const int32_t idx = static_cast<int32_t>(n_strings++);
+    str_offs[n_strings] = static_cast<uint32_t>(str_used);
+    interned.emplace(std::move(key), idx);
+    *out = idx;
+    return true;
+  };
+
+  while (n < max_records && tail < head) {
+    uint64_t pos = ring_pos(r, tail);
+    uint32_t rec_len;
+    const uint64_t contiguous = r->hdr->capacity - pos;
+    if (contiguous < 4) { tail += contiguous; continue; }
+    std::memcpy(&rec_len, r->data + pos, 4);
+    if (rec_len == WRAP_MARKER) { tail += contiguous; continue; }
+    const uint8_t* p = r->data + pos + 4;
+    uint64_t fixed[6];
+    std::memcpy(fixed, p, 48); p += 48;
+    const uint8_t k = *p++, st = *p++;
+    uint16_t svc_len, name_len;
+    std::memcpy(&svc_len, p, 2); p += 2;
+    std::memcpy(&name_len, p, 2); p += 2;
+    int32_t si, ni;
+    if (!intern(p, svc_len, &si)) break;         // string space exhausted:
+    if (!intern(p + svc_len, name_len, &ni)) break;  // leave record for next drain
+    trace_hi[n] = fixed[0]; trace_lo[n] = fixed[1];
+    span_id[n] = fixed[2]; parent_id[n] = fixed[3];
+    start_ns[n] = fixed[4]; end_ns[n] = fixed[5];
+    kind[n] = static_cast<int8_t>(k);
+    status[n] = static_cast<int8_t>(st);
+    svc_idx[n] = si; name_idx[n] = ni;
+    tail += rec_len + 4;
+    ++n;
+  }
+  r->hdr->tail.store(tail, std::memory_order_release);
+  *n_strings_out = n_strings;
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

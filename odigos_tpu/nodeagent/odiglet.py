@@ -140,9 +140,21 @@ class Odiglet:
             watches={"InstrumentationConfig": None})
         self.detector.start(self.instrumentation.on_process_event)
 
+    def start_ring_server(self, socket_path: str):
+        """Own the span-ring FD handoff socket (the unixfd server role,
+        odiglet.go:157-era wiring): agents' rings registered here survive
+        collector restarts; the node collector's shmspan receiver connects
+        and maps them."""
+        from ..transport import RingHandoffServer
+        self.ring_server = RingHandoffServer(socket_path)
+        self.ring_server.start()
+        return self.ring_server
+
     def stop(self) -> None:
         self.detector.stop()
         self.instrumentation.stop()
+        if getattr(self, "ring_server", None) is not None:
+            self.ring_server.stop()
 
     def poll(self) -> None:
         """One deterministic step: sync pod churn, detect process churn,

@@ -1,0 +1,14 @@
+"""Shared-memory span transport (the eBPF-map + unixfd equivalent).
+
+* ``SpanRing``          — Python face of the native SPSC ring
+                          (odigos_tpu/native/spanring.cpp)
+* ``RingHandoffServer`` / ``receive_rings`` — SCM_RIGHTS FD handoff over a
+  unix socket (common/unixfd/{server,client}.go roles; odiglet owns the
+  server, the node collector connects and maps)
+* ``ShmSpanReceiver``   — collector receiver draining rings into SpanBatches
+  (odigosebpfreceiver role, incl. surviving producer restarts by re-handoff)
+"""
+
+from .ring import SpanRing  # noqa: F401
+from .unixfd import RingHandoffServer, receive_rings  # noqa: F401
+from .receiver import ShmSpanReceiver  # noqa: F401

@@ -1,0 +1,124 @@
+"""Collector receiver draining shared-memory span rings.
+
+The odigosebpfreceiver role (SURVEY.md §2.3): a connector goroutine gets the
+ring FDs from the handoff socket, a drain loop turns records into batches.
+Producer restarts are survived by re-requesting the FDs when a ring goes
+quiet and its name re-registers (reader-swap, odigosebpfreceiver.go:74-93).
+
+Config:
+  socket_path:     handoff socket to fetch rings from (optional)
+  interval_s:      drain poll interval (default 0.01)
+  max_records:     per-drain record cap (default 65536)
+  refresh_idle_s:  re-request the handoff after this long with zero spans
+                   drained (default 2.0) — picks up restarted producers'
+                   replacement rings and newly instrumented processes
+Rings may also be attached directly via ``attach_ring`` (tests, same-process
+producers).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ..components.api import ComponentKind, Factory, Receiver, Signal, register
+from ..utils.telemetry import meter
+from .ring import SpanRing
+from .unixfd import receive_rings
+
+
+class ShmSpanReceiver(Receiver):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._rings: dict[str, SpanRing] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    def attach_ring(self, name: str, ring: SpanRing) -> None:
+        with self._lock:
+            old = self._rings.get(name)
+            self._rings[name] = ring
+        if old is not None:
+            old.close()
+
+    def refresh_rings(self) -> int:
+        """Re-request the handoff and swap in any ring whose memfd identity
+        changed (or is new). Returns rings (re)attached. The reference's
+        reader-swap on odiglet restart (odigosebpfreceiver.go:74-93)."""
+        path = self.config.get("socket_path")
+        if not path:
+            return 0
+        import os
+        swapped = 0
+        for ring_name, fd in receive_rings(path).items():
+            st = os.fstat(fd)
+            with self._lock:
+                current = self._rings.get(ring_name)
+            if current is not None and current.identity == (st.st_dev,
+                                                            st.st_ino):
+                os.close(fd)  # same ring; nothing to do
+                continue
+            self.attach_ring(ring_name, SpanRing.attach(fd))
+            swapped += 1
+        return swapped
+
+    def start(self) -> None:
+        super().start()
+        self.refresh_rings()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"shmspan-{self.name}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            rings, self._rings = dict(self._rings), {}
+        for ring in rings.values():
+            ring.close()
+        super().shutdown()
+
+    def drain_once(self) -> int:
+        """One pass over all rings; returns spans delivered (sync test
+        hook, also the loop body)."""
+        delivered = 0
+        with self._lock:
+            rings = list(self._rings.items())
+        for ring_name, ring in rings:
+            batch = ring.drain(int(self.config.get("max_records", 65536)))
+            if batch is None:
+                continue
+            try:
+                self.next_consumer.consume(batch)
+                delivered += len(batch)
+            except Exception:
+                meter.add("odigos_receiver_refused_batches_total"
+                          f"{{receiver={self.name}}}")
+        return delivered
+
+    def _run(self) -> None:
+        import time
+        interval = float(self.config.get("interval_s", 0.01))
+        refresh_idle = float(self.config.get("refresh_idle_s", 2.0))
+        last_active = time.monotonic()
+        while not self._stop.is_set():
+            if self.drain_once() == 0:
+                if time.monotonic() - last_active > refresh_idle:
+                    try:
+                        self.refresh_rings()
+                    except OSError:
+                        pass  # handoff server down; retry next idle window
+                    last_active = time.monotonic()
+                self._stop.wait(interval)
+            else:
+                last_active = time.monotonic()
+
+
+register(Factory(
+    type_name="shmspan", kind=ComponentKind.RECEIVER,
+    create=ShmSpanReceiver, signals=(Signal.TRACES,),
+    default_config=lambda: {"interval_s": 0.01, "max_records": 65536}))

@@ -1,0 +1,184 @@
+"""Python face of the native shared-memory span ring."""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..native import i8, i32, lib, p, u8, u32, u64
+from ..pdata.spans import SpanBatch
+
+_DEFAULT_CAPACITY = 8 * 1024 * 1024
+
+
+def _encode_string_table(strings: tuple[str, ...]) -> tuple[bytes, np.ndarray]:
+    encoded = [s.encode("utf-8") for s in strings]
+    offs = np.zeros(len(encoded) + 1, dtype=np.uint32)
+    np.cumsum([len(b) for b in encoded], out=offs[1:])
+    return b"".join(encoded), offs
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(p(ctype))
+
+
+class SpanRing:
+    """One producer's ring. ``create`` allocates a memfd-backed ring (the
+    producer side); ``attach`` maps an FD received over the handoff socket
+    (the consumer side). Both ends see the same header/cursors."""
+
+    def __init__(self, fd: int, mem: mmap.mmap, handle: int, owner: bool):
+        self.fd = fd
+        self._mem = mem
+        self._handle = handle
+        self._owner = owner
+        self._lib = lib()
+        self._scratch: Optional[dict] = None  # reused drain buffers
+        # memfd identity — lets a consumer recognize "same ring under the
+        # same name" across re-handoffs (producer restart detection)
+        st = os.fstat(fd)
+        self.identity = (st.st_dev, st.st_ino)
+
+    # ------------------------------------------------------------ setup
+
+    @classmethod
+    def create(cls, capacity: int = _DEFAULT_CAPACITY,
+               name: str = "spanring") -> "SpanRing":
+        L = lib()
+        map_len = L.sr_map_len(capacity)
+        fd = os.memfd_create(name)
+        os.ftruncate(fd, map_len)
+        mem = mmap.mmap(fd, map_len)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(mem))
+        handle = L.sr_init(addr, capacity)
+        return cls(fd, mem, handle, owner=True)
+
+    @classmethod
+    def attach(cls, fd: int) -> "SpanRing":
+        L = lib()
+        map_len = os.fstat(fd).st_size
+        mem = mmap.mmap(fd, map_len)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(mem))
+        handle = L.sr_attach(addr)
+        if not handle:
+            mem.close()
+            raise ValueError("fd does not hold a valid span ring")
+        return cls(fd, mem, handle, owner=False)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.sr_close(self._handle)
+            self._handle = 0
+        # the mmap buffer is exported via from_buffer; releasing requires no
+        # outstanding pointers — safe here because ctypes pointers are gone
+        # with the handle
+        self._mem.close()
+        os.close(self.fd)
+
+    # ------------------------------------------------------------- stats
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.sr_capacity(self._handle)
+
+    @property
+    def dropped(self) -> int:
+        return self._lib.sr_dropped(self._handle)
+
+    @property
+    def written(self) -> int:
+        return self._lib.sr_written(self._handle)
+
+    @property
+    def backlog_bytes(self) -> int:
+        return self._lib.sr_backlog(self._handle)
+
+    # ------------------------------------------------------------- write
+
+    def write_batch(self, batch: SpanBatch) -> int:
+        """Producer: append a whole columnar batch natively; returns spans
+        written (shortfall = dropped, counted in the ring header)."""
+        n = len(batch)
+        if n == 0:
+            return 0
+        strtab, offs = _encode_string_table(batch.strings)
+        strtab_arr = np.frombuffer(strtab, dtype=np.uint8) if strtab \
+            else np.zeros(0, dtype=np.uint8)
+        c = {k: np.ascontiguousarray(batch.col(k)) for k in (
+            "trace_id_hi", "trace_id_lo", "span_id", "parent_span_id",
+            "start_unix_nano", "end_unix_nano", "kind", "status_code",
+            "service", "name")}
+        return self._lib.sr_write_batch(
+            self._handle, n,
+            _ptr(c["trace_id_hi"], u64), _ptr(c["trace_id_lo"], u64),
+            _ptr(c["span_id"], u64), _ptr(c["parent_span_id"], u64),
+            _ptr(c["start_unix_nano"], u64), _ptr(c["end_unix_nano"], u64),
+            _ptr(c["kind"], i8), _ptr(c["status_code"], i8),
+            _ptr(c["service"], i32), _ptr(c["name"], i32),
+            _ptr(strtab_arr, u8), _ptr(offs, u32))
+
+    # ------------------------------------------------------------- drain
+
+    def drain(self, max_records: int = 65536,
+              strbuf_cap: int = 1 << 20,
+              max_strings: int = 65536) -> Optional[SpanBatch]:
+        """Consumer: drain up to max_records into a new SpanBatch; None when
+        the ring was empty. Resources are reconstructed per distinct service
+        (service.name attr), matching what the producer flattened."""
+        if self._lib.sr_backlog(self._handle) == 0:
+            return None  # empty: skip the scratch allocation entirely
+        scratch = self._scratch
+        if (scratch is None or scratch["max_records"] < max_records
+                or scratch["strbuf_cap"] < strbuf_cap
+                or scratch["max_strings"] < max_strings):
+            scratch = self._scratch = {
+                "max_records": max_records, "strbuf_cap": strbuf_cap,
+                "max_strings": max_strings,
+                "cols": {
+                    "trace_id_hi": np.empty(max_records, np.uint64),
+                    "trace_id_lo": np.empty(max_records, np.uint64),
+                    "span_id": np.empty(max_records, np.uint64),
+                    "parent_span_id": np.empty(max_records, np.uint64),
+                    "start_unix_nano": np.empty(max_records, np.uint64),
+                    "end_unix_nano": np.empty(max_records, np.uint64),
+                    "kind": np.empty(max_records, np.int8),
+                    "status_code": np.empty(max_records, np.int8),
+                    "service": np.empty(max_records, np.int32),
+                    "name": np.empty(max_records, np.int32),
+                },
+                "strbuf": np.empty(strbuf_cap, np.uint8),
+                "offs": np.zeros(max_strings + 1, np.uint32),
+            }
+        cols = scratch["cols"]
+        strbuf = scratch["strbuf"]
+        offs = scratch["offs"]
+        n_strings = u64(0)
+        n = self._lib.sr_drain(
+            self._handle, max_records,
+            _ptr(cols["trace_id_hi"], u64), _ptr(cols["trace_id_lo"], u64),
+            _ptr(cols["span_id"], u64), _ptr(cols["parent_span_id"], u64),
+            _ptr(cols["start_unix_nano"], u64),
+            _ptr(cols["end_unix_nano"], u64),
+            _ptr(cols["kind"], i8), _ptr(cols["status_code"], i8),
+            _ptr(cols["service"], i32), _ptr(cols["name"], i32),
+            _ptr(strbuf, u8), strbuf_cap, _ptr(offs, u32), max_strings,
+            ctypes.byref(n_strings))
+        if n <= 0:
+            return None
+        ns = n_strings.value
+        blob = strbuf[:offs[ns]].tobytes()
+        strings = tuple(blob[offs[i]:offs[i + 1]].decode("utf-8")
+                        for i in range(ns))
+        out = {k: v[:n].copy() for k, v in cols.items()}
+        # rebuild resources: one per distinct service string
+        uniq, inverse = np.unique(out["service"], return_inverse=True)
+        resources = tuple({"service.name": strings[int(s)]} for s in uniq)
+        out["resource_index"] = inverse.astype(np.int32)
+        out["scope"] = np.full(n, -1, np.int32)
+        return SpanBatch(
+            strings=strings, resources=resources,
+            span_attrs=({},) * int(n), columns=out)

@@ -1,0 +1,122 @@
+"""SCM_RIGHTS ring-FD handoff over a unix socket.
+
+Role of common/unixfd/{server,client}.go: odiglet owns the rings (they
+outlive collector restarts) and serves their FDs; the node collector
+connects, receives FDs + names, and maps them. On producer restart the
+server re-registers a new ring under the same name and connected consumers
+re-request (the odigosebpfreceiver.go:74-93 reader-swap behavior).
+
+Wire protocol: lockstep chunks of at most ``CHUNK`` FDs, because one
+SCM_RIGHTS message caps out (kernel SCM_MAX_FD ≈253; and the receiver must
+size maxfds up front). The client sends one request byte per chunk; the
+server replies with ``{"names": [...], "done": bool}`` plus that chunk's
+FDs attached. Lockstep (reply only after a request) keeps stream-coalescing
+from mixing two replies into one recvmsg.
+"""
+
+from __future__ import annotations
+
+import array
+import json
+import os
+import socket
+import threading
+from typing import Optional
+
+
+class RingHandoffServer:
+    def __init__(self, path: str):
+        self.path = path
+        self._rings: dict[str, int] = {}  # name -> fd
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def register_ring(self, name: str, fd: int) -> None:
+        """Adding a name twice replaces the fd (producer restart)."""
+        with self._lock:
+            self._rings[name] = fd
+
+    def unregister_ring(self, name: str) -> None:
+        with self._lock:
+            self._rings.pop(name, None)
+
+    def start(self) -> None:
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.path)
+        self._sock.listen(8)
+        self._sock.settimeout(0.2)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="ring-handoff")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._sock:
+            self._sock.close()
+            self._sock = None
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                with self._lock:
+                    items = sorted(self._rings.items())
+                chunks = [items[i:i + CHUNK]
+                          for i in range(0, len(items), CHUNK)] or [[]]
+                for i, chunk in enumerate(chunks):
+                    if not conn.recv(1):  # per-chunk request byte
+                        break
+                    header = json.dumps(
+                        {"names": [n for n, _ in chunk],
+                         "done": i == len(chunks) - 1}).encode()
+                    socket.send_fds(conn, [header],
+                                    [fd for _, fd in chunk])
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+
+CHUNK = 32  # FDs per SCM_RIGHTS message (kernel cap is ~253)
+
+
+def receive_rings(path: str, timeout: float = 5.0) -> dict[str, int]:
+    """Client side: returns {name: fd}. The received FDs are duplicates owned
+    by the caller (close them via SpanRing.close)."""
+    out: dict[str, int] = {}
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+            sock.settimeout(timeout)
+            sock.connect(path)
+            while True:
+                sock.sendall(b"?")
+                header, fds, _flags, _addr = socket.recv_fds(
+                    sock, 65536, CHUNK)
+                msg = json.loads(header.decode())
+                names = msg["names"]
+                if len(names) != len(fds):
+                    for fd in fds:
+                        os.close(fd)
+                    raise RuntimeError(
+                        "fd/name count mismatch in ring handoff")
+                out.update(zip(names, fds))
+                if msg["done"]:
+                    return out
+    except BaseException:
+        for fd in out.values():
+            os.close(fd)
+        raise
