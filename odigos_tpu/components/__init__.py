@@ -18,3 +18,6 @@ from .api import (  # noqa: F401
     registry,
 )
 from . import receivers, processors, exporters, connectors  # noqa: F401
+# network + shared-memory transports register their factories on import too
+# (safe here: both import only ..components.api, which is bound above)
+from .. import transport, wire  # noqa: E402,F401

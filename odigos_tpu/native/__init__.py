@@ -32,7 +32,8 @@ p = ctypes.POINTER
 
 def _build() -> None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    tmp = _SO + ".tmp"
+    tmp = f"{_SO}.{os.getpid()}.tmp"  # per-process: concurrent cold builds
+    # race only through the atomic os.replace, never through the same file
     subprocess.run(
         ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-march=native",
          _SRC, "-o", tmp],

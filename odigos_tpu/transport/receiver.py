@@ -36,11 +36,13 @@ class ShmSpanReceiver(Receiver):
         self._lock = threading.Lock()
 
     def attach_ring(self, name: str, ring: SpanRing) -> None:
+        # close-under-lock: drain_once also drains under the lock, so the
+        # old ring can never be freed while a native drain is inside it
         with self._lock:
             old = self._rings.get(name)
             self._rings[name] = ring
-        if old is not None:
-            old.close()
+            if old is not None:
+                old.close()
 
     def refresh_rings(self) -> int:
         """Re-request the handoff and swap in any ring whose memfd identity
@@ -52,20 +54,33 @@ class ShmSpanReceiver(Receiver):
         import os
         swapped = 0
         for ring_name, fd in receive_rings(path).items():
-            st = os.fstat(fd)
-            with self._lock:
-                current = self._rings.get(ring_name)
-            if current is not None and current.identity == (st.st_dev,
-                                                            st.st_ino):
-                os.close(fd)  # same ring; nothing to do
-                continue
-            self.attach_ring(ring_name, SpanRing.attach(fd))
-            swapped += 1
+            try:
+                st = os.fstat(fd)
+                with self._lock:
+                    current = self._rings.get(ring_name)
+                if current is not None and current.identity == (st.st_dev,
+                                                                st.st_ino):
+                    os.close(fd)  # same ring; nothing to do
+                    continue
+                self.attach_ring(ring_name, SpanRing.attach(fd))
+                swapped += 1
+            except (OSError, ValueError):
+                # not-yet-initialized or torn ring: close the fd, keep the
+                # rest of the handoff working
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
         return swapped
 
     def start(self) -> None:
         super().start()
-        self.refresh_rings()
+        try:
+            self.refresh_rings()
+        except Exception:
+            # handoff socket not up yet (odiglet starting): the drain loop
+            # retries on its idle schedule; never fail pipeline startup
+            pass
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"shmspan-{self.name}")
@@ -86,12 +101,14 @@ class ShmSpanReceiver(Receiver):
         """One pass over all rings; returns spans delivered (sync test
         hook, also the loop body)."""
         delivered = 0
-        with self._lock:
-            rings = list(self._rings.items())
-        for ring_name, ring in rings:
-            batch = ring.drain(int(self.config.get("max_records", 65536)))
-            if batch is None:
-                continue
+        with self._lock:  # same lock as attach_ring: no swap mid-drain
+            batches = []
+            for ring_name, ring in self._rings.items():
+                batch = ring.drain(int(self.config.get("max_records",
+                                                       65536)))
+                if batch is not None:
+                    batches.append(batch)
+        for batch in batches:  # consume outside the lock
             try:
                 self.next_consumer.consume(batch)
                 delivered += len(batch)
@@ -110,8 +127,8 @@ class ShmSpanReceiver(Receiver):
                 if time.monotonic() - last_active > refresh_idle:
                     try:
                         self.refresh_rings()
-                    except OSError:
-                        pass  # handoff server down; retry next idle window
+                    except Exception:
+                        pass  # handoff unreachable/garbled; retry next window
                     last_active = time.monotonic()
                 self._stop.wait(interval)
             else:

@@ -72,23 +72,30 @@ class RingHandoffServer:
                 continue
             except OSError:
                 return
-            try:
-                with self._lock:
-                    items = sorted(self._rings.items())
-                chunks = [items[i:i + CHUNK]
-                          for i in range(0, len(items), CHUNK)] or [[]]
-                for i, chunk in enumerate(chunks):
-                    if not conn.recv(1):  # per-chunk request byte
-                        break
-                    header = json.dumps(
-                        {"names": [n for n, _ in chunk],
-                         "done": i == len(chunks) - 1}).encode()
-                    socket.send_fds(conn, [header],
-                                    [fd for _, fd in chunk])
-            except OSError:
-                pass
-            finally:
-                conn.close()
+            # per-connection thread + timeout: one hung client must not
+            # starve every other collector's handoff
+            conn.settimeout(5.0)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True, name="ring-handoff-conn").start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            with self._lock:
+                items = sorted(self._rings.items())
+            chunks = [items[i:i + CHUNK]
+                      for i in range(0, len(items), CHUNK)] or [[]]
+            for i, chunk in enumerate(chunks):
+                if not conn.recv(1):  # per-chunk request byte
+                    break
+                header = json.dumps(
+                    {"names": [n for n, _ in chunk],
+                     "done": i == len(chunks) - 1}).encode()
+                socket.send_fds(conn, [header],
+                                [fd for _, fd in chunk])
+        except OSError:
+            pass
+        finally:
+            conn.close()
 
 
 CHUNK = 32  # FDs per SCM_RIGHTS message (kernel cap is ~253)

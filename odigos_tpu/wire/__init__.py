@@ -1,0 +1,20 @@
+"""Network wire path: OTLP-role framed transport between collectors.
+
+The reference's node→gateway leg is OTLP gRPC with a forked configgrpc that
+rejects messages *before decoding* under memory pressure (SURVEY.md §2.3
+configgrpc fork, §2.7 backpressure). Here:
+
+* ``codec``     — columnar frame format (SpanBatch ⇄ bytes, zero per-span work)
+* ``server``    — ``otlpwire`` receiver with pre-decode admission control
+                  feeding the rejection metric the HPA scales on
+* ``client``    — ``otlpwire`` exporter (bounded queue, retry w/ backoff) and
+                  ``loadbalancing`` exporter (consistent trace routing so
+                  whole traces land on one gateway replica)
+* ``hotreload`` — ConfigMap watcher driving Collector.reload
+                  (odigosk8scmprovider role)
+"""
+
+from .codec import decode_batch, encode_batch  # noqa: F401
+from .server import WireReceiver  # noqa: F401
+from .client import LoadBalancingExporter, WireExporter  # noqa: F401
+from .hotreload import watch_configmap  # noqa: F401

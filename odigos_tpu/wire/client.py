@@ -1,0 +1,277 @@
+"""Wire exporter + loadbalancing exporter.
+
+``otlpwire`` exporter: the node→gateway OTLP leg with the generated retry/
+queue semantics (autoscaler/controllers/nodecollector/collectorconfig/
+traces.go:46-72 retry_on_failure + sending_queue): bounded queue, sender
+thread, exponential backoff on connection errors and REJECTED responses.
+
+``loadbalancing`` exporter: consistent trace routing across gateway
+replicas (traces.go:26,75-85) so whole-trace operations (tail sampling,
+servicegraph, trace-tree anomaly models) see complete traces on one
+replica. Routing key is the trace id (vectorized ring lookup); resolver is
+a pluggable callable returning the endpoint list (the k8s-resolver role).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..components.api import ComponentKind, Exporter, Factory, Signal, register
+from ..pdata.spans import SpanBatch
+from ..utils.telemetry import meter
+from .codec import frame
+from .server import ACCEPTED, MALFORMED
+
+
+class WireExporter(Exporter):
+    """Config:
+    endpoint:        "host:port"
+    queue_size:      max buffered frames (default 512; overflow drops oldest)
+    retry_initial_s: first backoff (default 0.05)
+    retry_max_s:     backoff cap (default 2.0)
+    max_elapsed_s:   give up on a frame after this long (default 30)
+    """
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._queue: deque[bytes] = deque(
+            maxlen=int(config.get("queue_size", 512)))
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+
+    # ------------------------------------------------------------ pipeline
+
+    def export(self, batch: SpanBatch) -> None:
+        buf = frame(batch)  # encode on caller thread; send is async
+        if len(self._queue) == self._queue.maxlen:
+            meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+        self._queue.append(buf)
+        self._wake.set()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"otlpwire-send-{self.name}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.flush(timeout=float(self.config.get("shutdown_flush_s", 5.0)))
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._close_sock()
+        super().shutdown()
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while self._queue and time.monotonic() < deadline:
+            time.sleep(0.005)
+        return not self._queue
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    # ------------------------------------------------------------ sending
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            host, port = self.config["endpoint"].rsplit(":", 1)
+            self._sock = socket.create_connection((host, int(port)),
+                                                  timeout=5.0)
+        return self._sock
+
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _send_one(self, buf: bytes) -> bool:
+        """True = done with this frame (accepted or malformed-drop);
+        False = retry later (connection trouble or server overloaded)."""
+        try:
+            sock = self._connect()
+            sock.sendall(buf)
+            status = sock.recv(1)
+        except OSError:
+            self._close_sock()
+            return False
+        if status == ACCEPTED:
+            return True
+        if status == b"":
+            # connection died before the ack: keep the frame, reconnect
+            self._close_sock()
+            return False
+        if status == MALFORMED:
+            # permanently bad frame: drop it, don't head-of-line block
+            meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+            return True
+        # REJECTED: server sheds load — back off, keep the frame
+        meter.add(f"odigos_exporter_backpressure_total{{exporter={self.name}}}")
+        return False
+
+    def _run(self) -> None:
+        initial = float(self.config.get("retry_initial_s", 0.05))
+        cap = float(self.config.get("retry_max_s", 2.0))
+        max_elapsed = float(self.config.get("max_elapsed_s", 30.0))
+        backoff = initial
+        frame_started: Optional[float] = None
+        while not self._stop.is_set():
+            if not self._queue:
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+                continue
+            buf = self._queue[0]
+            if frame_started is None:
+                frame_started = time.monotonic()
+            if self._send_one(buf):
+                try:
+                    self._queue.popleft()
+                except IndexError:
+                    pass
+                backoff = initial
+                frame_started = None
+            elif time.monotonic() - frame_started > max_elapsed:
+                try:
+                    self._queue.popleft()
+                except IndexError:
+                    pass
+                meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+                backoff = initial
+                frame_started = None
+            else:
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, cap)
+
+
+# ------------------------------------------------------------ loadbalancing
+
+
+def _ring_points(endpoints: list[str], vnodes: int = 64) -> tuple[np.ndarray, list[str]]:
+    """Consistent-hash ring: vnodes points per endpoint, sorted."""
+    points = []
+    owners = []
+    for ep in endpoints:
+        for v in range(vnodes):
+            h = hashlib.md5(f"{ep}#{v}".encode()).digest()[:8]
+            points.append(int.from_bytes(h, "little"))
+            owners.append(ep)
+    order = np.argsort(np.asarray(points, dtype=np.uint64), kind="stable")
+    pts = np.asarray(points, dtype=np.uint64)[order]
+    return pts, [owners[i] for i in order]
+
+
+class LoadBalancingExporter(Exporter):
+    """Config:
+    endpoints: static endpoint list, or
+    resolver:  callable returning the current endpoint list (re-resolved
+               every ``resolve_interval_s``, default 5 — the k8s-resolver)
+    child:     config dict passed to each per-endpoint WireExporter
+    """
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._children: dict[str, WireExporter] = {}
+        self._ring: tuple[np.ndarray, list[str]] = (np.zeros(0, np.uint64), [])
+        self._resolver: Optional[Callable[[], list[str]]] = \
+            config.get("resolver")
+        self._last_resolve = 0.0
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        super().start()
+        self._resolve(force=True)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            children = list(self._children.values())
+            self._children = {}
+        for child in children:
+            child.shutdown()
+        super().shutdown()
+
+    def _resolve(self, force: bool = False) -> None:
+        now = time.monotonic()
+        interval = float(self.config.get("resolve_interval_s", 5.0))
+        if not force and now - self._last_resolve < interval:
+            return
+        self._last_resolve = now
+        endpoints = (self._resolver() if self._resolver is not None
+                     else list(self.config.get("endpoints", [])))
+        with self._lock:
+            current = set(self._children)
+            wanted = set(endpoints)
+            if current == wanted:
+                return
+            for ep in wanted - current:
+                child = WireExporter(
+                    f"{self.name}/{ep}",
+                    {"endpoint": ep, **self.config.get("child", {})})
+                if self._started:
+                    child.start()
+                self._children[ep] = child
+            stale = [self._children.pop(ep) for ep in current - wanted]
+            self._ring = _ring_points(sorted(wanted)) if wanted else (
+                np.zeros(0, np.uint64), [])
+        for child in stale:
+            child.shutdown()
+
+    def export(self, batch: SpanBatch) -> None:
+        self._resolve()
+        points, owners = self._ring
+        if not owners:
+            meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+            return
+        # vectorized ring lookup on the trace id: same trace -> same replica
+        keys = batch.col("trace_id_lo")
+        idx = np.searchsorted(points, keys, side="right") % len(owners)
+        with self._lock:
+            children = dict(self._children)
+        endpoints = sorted(set(owners))  # ring owners, not children: a
+        ep_index = {ep: i for i, ep in enumerate(endpoints)}  # resolve race
+        ep_of_point = np.asarray([ep_index[o] for o in owners],
+                                 dtype=np.int64)
+        span_ep = ep_of_point[idx]  # vnode -> endpoint, one frame per replica
+        for i, ep in enumerate(endpoints):
+            child = children.get(ep)
+            if child is None:
+                continue
+            mask = span_ep == i
+            if mask.any():
+                child.export(batch.filter(mask))
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout
+        ok = True
+        with self._lock:
+            children = list(self._children.values())
+        for child in children:
+            ok &= child.flush(max(0.0, deadline - time.monotonic()))
+        return ok
+
+
+register(Factory(
+    type_name="otlpwire", kind=ComponentKind.EXPORTER,
+    create=WireExporter, signals=(Signal.TRACES,),
+    default_config=lambda: {"queue_size": 512}))
+
+register(Factory(
+    type_name="loadbalancing", kind=ComponentKind.EXPORTER,
+    create=LoadBalancingExporter, signals=(Signal.TRACES,),
+    default_config=lambda: {"endpoints": [], "child": {}}))

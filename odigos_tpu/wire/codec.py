@@ -1,0 +1,79 @@
+"""Columnar wire codec for SpanBatch.
+
+Frame layout (little-endian):
+    u32 magic "OTW1"
+    u32 payload length
+payload:
+    u32 header length, header JSON:
+        {"n": spans, "strings": [...], "resources": [...],
+         "attrs": {span_idx: {...}},       # sparse — empties omitted
+         "cols": [[name, dtype], ...]}     # order = byte layout
+    raw column bytes, concatenated in header order
+
+The hot path ships the numeric columns as raw buffers (one memcpy each
+side); only the string table and sparse attrs go through JSON. This is the
+same discipline as the eBPF receiver's protobuf-to-columnar decode
+(collector/receivers/odigosebpfreceiver/traces.go:105) — per-batch cost,
+never per-span.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from ..pdata.spans import SpanBatch
+
+MAGIC = b"OTW1"
+_HDR = struct.Struct("<I")
+
+
+def encode_batch(batch: SpanBatch) -> bytes:
+    cols = [(name, arr) for name, arr in batch.columns.items()]
+    header = {
+        "n": len(batch),
+        "strings": list(batch.strings),
+        "resources": [dict(r) for r in batch.resources],
+        "attrs": {str(i): a for i, a in enumerate(batch.span_attrs) if a},
+        "cols": [[name, arr.dtype.str] for name, arr in cols],
+    }
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    parts = [_HDR.pack(len(hdr)), hdr]
+    parts.extend(np.ascontiguousarray(arr).tobytes() for _, arr in cols)
+    return b"".join(parts)
+
+
+def decode_batch(payload: bytes) -> SpanBatch:
+    (hdr_len,) = _HDR.unpack_from(payload, 0)
+    header = json.loads(payload[4:4 + hdr_len])
+    n = header["n"]
+    attrs_sparse = {int(k): v for k, v in header["attrs"].items()}
+    span_attrs = tuple(attrs_sparse.get(i, {}) for i in range(n))
+    columns = {}
+    off = 4 + hdr_len
+    for name, dtype_str in header["cols"]:
+        dt = np.dtype(dtype_str)
+        nbytes = dt.itemsize * n
+        columns[name] = np.frombuffer(
+            payload, dtype=dt, count=n, offset=off).copy()
+        off += nbytes
+    return SpanBatch(
+        strings=tuple(header["strings"]),
+        resources=tuple(header["resources"]),
+        span_attrs=span_attrs,
+        columns=columns)
+
+
+def frame(batch: SpanBatch) -> bytes:
+    payload = encode_batch(batch)
+    return MAGIC + _HDR.pack(len(payload)) + payload
+
+
+def read_frame_header(buf: bytes) -> int:
+    """Validate the 8-byte frame header; returns payload length."""
+    if buf[:4] != MAGIC:
+        raise ValueError("bad wire magic")
+    (n,) = _HDR.unpack_from(buf, 4)
+    return n

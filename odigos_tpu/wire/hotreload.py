@@ -1,0 +1,72 @@
+"""ConfigMap-driven collector hot reload (odigosk8scmprovider role).
+
+The reference's collectors load config through a confmap provider that
+watches the generated ConfigMap and reloads the service on change
+(collector/providers/odigosk8scmprovider/, SURVEY.md §3.4). Here the
+autoscaler writes generated configs into the Store as ConfigMap resources;
+``watch_configmap`` wires those events to ``Collector.reload``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+from ..api.store import Event, EventType, Store
+from ..utils.telemetry import meter
+
+if TYPE_CHECKING:  # avoid import cycle: pipeline.service imports components
+    from ..pipeline.service import Collector
+
+
+def _content_hash(data: dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(data, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def watch_configmap(store: Store, namespace: str, name: str,
+                    collector: "Collector",
+                    extract: Optional[Callable[[dict], dict]] = None
+                    ) -> Callable[[], None]:
+    """Subscribe the collector to the named ConfigMap; reload on content
+    change (hash-diffed, so status-only rewrites are no-ops). ``extract``
+    maps ConfigMap.data to the collector config dict (default: data as-is).
+    Returns an unsubscribe function. If the ConfigMap already exists, the
+    collector is reloaded from it immediately (level-triggered start)."""
+    state = {"hash": _content_hash(collector.config), "active": True}
+    extract = extract or (lambda data: data)
+
+    def apply(data: dict[str, Any]) -> None:
+        cfg = extract(data)
+        h = _content_hash(cfg)
+        if h == state["hash"]:
+            return
+        try:
+            collector.reload(cfg)
+        except Exception:
+            # bad generated config must not kill the running pipeline; keep
+            # serving the old graph (collector semantics: reload failures
+            # leave the previous service running)
+            meter.add("odigos_collector_reload_failures_total")
+            return
+        state["hash"] = h  # Collector.reload counts reloads itself
+
+    def on_event(event: Event) -> None:
+        if not state["active"]:
+            return
+        if event.kind != "ConfigMap" or event.key != (namespace, name):
+            return
+        if event.type == EventType.DELETED:
+            return  # keep last good config, like a deleted CM in k8s
+        apply(event.resource.data)
+
+    existing = store.get("ConfigMap", namespace, name)
+    if existing is not None:
+        apply(existing.data)
+    store.watch(on_event, kind="ConfigMap")
+
+    def unsubscribe() -> None:
+        state["active"] = False
+
+    return unsubscribe

@@ -1,0 +1,205 @@
+"""Wire receiver with pre-decode admission control.
+
+The configgrpc-fork behavior (collector/config/configgrpc/README.md:1-12):
+under memory pressure the gateway rejects incoming OTLP **before decoding**
+so a hot collector never spends CPU/heap on data it will drop; each
+rejection increments the metric the HPA custom-metrics handler scrapes
+(odigos_gateway_memory_limiter_rejections_total,
+autoscaler/metricshandler/custom_metrics_handler.go:27).
+
+Protocol per frame: client sends MAGIC+len+payload, server answers one
+status byte: 0 accepted, 1 rejected-overloaded (client should back off and
+retry), 2 malformed (client drops the frame).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Any, Callable, Optional
+
+from ..components.api import ComponentKind, Factory, Receiver, Signal, register
+from ..utils.telemetry import meter
+from .codec import MAGIC, decode_batch, read_frame_header
+
+ACCEPTED = b"\x00"
+REJECTED = b"\x01"
+MALFORMED = b"\x02"
+
+REJECTIONS_METRIC = "odigos_gateway_memory_limiter_rejections_total"
+
+
+class AdmissionController:
+    """Tracks bytes admitted-but-not-yet-consumed; over the soft limit new
+    frames are rejected pre-decode. A custom ``pressure_fn`` can add process
+    signals (RSS, queue depth)."""
+
+    def __init__(self, max_inflight_bytes: int = 64 << 20,
+                 pressure_fn: Optional[Callable[[], bool]] = None):
+        self.max_inflight_bytes = max_inflight_bytes
+        self.pressure_fn = pressure_fn
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    def try_admit(self, nbytes: int) -> bool:
+        with self._lock:
+            if self._inflight + nbytes > self.max_inflight_bytes:
+                return False
+            if self.pressure_fn is not None and self.pressure_fn():
+                return False
+            self._inflight += nbytes
+            return True
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._inflight -= nbytes
+
+    @property
+    def inflight_bytes(self) -> int:
+        with self._lock:
+            return self._inflight
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _discard_exact(sock: socket.socket, n: int) -> bool:
+    """Consume n bytes without retaining them (rejected frame)."""
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            return False
+        n -= len(chunk)
+    return True
+
+
+class WireReceiver(Receiver):
+    """Config:
+    port: TCP port (0 = ephemeral; resolved port in ``.port`` after start)
+    host: bind host (default 127.0.0.1)
+    max_inflight_bytes: admission soft limit (default 64 MiB)
+    """
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.admission = AdmissionController(
+            int(config.get("max_inflight_bytes", 64 << 20)))
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+
+    def start(self) -> None:
+        super().start()
+        receiver = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with receiver._conns_lock:
+                    receiver._conns.add(self.request)
+
+            def finish(self):
+                with receiver._conns_lock:
+                    receiver._conns.discard(self.request)
+
+            def handle(self):
+                sock = self.request
+                try:
+                    while True:
+                        head = _recv_exact(sock, 8)
+                        if head is None:
+                            return
+                        try:
+                            payload_len = read_frame_header(head)
+                        except ValueError:
+                            sock.sendall(MALFORMED)
+                            return
+                        if not receiver.admission.try_admit(payload_len):
+                            # pre-decode rejection: drain the socket bytes,
+                            # never allocate/decode, tell client to back off
+                            meter.add(REJECTIONS_METRIC)
+                            if not _discard_exact(sock, payload_len):
+                                return
+                            sock.sendall(REJECTED)
+                            continue
+                        try:
+                            payload = _recv_exact(sock, payload_len)
+                            if payload is None:
+                                return
+                            try:
+                                batch = decode_batch(payload)
+                            except Exception:
+                                # corrupt payload is permanent: MALFORMED
+                                # tells the client to drop, not retry
+                                meter.add(
+                                    "odigos_receiver_malformed_frames_total"
+                                    f"{{receiver={receiver.name}}}")
+                                sock.sendall(MALFORMED)
+                                continue
+                            try:
+                                receiver.next_consumer.consume(batch)
+                            except Exception:
+                                # downstream pressure is transient: REJECTED
+                                meter.add(
+                                    "odigos_receiver_refused_batches_total"
+                                    f"{{receiver={receiver.name}}}")
+                                sock.sendall(REJECTED)
+                                continue
+                            sock.sendall(ACCEPTED)
+                        except OSError:
+                            return
+                        finally:
+                            receiver.admission.release(payload_len)
+                except OSError:
+                    return
+
+        host = self.config.get("host", "127.0.0.1")
+        port = int(self.config.get("port", 0))
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True  # fast rebinds on collector restart
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler, bind_and_activate=True)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"otlpwire-{self.name}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        # close accepted connections too: handler threads otherwise outlive
+        # shutdown and keep consuming into the torn-down pipeline
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().shutdown()
+
+
+register(Factory(
+    type_name="otlpwire", kind=ComponentKind.RECEIVER,
+    create=WireReceiver, signals=(Signal.TRACES,),
+    default_config=lambda: {"host": "127.0.0.1", "port": 0,
+                            "max_inflight_bytes": 64 << 20}))
