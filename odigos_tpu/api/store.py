@@ -118,6 +118,10 @@ class Store:
         with self._lock:
             self._watchers.append((kind, fn))
 
+    def unwatch(self, fn: WatchFn) -> None:
+        with self._lock:
+            self._watchers = [(k, f) for k, f in self._watchers if f is not fn]
+
     def _notify(self, event: Event) -> None:
         with self._lock:
             watchers = list(self._watchers)

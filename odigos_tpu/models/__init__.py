@@ -15,13 +15,14 @@ and (for the learned ones) a jittable train step. Scores are calibrated so
 """
 
 from .zscore import ZScoreDetector, ZScoreState
-from .autoencoder import SpanAutoencoder
+from .autoencoder import AutoencoderConfig, SpanAutoencoder
 from .transformer import TraceTransformer, TransformerConfig
 
 __all__ = [
     "ZScoreDetector",
     "ZScoreState",
     "SpanAutoencoder",
+    "AutoencoderConfig",
     "TraceTransformer",
     "TransformerConfig",
 ]

@@ -19,7 +19,8 @@ from .spans import (
     SpanBatchBuilder,
     concat_batches,
 )
-from .gen import TraceShape, synthesize_traces
+from .gen import (FAULT_KINDS, FaultReport, TraceShape, inject_faults,
+                  synthesize_traces)
 from .traces import TraceView, service_span_mask, trace_keys
 from .metrics import (
     MetricBatch,
@@ -66,4 +67,7 @@ __all__ = [
     "concat_batches",
     "TraceShape",
     "synthesize_traces",
+    "inject_faults",
+    "FaultReport",
+    "FAULT_KINDS",
 ]

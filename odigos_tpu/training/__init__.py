@@ -1,0 +1,11 @@
+"""Model training, checkpointing, and evaluation.
+
+The reference has no training stage (telemetry flows through); this package
+exists for the TPU anomaly models the north star adds (BASELINE configs
+#3-#5). Checkpoint/resume is orbax-backed — the one genuinely *new*
+durability requirement relative to the reference (SURVEY.md §5.4).
+"""
+
+from .data import LabeledSequences, labeled_sequences, training_stream  # noqa: F401
+from .trainer import TrainConfig, Trainer, TrainResult  # noqa: F401
+from .evaluate import evaluate_detector, roc_auc  # noqa: F401

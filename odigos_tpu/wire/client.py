@@ -188,7 +188,9 @@ class LoadBalancingExporter(Exporter):
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         self._children: dict[str, WireExporter] = {}
-        self._ring: tuple[np.ndarray, list[str]] = (np.zeros(0, np.uint64), [])
+        # (ring points, endpoints, vnode -> endpoint index)
+        self._ring: tuple[np.ndarray, list[str], np.ndarray] = (
+            np.zeros(0, np.uint64), [], np.zeros(0, np.int64))
         self._resolver: Optional[Callable[[], list[str]]] = \
             config.get("resolver")
         self._last_resolve = 0.0
@@ -227,26 +229,30 @@ class LoadBalancingExporter(Exporter):
                     child.start()
                 self._children[ep] = child
             stale = [self._children.pop(ep) for ep in current - wanted]
-            self._ring = _ring_points(sorted(wanted)) if wanted else (
-                np.zeros(0, np.uint64), [])
+            if wanted:
+                points, owners = _ring_points(sorted(wanted))
+                endpoints = sorted(wanted)
+                ep_index = {ep: i for i, ep in enumerate(endpoints)}
+                ep_of_point = np.asarray([ep_index[o] for o in owners],
+                                         dtype=np.int64)
+                self._ring = (points, endpoints, ep_of_point)
+            else:
+                self._ring = (np.zeros(0, np.uint64), [],
+                              np.zeros(0, np.int64))
         for child in stale:
             child.shutdown()
 
     def export(self, batch: SpanBatch) -> None:
         self._resolve()
-        points, owners = self._ring
-        if not owners:
+        with self._lock:  # ring + children snapshot, consistent pair
+            points, endpoints, ep_of_point = self._ring
+            children = dict(self._children)
+        if not endpoints:
             meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
             return
         # vectorized ring lookup on the trace id: same trace -> same replica
         keys = batch.col("trace_id_lo")
-        idx = np.searchsorted(points, keys, side="right") % len(owners)
-        with self._lock:
-            children = dict(self._children)
-        endpoints = sorted(set(owners))  # ring owners, not children: a
-        ep_index = {ep: i for i, ep in enumerate(endpoints)}  # resolve race
-        ep_of_point = np.asarray([ep_index[o] for o in owners],
-                                 dtype=np.int64)
+        idx = np.searchsorted(points, keys, side="right") % len(ep_of_point)
         span_ep = ep_of_point[idx]  # vnode -> endpoint, one frame per replica
         for i, ep in enumerate(endpoints):
             child = children.get(ep)

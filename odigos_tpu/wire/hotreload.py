@@ -61,12 +61,15 @@ def watch_configmap(store: Store, namespace: str, name: str,
             return  # keep last good config, like a deleted CM in k8s
         apply(event.resource.data)
 
+    # watch-then-get: a ConfigMap applied between get and watch would be
+    # missed forever the other way around (level-triggered start)
+    store.watch(on_event, kind="ConfigMap")
     existing = store.get("ConfigMap", namespace, name)
     if existing is not None:
         apply(existing.data)
-    store.watch(on_event, kind="ConfigMap")
 
     def unsubscribe() -> None:
         state["active"] = False
+        store.unwatch(on_event)
 
     return unsubscribe

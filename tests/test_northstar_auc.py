@@ -1,0 +1,19 @@
+"""North-star acceptance: trained trace transformer reaches ROC-AUC >= 0.95
+on held-out injected faults (BASELINE.json), at default model scale.
+
+This is the slowest test in the suite (~2 min single-core CPU; fast on
+TPU). It is the judged metric, so it runs in the default suite.
+"""
+
+from odigos_tpu.training import TrainConfig, Trainer, evaluate_detector
+from odigos_tpu.training.evaluate import transformer_scorer
+
+
+def test_northstar_auc():
+    cfg = TrainConfig(steps=200, traces_per_step=64, max_len=32, seed=0)
+    trainer = Trainer(cfg)
+    res = trainer.train()
+    assert res.losses[-1] < res.losses[0] / 2
+    scorer = transformer_scorer(trainer.model, res.variables, max_len=32)
+    ev = evaluate_detector(scorer, n_traces=1000, seed=999)
+    assert ev["auc"] >= 0.95, ev
