@@ -1,1 +1,1 @@
-from . import debug, filelog, mock  # noqa: F401
+from . import debug, filelog, mock, tracedb  # noqa: F401

@@ -1,0 +1,106 @@
+"""Queryable in-memory trace store exporter — the simple-trace-db analog.
+
+The reference's e2e scenarios assert by deploying simple-trace-db as a
+Destination and querying it (tests/common/apply/
+simple-trace-db-deployment.yaml:9, tests/common/simple_trace_db_query_runner.sh,
+queries in tests/common/queries/*.yaml: wait-for-trace, span/resource
+attributes, context propagation). This exporter plays that role in-process:
+scenarios route telemetry to it through the full generated pipeline, then
+assert with the query API below.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch, concat_batches
+from ..api import ComponentKind, Exporter, Factory, Signal, register
+
+
+class TraceDbExporter(Exporter):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._batches: list[SpanBatch] = []
+        self._lock = threading.Lock()
+        self._arrival = threading.Condition(self._lock)
+
+    # ------------------------------------------------------------ pipeline
+
+    def export(self, batch: SpanBatch) -> None:
+        with self._arrival:
+            self._batches.append(batch)
+            self._arrival.notify_all()
+
+    # ------------------------------------------------------------- queries
+
+    def all_spans(self) -> SpanBatch:
+        with self._lock:
+            batches = list(self._batches)
+        return concat_batches(batches) if batches else SpanBatch.empty()
+
+    @property
+    def span_count(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._batches)
+
+    def wait_for_spans(self, n: int = 1, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._arrival:
+            while self.span_count_locked() < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._arrival.wait(remaining)
+        return True
+
+    def span_count_locked(self) -> int:
+        return sum(len(b) for b in self._batches)
+
+    def wait_for_trace(self, service: str, min_spans: int = 1,
+                       timeout: float = 10.0) -> Optional[SpanBatch]:
+        """Wait until some trace containing a span of ``service`` has at
+        least ``min_spans`` spans stored; returns that trace's spans
+        (the wait-for-trace query)."""
+        deadline = time.monotonic() + timeout
+        seen_batches = -1
+        while True:
+            with self._arrival:
+                # rescan only when new batches arrived (no busy-poll)
+                while len(self._batches) == seen_batches:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._arrival.wait(remaining)
+                seen_batches = len(self._batches)
+            spans = self.all_spans()
+            if not len(spans):
+                continue
+            services = np.asarray(spans.col("service"))
+            svc_idx = [i for i, s in enumerate(spans.strings)
+                       if s == service]
+            if not svc_idx:
+                continue
+            hit = np.isin(services, svc_idx)
+            for t in np.unique(spans.col("trace_id_lo")[hit]):
+                trace = spans.filter(spans.col("trace_id_lo") == t)
+                if len(trace) >= min_spans:
+                    return trace
+
+    def query(self, predicate: Callable[[dict[str, Any]], bool]
+              ) -> list[dict[str, Any]]:
+        """Span-dict filter (the span/resource-attribute query style)."""
+        spans = self.all_spans()
+        return [s for s in spans.iter_spans() if predicate(s)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._batches = []
+
+
+register(Factory(
+    type_name="tracedb", kind=ComponentKind.EXPORTER,
+    create=TraceDbExporter, signals=(Signal.TRACES,)))

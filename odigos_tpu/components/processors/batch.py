@@ -59,7 +59,15 @@ class BatchProcessor(Processor):
             self._timer = None
             taken = self._take_locked()
         if taken:
-            self._send(taken)
+            try:
+                self._send(taken)
+            except Exception:
+                # downstream refusal on the timer thread: the caller that
+                # could retry is long gone — count + drop, never kill the
+                # timer path (retries belong to exporters' own queues)
+                from ...utils.telemetry import meter
+                meter.add("odigos_batch_dropped_on_flush_total"
+                          f"{{processor={self.name}}}")
 
     def _send(self, batches: list[SpanBatch]) -> None:
         merged = concat_any(batches)

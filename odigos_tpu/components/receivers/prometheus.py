@@ -1,0 +1,107 @@
+"""Prometheus self-scrape receiver + scrape-endpoint exporter.
+
+The own-telemetry seam (SURVEY.md §5.5): every generated collector config
+carries a ``metrics/otelcol`` pipeline whose receiver scrapes the
+collector's own metrics (autoscaler/controllers/clustercollector/
+configmap.go:42 addSelfTelemetryPipeline). Our process-local ``meter`` is
+the metrics registry; this receiver snapshots it on an interval into
+MetricBatches. The ``prometheus`` *exporter* is the scrape-endpoint role
+(prometheus/servicegraph): it retains the latest points for pull-style
+consumers (the custom-metrics HPA handler, the UI)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from ...pdata.metrics import MetricBatch, MetricBatchBuilder, MetricType
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Exporter, Factory, Receiver, Signal, register
+
+
+def snapshot_to_batch(snapshot: dict[str, float],
+                      resource: Optional[dict[str, Any]] = None
+                      ) -> MetricBatch:
+    b = MetricBatchBuilder()
+    res = b.add_resource(resource or {"service.name": "odigos-collector"})
+    now = time.time_ns()
+    for name, value in sorted(snapshot.items()):
+        # flattened label syntax name{k=v,...} stays intact in the name —
+        # consumers that care parse it; counters vs gauges by the _total
+        # convention applied to the bare name (labels stripped)
+        mtype = (MetricType.SUM
+                 if name.split("{", 1)[0].endswith("_total")
+                 else MetricType.GAUGE)
+        b.add_point(name=name, value=value, metric_type=mtype,
+                    time_unix_nano=now, resource_index=res)
+    return b.build()
+
+
+class PrometheusSelfScrapeReceiver(Receiver):
+    """Config: scrape_interval_s (default 10)."""
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def scrape_once(self) -> int:
+        batch = snapshot_to_batch(meter.snapshot())
+        if len(batch):
+            self.next_consumer.consume(batch)
+        return len(batch)
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"selfscrape-{self.name}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().shutdown()
+
+    def _run(self) -> None:
+        interval = float(self.config.get("scrape_interval_s", 10))
+        while not self._stop.wait(interval):
+            try:
+                self.scrape_once()
+            except Exception:
+                meter.add("odigos_selfscrape_errors_total")
+
+
+class PrometheusEndpointExporter(Exporter):
+    """Retains the latest value per metric name — the /metrics endpoint
+    stand-in; ``latest()`` is the scrape."""
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._latest: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def export(self, batch) -> None:
+        ns = self.config.get("namespace", "")
+        with self._lock:
+            for i in range(len(batch)):
+                name = batch.strings[int(batch.columns["name"][i])]
+                full = f"{ns}_{name}" if ns else name
+                self._latest[full] = float(batch.columns["value"][i])
+
+    def latest(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._latest)
+
+
+register(Factory(
+    type_name="prometheus", kind=ComponentKind.RECEIVER,
+    create=PrometheusSelfScrapeReceiver, signals=(Signal.METRICS,),
+    default_config=lambda: {"scrape_interval_s": 10}))
+
+register(Factory(
+    type_name="prometheus", kind=ComponentKind.EXPORTER,
+    create=PrometheusEndpointExporter, signals=(Signal.METRICS,)))

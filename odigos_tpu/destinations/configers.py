@@ -558,6 +558,7 @@ _CONFIGERS: dict[str, Recipe] = {
     "debug": _single("debug", lambda d: {"verbosity": "basic"}),
     "nop": _single("nop", lambda d: {}),
     "mock": _mock,
+    "tracedb": _single("tracedb", lambda d: {}),
 }
 
 

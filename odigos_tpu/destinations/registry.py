@@ -216,6 +216,8 @@ _ALL = [
     _spec("nop", "Nop", "self hosted", "TML"),
     _spec("mock", "Mock Destination", "self hosted", "TML",
           "MOCK_REJECT_FRACTION", "MOCK_RESPONSE_DURATION"),
+    # simple-trace-db analog: queryable in-process store for e2e asserts
+    _spec("tracedb", "Trace DB (e2e)", "self hosted", "T"),
 ]
 
 SPECS: dict[str, DestinationSpec] = {s.dest_type: s for s in _ALL}

@@ -1,0 +1,13 @@
+"""In-process e2e harness — the KinD + chainsaw analog (SURVEY.md §4).
+
+``E2EEnvironment`` boots the whole stack in one process: store + controller
+manager, scheduler/instrumentor/autoscaler, per-node odiglets, and a live
+gateway Collector that hot-reloads the autoscaler-generated ConfigMap.
+``Scenario`` runs chainsaw-style step lists (apply / assert-with-timeout /
+script) against it. Chaos helpers flip fault injection on running
+components (the chaos-mesh network-latency analog).
+"""
+
+from .environment import E2EEnvironment  # noqa: F401
+from .scenario import Scenario, Step  # noqa: F401
+from .chaos import inject_exporter_chaos, clear_exporter_chaos  # noqa: F401

@@ -1,0 +1,32 @@
+"""Chaos helpers — the chaos-mesh network-latency / mockdestination
+fault-injection analog (SURVEY.md §4 item 6, §5.3).
+
+The reference injects faults at two levels: network latency between pipeline
+hops (tests/chaos/experiments/network-latency.yaml) and destination
+misbehavior (mockdestinationexporter reject_fraction/response_duration).
+Both map to mutating a live mockdestination exporter's config here; the
+memory-limiter/HPA reaction is what scenarios then assert.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .environment import E2EEnvironment
+
+
+def inject_exporter_chaos(env: E2EEnvironment, exporter_id: str, *,
+                          reject_fraction: Optional[float] = None,
+                          response_duration_ms: Optional[float] = None
+                          ) -> None:
+    """Flip fault knobs on a running mockdestination exporter."""
+    exp = env.gateway_component(exporter_id)
+    if reject_fraction is not None:
+        exp.config["reject_fraction"] = float(reject_fraction)
+    if response_duration_ms is not None:
+        exp.config["response_duration_ms"] = float(response_duration_ms)
+
+
+def clear_exporter_chaos(env: E2EEnvironment, exporter_id: str) -> None:
+    inject_exporter_chaos(env, exporter_id, reject_fraction=0.0,
+                          response_duration_ms=0.0)

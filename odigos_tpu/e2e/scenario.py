@@ -1,0 +1,79 @@
+"""Chainsaw-style scenario runner.
+
+A scenario is an ordered list of steps, each an apply / assert / script
+(tests/e2e/trace-collection/chainsaw-test.yaml:1-40 shape). ``assert``
+steps poll a predicate with a timeout — the level-triggered analog of
+chainsaw's assert resources.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .environment import E2EEnvironment
+
+ApplyFn = Callable[[E2EEnvironment], None]
+AssertFn = Callable[[E2EEnvironment], bool]
+
+
+@dataclass
+class Step:
+    name: str
+    apply: Optional[ApplyFn] = None
+    assert_fn: Optional[AssertFn] = None
+    script: Optional[ApplyFn] = None
+    timeout_s: float = 10.0
+
+
+@dataclass
+class StepResult:
+    step: str
+    ok: bool
+    elapsed_s: float
+    error: str = ""
+
+
+@dataclass
+class Scenario:
+    name: str
+    steps: list[Step] = field(default_factory=list)
+
+    def run(self, env: E2EEnvironment) -> list[StepResult]:
+        """Run all steps; stops at the first failure (chainsaw semantics).
+        Raises AssertionError with the failing step's name."""
+        results: list[StepResult] = []
+        for step in self.steps:
+            t0 = time.monotonic()
+            error = ""
+            ok = True
+            try:
+                if step.apply is not None:
+                    step.apply(env)
+                    env.reconcile()
+                if step.script is not None:
+                    step.script(env)
+                if step.assert_fn is not None:
+                    ok = self._poll(env, step)
+                    if not ok:
+                        error = "assert timed out"
+            except Exception as e:  # surfaced with step context below
+                ok, error = False, f"{type(e).__name__}: {e}"
+            results.append(StepResult(step.name, ok,
+                                      time.monotonic() - t0, error))
+            if not ok:
+                raise AssertionError(
+                    f"scenario {self.name!r} failed at step {step.name!r}: "
+                    f"{error}\ncompleted: {[r.step for r in results if r.ok]}")
+        return results
+
+    @staticmethod
+    def _poll(env: E2EEnvironment, step: Step) -> bool:
+        deadline = time.monotonic() + step.timeout_s
+        while time.monotonic() < deadline:
+            env.reconcile(rounds=1)
+            if step.assert_fn(env):
+                return True
+            time.sleep(0.02)
+        return False

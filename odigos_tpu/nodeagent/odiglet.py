@@ -167,15 +167,21 @@ class Odiglet:
         """Reconcile tracked processes with this node's current pods: pods
         that went away get their processes killed (rollout restart, scale
         down); new pods get processes spawned with their injected env —
-        the sim analog of kubelet starting containers."""
+        the sim analog of kubelet starting containers. New processes
+        trigger an InstrumentationConfig resync so runtime inspection runs
+        for workloads whose IC predates the pod (informer-resync role)."""
         current = {name: pod for name, pod in self.cluster.pods.items()
                    if pod.node == self.node}
         owned = {pod for (pod, _c) in self._pid_owner.values()}
         for name in owned - set(current):
             self.kill_pod_processes(name)
+        spawned = False
         for name, pod in current.items():
             if name not in owned:
                 self.spawn_pod_processes(pod)
+                spawned = True
+        if spawned:
+            self._mgr.enqueue_all("InstrumentationConfig")
 
     # ----------------------------------------------- pod/process plumbing
 

@@ -277,6 +277,13 @@ register(Factory(
     create=WireExporter, signals=(Signal.TRACES,),
     default_config=lambda: {"queue_size": 512}))
 
+# "otlp" alias for generated destination exporters (otlp/jaeger-... etc.);
+# config key "endpoint" carries host:port like the reference's otlp exporter
+register(Factory(
+    type_name="otlp", kind=ComponentKind.EXPORTER,
+    create=WireExporter, signals=(Signal.TRACES,),
+    default_config=lambda: {"queue_size": 512}))
+
 register(Factory(
     type_name="loadbalancing", kind=ComponentKind.EXPORTER,
     create=LoadBalancingExporter, signals=(Signal.TRACES,),

@@ -34,23 +34,33 @@ def watch_configmap(store: Store, namespace: str, name: str,
     maps ConfigMap.data to the collector config dict (default: data as-is).
     Returns an unsubscribe function. If the ConfigMap already exists, the
     collector is reloaded from it immediately (level-triggered start)."""
+    import threading
+
     state = {"hash": _content_hash(collector.config), "active": True}
+    lock = threading.Lock()
     extract = extract or (lambda data: data)
 
-    def apply(data: dict[str, Any]) -> None:
-        cfg = extract(data)
-        h = _content_hash(cfg)
-        if h == state["hash"]:
-            return
-        try:
-            collector.reload(cfg)
-        except Exception:
-            # bad generated config must not kill the running pipeline; keep
-            # serving the old graph (collector semantics: reload failures
-            # leave the previous service running)
-            meter.add("odigos_collector_reload_failures_total")
-            return
-        state["hash"] = h  # Collector.reload counts reloads itself
+    def apply_current() -> None:
+        """Re-read the CURRENT ConfigMap and converge to it. Events are
+        only triggers, never payloads: two racing events both land on the
+        store's latest object, so a stale event can never clobber a newer
+        config (level-triggered semantics). The lock serializes reloads."""
+        with lock:
+            cm = store.get("ConfigMap", namespace, name)
+            if cm is None:
+                return  # keep last good config, like a deleted CM in k8s
+            cfg = extract(cm.data)
+            h = _content_hash(cfg)
+            if h == state["hash"]:
+                return
+            try:
+                collector.reload(cfg)
+            except Exception:
+                # bad generated config must not kill the running pipeline;
+                # keep serving the old graph (collector reload semantics)
+                meter.add("odigos_collector_reload_failures_total")
+                return
+            state["hash"] = h  # Collector.reload counts reloads itself
 
     def on_event(event: Event) -> None:
         if not state["active"]:
@@ -58,15 +68,13 @@ def watch_configmap(store: Store, namespace: str, name: str,
         if event.kind != "ConfigMap" or event.key != (namespace, name):
             return
         if event.type == EventType.DELETED:
-            return  # keep last good config, like a deleted CM in k8s
-        apply(event.resource.data)
+            return
+        apply_current()
 
-    # watch-then-get: a ConfigMap applied between get and watch would be
-    # missed forever the other way around (level-triggered start)
+    # watch-then-apply: a write between the two is caught either by its own
+    # event or by the initial apply_current reading the latest state
     store.watch(on_event, kind="ConfigMap")
-    existing = store.get("ConfigMap", namespace, name)
-    if existing is not None:
-        apply(existing.data)
+    apply_current()
 
     def unsubscribe() -> None:
         state["active"] = False

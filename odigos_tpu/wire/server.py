@@ -203,3 +203,12 @@ register(Factory(
     create=WireReceiver, signals=(Signal.TRACES,),
     default_config=lambda: {"host": "127.0.0.1", "port": 0,
                             "max_inflight_bytes": 64 << 20}))
+
+# "otlp" alias: generated configs use the OTLP front-door name
+# (pipelinegen root pipelines, config_builder.go:184); this wire receiver
+# plays that role in our distro
+register(Factory(
+    type_name="otlp", kind=ComponentKind.RECEIVER,
+    create=WireReceiver, signals=(Signal.TRACES,),
+    default_config=lambda: {"host": "127.0.0.1", "port": 0,
+                            "max_inflight_bytes": 64 << 20}))
