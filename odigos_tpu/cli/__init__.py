@@ -1,0 +1,8 @@
+"""CLI layer — the cobra-command surface (SURVEY.md §2.5, cli/cmd/root.go):
+install / uninstall / status / sources / destinations / workloads /
+describe / diagnose / profile / demo / version, operating on a persisted
+local control-plane state (the kubeconfig-pointed-cluster role is played by
+a state directory holding the resource store + simulated cluster).
+"""
+
+from .commands import main  # noqa: F401

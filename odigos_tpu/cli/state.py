@@ -1,0 +1,121 @@
+"""Persisted CLI state: resource store + simulated cluster + config.
+
+The reference CLI talks to the k8s API; this CLI talks to a state dir
+(default ``~/.odigos-tpu`` or ``$ODIGOS_TPU_STATE``). Loading re-registers
+all controllers and reconciles, so every command is level-triggered exactly
+like a controller restart (SURVEY.md §5.4)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api.store import ControllerManager, Store
+from ..config.model import Configuration
+from ..controlplane import Autoscaler, Cluster, Instrumentor, Scheduler
+from ..nodeagent import Odiglet
+
+STATE_VERSION = 1
+
+
+def default_state_dir() -> str:
+    return os.environ.get(
+        "ODIGOS_TPU_STATE",
+        os.path.join(os.path.expanduser("~"), ".odigos-tpu"))
+
+
+@dataclass
+class CliState:
+    """A booted control plane over persisted resources."""
+
+    path: str
+    store: Store
+    cluster: Cluster
+    config: Configuration
+    manager: ControllerManager
+    scheduler: Scheduler
+    instrumentor: Instrumentor
+    autoscaler: Autoscaler
+    odiglets: list[Odiglet]
+
+    def reconcile(self, rounds: int = 3) -> None:
+        for _ in range(rounds):
+            self.manager.run_once()
+            for od in self.odiglets:
+                od.poll()
+
+    def save(self) -> None:
+        payload = {
+            "version": STATE_VERSION,
+            "store_objects": self.store._objects,
+            "cluster": self.cluster,
+            "config": self.config.to_dict(),
+        }
+        os.makedirs(self.path, exist_ok=True)
+        tmp = os.path.join(self.path, "state.pkl.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, os.path.join(self.path, "state.pkl"))
+
+
+def state_exists(path: Optional[str] = None) -> bool:
+    path = path or default_state_dir()
+    return os.path.exists(os.path.join(path, "state.pkl"))
+
+
+def _boot(path: str, store: Store, cluster: Cluster,
+          config: Configuration) -> CliState:
+    manager = ControllerManager(store)
+    scheduler = Scheduler(store, manager)
+    instrumentor = Instrumentor(store, manager, cluster, config)
+    autoscaler = Autoscaler(store, manager, config)
+    odiglets = [Odiglet(store, manager, cluster, node=n)
+                for n in cluster.nodes]
+    for od in odiglets:
+        od.run()
+    return CliState(path, store, cluster, config, manager, scheduler,
+                    instrumentor, autoscaler, odiglets)
+
+
+def create_state(path: Optional[str] = None, nodes: int = 1,
+                 config: Optional[Configuration] = None) -> CliState:
+    path = path or default_state_dir()
+    state = _boot(path, Store(), Cluster(nodes=nodes),
+                  config or Configuration())
+    state.scheduler.apply_authored(state.config)
+    state.reconcile()
+    return state
+
+
+def load_state(path: Optional[str] = None) -> CliState:
+    path = path or default_state_dir()
+    file = os.path.join(path, "state.pkl")
+    if not os.path.exists(file):
+        raise FileNotFoundError(
+            f"no odigos-tpu installation at {path} (run `install` first)")
+    with open(file, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("version") != STATE_VERSION:
+        raise RuntimeError(f"state version mismatch at {file}")
+    store = Store()
+    store._objects = payload["store_objects"]
+    cluster = payload["cluster"]
+    config = Configuration.from_dict(payload["config"])
+    state = _boot(path, store, cluster, config)
+    # resync: controllers resume from stored state (level-triggered)
+    for kind in list(store._objects):
+        state.manager.enqueue_all(kind)
+    state.reconcile()
+    return state
+
+
+def delete_state(path: Optional[str] = None) -> bool:
+    import shutil
+
+    path = path or default_state_dir()
+    if not os.path.isdir(path):
+        return False
+    shutil.rmtree(path)
+    return True

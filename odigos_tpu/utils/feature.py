@@ -1,0 +1,87 @@
+"""Feature gates keyed on platform-version maturity.
+
+Equivalent of k8sutils/pkg/feature (feature.go:22-48): each gate records the
+platform version at which it reached alpha/beta/GA; callers ask "is this
+enabled on the connected cluster/runtime version". Defaults mirror the
+reference's posture: beta and GA are on by default, alpha is opt-in.
+
+Our "platform" is the runtime pair (k8s-style control plane version for the
+deployment features, jax version for the TPU-path features); gates carry
+which axis they key on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+Version = tuple[int, int]
+
+
+def parse_version(v: str) -> Optional[Version]:
+    parts = v.lstrip("v").split(".")
+    try:
+        return int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
+    except (ValueError, IndexError):
+        return None
+
+
+@dataclass(frozen=True)
+class Gate:
+    name: str
+    axis: str  # "k8s" | "jax"
+    alpha: Optional[Version] = None
+    beta: Optional[Version] = None
+    ga: Optional[Version] = None
+
+    def stage(self, version: Version) -> Optional[str]:
+        if self.ga is not None and version >= self.ga:
+            return "ga"
+        if self.beta is not None and version >= self.beta:
+            return "beta"
+        if self.alpha is not None and version >= self.alpha:
+            return "alpha"
+        return None
+
+
+DEFAULT_GATES: tuple[Gate, ...] = (
+    # deployment-side (mirror the reference's k8s-maturity-keyed gates)
+    Gate("native-sidecar-containers", "k8s",
+         alpha=(1, 28), beta=(1, 29), ga=(1, 33)),
+    Gate("pod-level-resources", "k8s", alpha=(1, 32), beta=(1, 34)),
+    Gate("in-place-pod-resize", "k8s", alpha=(1, 27), beta=(1, 33)),
+    # TPU-path features keyed on jax maturity
+    Gate("shard-map-scoring", "jax", beta=(0, 4), ga=(0, 5)),
+    Gate("pallas-featurizer-kernels", "jax", alpha=(0, 4), beta=(0, 6)),
+    Gate("ring-attention-sp", "jax", beta=(0, 4)),
+)
+
+
+class Features:
+    """Resolved gate set for concrete platform versions."""
+
+    def __init__(self, k8s_version: str = "1.30", jax_version: str = "0.5",
+                 gates: tuple[Gate, ...] = DEFAULT_GATES,
+                 enable_alpha: bool = False):
+        self._versions = {"k8s": parse_version(k8s_version) or (0, 0),
+                          "jax": parse_version(jax_version) or (0, 0)}
+        self._gates = {g.name: g for g in gates}
+        self.enable_alpha = enable_alpha
+
+    def stage(self, name: str) -> Optional[str]:
+        gate = self._gates.get(name)
+        if gate is None:
+            return None
+        return gate.stage(self._versions[gate.axis])
+
+    def enabled(self, name: str) -> bool:
+        stage = self.stage(name)
+        if stage is None:
+            return False
+        return stage in ("beta", "ga") or (stage == "alpha"
+                                           and self.enable_alpha)
+
+    def snapshot(self) -> dict[str, dict]:
+        return {name: {"stage": self.stage(name),
+                       "enabled": self.enabled(name)}
+                for name in sorted(self._gates)}
