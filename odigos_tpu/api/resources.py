@@ -146,6 +146,18 @@ class WorkloadKind(str, enum.Enum):
     CRONJOB = "CronJob"
     NAMESPACE = "Namespace"
 
+    @classmethod
+    def parse(cls, s: str) -> "WorkloadKind":
+        """Case-insensitive lookup ('statefulset' → STATEFULSET); value
+        capitalization is not derivable from .capitalize() for the
+        multi-word kinds."""
+        try:
+            return cls[s.upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown workload kind {s!r} "
+                f"(known: {[k.value for k in cls]})") from None
+
 
 @dataclass(frozen=True)
 class WorkloadRef:
@@ -412,3 +424,24 @@ class ConfigMap(Resource):
     configmap.go:150; collectors hot-reload via the odigosk8scmprovider)."""
 
     data: dict[str, Any] = field(default_factory=dict)
+
+
+# ------------------------------------------------------------ kind registry
+
+
+def resource_class(kind: str) -> type:
+    """Resolve a store kind name (= class name) to its resource class —
+    the clientset-scheme lookup the reference generates
+    (api/generated/clientset)."""
+    cls = globals().get(kind)
+    if isinstance(cls, type) and issubclass(cls, Resource):
+        return cls
+    raise KeyError(f"unknown resource kind {kind!r}")
+
+
+def advance_uid_floor(floor: int) -> None:
+    """After loading persisted resources, move the uid counter past every
+    restored uid so new objects never collide."""
+    global _uid_counter
+    current = next(_uid_counter)
+    _uid_counter = itertools.count(max(current, floor + 1))

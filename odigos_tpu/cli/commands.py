@@ -1,0 +1,399 @@
+"""CLI commands — the cobra-command surface of the reference
+(cli/cmd/root.go:17: install / uninstall / ui / describe / diagnose /
+sources / profile ...), over a persisted local control plane (state.py).
+
+Every mutating command is level-triggered: load state (controllers
+re-register and resync), mutate resources, reconcile, save — a controller
+restart per invocation, which is exactly how the reference CLI relates to
+its cluster (SURVEY.md §3.1: the CLI applies resources; controllers do the
+work).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from .. import __version__
+from ..api.resources import (
+    DestinationResource, ObjectMeta, Source, WorkloadKind, WorkloadRef)
+from ..controlplane.cluster import Container
+from ..controlplane.scheduler import ODIGOS_NAMESPACE
+from .state import (
+    CliState, create_state, default_state_dir, delete_state, load_state,
+    state_exists)
+
+
+def _err(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    return 1
+
+
+def _load(args) -> CliState:
+    return load_state(args.state_dir)
+
+
+def _workload_ref(namespace: str, name: str, kind: str) -> WorkloadRef:
+    return WorkloadRef(namespace, WorkloadKind.parse(kind), name)
+
+
+# ---------------------------------------------------------------- commands
+
+
+def cmd_install(args) -> int:
+    if state_exists(args.state_dir):
+        return _err(f"already installed at "
+                    f"{args.state_dir or default_state_dir()} "
+                    "(run uninstall first)")
+    from ..config.model import Configuration, Tier
+    from ..config.profiles import resolve_profiles
+
+    config = Configuration(profiles=list(args.profile or []))
+    tier = Tier(args.tier)
+    _, unknown = resolve_profiles(config.profiles, tier)
+    if unknown:
+        return _err(f"unknown or tier-gated profiles: {unknown}")
+    state = create_state(path=args.state_dir, nodes=args.nodes,
+                         config=config)
+    state.save()
+    print(f"installed odigos-tpu (nodes={args.nodes}, tier={tier.value}, "
+          f"profiles={config.profiles or 'none'}) "
+          f"at {state.path}")
+    return 0
+
+
+def cmd_uninstall(args) -> int:
+    if not args.yes:
+        return _err("refusing to uninstall without --yes")
+    if delete_state(args.state_dir):
+        print("uninstalled")
+        return 0
+    return _err("nothing installed")
+
+
+def cmd_status(args) -> int:
+    from .describe import describe_install
+
+    print(describe_install(_load(args)))
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(f"odigos-tpu {__version__}")
+    return 0
+
+
+# ------------------------------------------------------------------ sources
+
+
+def cmd_sources(args) -> int:
+    state = _load(args)
+    if args.action == "list":
+        srcs = state.store.list("Source", namespace=args.namespace or None)
+        for s in srcs:
+            kind = ("namespace" if s.is_namespace_source
+                    else s.workload.kind.value)
+            mode = "disable" if s.disable_instrumentation else "enable"
+            print(f"{s.namespace}/{s.name}: {kind} {s.workload.name} "
+                  f"[{mode}]"
+                  + (f" streams={s.data_stream_names}"
+                     if s.data_stream_names else ""))
+        if not srcs:
+            print("(no sources)")
+        return 0
+    if args.action == "add":
+        ref = _workload_ref(args.namespace, args.name, args.kind)
+        state.store.apply(Source(
+            meta=ObjectMeta(name=f"src-{args.name}",
+                            namespace=args.namespace),
+            workload=ref,
+            disable_instrumentation=args.disable,
+            otel_service_name=args.service_name or "",
+            data_stream_names=list(args.stream or [])))
+        state.reconcile()
+        state.save()
+        print(f"source src-{args.name} applied for "
+              f"{args.namespace}/{ref.kind.value}/{args.name}")
+        return 0
+    if args.action == "remove":
+        if state.store.delete("Source", args.namespace, f"src-{args.name}"):
+            state.reconcile()
+            state.save()
+            print("source removed (workload will be un-instrumented)")
+            return 0
+        return _err(f"no source src-{args.name} in {args.namespace}")
+    return _err(f"unknown sources action {args.action}")
+
+
+# -------------------------------------------------------------- workloads
+
+
+def cmd_workloads(args) -> int:
+    state = _load(args)
+    if args.action == "list":
+        for w in state.cluster.workloads.values():
+            pods = state.cluster.pods_of(w.ref)
+            phases = ", ".join(f"{p.name}[{p.phase.value}]" for p in pods)
+            print(f"{w.ref.namespace}/{w.ref.kind.value}/{w.ref.name}: "
+                  f"replicas={w.replicas} {phases}")
+        if not state.cluster.workloads:
+            print("(no workloads)")
+        return 0
+    if args.action == "add":
+        state.cluster.add_workload(
+            args.namespace, args.name,
+            [Container("main", language=args.language,
+                       runtime_version=args.runtime_version)],
+            kind=WorkloadKind.parse(args.kind),
+            replicas=args.replicas)
+        state.reconcile()
+        state.save()
+        print(f"workload {args.namespace}/{args.name} added "
+              f"({args.language}, replicas={args.replicas})")
+        return 0
+    if args.action == "remove":
+        ref = _workload_ref(args.namespace, args.name, args.kind)
+        state.cluster.remove_workload(ref)
+        state.reconcile()
+        state.save()
+        print("workload removed")
+        return 0
+    return _err(f"unknown workloads action {args.action}")
+
+
+# ----------------------------------------------------------- destinations
+
+
+def cmd_destinations(args) -> int:
+    from ..components.api import Signal
+    from ..destinations import SPECS, get_spec, validate_destination
+
+    if args.action == "types":
+        for spec in sorted(SPECS.values(), key=lambda s: s.dest_type):
+            sigs = ",".join(s.value for s in Signal if spec.supports(s))
+            print(f"{spec.dest_type}: {spec.display_name} [{sigs}]")
+        return 0
+
+    state = _load(args)
+    if args.action == "list":
+        dests = state.store.list("DestinationResource")
+        for d in dests:
+            print(f"{d.name}: {d.dest_type} signals={d.signals}"
+                  + (f" streams={d.data_stream_names}"
+                     if d.data_stream_names else ""))
+        if not dests:
+            print("(no destinations)")
+        return 0
+    if args.action == "add":
+        try:
+            get_spec(args.type)
+        except KeyError:
+            return _err(f"unknown destination type {args.type!r} "
+                        "(see `destinations types`)")
+        config = {}
+        for kv in args.set or []:
+            if "=" not in kv:
+                return _err(f"--set expects key=value, got {kv!r}")
+            k, v = kv.split("=", 1)
+            config[k] = v
+        from ..destinations import Destination
+
+        dest = Destination(
+            id=args.name, dest_type=args.type,
+            signals=[Signal(s) for s in (args.signal or ["traces"])],
+            config=config,
+            data_stream_names=list(args.stream or []))
+        problems = validate_destination(dest)
+        if problems:
+            return _err("; ".join(problems))
+        state.store.apply(DestinationResource(
+            meta=ObjectMeta(name=args.name, namespace=ODIGOS_NAMESPACE),
+            dest_type=args.type,
+            signals=[s.value for s in dest.signals],
+            config=config,
+            data_stream_names=list(dest.data_stream_names)))
+        state.reconcile()
+        state.save()
+        print(f"destination {args.name} ({args.type}) applied")
+        return 0
+    if args.action == "remove":
+        if state.store.delete("DestinationResource", ODIGOS_NAMESPACE,
+                              args.name):
+            state.reconcile()
+            state.save()
+            print("destination removed")
+            return 0
+        return _err(f"no destination {args.name}")
+    return _err(f"unknown destinations action {args.action}")
+
+
+# -------------------------------------------------------------- profiles
+
+
+def cmd_profile(args) -> int:
+    from ..config.model import Tier
+    from ..config.profiles import available_profiles_for_tier
+
+    if args.action == "list":
+        state = _load(args) if state_exists(args.state_dir) else None
+        active = set(state.config.profiles) if state else set()
+        for p in available_profiles_for_tier(Tier(args.tier)):
+            mark = "*" if p.name in active else " "
+            print(f"{mark} {p.name}: {p.short_description}")
+        return 0
+    state = _load(args)
+    if args.action == "add":
+        if args.name in state.config.profiles:
+            return _err(f"profile {args.name} already active")
+        from ..config.profiles import resolve_profiles
+
+        _, unknown = resolve_profiles([args.name], Tier(args.tier))
+        if unknown:
+            return _err(f"unknown or tier-gated profile {args.name!r}")
+        state.config.profiles.append(args.name)
+        state.scheduler.apply_authored(state.config)
+        state.reconcile()
+        state.save()
+        print(f"profile {args.name} added")
+        return 0
+    if args.action == "remove":
+        if args.name not in state.config.profiles:
+            return _err(f"profile {args.name} not active")
+        state.config.profiles.remove(args.name)
+        state.scheduler.apply_authored(state.config)
+        state.reconcile()
+        state.save()
+        print(f"profile {args.name} removed")
+        return 0
+    return _err(f"unknown profile action {args.action}")
+
+
+# ----------------------------------------------------- describe / diagnose
+
+
+def cmd_describe(args) -> int:
+    from .describe import describe_install, describe_workload
+
+    state = _load(args)
+    if args.target == "odigos":
+        print(describe_install(state))
+        return 0
+    print(describe_workload(state, args.namespace, args.kind, args.name))
+    return 0
+
+
+def cmd_diagnose(args) -> int:
+    from .diagnose import collect_bundle
+
+    path = collect_bundle(_load(args), args.output)
+    print(f"bundle written: {path}")
+    return 0
+
+
+# ---------------------------------------------------------------- parser
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="odigos-tpu",
+        description="TPU-native distributed-tracing platform CLI")
+    ap.add_argument("--state-dir", default=None,
+                    help="state directory (default ~/.odigos-tpu or "
+                         "$ODIGOS_TPU_STATE)")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("install", help="install the control plane")
+    p.add_argument("--nodes", type=int, default=1)
+    p.add_argument("--profile", action="append")
+    p.add_argument("--tier", default="community",
+                   choices=["community", "cloud", "onprem"])
+    p.set_defaults(fn=cmd_install)
+
+    p = sub.add_parser("uninstall", help="delete the installation")
+    p.add_argument("--yes", action="store_true")
+    p.set_defaults(fn=cmd_uninstall)
+
+    p = sub.add_parser("status", help="installation summary")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("version")
+    p.set_defaults(fn=cmd_version)
+
+    p = sub.add_parser("sources", help="manage instrumentation sources")
+    p.add_argument("action", choices=["list", "add", "remove"])
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--name")
+    p.add_argument("--kind", default="deployment")
+    p.add_argument("--service-name")
+    p.add_argument("--stream", action="append")
+    p.add_argument("--disable", action="store_true",
+                   help="exclude instead of include")
+    p.set_defaults(fn=cmd_sources)
+
+    p = sub.add_parser("workloads", help="manage simulated workloads")
+    p.add_argument("action", choices=["list", "add", "remove"])
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--name")
+    p.add_argument("--kind", default="deployment")
+    p.add_argument("--language", default="python")
+    p.add_argument("--runtime-version", default="")
+    p.add_argument("--replicas", type=int, default=1)
+    p.set_defaults(fn=cmd_workloads)
+
+    p = sub.add_parser("destinations", help="manage export destinations")
+    p.add_argument("action", choices=["list", "add", "remove", "types"])
+    p.add_argument("--name")
+    p.add_argument("--type")
+    p.add_argument("--signal", action="append",
+                   choices=["traces", "metrics", "logs"])
+    p.add_argument("--set", action="append", metavar="KEY=VALUE")
+    p.add_argument("--stream", action="append")
+    p.set_defaults(fn=cmd_destinations)
+
+    p = sub.add_parser("profile", help="manage config profiles")
+    p.add_argument("action", choices=["list", "add", "remove"])
+    p.add_argument("--name")
+    p.add_argument("--tier", default="community",
+                   choices=["community", "cloud", "onprem"])
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("describe",
+                       help="explain one workload's instrumentation chain")
+    p.add_argument("target", choices=["odigos", "workload"])
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--kind", default="deployment")
+    p.add_argument("--name")
+    p.set_defaults(fn=cmd_describe)
+
+    p = sub.add_parser("diagnose", help="collect a support bundle")
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_diagnose)
+
+    return ap
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    needs_name = {
+        (cmd_sources, "add"), (cmd_sources, "remove"),
+        (cmd_workloads, "add"), (cmd_workloads, "remove"),
+        (cmd_destinations, "add"), (cmd_destinations, "remove"),
+        (cmd_profile, "add"), (cmd_profile, "remove"),
+    }
+    action = getattr(args, "action", None)
+    if (args.fn, action) in needs_name and not args.name:
+        return _err(f"--name is required for `{args.command} {action}`")
+    if args.fn is cmd_destinations and action == "add" and not args.type:
+        return _err("--type is required for `destinations add`")
+    if (args.fn is cmd_describe and args.target == "workload"
+            and not args.name):
+        return _err("--name is required for `describe workload`")
+    try:
+        return args.fn(args)
+    except (FileNotFoundError, ValueError) as e:
+        return _err(str(e))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

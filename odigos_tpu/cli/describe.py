@@ -1,0 +1,120 @@
+"""Describe engine — the source-of-truth introspection the reference ships
+in k8sutils/pkg/describe/ (odigos describe workload/source): walk one
+workload from Source → InstrumentationConfig conditions → runtime details →
+agent config → pipeline placement, and render the chain as text so an
+operator can see exactly where instrumentation stands and why.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.resources import (
+    InstrumentationConfig, WorkloadKind, WorkloadRef, condition_logical_order)
+from ..controlplane.scheduler import ODIGOS_NAMESPACE
+from .state import CliState
+
+_CHECK = {"True": "✓", "False": "✗", "Unknown": "?"}
+
+
+def _fmt_condition(c) -> str:
+    mark = _CHECK.get(c.status.value, "?")
+    msg = f" — {c.message}" if c.message else ""
+    return f"  [{mark}] {c.type}: {c.reason}{msg}"
+
+
+def workload_ic(state: CliState, ref: WorkloadRef
+                ) -> Optional[InstrumentationConfig]:
+    for ic in state.store.list("InstrumentationConfig"):
+        if ic.workload == ref:
+            return ic
+    return None
+
+
+def describe_workload(state: CliState, namespace: str, kind: str,
+                      name: str) -> str:
+    ref = WorkloadRef(namespace, WorkloadKind.parse(kind), name)
+    lines = [f"Workload: {namespace}/{ref.kind.value}/{name}"]
+
+    w = state.cluster.get_workload(ref)
+    if w is None:
+        lines.append("  (not present in cluster)")
+    else:
+        pods = state.cluster.pods_of(ref)
+        lines.append(f"  replicas: {w.replicas}, pods: "
+                     + (", ".join(f"{p.name}[{p.phase.value}]"
+                                  for p in pods) or "none"))
+
+    sources = [s for s in state.store.list("Source")
+               if s.workload == ref or
+               (s.is_namespace_source and s.workload.namespace == namespace)]
+    if not sources:
+        lines.append("Source: none (not marked for instrumentation)")
+    for s in sources:
+        scope = "namespace" if s.is_namespace_source else "workload"
+        verb = "disabled" if s.disable_instrumentation else "enabled"
+        lines.append(f"Source: {s.namespace}/{s.name} ({scope}, {verb})"
+                     + (f" streams={s.data_stream_names}"
+                        if s.data_stream_names else ""))
+
+    ic = workload_ic(state, ref)
+    if ic is None:
+        lines.append("InstrumentationConfig: none")
+        return "\n".join(lines)
+
+    lines.append(f"InstrumentationConfig: {ic.namespace}/{ic.name} "
+                 f"(service {ic.service_name or name})")
+    for c in sorted(ic.conditions,
+                    key=lambda c: condition_logical_order(c.type)):
+        lines.append(_fmt_condition(c))
+    for rd in ic.runtime_details:
+        lines.append(f"  runtime[{rd.container_name}]: {rd.language} "
+                     f"{rd.runtime_version} ({rd.libc_type})")
+    for ca in ic.containers:
+        state_s = "enabled" if ca.agent_enabled else "disabled"
+        lines.append(f"  agent[{ca.container_name}]: {state_s} "
+                     f"distro={ca.distro_name or '-'} ({ca.reason.value})")
+
+    # pipeline placement: which data-stream pipelines will carry its spans
+    from ..controlplane.autoscaler import GATEWAY_CONFIG_NAME
+
+    streams = ic.data_stream_names or ["default"]
+    cm = state.store.get("ConfigMap", ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)
+    placed = []
+    if cm is not None:
+        pipelines = (cm.data.get("collector-conf", {})
+                     .get("service", {}).get("pipelines", {}))
+        for stream in streams:
+            placed += [p for p in pipelines
+                       if p.endswith(f"/{stream}") or stream in p]
+    lines.append(f"Pipeline placement: streams={streams} "
+                 f"pipelines={sorted(set(placed)) or '(gateway not rendered)'}")
+    return "\n".join(lines)
+
+
+def describe_install(state: CliState) -> str:
+    """Cluster-level summary (odigos describe odigos)."""
+    lines = ["odigos-tpu installation"]
+    lines.append(f"  state dir: {state.path}")
+    lines.append(f"  nodes: {len(state.cluster.nodes)}")
+    lines.append(f"  profiles: {state.config.profiles or '(none)'}")
+    for cg in state.store.list("CollectorsGroup"):
+        ready = "ready" if cg.ready else "not-ready"
+        extra = (f", tpu_replicas={cg.tpu_replicas}"
+                 if cg.tpu_replicas else "")
+        lines.append(f"  collectors[{cg.role.value}]: {ready}{extra}")
+        for c in cg.conditions:
+            lines.append("  " + _fmt_condition(c))
+    dests = state.store.list("DestinationResource")
+    lines.append(f"  destinations: {len(dests)}")
+    for d in dests:
+        lines.append(f"    {d.name}: {d.dest_type} signals={d.signals}")
+        for c in d.conditions:
+            lines.append("  " + _fmt_condition(c))
+    ics = state.store.list("InstrumentationConfig")
+    lines.append(f"  instrumented workloads: {len(ics)}")
+    for ic in ics:
+        ok = sum(1 for c in ic.conditions if c.status.value == "True")
+        lines.append(f"    {ic.workload.namespace}/{ic.workload.name}: "
+                     f"{ok}/{len(ic.conditions)} conditions true")
+    return "\n".join(lines)

@@ -1,0 +1,62 @@
+"""Diagnose — support-bundle collection (odigos diagnose;
+cli/cmd/diagnose.go + k8sutils/pkg/diagnose/ in the reference): dump the
+full installation state, effective config, self-telemetry metrics snapshot,
+and environment info into one tar.gz an operator can attach to a bug report.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import platform
+import tarfile
+import time
+from typing import Optional
+
+from ..controlplane.scheduler import (
+    EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE)
+from ..utils.serde import to_jsonable
+from ..utils.telemetry import meter
+from .describe import describe_install
+from .state import CliState
+
+
+def _add_file(tar: tarfile.TarFile, name: str, content: str) -> None:
+    data = content.encode()
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = int(time.time())
+    tar.addfile(info, io.BytesIO(data))
+
+
+def collect_bundle(state: CliState, out_path: Optional[str] = None) -> str:
+    """Write the support bundle; returns its path."""
+    out_path = out_path or os.path.join(
+        state.path, f"odigos-diagnose-{int(time.time())}.tar.gz")
+    with tarfile.open(out_path, "w:gz") as tar:
+        # resources, kind by kind (the kubectl-get-everything analog)
+        for kind, objs in sorted(state.store._objects.items()):
+            dump = json.dumps([to_jsonable(r) for r in objs.values()],
+                              indent=1, sort_keys=True)
+            _add_file(tar, f"resources/{kind}.json", dump)
+        _add_file(tar, "cluster.json",
+                  json.dumps(state.cluster.to_dict(), indent=1))
+        _add_file(tar, "config/authored.json",
+                  json.dumps(state.config.to_dict(), indent=1))
+        eff = state.store.get("ConfigMap", ODIGOS_NAMESPACE,
+                              EFFECTIVE_CONFIG_NAME)
+        if eff is not None:
+            _add_file(tar, "config/effective.json",
+                      json.dumps(to_jsonable(eff.data), indent=1))
+        # self-telemetry snapshot (the pprof/metrics piece of the bundle)
+        _add_file(tar, "metrics.json",
+                  json.dumps(meter.snapshot(), indent=1, sort_keys=True))
+        _add_file(tar, "describe.txt", describe_install(state))
+        _add_file(tar, "environment.json", json.dumps({
+            "python": platform.python_version(),
+            "platform": platform.platform(),
+            "state_dir": state.path,
+            "collected_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        }, indent=1))
+    return out_path

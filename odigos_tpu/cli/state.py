@@ -7,17 +7,22 @@ like a controller restart (SURVEY.md §5.4)."""
 
 from __future__ import annotations
 
+import json
 import os
-import pickle
 from dataclasses import dataclass
 from typing import Optional
 
+from ..api.resources import advance_uid_floor, resource_class
 from ..api.store import ControllerManager, Store
 from ..config.model import Configuration
 from ..controlplane import Autoscaler, Cluster, Instrumentor, Scheduler
 from ..nodeagent import Odiglet
+from ..utils.serde import from_jsonable, to_jsonable
 
-STATE_VERSION = 1
+# v2: JSON via utils.serde (v1 was pickle — arbitrary code execution on a
+# tampered state file, and fragile across code changes)
+STATE_VERSION = 2
+STATE_FILE = "state.json"
 
 
 def default_state_dir() -> str:
@@ -47,22 +52,26 @@ class CliState:
                 od.poll()
 
     def save(self) -> None:
+        resources = {
+            kind: [to_jsonable(r) for r in objs.values()]
+            for kind, objs in self.store._objects.items()
+        }
         payload = {
             "version": STATE_VERSION,
-            "store_objects": self.store._objects,
-            "cluster": self.cluster,
+            "resources": resources,
+            "cluster": self.cluster.to_dict(),
             "config": self.config.to_dict(),
         }
         os.makedirs(self.path, exist_ok=True)
-        tmp = os.path.join(self.path, "state.pkl.tmp")
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, os.path.join(self.path, "state.pkl"))
+        tmp = os.path.join(self.path, STATE_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, os.path.join(self.path, STATE_FILE))
 
 
 def state_exists(path: Optional[str] = None) -> bool:
     path = path or default_state_dir()
-    return os.path.exists(os.path.join(path, "state.pkl"))
+    return os.path.exists(os.path.join(path, STATE_FILE))
 
 
 def _boot(path: str, store: Store, cluster: Cluster,
@@ -91,17 +100,25 @@ def create_state(path: Optional[str] = None, nodes: int = 1,
 
 def load_state(path: Optional[str] = None) -> CliState:
     path = path or default_state_dir()
-    file = os.path.join(path, "state.pkl")
+    file = os.path.join(path, STATE_FILE)
     if not os.path.exists(file):
         raise FileNotFoundError(
             f"no odigos-tpu installation at {path} (run `install` first)")
-    with open(file, "rb") as f:
-        payload = pickle.load(f)
+    with open(file) as f:
+        payload = json.load(f)
     if payload.get("version") != STATE_VERSION:
         raise RuntimeError(f"state version mismatch at {file}")
     store = Store()
-    store._objects = payload["store_objects"]
-    cluster = payload["cluster"]
+    max_uid = 0
+    for kind, items in payload["resources"].items():
+        cls = resource_class(kind)
+        bucket = store._objects.setdefault(kind, {})
+        for item in items:
+            r = from_jsonable(cls, item)
+            bucket[r.meta.key] = r
+            max_uid = max(max_uid, r.meta.uid)
+    advance_uid_floor(max_uid)
+    cluster = Cluster.from_dict(payload["cluster"])
     config = Configuration.from_dict(payload["config"])
     state = _boot(path, store, cluster, config)
     # resync: controllers resume from stored state (level-triggered)

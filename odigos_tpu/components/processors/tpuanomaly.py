@@ -19,7 +19,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ...features.featurizer import FeaturizerConfig, featurize
+from ...features.featurizer import FeaturizerConfig
 from ...pdata.spans import SpanBatch
 from ...serving.engine import EngineConfig, ScoringEngine
 from ...utils.telemetry import meter
@@ -71,7 +71,9 @@ def _engine_for(cfg: EngineConfig, shared: bool) -> ScoringEngine:
 
 class TpuAnomalyProcessor(Processor):
     """Config:
-    model: zscore | transformer | autoencoder | mock
+    model: zscore | transformer | autoencoder | mock | remote
+    socket_path: unix socket of an out-of-process scoring sidecar
+        (model "remote"; serving/sidecar.py)
     threshold: score in [0,1] above which a span is tagged (default 0.8)
     timeout_ms: scoring latency budget before pass-through (default 5.0)
     attr_slots / max_len / trace_bucket / online_update / checkpoint_path:
@@ -84,14 +86,26 @@ class TpuAnomalyProcessor(Processor):
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         fz = FeaturizerConfig(attr_slots=int(config.get("attr_slots", 0)))
+        model = config.get("model", "zscore")
+        # a `model_config` mapping sizes the sequence model from pipeline
+        # config (d_model, max_len, vocabs, dtype-by-name...); the factory —
+        # not the caller — knows how to build the frozen config dataclass
+        # (odigossamplingprocessor/factory.go:13 seam)
+        model_config = config.get("model_config")
+        if isinstance(model_config, dict):
+            from ...training.checkpoint import make_model_config
+
+            model_config = make_model_config(model, model_config)
         self.engine_cfg = EngineConfig(
-            model=config.get("model", "zscore"),
+            model=model,
             max_batch_spans=int(config.get("max_batch", 65536)),
             max_len=int(config.get("max_len", 64)),
             trace_bucket=int(config.get("trace_bucket", 256)),
             online_update=bool(config.get("online_update", True)),
             featurizer=fz,
+            model_config=model_config,
             checkpoint_path=config.get("checkpoint_path"),
+            socket_path=config.get("socket_path"),
             seed=int(config.get("seed", 0)),
         )
         self.engine = _engine_for(self.engine_cfg,
@@ -110,8 +124,9 @@ class TpuAnomalyProcessor(Processor):
         super().shutdown()
 
     def process(self, batch: SpanBatch) -> Optional[SpanBatch]:
-        features = featurize(batch, self.engine_cfg.featurizer)
-        scores = self.engine.score_sync(batch, features,
+        # the engine featurizes (or skips it for remote backends, which
+        # featurize sidecar-side); passing None avoids doing it twice
+        scores = self.engine.score_sync(batch, None,
                                         timeout_s=self.timeout_s)
         if scores is None:  # timeout / queue full: pass through untagged
             return batch

@@ -182,3 +182,39 @@ class Cluster:
         for p in self.pods_of(ref):
             p.phase = PodPhase.RUNNING
             p.phase_since = time.time()
+
+    # -------------------------------------------------------- persistence
+
+    def to_dict(self) -> dict:
+        """JSON-safe snapshot (CLI state dir / diagnose bundle); admission
+        hooks are runtime wiring and re-register on boot."""
+        from ..utils.serde import to_jsonable
+
+        pod_n = next(self._pod_counter)
+        self._pod_counter = itertools.count(pod_n)  # peek without skipping
+        rr_n = next(self._node_rr)
+        self._node_rr = itertools.count(rr_n)
+        return {
+            "nodes": list(self.nodes),
+            "workloads": {k: to_jsonable(w)
+                          for k, w in self.workloads.items()},
+            "pods": {k: to_jsonable(p) for k, p in self.pods.items()},
+            "fail_next": {k: v.value for k, v in self._fail_next.items()},
+            "pod_counter": pod_n,
+            "node_rr": rr_n,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Cluster":
+        from ..utils.serde import from_jsonable
+
+        c = cls(nodes=1)
+        c.nodes = list(data["nodes"])
+        c.workloads = {k: from_jsonable(Workload, w)
+                       for k, w in data["workloads"].items()}
+        c.pods = {k: from_jsonable(Pod, p) for k, p in data["pods"].items()}
+        c._fail_next = {k: PodPhase(v)
+                        for k, v in data.get("fail_next", {}).items()}
+        c._pod_counter = itertools.count(data.get("pod_counter", 1))
+        c._node_rr = itertools.count(data.get("node_rr", 0))
+        return c

@@ -232,7 +232,9 @@ def get_spec(dest_type: str) -> DestinationSpec:
 
 
 def validate_destination(dest: Destination) -> list[str]:
-    """Schema validation: type exists, enabled signals are supported."""
+    """Schema validation: type exists, enabled signals are supported,
+    required fields are present (the create-time check the reference runs
+    in its UI/CLI wizard before the configer ever sees the destination)."""
     problems = []
     spec = SPECS.get(dest.dest_type)
     if spec is None:
@@ -243,4 +245,18 @@ def validate_destination(dest: Destination) -> list[str]:
                 f"destination {dest.id}: {dest.dest_type} does not support {sig.value}")
     if not dest.signals:
         problems.append(f"destination {dest.id}: no signals enabled")
+    if not problems:
+        # dry-run the configer against scratch config: it is the table
+        # that knows which fields are required, so create-time validation
+        # catches "required field X not set" before the resource is applied
+        # (the reference's UI/CLI wizard check)
+        from .configers import modify_config
+
+        try:
+            modify_config(dest, {"exporters": {}, "processors": {},
+                                 "connectors": {},
+                                 "service": {"pipelines": {}}})
+        except Exception as e:  # noqa: BLE001 — a recipe crash (bad field
+            # value, parse error) IS the validation failure to report
+            problems.append(f"destination {dest.id}: {e}")
     return problems

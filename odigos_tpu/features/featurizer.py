@@ -292,34 +292,73 @@ def pack_sequences(batch: SpanBatch,
             np.zeros((R, max_len), np.int32),
             np.full((R, max_len), -1, np.int32))
 
-    from ..pdata.traces import trace_keys
+    # one integer lexsort groups spans by trace and time-orders them; a
+    # structured-dtype np.unique here costs ~3 ms at 8k spans (generic
+    # compares), which alone would blow the <5 ms serving budget
+    hi = batch.col("trace_id_hi")
+    lo = batch.col("trace_id_lo")
+    order = np.lexsort((batch.col("start_unix_nano"), lo, hi))
+    hi_s = hi[order]
+    lo_s = lo[order]
+    new_trace = np.empty(n, bool)
+    new_trace[0] = True
+    np.logical_or(hi_s[1:] != hi_s[:-1], lo_s[1:] != lo_s[:-1],
+                  out=new_trace[1:])
+    inv_sorted = np.cumsum(new_trace) - 1  # dense trace ordinal, sorted order
 
-    _, inverse = np.unique(trace_keys(batch), return_inverse=True)
-    order = np.lexsort((batch.col("start_unix_nano"), inverse))
-    inv_sorted = inverse[order]
-    boundaries = np.nonzero(np.diff(inv_sorted))[0] + 1
-    trace_slices = np.split(order, boundaries)  # list of row-index arrays
+    # ---- vectorized chunking: every span gets a (segment, within-chunk
+    # position); segments are (trace, chunk) pairs, ≤ max_len spans each.
+    # All span-level work is numpy; the only Python loop is the first-fit
+    # scan over segments (ints, ~n_traces iterations) — this path sits on
+    # the <5 ms serving budget, so per-trace array allocation is banned.
+    T = int(inv_sorted[-1]) + 1 if n else 0
+    counts = np.bincount(inv_sorted, minlength=T)
+    first_idx = np.zeros(T, np.int64)
+    np.cumsum(counts[:-1], out=first_idx[1:])
+    pos_in_trace = np.arange(n, dtype=np.int64) - first_idx[inv_sorted]
+    chunk_of_span = pos_in_trace // max_len
+    pos_in_chunk = (pos_in_trace % max_len).astype(np.int32)
 
-    rows: list[list[np.ndarray]] = []   # per row: list of chunk arrays
+    n_chunks = (counts + max_len - 1) // max_len  # per trace
+    seg_first = np.zeros(T, np.int64)
+    np.cumsum(n_chunks[:-1], out=seg_first[1:])
+    total_segs = int(seg_first[-1] + n_chunks[-1]) if T else 0
+    # segment lengths: max_len everywhere, remainder on each trace's last
+    seg_len = np.full(total_segs, max_len, np.int64)
+    last_seg = seg_first + n_chunks - 1
+    seg_len[last_seg] = counts - (n_chunks - 1) * max_len
+    span_seg = seg_first[inv_sorted] + chunk_of_span
+
+    # ---- first-fit over segments with bounded lookback (O(segments));
+    # plain-int list ops only — numpy scalar writes in this loop would
+    # triple its cost
+    seg_row_l: list[int] = []
+    seg_off_l: list[int] = []
+    seg_slot_l: list[int] = []  # 1-based id within its row
     row_fill: list[int] = []
-    for rows_of_trace in trace_slices:
-        # split over-long traces into max_len chunks
-        for lo in range(0, len(rows_of_trace), max_len):
-            chunk = rows_of_trace[lo:lo + max_len]
-            placed = False
-            # first-fit over the last few open rows (bounded lookback keeps
-            # packing O(traces))
-            for ri in range(len(rows) - 1, max(len(rows) - 8, -1), -1):
-                if row_fill[ri] + len(chunk) <= max_len:
-                    rows[ri].append(chunk)
-                    row_fill[ri] += len(chunk)
-                    placed = True
-                    break
-            if not placed:
-                rows.append([chunk])
-                row_fill.append(len(chunk))
+    row_nseg: list[int] = []
+    for k in seg_len.tolist():
+        n_rows = len(row_fill)
+        placed = -1
+        lo_ri = n_rows - 8 if n_rows > 8 else -1
+        for ri in range(n_rows - 1, lo_ri, -1):
+            if row_fill[ri] + k <= max_len:
+                placed = ri
+                break
+        if placed < 0:
+            placed = n_rows
+            row_fill.append(0)
+            row_nseg.append(0)
+        seg_row_l.append(placed)
+        seg_off_l.append(row_fill[placed])
+        seg_slot_l.append(row_nseg[placed] + 1)
+        row_fill[placed] += k
+        row_nseg[placed] += 1
+    seg_row = np.asarray(seg_row_l, np.int64)
+    seg_off = np.asarray(seg_off_l, np.int64)
+    seg_slot = np.asarray(seg_slot_l, np.int64)
 
-    R_real = len(rows)
+    R_real = len(row_fill)
     if pad_rows_to:
         R = ((R_real + pad_rows_to - 1) // pad_rows_to) * pad_rows_to
     else:
@@ -330,15 +369,11 @@ def pack_sequences(batch: SpanBatch,
     positions = np.zeros((R, max_len), np.int32)
     span_index = np.full((R, max_len), -1, np.int32)
 
-    for ri, chunks in enumerate(rows):
-        off = 0
-        for si, chunk in enumerate(chunks):
-            k = len(chunk)
-            sl = slice(off, off + k)
-            cat[ri, sl] = features.categorical[chunk]
-            cont[ri, sl] = features.continuous[chunk]
-            segments[ri, sl] = si + 1
-            positions[ri, sl] = np.arange(k)
-            span_index[ri, sl] = chunk.astype(np.int32)
-            off += k
+    span_row = seg_row[span_seg]
+    span_col = seg_off[span_seg] + pos_in_chunk
+    cat[span_row, span_col] = features.categorical[order]
+    cont[span_row, span_col] = features.continuous[order]
+    segments[span_row, span_col] = seg_slot[span_seg]
+    positions[span_row, span_col] = pos_in_chunk
+    span_index[span_row, span_col] = order
     return PackedSequences(cat, cont, segments, positions, span_index)

@@ -48,7 +48,7 @@ COLD_METRIC = "odigos_anomaly_cold_spans_total"
 
 @dataclass(frozen=True)
 class EngineConfig:
-    model: str = "zscore"  # zscore | transformer | autoencoder | mock
+    model: str = "zscore"  # zscore | transformer | autoencoder | mock | remote
     max_queue: int = 64          # pending requests bound
     max_batch_spans: int = 65536  # coalescing cap per device call
     max_len: int = 64            # sequence models: spans per trace
@@ -57,6 +57,8 @@ class EngineConfig:
     featurizer: FeaturizerConfig = field(default_factory=FeaturizerConfig)
     model_config: Optional[Any] = None  # TransformerConfig / AutoencoderConfig
     checkpoint_path: Optional[str] = None
+    socket_path: Optional[str] = None  # model "remote": sidecar unix socket
+    remote_timeout_s: float = 10.0  # model "remote": per-call socket deadline
     seed: int = 0
 
 
@@ -113,22 +115,44 @@ class SequenceBackend:
         import jax
 
         self.cfg = cfg
+        model_config = cfg.model_config
+        variables = None
+        if cfg.checkpoint_path:
+            # serving bundle (training/checkpoint.py): the artifact carries
+            # the model geometry, so a pipeline config only needs the path
+            from ..training.checkpoint import load_bundle
+
+            bundle = load_bundle(cfg.checkpoint_path)
+            if bundle.model != cfg.model:
+                raise ValueError(
+                    f"checkpoint {cfg.checkpoint_path} holds a "
+                    f"{bundle.model!r} model but the engine is configured "
+                    f"for {cfg.model!r}")
+            if model_config is not None and model_config != bundle.model_config:
+                # an explicit geometry that disagrees with the restored
+                # weights would mis-index silently (e.g. a too-long
+                # positional table clamps instead of erroring)
+                raise ValueError(
+                    f"model_config disagrees with checkpoint "
+                    f"{cfg.checkpoint_path}: {model_config} vs "
+                    f"{bundle.model_config}")
+            model_config = bundle.model_config
+            variables = bundle.variables
         if cfg.model == "transformer":
             from ..models.transformer import TraceTransformer, TransformerConfig
 
-            self.model = TraceTransformer(cfg.model_config or TransformerConfig(
+            self.model = TraceTransformer(model_config or TransformerConfig(
                 attr_slots=cfg.featurizer.attr_slots))
         else:
             from ..models.autoencoder import AutoencoderConfig, SpanAutoencoder
 
-            self.model = SpanAutoencoder(cfg.model_config or AutoencoderConfig(
+            self.model = SpanAutoencoder(model_config or AutoencoderConfig(
                 attr_slots=cfg.featurizer.attr_slots))
-        if cfg.checkpoint_path:
-            from ..train.checkpoint import restore_variables
-
-            self.variables = restore_variables(cfg.checkpoint_path)
-        else:
-            self.variables = self.model.init(jax.random.PRNGKey(cfg.seed))
+        # the model's positional table bounds the sequence geometry: never
+        # pack longer rows than the (possibly restored) model can embed
+        self.max_len = min(cfg.max_len, self.model.cfg.max_len)
+        self.variables = variables if variables is not None else \
+            self.model.init(jax.random.PRNGKey(cfg.seed))
 
     def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
         import jax.numpy as jnp
@@ -138,7 +162,7 @@ class SequenceBackend:
             # naive per-trace padding (bench.py measures this path)
             from ..features.featurizer import pack_sequences
 
-            packed = pack_sequences(batch, features, max_len=self.cfg.max_len,
+            packed = pack_sequences(batch, features, max_len=self.max_len,
                                     pad_rows_to=self.cfg.trace_bucket)
             span_scores = np.asarray(self.model.score_packed(
                 self.variables, jnp.asarray(packed.categorical),
@@ -150,7 +174,7 @@ class SequenceBackend:
             return out
 
         seqs = assemble_sequences(
-            batch, features, max_len=self.cfg.max_len,
+            batch, features, max_len=self.max_len,
             pad_traces_to=self.cfg.trace_bucket)
         span_scores, _ = self.model.score_spans(
             self.variables, jnp.asarray(seqs.categorical),
@@ -165,11 +189,18 @@ class SequenceBackend:
         return out
 
 
+def _remote_backend(cfg: "EngineConfig"):
+    from .sidecar import RemoteBackend
+
+    return RemoteBackend(cfg)
+
+
 _BACKENDS = {
     "mock": MockBackend,
     "zscore": ZScoreBackend,
     "transformer": SequenceBackend,
     "autoencoder": SequenceBackend,
+    "remote": _remote_backend,
 }
 
 
@@ -220,8 +251,11 @@ class ScoringEngine:
     def submit(self, batch: SpanBatch,
                features: Optional[SpanFeatures] = None) -> Optional[ScoreRequest]:
         """Enqueue for scoring; returns None (and counts) if queue is full."""
-        features = features if features is not None else featurize(
-            batch, self.cfg.featurizer)
+        if features is None and getattr(self.backend, "needs_features", True):
+            # a remote backend ships the raw batch and the sidecar
+            # featurizes server-side; featurizing here too would pay the
+            # host cost twice against the latency budget
+            features = featurize(batch, self.cfg.featurizer)
         req = ScoreRequest(batch=batch, features=features,
                            submitted_ns=time.monotonic_ns())
         try:
@@ -288,9 +322,11 @@ class ScoringEngine:
             from ..pdata.spans import concat_batches
 
             merged = concat_batches([r.batch for r in reqs])
-            feats = SpanFeatures(
-                np.concatenate([r.features.categorical for r in reqs]),
-                np.concatenate([r.features.continuous for r in reqs]))
+            feats = None
+            if all(r.features is not None for r in reqs):
+                feats = SpanFeatures(
+                    np.concatenate([r.features.categorical for r in reqs]),
+                    np.concatenate([r.features.continuous for r in reqs]))
             scores = self.backend.score(merged, feats)
             off = 0
             for r in reqs:

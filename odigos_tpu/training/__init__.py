@@ -9,3 +9,6 @@ durability requirement relative to the reference (SURVEY.md §5.4).
 from .data import LabeledSequences, labeled_sequences, training_stream  # noqa: F401
 from .trainer import TrainConfig, Trainer, TrainResult  # noqa: F401
 from .evaluate import evaluate_detector, roc_auc  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    ServingBundle, load_bundle, make_model_config, restore_variables,
+    save_bundle)

@@ -112,6 +112,15 @@ class Trainer:
                                args=ocp.args.StandardRestore(template))
         return step, restored
 
+    def export(self, path: str, variables) -> str:
+        """Export trained variables as a serving bundle that
+        serving.SequenceBackend (and the tpuanomaly processor's
+        ``checkpoint_path`` config) can load directly."""
+        from .checkpoint import save_bundle
+
+        return save_bundle(path, variables, model=self.config.model,
+                           model_config=self.model.cfg)
+
     # ------------------------------------------------------------- training
 
     def _init_variables(self):

@@ -1,0 +1,118 @@
+"""Type-driven dataclass⇄JSON serialization.
+
+Replaces pickle for durable state (a tampered pickle is arbitrary code
+execution; JSON is inert data) and backs every surface that ships resources
+across a boundary: the CLI state dir, the diagnose bundle, and the operator
+HTTP API. The reference's analog is the generated CRD clientset — typed
+objects with a fixed JSON shape (api/generated/) — which we get from the
+dataclass field types themselves instead of code generation.
+
+``to_jsonable`` lowers dataclasses/enums/numpy to plain JSON types;
+``from_jsonable(tp, data)`` rebuilds the typed object from the target
+type's hints (Optional / list / tuple / dict / nested dataclasses / enums).
+Round trip contract: ``from_jsonable(type(x), to_jsonable(x)) == x`` for
+any tree of dataclasses with JSON-compatible leaf types.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Optional, Union
+
+_PRIMITIVES = (str, int, float, bool, type(None))
+
+
+def to_jsonable(obj: Any) -> Any:
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, _PRIMITIVES):
+        return obj
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: to_jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if isinstance(k, enum.Enum):
+                k = k.value
+            elif not isinstance(k, str):
+                k = str(k)
+            out[k] = to_jsonable(v)
+        return out
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_jsonable(v) for v in obj]
+    # numpy scalars / arrays without importing numpy eagerly
+    item = getattr(obj, "item", None)
+    if callable(item) and getattr(obj, "shape", None) == ():
+        return obj.item()
+    tolist = getattr(obj, "tolist", None)
+    if callable(tolist):
+        return to_jsonable(obj.tolist())
+    raise TypeError(f"cannot serialize {type(obj).__name__}: {obj!r}")
+
+
+def _resolve_hints(cls: type) -> dict[str, Any]:
+    # get_type_hints resolves "from __future__ import annotations" strings
+    return typing.get_type_hints(cls)
+
+
+def from_jsonable(tp: Any, data: Any) -> Any:
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+
+    if tp is Any or tp is None:
+        return data
+    if origin is Union:  # Optional[T] and general unions
+        if data is None and type(None) in args:
+            return None
+        last_err: Optional[Exception] = None
+        for cand in args:
+            if cand is type(None):
+                continue
+            try:
+                return from_jsonable(cand, data)
+            except (TypeError, ValueError, KeyError) as e:
+                last_err = e
+        raise TypeError(f"no union member of {tp} accepts {data!r}: "
+                        f"{last_err}")
+    if origin in (list, set, frozenset):
+        elem = args[0] if args else Any
+        seq = [from_jsonable(elem, v) for v in data]
+        return origin(seq) if origin is not list else seq
+    if origin is tuple:
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(from_jsonable(args[0], v) for v in data)
+        if args:
+            return tuple(from_jsonable(a, v) for a, v in zip(args, data))
+        return tuple(data)
+    if origin is dict:
+        kt = args[0] if args else Any
+        vt = args[1] if len(args) > 1 else Any
+        return {_key_from(kt, k): from_jsonable(vt, v)
+                for k, v in data.items()}
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(data)
+    if dataclasses.is_dataclass(tp):
+        hints = _resolve_hints(tp)
+        kwargs = {}
+        for f in dataclasses.fields(tp):
+            if f.name in data:
+                kwargs[f.name] = from_jsonable(hints[f.name], data[f.name])
+        return tp(**kwargs)
+    if tp in (int, float, str, bool):
+        if tp is float and isinstance(data, int):
+            return float(data)
+        if not isinstance(data, tp):
+            raise TypeError(f"expected {tp.__name__}, got {type(data).__name__}")
+        return data
+    return data
+
+
+def _key_from(kt: Any, key: str) -> Any:
+    if kt is int:
+        return int(key)
+    if isinstance(kt, type) and issubclass(kt, enum.Enum):
+        return kt(key)
+    return key
