@@ -80,8 +80,10 @@ def _boot(path: str, store: Store, cluster: Cluster,
     scheduler = Scheduler(store, manager)
     instrumentor = Instrumentor(store, manager, cluster, config)
     autoscaler = Autoscaler(store, manager, config)
-    odiglets = [Odiglet(store, manager, cluster, node=n)
+    odiglets = [Odiglet(store, manager, cluster, node=n,
+                        tpu_chips=int(config.extra.get("tpu_chips", 0)))
                 for n in cluster.nodes]
+    autoscaler.attach_device_registries([od.devices for od in odiglets])
     for od in odiglets:
         od.run()
     return CliState(path, store, cluster, config, manager, scheduler,

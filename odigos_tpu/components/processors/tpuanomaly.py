@@ -106,6 +106,7 @@ class TpuAnomalyProcessor(Processor):
             model_config=model_config,
             checkpoint_path=config.get("checkpoint_path"),
             socket_path=config.get("socket_path"),
+            data_parallel=int(config.get("data_parallel", 0)),
             seed=int(config.get("seed", 0)),
         )
         self.engine = _engine_for(self.engine_cfg,

@@ -147,6 +147,10 @@ class Configuration:
     cluster_name: str = ""
     ui_mode: UiMode = UiMode.NORMAL
     ui_pagination_limit: int = 0
+    # where collectors ship their own-telemetry metrics stream (the
+    # frontend's collector-metrics consumer listens here); tests point it
+    # at an ephemeral local port
+    ui_endpoint: str = "ui.odigos-system:4317"
     collector_gateway: CollectorGatewayConfiguration = field(
         default_factory=CollectorGatewayConfiguration)
     collector_node: CollectorNodeConfiguration = field(

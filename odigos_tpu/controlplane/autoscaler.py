@@ -194,6 +194,10 @@ class Autoscaler:
         self.config = effective_config
         self.hpa = HpaDecider()
         self.gateway_replicas = 1
+        # TPU co-scheduling (north star): node device registries attached by
+        # the environment; held device ids back anomaly-stage replicas
+        self._device_registries: list[Any] = []
+        self._tpu_held: list[tuple[Any, str]] = []  # (plugin, device id)
         gateway_key = lambda e: [(ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)]
         manager.register("cluster-collector", self, {
             "DestinationResource": gateway_key,
@@ -229,6 +233,7 @@ class Autoscaler:
                 gateway_group and gateway_group.cluster_metrics_enabled),
             small_batches=self.config.extra.get("small_batches"),
             anomaly=self.config.anomaly,
+            ui_endpoint=self.config.ui_endpoint,
         )
         config, status, enabled_signals = build_gateway_config(
             destinations, processors, data_streams, options)
@@ -291,11 +296,84 @@ class Autoscaler:
                         now: Optional[float] = None) -> int:
         """Feed the HPA one metrics sample; returns (and records) the new
         replica count (custom_metrics_handler.go:251 scrapeGatewayMetric +
-        hpa.go behavior)."""
-        self.gateway_replicas = self.hpa.desired_replicas(
+        hpa.go behavior). When the anomaly stage is on, scale-out is
+        co-scheduled with TPU devices (north star: the virtual-device
+        affinity pattern of distros/yamls/golang-community.yaml:15-18
+        applied to gateway replicas)."""
+        desired = self.hpa.desired_replicas(
             self.gateway_replicas, cpu_pct, memory_pct, rejections_per_pod,
             now)
+        group = self._gateway_group(self.store)
+        if group is not None:
+            desired = self._co_schedule_tpu(desired, group)
+        self.gateway_replicas = desired
         return self.gateway_replicas
+
+    # ------------------------------------------------- TPU co-scheduling
+
+    def attach_device_registries(self, registries: list[Any]) -> None:
+        """Give the autoscaler sight of the nodes' device-plugin pools
+        (deviceplugin/pkg/instrumentation/plugin.go:24 role)."""
+        self._device_registries = list(registries)
+
+    def _tpu_plugins(self) -> list[Any]:
+        from ..nodeagent.deviceplugin import TPU_DEVICE
+
+        return [r.plugins[TPU_DEVICE] for r in self._device_registries
+                if TPU_DEVICE in getattr(r, "plugins", {})]
+
+    def tpu_devices_held(self) -> int:
+        return len(self._tpu_held)
+
+    def _co_schedule_tpu(self, desired: int, group) -> int:
+        """Align gateway scale with TPU devices: every replica carries the
+        full pipeline (shared-nothing, SURVEY §2.7), so with the anomaly
+        stage enabled each replica needs one device. Scale-out is capped at
+        what the pools can back; a shortfall surfaces as a TpuScheduling
+        condition on the CollectorsGroup (the HPA-visible 'tpu-starved'
+        signal)."""
+        plugins = self._tpu_plugins()
+        if group.tpu_replicas <= 0:
+            if self._tpu_held:  # anomaly turned off: give devices back
+                for plugin, dev in self._tpu_held:
+                    plugin.release([dev])
+                self._tpu_held = []
+            return desired
+
+        # grow/shrink holdings toward `desired`, one device per replica
+        while len(self._tpu_held) > desired:
+            plugin, dev = self._tpu_held.pop()
+            plugin.release([dev])
+        for plugin in plugins:
+            while (len(self._tpu_held) < desired
+                   and plugin.ids.free_count > 0):
+                ids, _resp = plugin.allocate(1)
+                self._tpu_held.append((plugin, ids[0]))
+            if len(self._tpu_held) >= desired:
+                break
+
+        held = len(self._tpu_held)
+        total = sum(p.ids.capacity for p in plugins)
+        # starved whenever the pools cannot back the HPA's desired scale —
+        # both "no devices at all" and "scale-out capped by devices"
+        starved = held < desired
+        capped = desired if held >= desired else max(
+            self.hpa.min_replicas, held)
+
+        cond = Condition(
+            "TpuScheduling",
+            ConditionStatus.FALSE if starved else ConditionStatus.TRUE,
+            "TpuStarved" if starved else "DevicesAllocated",
+            f"{held}/{desired} gateway replicas TPU-backed "
+            f"({total} devices in cluster)")
+        prev = next((c for c in group.conditions if c.type == cond.type),
+                    None)
+        if prev is None or (prev.status, prev.reason, prev.message) != (
+                cond.status, cond.reason, cond.message):
+            group.conditions = [c for c in group.conditions
+                                if c.type != cond.type] + [cond]
+            self.store.update_status(group)
+        return capped
 
     # ------------------------------------------------------------ helpers
 

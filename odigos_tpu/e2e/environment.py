@@ -26,7 +26,8 @@ from ..wire.hotreload import watch_configmap
 
 class E2EEnvironment:
     def __init__(self, nodes: int = 1,
-                 config: Optional[Configuration] = None):
+                 config: Optional[Configuration] = None,
+                 tpu_chips_per_node: int = 0):
         self.store = Store()
         self.manager = ControllerManager(self.store)
         self.cluster = Cluster(nodes=nodes)
@@ -37,10 +38,15 @@ class E2EEnvironment:
                                          self.cluster, self.config)
         self.autoscaler = Autoscaler(self.store, self.manager, self.config)
         self.odiglets = [
-            Odiglet(self.store, self.manager, self.cluster, node=n)
+            Odiglet(self.store, self.manager, self.cluster, node=n,
+                    tpu_chips=tpu_chips_per_node)
             for n in self.cluster.nodes]
+        # north-star co-scheduling: the autoscaler sees the node TPU pools
+        self.autoscaler.attach_device_registries(
+            [od.devices for od in self.odiglets])
         self.gateway: Optional[Collector] = None
         self._unsub = None
+        self._wire_tap = None  # lazy WireExporter into the gateway
 
     # ------------------------------------------------------------ lifecycle
 
@@ -62,6 +68,9 @@ class E2EEnvironment:
         return self
 
     def shutdown(self) -> None:
+        if self._wire_tap is not None:
+            self._wire_tap.shutdown()
+            self._wire_tap = None
         if self._unsub:
             self._unsub()
         if self.gateway is not None:
@@ -112,10 +121,8 @@ class E2EEnvironment:
         return self.gateway.component(component_id)
 
     def send_traces(self, batch) -> None:
-        """Feed a span batch into the gateway's front door (the node
-        collector leg is exercised separately by wire tests; scenarios
-        inject at the gateway the way chainsaw's traffic job hits the
-        cluster)."""
+        """Feed a span batch into the gateway's front door directly
+        (in-process; for scenarios that don't care about the transport)."""
         assert self.gateway is not None
         receivers = self.gateway.graph.receivers
         for rid, recv in receivers.items():
@@ -123,6 +130,30 @@ class E2EEnvironment:
                 recv.next_consumer.consume(batch)
                 return
         raise RuntimeError(f"no otlp receiver in gateway ({list(receivers)})")
+
+    def gateway_otlp_port(self) -> int:
+        """TCP port of the gateway's otlp front door (WireReceiver)."""
+        assert self.gateway is not None
+        for rid, recv in self.gateway.graph.receivers.items():
+            if rid.split("/")[0] == "otlp" and hasattr(recv, "port"):
+                return recv.port
+        raise RuntimeError("gateway has no wire otlp receiver")
+
+    def send_traces_wire(self, batch, timeout: float = 10.0) -> bool:
+        """Feed spans over the REAL wire: framed TCP through the gateway's
+        admission-controlled otlp receiver (the reference's backpressure
+        e2e path, tests/e2e/ + configgrpc fork). Returns False when the
+        frame could not be delivered inside the timeout (rejected or
+        dropped); REJECTED frames feed the HPA rejection metric."""
+        from ..wire.client import WireExporter
+
+        if self._wire_tap is None:
+            self._wire_tap = WireExporter("otlpwire/e2e", {
+                "endpoint": f"127.0.0.1:{self.gateway_otlp_port()}",
+                "max_elapsed_s": timeout})
+            self._wire_tap.start()
+        self._wire_tap.export(batch)
+        return self._wire_tap.flush(timeout=timeout)
 
 
 _IDLE_CONFIG: dict[str, Any] = {

@@ -1,0 +1,10 @@
+"""Operator-facing API layer — the reference's second-largest component
+(frontend/: GraphQL server + SSE push + collector-metrics consumer +
+webapp, frontend/main.go:155,217). Re-designed as an HTTP/JSON API over
+the resource Store plus an SSE event stream from store watches plus a
+wire-fed consumer of the collectors' own-telemetry metrics stream
+(services/collector_metrics/collector_metrics.go).
+"""
+
+from .collector_metrics import CollectorMetricsConsumer  # noqa: F401
+from .server import FrontendServer  # noqa: F401

@@ -1,0 +1,134 @@
+"""Collector own-metrics consumer.
+
+The reference's UI does not scrape collectors; the collectors *push* their
+own OTLP metrics to the frontend, which aggregates per-source and
+per-destination throughput
+(frontend/services/collector_metrics/{collector_metrics,cluster_collector}.go).
+This consumer plays that role: it receives the ``metrics/otelcol``
+pipeline's MetricBatches (over the wire from ``otlp/ui`` or in-process) and
+derives rates from counter deltas.
+
+Metric names arrive flattened as ``name{label=value}`` (see
+components/receivers/prometheus.py snapshot_to_batch).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from ..pdata.metrics import MetricBatch
+
+TRAFFIC_SPANS = "odigos_traffic_spans_total"
+TRAFFIC_BYTES = "odigos_traffic_bytes_total"
+ANOMALY_FLAGGED = "odigos_anomaly_flagged_spans_total"
+ANOMALY_SCORED = "odigos_anomaly_scored_spans_total"
+ANOMALY_PASSTHROUGH = "odigos_anomaly_passthrough_total"
+
+
+def parse_flat_name(name: str) -> tuple[str, dict[str, str]]:
+    """``odigos_traffic_spans_total{service=cart}`` → (base, labels)."""
+    if "{" not in name:
+        return name, {}
+    base, rest = name.split("{", 1)
+    labels: dict[str, str] = {}
+    for part in rest.rstrip("}").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels[k.strip()] = v.strip()
+    return base, labels
+
+
+class _Series:
+    """One counter series: latest cumulative value + derived rate."""
+
+    __slots__ = ("value", "rate", "_prev", "_prev_t")
+
+    def __init__(self) -> None:
+        self.value = 0.0
+        self.rate = 0.0
+        self._prev: Optional[float] = None
+        self._prev_t = 0.0
+
+    def observe(self, value: float, t: float) -> None:
+        if self._prev is not None and t > self._prev_t:
+            delta = value - self._prev
+            if delta >= 0:  # counter reset → skip one window
+                self.rate = delta / (t - self._prev_t)
+        self._prev, self._prev_t = value, t
+        self.value = value
+
+
+class CollectorMetricsConsumer:
+    """Consumes self-telemetry MetricBatches; answers throughput queries.
+
+    Wire this as the ``next_consumer`` of a WireReceiver listening on the
+    config's ``ui_endpoint`` — or call :meth:`consume` directly in-process.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_service: dict[str, dict[str, _Series]] = {}
+        self._by_pipeline: dict[str, dict[str, _Series]] = {}
+        self._totals: dict[str, _Series] = {}
+        self._last_batch_time = 0.0
+        self._batches = 0
+
+    # ------------------------------------------------------------ consume
+
+    def consume(self, batch: MetricBatch) -> None:
+        if not isinstance(batch, MetricBatch):
+            return  # spans on the metrics port: ignore
+        now = time.time()
+        names = batch.metric_names()
+        values = batch.col("value")
+        times = batch.col("time_unix_nano")
+        with self._lock:
+            self._batches += 1
+            self._last_batch_time = now
+            for i, flat in enumerate(names):
+                base, labels = parse_flat_name(flat)
+                t = float(times[i]) / 1e9 if times[i] else now
+                v = float(values[i])
+                if "service" in labels:
+                    bucket = self._by_service.setdefault(
+                        labels["service"], {})
+                elif "pipeline" in labels:
+                    bucket = self._by_pipeline.setdefault(
+                        labels["pipeline"], {})
+                else:
+                    bucket = self._totals
+                bucket.setdefault(base, _Series()).observe(v, t)
+
+    # ------------------------------------------------------------ queries
+
+    @staticmethod
+    def _render(bucket: dict[str, _Series]) -> dict[str, dict[str, float]]:
+        return {base: {"total": s.value, "per_sec": round(s.rate, 3)}
+                for base, s in bucket.items()}
+
+    def throughput(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "services": {svc: self._render(b)
+                             for svc, b in self._by_service.items()},
+                "pipelines": {p: self._render(b)
+                              for p, b in self._by_pipeline.items()},
+                "totals": self._render(self._totals),
+                "batches_received": self._batches,
+                "last_batch_age_s": (round(time.time()
+                                           - self._last_batch_time, 3)
+                                     if self._last_batch_time else None),
+            }
+
+    def anomaly_summary(self) -> dict[str, float]:
+        with self._lock:
+            out = {}
+            for key, metric in (("flagged", ANOMALY_FLAGGED),
+                                ("scored", ANOMALY_SCORED),
+                                ("passthrough", ANOMALY_PASSTHROUGH)):
+                s = self._totals.get(metric)
+                out[key] = s.value if s else 0.0
+                out[f"{key}_per_sec"] = round(s.rate, 3) if s else 0.0
+            return out

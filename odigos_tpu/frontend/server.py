@@ -1,0 +1,349 @@
+"""Frontend HTTP server: JSON API over the Store + SSE push + the
+own-metrics wire listener.
+
+Reference shape (frontend/main.go:155 startHTTPServer): one server exposes
+resource queries (GraphQL there, JSON here), ``/api/events`` SSE
+(main.go:217), describe/diagnose endpoints (:258), and receives the
+collectors' own-telemetry stream (services/collector_metrics). The server
+is read-only over the store except where the reference's UI mutates
+(sources/destinations) — mutation endpoints accept POST/DELETE with the
+same validation the CLI applies.
+
+Endpoints:
+    GET  /healthz
+    GET  /api/sources[?namespace=]         GET /api/destinations
+    GET  /api/instrumentation-configs      GET /api/collectors-groups
+    GET  /api/workloads                    GET /api/config
+    GET  /api/pipeline                     (gateway topology graph)
+    GET  /api/metrics                      (per-source/destination throughput)
+    GET  /api/anomalies                    (flagged/scored counters + rates)
+    GET  /api/describe/workload?namespace=&kind=&name=
+    GET  /api/events                       (SSE stream of store events)
+    POST /api/sources                      {namespace,name,kind,...}
+    DELETE /api/sources/<ns>/<name>
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..api.resources import (
+    ObjectMeta, Source, WorkloadKind, WorkloadRef)
+from ..api.store import Event, Store
+from ..controlplane.scheduler import (
+    EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE)
+from ..utils.serde import to_jsonable
+from ..utils.telemetry import meter
+from .collector_metrics import CollectorMetricsConsumer
+
+
+def _resource_list(store: Store, kind: str,
+                   namespace: Optional[str] = None) -> list[dict[str, Any]]:
+    return [to_jsonable(r) for r in store.list(kind, namespace=namespace)]
+
+
+def pipeline_topology(store: Store) -> dict[str, Any]:
+    """Nodes + edges of the rendered gateway config — what the reference's
+    UI graph view draws from the generated ConfigMap."""
+    from ..controlplane.autoscaler import GATEWAY_CONFIG_NAME
+
+    cm = store.get("ConfigMap", ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)
+    if cm is None:
+        return {"nodes": [], "edges": [], "pipelines": {}}
+    conf = cm.data.get("collector-conf", {})
+    pipelines = conf.get("service", {}).get("pipelines", {})
+    nodes: dict[str, dict[str, str]] = {}
+    edges: list[dict[str, str]] = []
+    for pname, pipe in pipelines.items():
+        chain: list[str] = []
+        for role in ("receivers", "processors", "exporters"):
+            for cid in pipe.get(role, []):
+                nodes.setdefault(cid, {
+                    "id": cid, "role": role[:-1],
+                    "type": cid.split("/")[0]})
+                chain.append(cid)
+        for a, b in zip(chain, chain[1:]):
+            edges.append({"from": a, "to": b, "pipeline": pname})
+    return {"nodes": list(nodes.values()), "edges": edges,
+            "pipelines": {p: {r: list(pipe.get(r, []))
+                              for r in ("receivers", "processors",
+                                        "exporters")}
+                          for p, pipe in pipelines.items()}}
+
+
+class FrontendServer:
+    """Serves the operator API for one Store.
+
+    ``metrics_port`` opens a wire listener for the collectors' ``otlp/ui``
+    stream (0 = ephemeral; resolved port on ``.metrics_port`` after start);
+    None disables it (tests can call ``.metrics.consume`` directly).
+    """
+
+    def __init__(self, store: Store, host: str = "127.0.0.1",
+                 port: int = 0, metrics_port: Optional[int] = 0,
+                 cluster=None):
+        self.store = store
+        self.cluster = cluster
+        self.host = host
+        self.port = port
+        self.metrics = CollectorMetricsConsumer()
+        self._want_metrics_port = metrics_port
+        self.metrics_port: Optional[int] = None
+        self._metrics_recv = None
+        self._http: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        # SSE fan-out: every connected client owns a queue fed by one store
+        # watch (the /api/events push channel, frontend/main.go:217)
+        self._sse_clients: list[queue.Queue] = []
+        self._sse_lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "FrontendServer":
+        self.store.watch(self._on_event)
+        if self._want_metrics_port is not None:
+            from ..wire.server import WireReceiver
+
+            self._metrics_recv = WireReceiver("otlpwire/ui", {
+                "host": self.host, "port": self._want_metrics_port})
+            self._metrics_recv.set_consumer(self.metrics)
+            self._metrics_recv.start()
+            self.metrics_port = self._metrics_recv.port
+
+        server = self
+
+        class Handler(_Handler):
+            frontend = server
+
+        class HTTPServer(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._http = HTTPServer((self.host, self.port), Handler)
+        self.port = self._http.server_address[1]
+        self._thread = threading.Thread(target=self._http.serve_forever,
+                                        name="frontend-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self.store.unwatch(self._on_event)
+        with self._sse_lock:
+            clients, self._sse_clients = self._sse_clients, []
+        for q in clients:
+            q.put(None)  # unblock + close SSE handlers
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+        if self._metrics_recv is not None:
+            self._metrics_recv.shutdown()
+            self._metrics_recv = None
+
+    def __enter__(self) -> "FrontendServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ---------------------------------------------------------------- SSE
+
+    def _on_event(self, event: Event) -> None:
+        payload = {
+            "type": event.type.value,
+            "kind": event.kind,
+            "namespace": event.key[0],
+            "name": event.key[1],
+        }
+        with self._sse_lock:
+            clients = list(self._sse_clients)
+        for q in clients:
+            try:
+                q.put_nowait(payload)
+            except queue.Full:
+                pass  # slow client: drop (push channel, not a log)
+
+    def sse_subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue(maxsize=256)
+        with self._sse_lock:
+            self._sse_clients.append(q)
+        return q
+
+    def sse_unsubscribe(self, q: queue.Queue) -> None:
+        with self._sse_lock:
+            if q in self._sse_clients:
+                self._sse_clients.remove(q)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    frontend: FrontendServer  # injected subclass attribute
+    protocol_version = "HTTP/1.1"
+
+    # silence per-request stderr logging
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    # ------------------------------------------------------------ helpers
+
+    def _json(self, obj: Any, status: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, msg: str, status: int = 400) -> None:
+        self._json({"error": msg}, status)
+
+    # -------------------------------------------------------------- GET
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        fe = self.frontend
+        store = fe.store
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        path = url.path.rstrip("/")
+        try:
+            if path == "/healthz":
+                return self._json({"status": "ok"})
+            if path == "/api/sources":
+                return self._json(_resource_list(
+                    store, "Source", q.get("namespace")))
+            if path == "/api/destinations":
+                return self._json(_resource_list(
+                    store, "DestinationResource"))
+            if path == "/api/instrumentation-configs":
+                return self._json(_resource_list(
+                    store, "InstrumentationConfig", q.get("namespace")))
+            if path == "/api/collectors-groups":
+                return self._json(_resource_list(store, "CollectorsGroup"))
+            if path == "/api/workloads":
+                if fe.cluster is None:
+                    return self._json([])
+                return self._json([to_jsonable(w)
+                                   for w in fe.cluster.workloads.values()])
+            if path == "/api/config":
+                cm = store.get("ConfigMap", ODIGOS_NAMESPACE,
+                               EFFECTIVE_CONFIG_NAME)
+                return self._json(to_jsonable(cm.data)
+                                  if cm is not None else {})
+            if path == "/api/pipeline":
+                return self._json(pipeline_topology(store))
+            if path == "/api/metrics":
+                out = fe.metrics.throughput()
+                # the server process's own meter complements the stream
+                # (single-process deployments see one merged view)
+                out["local"] = {
+                    k: v for k, v in meter.snapshot().items()
+                    if k.startswith(("odigos_traffic", "odigos_anomaly"))}
+                return self._json(out)
+            if path == "/api/anomalies":
+                out = fe.metrics.anomaly_summary()
+                out["local_flagged"] = meter.counter(
+                    "odigos_anomaly_flagged_spans_total")
+                return self._json(out)
+            if path == "/api/describe/workload":
+                from ..cli.describe import describe_workload
+
+                missing = [k for k in ("namespace", "kind", "name")
+                           if k not in q]
+                if missing:
+                    return self._error(f"missing query params: {missing}")
+                # the describe engine wants a CliState-shaped object; wrap
+                state = _DescribeState(store, fe.cluster)
+                return self._json({"text": describe_workload(
+                    state, q["namespace"], q["kind"], q["name"])})
+            if path == "/api/events":
+                return self._serve_sse()
+            return self._error("not found", 404)
+        except ValueError as e:
+            return self._error(str(e))
+        except BrokenPipeError:
+            return
+
+    def _serve_sse(self) -> None:
+        fe = self.frontend
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        q = fe.sse_subscribe()
+        try:
+            while True:
+                item = q.get()
+                if item is None:  # server shutting down
+                    return
+                data = json.dumps(item)
+                self.wfile.write(f"data: {data}\n\n".encode())
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
+        finally:
+            fe.sse_unsubscribe(q)
+
+    # ----------------------------------------------------- POST / DELETE
+
+    def do_POST(self) -> None:  # noqa: N802
+        fe = self.frontend
+        path = urlparse(self.path).path.rstrip("/")
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return self._error("invalid JSON body")
+        if path == "/api/sources":
+            missing = [k for k in ("namespace", "name") if k not in body]
+            if missing:
+                return self._error(f"missing fields: {missing}")
+            try:
+                kind = WorkloadKind.parse(body.get("kind", "deployment"))
+            except ValueError as e:
+                return self._error(str(e))
+            fe.store.apply(Source(
+                meta=ObjectMeta(name=f"src-{body['name']}",
+                                namespace=body["namespace"]),
+                workload=WorkloadRef(body["namespace"], kind, body["name"]),
+                disable_instrumentation=bool(
+                    body.get("disable_instrumentation", False)),
+                otel_service_name=body.get("otel_service_name", ""),
+                data_stream_names=list(body.get("data_stream_names", []))))
+            return self._json({"applied": f"src-{body['name']}"}, 201)
+        return self._error("not found", 404)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        fe = self.frontend
+        parts = urlparse(self.path).path.rstrip("/").split("/")
+        # /api/sources/<namespace>/<name>
+        if len(parts) == 5 and parts[1] == "api" and parts[2] == "sources":
+            _, _, _, ns, name = parts
+            if fe.store.delete("Source", ns, name):
+                return self._json({"deleted": name})
+            return self._error(f"no source {ns}/{name}", 404)
+        return self._error("not found", 404)
+
+
+class _DescribeState:
+    """Duck-typed CliState for the describe engine (store + cluster)."""
+
+    def __init__(self, store: Store, cluster) -> None:
+        self.store = store
+        self.cluster = cluster or _EmptyCluster()
+
+
+class _EmptyCluster:
+    def get_workload(self, ref):
+        return None
+
+    def pods_of(self, ref):
+        return []

@@ -41,6 +41,14 @@ class IDManager:
     def list_ids(self) -> list[str]:
         return sorted(self._free) + sorted(self._used)
 
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        return len(self._free) + len(self._used)
+
     def allocate(self, n: int = 1) -> list[str]:
         if n > len(self._free):
             raise RuntimeError(f"{self.resource}: device pool exhausted")

@@ -4,6 +4,7 @@ from .sharding import (
     shard_variables,
     batch_spec,
     make_sharded_score_fn,
+    make_sharded_packed_score_fn,
     make_sharded_train_step,
 )
 from .ring_attention import ring_attention
@@ -15,6 +16,7 @@ __all__ = [
     "shard_variables",
     "batch_spec",
     "make_sharded_score_fn",
+    "make_sharded_packed_score_fn",
     "make_sharded_train_step",
     "ring_attention",
 ]

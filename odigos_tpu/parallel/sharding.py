@@ -53,11 +53,14 @@ def shard_variables(variables: Any, mesh: Mesh,
     """Place a variable pytree onto the mesh per spec_fn."""
     def place(path, leaf):
         spec = spec_fn(tuple(k.key for k in path), leaf)
-        # axes must divide; fall back to replication when they don't
+        # axes must exist in this mesh and divide the dim; fall back to
+        # replication when they don't (a pure-"data" DP mesh replicates
+        # every "model"-sharded param)
         for axis_name, dim in zip(spec, getattr(leaf, "shape", ())):
             if axis_name is None:
                 continue
-            if dim % mesh.shape[axis_name] != 0:
+            if (axis_name not in mesh.shape
+                    or dim % mesh.shape[axis_name] != 0):
                 spec = P()
                 break
         return jax.device_put(leaf, NamedSharding(mesh, spec))
@@ -124,3 +127,32 @@ def make_sharded_train_step(model, tx, mesh: Mesh):
                     trace_labels)
 
     return run
+
+
+def make_sharded_packed_score_fn(model, mesh: Mesh):
+    """Data-parallel **packed** scoring (BASELINE config #5: DP across
+    v5e-8) — the serving path's flagship shape. Packed rows shard on
+    "data"; variables placed per the transformer rules (pure-DP meshes
+    replicate them; a "model" axis shards heads/ffn too). XLA inserts the
+    collectives from the placements.
+    """
+    dp = mesh.shape["data"]
+    variables_cache: dict[int, Any] = {}
+
+    def score(variables, cat, cont, segments, positions) -> np.ndarray:
+        key = id(variables)
+        if key not in variables_cache:
+            variables_cache.clear()
+            variables_cache[key] = shard_variables(variables, mesh)
+        v = variables_cache[key]
+        R = np.asarray(segments).shape[0]
+        if R % dp:
+            raise ValueError(
+                f"packed rows {R} not divisible by data axis {dp}; "
+                f"choose trace_bucket as a multiple of data_parallel")
+        cat, cont, segments, positions = _shard_inputs(
+            mesh, (cat, cont, segments, positions))
+        span_p = model.score_packed(v, cat, cont, segments, positions)
+        return np.asarray(span_p)[:R]
+
+    return score

@@ -81,6 +81,7 @@ class GatewayOptions:
     small_batches: Optional[GenericMap] = None  # small-batches profile config
     anomaly: Optional[AnomalyStageConfiguration] = None
     self_telemetry: bool = True
+    ui_endpoint: str = "ui.odigos-system:4317"  # otlp/ui stream target
     # extra processor ids (already configured in `processors`) to run in the
     # root pipeline per signal, e.g. compiled Actions.
     root_processors: dict[Signal, list[str]] = field(default_factory=dict)
@@ -329,7 +330,7 @@ def build_gateway_config(
             pipe["processors"] = list(pipe["processors"]) + [TRAFFIC_METRICS]
         config["receivers"]["prometheus/self-metrics"] = {
             "scrape_interval_s": 10}
-        config["exporters"]["otlp/ui"] = {"endpoint": "ui.odigos-system:4317"}
+        config["exporters"]["otlp/ui"] = {"endpoint": options.ui_endpoint}
         config["service"]["pipelines"]["metrics/otelcol"] = {
             "receivers": ["prometheus/self-metrics"],
             "processors": [VERSION_RESOURCE_PROCESSOR],

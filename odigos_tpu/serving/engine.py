@@ -59,6 +59,10 @@ class EngineConfig:
     checkpoint_path: Optional[str] = None
     socket_path: Optional[str] = None  # model "remote": sidecar unix socket
     remote_timeout_s: float = 10.0  # model "remote": per-call socket deadline
+    # data-parallel scoring across chips (BASELINE config #5: dp over
+    # v5e-8). 0/1 = single device; N>1 builds an N-device "data" mesh and
+    # shards packed rows over it. trace_bucket must divide by N.
+    data_parallel: int = 0
     seed: int = 0
 
 
@@ -153,6 +157,17 @@ class SequenceBackend:
         self.max_len = min(cfg.max_len, self.model.cfg.max_len)
         self.variables = variables if variables is not None else \
             self.model.init(jax.random.PRNGKey(cfg.seed))
+        self._packed_score = None
+        if cfg.data_parallel and cfg.data_parallel > 1:
+            if cfg.trace_bucket % cfg.data_parallel:
+                raise ValueError(
+                    f"trace_bucket {cfg.trace_bucket} must be a multiple "
+                    f"of data_parallel {cfg.data_parallel}")
+            from ..parallel import make_mesh, make_sharded_packed_score_fn
+
+            mesh = make_mesh({"data": cfg.data_parallel})
+            self._packed_score = make_sharded_packed_score_fn(
+                self.model, mesh)
 
     def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
         import jax.numpy as jnp
@@ -164,10 +179,16 @@ class SequenceBackend:
 
             packed = pack_sequences(batch, features, max_len=self.max_len,
                                     pad_rows_to=self.cfg.trace_bucket)
-            span_scores = np.asarray(self.model.score_packed(
-                self.variables, jnp.asarray(packed.categorical),
-                jnp.asarray(packed.continuous), jnp.asarray(packed.segments),
-                jnp.asarray(packed.positions)), dtype=np.float32)
+            if self._packed_score is not None:  # dp across chips
+                span_scores = np.asarray(self._packed_score(
+                    self.variables, packed.categorical, packed.continuous,
+                    packed.segments, packed.positions), dtype=np.float32)
+            else:
+                span_scores = np.asarray(self.model.score_packed(
+                    self.variables, jnp.asarray(packed.categorical),
+                    jnp.asarray(packed.continuous),
+                    jnp.asarray(packed.segments),
+                    jnp.asarray(packed.positions)), dtype=np.float32)
             out = np.zeros(len(batch), np.float32)
             m = packed.mask
             out[packed.span_index[m]] = span_scores[m]

@@ -1,12 +1,14 @@
-"""Columnar wire codec for SpanBatch.
+"""Columnar wire codec for SpanBatch / MetricBatch.
 
 Frame layout (little-endian):
     u32 magic "OTW1"
     u32 payload length
 payload:
     u32 header length, header JSON:
-        {"n": spans, "strings": [...], "resources": [...],
-         "attrs": {span_idx: {...}},       # sparse — empties omitted
+        {"n": points, "kind": "spans"|"metrics" (absent = spans),
+         "strings": [...], "resources": [...],
+         "attrs": {row_idx: {...}},        # sparse — empties omitted
+         "hists": {row_idx: {...}},        # metrics only, sparse
          "cols": [[name, dtype], ...]}     # order = byte layout
     raw column bytes, concatenated in header order
 
@@ -14,7 +16,9 @@ The hot path ships the numeric columns as raw buffers (one memcpy each
 side); only the string table and sparse attrs go through JSON. This is the
 same discipline as the eBPF receiver's protobuf-to-columnar decode
 (collector/receivers/odigosebpfreceiver/traces.go:105) — per-batch cost,
-never per-span.
+never per-span. Metrics share the layout so the self-telemetry pipeline's
+``otlp/ui`` exporter rides the same transport to the frontend consumer
+(frontend/services/collector_metrics in the reference).
 """
 
 from __future__ import annotations
@@ -24,33 +28,42 @@ import struct
 
 import numpy as np
 
+from ..pdata.metrics import MetricBatch
 from ..pdata.spans import SpanBatch
 
 MAGIC = b"OTW1"
 _HDR = struct.Struct("<I")
 
 
-def encode_batch(batch: SpanBatch) -> bytes:
+def encode_batch(batch) -> bytes:
     cols = [(name, arr) for name, arr in batch.columns.items()]
     header = {
         "n": len(batch),
         "strings": list(batch.strings),
         "resources": [dict(r) for r in batch.resources],
-        "attrs": {str(i): a for i, a in enumerate(batch.span_attrs) if a},
         "cols": [[name, arr.dtype.str] for name, arr in cols],
     }
+    if isinstance(batch, MetricBatch):
+        header["kind"] = "metrics"
+        header["attrs"] = {str(i): a
+                           for i, a in enumerate(batch.point_attrs) if a}
+        header["hists"] = {str(i): h
+                           for i, h in enumerate(batch.histograms) if h}
+    else:
+        header["attrs"] = {str(i): a
+                           for i, a in enumerate(batch.span_attrs) if a}
     hdr = json.dumps(header, separators=(",", ":")).encode()
     parts = [_HDR.pack(len(hdr)), hdr]
     parts.extend(np.ascontiguousarray(arr).tobytes() for _, arr in cols)
     return b"".join(parts)
 
 
-def decode_batch(payload: bytes) -> SpanBatch:
+def decode_batch(payload: bytes):
     (hdr_len,) = _HDR.unpack_from(payload, 0)
     header = json.loads(payload[4:4 + hdr_len])
     n = header["n"]
     attrs_sparse = {int(k): v for k, v in header["attrs"].items()}
-    span_attrs = tuple(attrs_sparse.get(i, {}) for i in range(n))
+    attrs = tuple(attrs_sparse.get(i, {}) for i in range(n))
     columns = {}
     off = 4 + hdr_len
     for name, dtype_str in header["cols"]:
@@ -59,10 +72,18 @@ def decode_batch(payload: bytes) -> SpanBatch:
         columns[name] = np.frombuffer(
             payload, dtype=dt, count=n, offset=off).copy()
         off += nbytes
+    if header.get("kind") == "metrics":
+        hists_sparse = {int(k): v for k, v in header.get("hists", {}).items()}
+        return MetricBatch(
+            strings=tuple(header["strings"]),
+            resources=tuple(header["resources"]),
+            point_attrs=attrs,
+            histograms=tuple(hists_sparse.get(i) for i in range(n)),
+            columns=columns)
     return SpanBatch(
         strings=tuple(header["strings"]),
         resources=tuple(header["resources"]),
-        span_attrs=span_attrs,
+        span_attrs=attrs,
         columns=columns)
 
 

@@ -543,3 +543,82 @@ class TestReviewRegressions:
         pod = cluster.pods_of(w.ref)[0]
         assert pod.resource_attrs.get("k8s.statefulset.name") == "db"
         assert "k8s.deployment.name" not in pod.resource_attrs
+
+
+class TestTpuCoScheduling:
+    """North star: the autoscaler co-schedules gateway replicas with TPU
+    devices (VERDICT r1 item 6; reference pattern:
+    clustercollector/hpa.go:36-68 + virtual-device affinity,
+    distros/yamls/golang-community.yaml:15-18)."""
+
+    def make_env(self, tpu_chips=2, anomaly=True):
+        from odigos_tpu.nodeagent.deviceplugin import DevicePluginRegistry
+
+        store = Store()
+        mgr = ControllerManager(store)
+        sched = Scheduler(store, mgr)
+        cfg = Configuration()
+        cfg.anomaly.enabled = anomaly
+        asc = Autoscaler(store, mgr, cfg)
+        reg = DevicePluginRegistry(tpu_chips=tpu_chips)
+        asc.attach_device_registries([reg])
+        sched.apply_authored(cfg)
+        mgr.run_once()
+        return store, asc, reg
+
+    def test_anomaly_on_replicas_backed_by_devices(self):
+        store, asc, reg = self.make_env(tpu_chips=4)
+        assert asc.observe_metrics(10.0, 10.0, 0.0, now=1000.0) == 1
+        assert asc.tpu_devices_held() == 1
+        gw = store.get("CollectorsGroup", ODIGOS_NAMESPACE,
+                       GATEWAY_GROUP_NAME)
+        cond = next(c for c in gw.conditions if c.type == "TpuScheduling")
+        assert cond.status.value == "True"
+        assert cond.reason == "DevicesAllocated"
+
+    def test_devices_exhausted_caps_scale_and_sets_condition(self):
+        store, asc, reg = self.make_env(tpu_chips=2)
+        # drive load high repeatedly: HPA wants +2/15s, devices cap at 2
+        n = asc.observe_metrics(160.0, 10.0, 0.0, now=1000.0)
+        assert n == 2
+        n = asc.observe_metrics(160.0, 10.0, 0.0, now=1020.0)
+        assert n == 2, "scale-out must cap at available TPU devices"
+        assert asc.tpu_devices_held() == 2
+        gw = store.get("CollectorsGroup", ODIGOS_NAMESPACE,
+                       GATEWAY_GROUP_NAME)
+        cond = next(c for c in gw.conditions if c.type == "TpuScheduling")
+        assert cond.status.value == "False"
+        assert cond.reason == "TpuStarved"
+        assert "2/" in cond.message
+
+    def test_scale_down_releases_devices(self):
+        store, asc, reg = self.make_env(tpu_chips=4)
+        asc.hpa.stabilization_s = 0.0
+        asc.hpa.scale_down_window_s = 0.0
+        asc.observe_metrics(160.0, 10.0, 0.0, now=1000.0)
+        asc.observe_metrics(160.0, 10.0, 0.0, now=1020.0)
+        held_at_peak = asc.tpu_devices_held()
+        assert held_at_peak >= 3
+        asc.observe_metrics(1.0, 1.0, 0.0, now=2000.0)
+        assert asc.tpu_devices_held() < held_at_peak
+        from odigos_tpu.nodeagent.deviceplugin import TPU_DEVICE
+
+        free = reg.plugins[TPU_DEVICE].ids.free_count
+        assert free == 4 - asc.tpu_devices_held()
+
+    def test_anomaly_off_no_devices_touched(self):
+        store, asc, reg = self.make_env(tpu_chips=2, anomaly=False)
+        asc.observe_metrics(160.0, 10.0, 0.0, now=1000.0)
+        assert asc.tpu_devices_held() == 0
+        from odigos_tpu.nodeagent.deviceplugin import TPU_DEVICE
+
+        assert reg.plugins[TPU_DEVICE].ids.free_count == 2
+
+    def test_zero_devices_starved_but_min_replicas_survive(self):
+        store, asc, reg = self.make_env(tpu_chips=0)
+        n = asc.observe_metrics(160.0, 10.0, 0.0, now=1000.0)
+        assert n == 1  # min_replicas floor even unbacked
+        gw = store.get("CollectorsGroup", ODIGOS_NAMESPACE,
+                       GATEWAY_GROUP_NAME)
+        cond = next(c for c in gw.conditions if c.type == "TpuScheduling")
+        assert cond.reason == "TpuStarved"

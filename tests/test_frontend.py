@@ -1,0 +1,177 @@
+"""Operator API layer (frontend/): HTTP/JSON over the store, SSE push, and
+the collector-metrics consumer fed by the gateway's otlp/ui stream over the
+real wire (VERDICT r1 item 5; reference: frontend/main.go:155,217 +
+services/collector_metrics).
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from odigos_tpu.components.api import Signal
+from odigos_tpu.destinations import Destination
+from odigos_tpu.e2e.environment import E2EEnvironment
+from odigos_tpu.frontend import CollectorMetricsConsumer, FrontendServer
+from odigos_tpu.frontend.collector_metrics import parse_flat_name
+from odigos_tpu.pdata import synthesize_traces
+
+
+def get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def post_json(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+# ------------------------------------------------------------- unit level
+def test_parse_flat_name():
+    assert parse_flat_name("x_total") == ("x_total", {})
+    assert parse_flat_name("x_total{service=cart}") == (
+        "x_total", {"service": "cart"})
+    assert parse_flat_name("x{pipeline=traces/in,extra=1}") == (
+        "x", {"pipeline": "traces/in", "extra": "1"})
+
+
+def test_consumer_rates_from_counter_deltas():
+    from odigos_tpu.components.receivers.prometheus import snapshot_to_batch
+
+    c = CollectorMetricsConsumer()
+    b1 = snapshot_to_batch({"odigos_traffic_spans_total{service=cart}": 100})
+    c.consume(b1)
+    # 10s later, 400 more spans
+    import numpy as np
+
+    b2 = snapshot_to_batch({"odigos_traffic_spans_total{service=cart}": 500})
+    cols = dict(b2.columns)
+    cols["time_unix_nano"] = b1.col("time_unix_nano") + np.uint64(10_000_000_000)
+    from dataclasses import replace
+
+    c.consume(replace(b2, columns=cols))
+    tp = c.throughput()
+    svc = tp["services"]["cart"]["odigos_traffic_spans_total"]
+    assert svc["total"] == 500
+    assert svc["per_sec"] == pytest.approx(40.0, rel=0.01)
+
+
+# ---------------------------------------------------------------- e2e
+@pytest.fixture
+def env_with_frontend():
+    env = E2EEnvironment(nodes=1)
+    fe = FrontendServer(env.store, cluster=env.cluster).start()
+    env.config.ui_endpoint = f"127.0.0.1:{fe.metrics_port}"
+    env.start()
+    try:
+        yield env, fe
+    finally:
+        env.shutdown()
+        fe.shutdown()
+
+
+def test_api_reflects_store_and_metrics_flow(env_with_frontend):
+    env, fe = env_with_frontend
+    from odigos_tpu.controlplane.cluster import Container
+
+    env.cluster.add_workload("shop", "cart",
+                             [Container("main", language="python")])
+    env.instrument_workload("shop", "cart")
+    env.add_destination(Destination(
+        id="db", dest_type="tracedb", signals=[Signal.TRACES]))
+
+    base = fe.url
+    assert get_json(f"{base}/healthz")["status"] == "ok"
+
+    sources = get_json(f"{base}/api/sources")
+    assert len(sources) == 1 and sources[0]["meta"]["name"] == "src-cart"
+
+    ics = get_json(f"{base}/api/instrumentation-configs")
+    assert len(ics) == 1
+    assert any(c["type"] == "AgentEnabled" for c in ics[0]["conditions"])
+
+    dests = get_json(f"{base}/api/destinations")
+    assert len(dests) == 1 and dests[0]["dest_type"] == "tracedb"
+
+    topo = get_json(f"{base}/api/pipeline")
+    assert topo["pipelines"], "gateway topology empty"
+    assert any(n["type"] == "odigostrafficmetrics" for n in topo["nodes"])
+
+    # traffic through the gateway, then its self-scrape ships the
+    # own-metrics batch over the wire to the frontend consumer
+    env.send_traces(synthesize_traces(50, seed=1))
+    scraper = env.gateway_component("prometheus/self-metrics")
+    scraper.scrape_once()
+    ui_exporter = env.gateway_component("otlp/ui")
+    assert ui_exporter.flush(timeout=10), "otlp/ui did not drain"
+
+    deadline = threading.Event()
+    for _ in range(100):
+        tp = get_json(f"{base}/api/metrics")
+        if tp["batches_received"] > 0:
+            break
+        deadline.wait(0.05)
+    assert tp["batches_received"] > 0, "no metrics batch reached frontend"
+    totals = tp["pipelines"]
+    assert any("odigos_traffic_spans_total" in m for m in totals.values()), totals
+
+    anomalies = get_json(f"{base}/api/anomalies")
+    assert "flagged" in anomalies and "scored" in anomalies
+
+    desc = get_json(f"{base}/api/describe/workload?namespace=shop"
+                    "&kind=deployment&name=cart")
+    assert "MarkedForInstrumentation" in desc["text"]
+
+
+def test_sse_stream_pushes_store_events(env_with_frontend):
+    env, fe = env_with_frontend
+    events = []
+    got_one = threading.Event()
+
+    def listen():
+        req = urllib.request.Request(f"{fe.url}/api/events")
+        with urllib.request.urlopen(req, timeout=15) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if line.startswith("data: "):
+                    events.append(json.loads(line[6:]))
+                    got_one.set()
+                    return
+
+    t = threading.Thread(target=listen, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.3)  # let the client subscribe
+    from odigos_tpu.controlplane.cluster import Container
+
+    env.cluster.add_workload("shop", "web",
+                             [Container("main", language="python")])
+    env.instrument_workload("shop", "web")
+    assert got_one.wait(10), "no SSE event received"
+    assert events and events[0]["kind"]
+
+
+def test_mutating_endpoints(env_with_frontend):
+    env, fe = env_with_frontend
+    from odigos_tpu.controlplane.cluster import Container
+
+    env.cluster.add_workload("shop", "pay",
+                             [Container("main", language="python")])
+    status, out = post_json(f"{fe.url}/api/sources",
+                            {"namespace": "shop", "name": "pay"})
+    assert status == 201 and out["applied"] == "src-pay"
+    env.reconcile()
+    assert env.store.get("InstrumentationConfig", "shop",
+                         "deployment-pay") is not None
+
+    req = urllib.request.Request(f"{fe.url}/api/sources/shop/src-pay",
+                                 method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    assert env.store.get("Source", "shop", "src-pay") is None
