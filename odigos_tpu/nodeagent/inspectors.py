@@ -222,6 +222,10 @@ def inspect_process(ctx: ProcessContext) -> InspectionResult:
         res.runtime_version = detect_version(ctx, lang)
         res.libc_type = detect_libc(ctx)
     res.other_agent = detect_other_agent(ctx)
-    # AT_SECURE processes (setuid etc.) must not get LD_PRELOAD-style agents
-    res.secure_execution_mode = ctx.environ.get("AT_SECURE") == "1"
+    # AT_SECURE processes (setuid etc.) must not get LD_PRELOAD-style
+    # agents. RealProcSource parses it from /proc/<pid>/auxv (the kernel
+    # never puts AT_SECURE in environ); the env spelling remains only for
+    # fabricated simulator contexts.
+    res.secure_execution_mode = (ctx.secure_execution
+                                 or ctx.environ.get("AT_SECURE") == "1")
     return res

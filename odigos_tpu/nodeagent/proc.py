@@ -28,6 +28,9 @@ class ProcessContext:
     mapped_files: list[str] = field(default_factory=list)
     # first bytes of the executable (ELF header sniffing, Go buildinfo)
     exe_head: bytes = b""
+    # AT_SECURE from the aux vector (setuid/setgid/caps). Never visible in
+    # environ on a real host — the kernel only exposes it via auxv.
+    secure_execution: bool = False
 
     @property
     def exe_base(self) -> str:
@@ -67,7 +70,28 @@ class RealProcSource:
                 ctx.exe_head = f.read(4096)
         except OSError:
             pass
+        ctx.secure_execution = self._read_at_secure(
+            os.path.join(base, "auxv"))
         return ctx
+
+    @staticmethod
+    def _read_at_secure(path: str) -> bool:
+        """Parse AT_SECURE (type 23) out of /proc/<pid>/auxv — pairs of
+        native-width unsigned longs, AT_NULL-terminated."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read(4096)
+        except OSError:
+            return False
+        width = 8  # 64-bit; auxv entries are 2 * sizeof(unsigned long)
+        for off in range(0, len(raw) - 2 * width + 1, 2 * width):
+            a_type = int.from_bytes(raw[off:off + width], "little")
+            if a_type == 0:  # AT_NULL
+                break
+            if a_type == 23:  # AT_SECURE
+                return bool(int.from_bytes(
+                    raw[off + width:off + 2 * width], "little"))
+        return False
 
     @staticmethod
     def _read_nul_list(path: str) -> list[str]:
@@ -143,7 +167,8 @@ class SimulatedProcSource:
 
     def spawn(self, pod_name: str, container_name: str, language: str,
               runtime_version: str = "", libc: str = "glibc",
-              env: Optional[dict[str, str]] = None) -> int:
+              env: Optional[dict[str, str]] = None,
+              secure: bool = False) -> int:
         pid = self._next_pid
         self._next_pid += 1
         fp = _RUNTIME_FOOTPRINT.get(language, {"exe": "/bin/app", "maps": []})
@@ -153,6 +178,7 @@ class SimulatedProcSource:
             exe_path=fp["exe"].format(v=v),
             cmdline=[fp["exe"].format(v=v)],
             environ=dict(env or {}),
+            secure_execution=secure,
         )
         for key, val in fp.get("env", {}).items():
             ctx.environ.setdefault(key, val.format(v=v))

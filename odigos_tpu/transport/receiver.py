@@ -53,7 +53,8 @@ class ShmSpanReceiver(Receiver):
             return 0
         import os
         swapped = 0
-        for ring_name, fd in receive_rings(path).items():
+        handoff = receive_rings(path)
+        for ring_name, fd in handoff.items():
             try:
                 st = os.fstat(fd)
                 with self._lock:
@@ -71,6 +72,17 @@ class ShmSpanReceiver(Receiver):
                     os.close(fd)
                 except OSError:
                     pass
+        # The handoff is the full current inventory: rings it no longer
+        # names belong to exited producers — detach them so their mmaps and
+        # drain work don't leak for the receiver's lifetime.
+        with self._lock:
+            stale = {n: self._rings.pop(n)
+                     for n in list(self._rings) if n not in handoff}
+        for ring in stale.values():
+            ring.close()
+        if stale:
+            meter.add("odigos_receiver_detached_rings_total"
+                      f"{{receiver={self.name}}}", len(stale))
         return swapped
 
     def start(self) -> None:

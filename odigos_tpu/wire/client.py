@@ -47,6 +47,7 @@ class WireExporter(Exporter):
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._wake = threading.Event()
+        self._inflight: Optional[bytes] = None
 
     # ------------------------------------------------------------ pipeline
 
@@ -78,13 +79,13 @@ class WireExporter(Exporter):
 
     def flush(self, timeout: float = 5.0) -> bool:
         deadline = time.monotonic() + timeout
-        while self._queue and time.monotonic() < deadline:
+        while self.queued and time.monotonic() < deadline:
             time.sleep(0.005)
-        return not self._queue
+        return not self.queued
 
     @property
     def queued(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + (1 if self._inflight is not None else 0)
 
     # ------------------------------------------------------------ sending
 
@@ -131,30 +132,27 @@ class WireExporter(Exporter):
         cap = float(self.config.get("retry_max_s", 2.0))
         max_elapsed = float(self.config.get("max_elapsed_s", 30.0))
         backoff = initial
-        frame_started: Optional[float] = None
+        frame_started = 0.0
         while not self._stop.is_set():
-            if not self._queue:
-                self._wake.wait(timeout=0.1)
-                self._wake.clear()
-                continue
-            buf = self._queue[0]
-            if frame_started is None:
+            # Pop-before-send: holding the frame out of the deque means a
+            # producer overflow (deque maxlen displacing the head) can never
+            # race us into sending a displaced frame or silently losing the
+            # one being retried.
+            if self._inflight is None:
+                try:
+                    self._inflight = self._queue.popleft()
+                except IndexError:
+                    self._wake.wait(timeout=0.1)
+                    self._wake.clear()
+                    continue
                 frame_started = time.monotonic()
-            if self._send_one(buf):
-                try:
-                    self._queue.popleft()
-                except IndexError:
-                    pass
+            if self._send_one(self._inflight):
+                self._inflight = None
                 backoff = initial
-                frame_started = None
             elif time.monotonic() - frame_started > max_elapsed:
-                try:
-                    self._queue.popleft()
-                except IndexError:
-                    pass
+                self._inflight = None
                 meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
                 backoff = initial
-                frame_started = None
             else:
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, cap)

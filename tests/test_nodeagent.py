@@ -456,3 +456,32 @@ class TestInitPhase:
         v2 = OdigletInitPhase(str(src), str(host))
         assert v2 != v1 and os.path.isdir(v1)
         assert os.path.realpath(host / "current") == os.path.realpath(v2)
+
+
+class TestRealProcAuxv:
+    """AT_SECURE comes from /proc/<pid>/auxv — the kernel never exposes it
+    in environ (round-2 advisor finding on inspectors.py)."""
+
+    @staticmethod
+    def _fake_proc(tmp_path, pid, secure):
+        base = tmp_path / str(pid)
+        base.mkdir()
+        (base / "cmdline").write_bytes(b"/bin/app\0")
+        (base / "environ").write_bytes(b"PATH=/bin\0")
+        (base / "maps").write_text("")
+        auxv = (6).to_bytes(8, "little") + (4096).to_bytes(8, "little")
+        auxv += (23).to_bytes(8, "little") + int(secure).to_bytes(8, "little")
+        auxv += (0).to_bytes(16, "little")
+        (base / "auxv").write_bytes(auxv)
+
+    def test_at_secure_parsed_from_auxv(self, tmp_path):
+        from odigos_tpu.nodeagent.proc import RealProcSource
+        self._fake_proc(tmp_path, 101, secure=True)
+        self._fake_proc(tmp_path, 102, secure=False)
+        src = RealProcSource(root=str(tmp_path))
+        ctx = src.context(101)
+        assert ctx.secure_execution
+        assert inspect_process(ctx).secure_execution_mode
+        ctx2 = src.context(102)
+        assert not ctx2.secure_execution
+        assert not inspect_process(ctx2).secure_execution_mode

@@ -264,3 +264,35 @@ class TestShmSpanReceiver:
         import odigos_tpu.transport  # noqa: F401  (registration side effect)
         factory = registry.get(ComponentKind.RECEIVER, "shmspan")
         assert factory.type_name == "shmspan"
+
+
+class TestRefreshDetach:
+    def test_refresh_detaches_absent_rings(self, tmp_path):
+        """A handoff that no longer names a ring means its producer exited:
+        the receiver must drop (and close) the stale ring rather than drain
+        it forever (reference reader-swap inventory semantics,
+        odigosebpfreceiver.go:74-93)."""
+        sock = str(tmp_path / "handoff.sock")
+        server = RingHandoffServer(sock)
+        ring1 = SpanRing.create(1 << 18)
+        ring2 = SpanRing.create(1 << 18)
+        server.register_ring("agent-0", ring1.fd)
+        server.register_ring("agent-1", ring2.fd)
+        server.start()
+        recv = ShmSpanReceiver("shmspan", {"socket_path": sock})
+        recv.set_consumer(_Sink())
+        try:
+            assert recv.refresh_rings() == 2
+            assert set(recv._rings) == {"agent-0", "agent-1"}
+            server.unregister_ring("agent-1")
+            recv.refresh_rings()
+            assert set(recv._rings) == {"agent-0"}
+            # drained data from the surviving ring still flows
+            ring1.write_batch(synthesize_traces(3, seed=1))
+            assert recv.drain_once() > 0
+        finally:
+            server.stop()
+            ring1.close()
+            ring2.close()
+            for r in recv._rings.values():
+                r.close()

@@ -283,3 +283,37 @@ class TestHotReload:
         finally:
             unsub()
             collector.shutdown()
+
+
+class TestInflightFrame:
+    def test_inflight_frame_survives_queue_overflow(self):
+        """Pop-before-send: the frame being retried is held out of the
+        bounded deque, so producer overflow can neither displace it nor
+        make the sender skip/double-send (round-2 advisor finding)."""
+        import socket as socketlib
+        s = socketlib.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        exp = WireExporter("otlpwire", {
+            "endpoint": f"127.0.0.1:{port}", "queue_size": 2,
+            "retry_initial_s": 0.02, "retry_max_s": 0.05})
+        exp.start()
+        try:
+            first = synthesize_traces(3, seed=42)
+            exp.export(first)  # no listener yet: goes in-flight, retries
+            assert wait_for(lambda: exp._inflight is not None)
+            for i in range(6):  # overflow the deque while head is in-flight
+                exp.export(synthesize_traces(1, seed=100 + i))
+            assert exp.queued == 3  # 2 queued + 1 in-flight
+            recv = WireReceiver("otlpwire", {"port": port})
+            sink = _Sink()
+            recv.set_consumer(sink)
+            recv.start()
+            try:
+                assert wait_for(lambda: sink.batches)
+                assert_batches_equal(sink.batches[0], first)
+            finally:
+                recv.shutdown()
+        finally:
+            exp.shutdown()
