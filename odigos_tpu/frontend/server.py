@@ -87,11 +87,13 @@ class FrontendServer:
 
     def __init__(self, store: Store, host: str = "127.0.0.1",
                  port: int = 0, metrics_port: Optional[int] = 0,
-                 cluster=None):
+                 cluster=None, max_sse_clients: int = 64):
         self.store = store
         self.cluster = cluster
         self.host = host
         self.port = port
+        self.max_sse_clients = max_sse_clients
+        self.sse_heartbeat_s = 15.0
         self.metrics = CollectorMetricsConsumer()
         self._want_metrics_port = metrics_port
         self.metrics_port: Optional[int] = None
@@ -173,9 +175,13 @@ class FrontendServer:
             except queue.Full:
                 pass  # slow client: drop (push channel, not a log)
 
-    def sse_subscribe(self) -> queue.Queue:
+    def sse_subscribe(self) -> Optional[queue.Queue]:
+        """Returns None when the client cap is reached (admission control at
+        the push boundary — same posture as the engine queue)."""
         q: queue.Queue = queue.Queue(maxsize=256)
         with self._sse_lock:
+            if len(self._sse_clients) >= self.max_sse_clients:
+                return None
             self._sse_clients.append(q)
         return q
 
@@ -206,6 +212,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, msg: str, status: int = 400) -> None:
         self._json({"error": msg}, status)
 
+    def _html(self, body: bytes) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     # -------------------------------------------------------------- GET
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -215,6 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
         q = {k: v[0] for k, v in parse_qs(url.query).items()}
         path = url.path.rstrip("/")
         try:
+            if path in ("", "/dashboard"):
+                return self._html(_dashboard_page())
             if path == "/healthz":
                 return self._json({"status": "ok"})
             if path == "/api/sources":
@@ -274,14 +289,24 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_sse(self) -> None:
         fe = self.frontend
+        q = fe.sse_subscribe()
+        if q is None:  # client cap reached: shed, don't hold a thread
+            return self._error("too many event streams", 503)
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.end_headers()
-        q = fe.sse_subscribe()
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get(timeout=fe.sse_heartbeat_s)
+                except queue.Empty:
+                    # heartbeat comment: a silently-gone client fails the
+                    # write here, so the handler thread + queue are freed
+                    # instead of leaking until the next store event
+                    self.wfile.write(b": ping\n\n")
+                    self.wfile.flush()
+                    continue
                 if item is None:  # server shutting down
                     return
                 data = json.dumps(item)
@@ -331,6 +356,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json({"deleted": name})
             return self._error(f"no source {ns}/{name}", 404)
         return self._error("not found", 404)
+
+
+def _dashboard_page() -> bytes:
+    """The operator dashboard (the reference's webapp role, served without
+    a build step — frontend/webapp/app/(overview))."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "dashboard.html")
+    with open(path, "rb") as f:
+        return f.read()
 
 
 class _DescribeState:

@@ -175,3 +175,78 @@ def test_mutating_endpoints(env_with_frontend):
     with urllib.request.urlopen(req, timeout=10) as r:
         assert r.status == 200
     assert env.store.get("Source", "shop", "src-pay") is None
+
+
+def test_dashboard_page_serves(env_with_frontend):
+    """The webapp analog: the dashboard page serves at / and wires itself to
+    the data endpoints the page's JS polls (VERDICT r2 item 2)."""
+    env, fe = env_with_frontend
+    with urllib.request.urlopen(fe.url + "/", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/html")
+        page = r.read().decode()
+    # every endpoint the page polls must exist and round-trip
+    for endpoint in ("/api/pipeline", "/api/metrics", "/api/anomalies",
+                     "/api/sources", "/api/destinations", "/api/events"):
+        assert endpoint in page, f"dashboard does not reference {endpoint}"
+        if endpoint != "/api/events":
+            get_json(fe.url + endpoint)  # 200 + JSON body
+    for element in ("pipeline", "throughput", "anomalies", "eventlog",
+                    "tiles"):
+        assert f'id="{element}"' in page
+    # /dashboard is an alias
+    with urllib.request.urlopen(fe.url + "/dashboard", timeout=10) as r:
+        assert r.read().decode() == page
+
+
+def test_sse_client_cap_sheds_excess(env_with_frontend):
+    env, fe = env_with_frontend
+    fe.max_sse_clients = 2
+    import time
+
+    held = []
+    try:
+        for _ in range(2):
+            held.append(urllib.request.urlopen(
+                f"{fe.url}/api/events", timeout=10))
+        time.sleep(0.2)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{fe.url}/api/events", timeout=10)
+        assert ei.value.code == 503
+    finally:
+        for h in held:
+            h.close()
+
+
+def test_sse_heartbeat_frees_dead_client(env_with_frontend):
+    """A silently-disconnected SSE client is detected by the ping write and
+    unsubscribed (round-2 advisor finding: handler threads leaked)."""
+    env, fe = env_with_frontend
+    fe.sse_heartbeat_s = 0.1
+    import time
+
+    conn = urllib.request.urlopen(f"{fe.url}/api/events", timeout=10)
+    deadline = time.time() + 5
+    while not fe._sse_clients and time.time() < deadline:
+        time.sleep(0.02)
+    assert len(fe._sse_clients) == 1
+    conn.close()  # client vanishes without a byte
+    deadline = time.time() + 5
+    while fe._sse_clients and time.time() < deadline:
+        time.sleep(0.05)
+    assert not fe._sse_clients, "dead SSE client never unsubscribed"
+
+
+def test_series_rate_resets_on_counter_reset():
+    """Collector restart: the cumulative counter drops; the stale rate must
+    not be reported forever (round-2 advisor finding)."""
+    from odigos_tpu.frontend.collector_metrics import _Series
+
+    s = _Series()
+    s.observe(100.0, 10.0)
+    s.observe(500.0, 20.0)
+    assert s.rate == pytest.approx(40.0)
+    s.observe(50.0, 30.0)  # restart: counter went backwards
+    assert s.rate == 0.0
+    s.observe(150.0, 40.0)  # rates resume from the new baseline
+    assert s.rate == pytest.approx(10.0)
