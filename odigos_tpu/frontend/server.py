@@ -358,14 +358,21 @@ class _Handler(BaseHTTPRequestHandler):
         return self._error("not found", 404)
 
 
+_dashboard_cache: Optional[bytes] = None
+
+
 def _dashboard_page() -> bytes:
     """The operator dashboard (the reference's webapp role, served without
-    a build step — frontend/webapp/app/(overview))."""
-    import os
+    a build step — frontend/webapp/app/(overview)). Read once: the content
+    never changes at runtime and the page polls every 2 s."""
+    global _dashboard_cache
+    if _dashboard_cache is None:
+        import os
 
-    path = os.path.join(os.path.dirname(__file__), "dashboard.html")
-    with open(path, "rb") as f:
-        return f.read()
+        path = os.path.join(os.path.dirname(__file__), "dashboard.html")
+        with open(path, "rb") as f:
+            _dashboard_cache = f.read()
+    return _dashboard_cache
 
 
 class _DescribeState:

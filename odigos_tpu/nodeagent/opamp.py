@@ -30,7 +30,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ..api.resources import (
-    InstrumentationConfig, InstrumentationInstance, ObjectMeta, WorkloadRef)
+    InstrumentationConfig, InstrumentationInstance, ObjectMeta, WorkloadKind,
+    WorkloadRef)
 from ..api.store import Store
 
 
@@ -155,9 +156,12 @@ class OpampServer:
         """Resolve pod identity → workload (handlers.go:268); refuse agents
         we can't attribute."""
         try:
-            workload = WorkloadRef(desc["namespace"], desc["workload_kind"],
+            kind = desc["workload_kind"]
+            if not isinstance(kind, WorkloadKind):
+                kind = WorkloadKind.parse(str(kind))  # JSON transports
+            workload = WorkloadRef(desc["namespace"], kind,
                                    desc["workload_name"])
-        except KeyError:
+        except (KeyError, ValueError):
             return None
         conn = AgentConnection(
             instance_uid=uid, workload=workload,

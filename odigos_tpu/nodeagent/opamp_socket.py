@@ -1,0 +1,280 @@
+"""OpAMP over a real process boundary: unix-domain-socket transport.
+
+The reference's opampserver exists precisely because agents live in *other
+processes* (the instrumented apps) and reach odiglet over a socket
+(opampserver/pkg/server/server.go:23 StartOpAmpServer, handlers.go:43
+OnNewConnection / :125 OnAgentToServerMessage). ``nodeagent.opamp`` holds
+the protocol logic (connection cache, config compilation, instance-status
+writeback) behind a transport-agnostic ``handle_message(msg, send)``; this
+module is the socket transport:
+
+* ``OpampSocketServer`` — accept loop + one reader thread per agent
+  connection. Each JSON frame is fed to ``OpampServer.handle_message`` with
+  a ``send`` bound to that connection (server pushes — config updates —
+  ride the same socket). EOF/reset marks every instance seen on the
+  connection unhealthy via ``agent_disconnected`` (handlers.go
+  OnConnectionClose role).
+* ``OpampSocketAgent`` — the client the per-language SDK agents embed:
+  connects, describes itself, heartbeats, applies pushed remote config.
+* ``python -m odigos_tpu.nodeagent.opamp_socket`` — a standalone agent
+  process for cross-process tests (kill it → unhealthy instance).
+
+Frame: magic ``OAP1`` | u32 length | JSON body (little-endian), the same
+shape as the scoring sidecar's framing (serving/sidecar.py) with a JSON
+payload instead of a span batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Any, Callable, Optional
+
+from ..utils.framing import (
+    ConnRegistry, connect_unix_retry, recv_frame, send_frame, shutdown_close)
+from .opamp import OpampServer
+
+MAGIC = b"OAP1"
+MAX_FRAME = 1 << 20  # an OpAMP message is small; a huge length is corruption
+
+
+def send_msg(sock: socket.socket, msg: dict[str, Any]) -> None:
+    send_frame(sock, MAGIC, json.dumps(msg).encode())
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict[str, Any]]:
+    body = recv_frame(sock, MAGIC, MAX_FRAME)
+    if body is None:
+        return None
+    msg = json.loads(body)
+    if not isinstance(msg, dict):
+        # valid JSON but not a message — treat as stream corruption rather
+        # than crashing the connection thread on msg.get
+        raise ValueError(f"opamp message is {type(msg).__name__}, not dict")
+    return msg
+
+
+class OpampSocketServer:
+    """Socket front-end for one ``OpampServer``.
+
+    ``sweep_interval_s`` > 0 also runs the heartbeat-timeout sweep
+    (``OpampServer.expire_stale``) so an agent that stops heartbeating
+    without closing its socket is still expired.
+    """
+
+    def __init__(self, server: OpampServer, socket_path: str,
+                 sweep_interval_s: float = 0.0):
+        self.server = server
+        self.socket_path = socket_path
+        self.sweep_interval_s = sweep_interval_s
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conns = ConnRegistry()
+
+    def start(self) -> "OpampSocketServer":
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(64)
+        self._stop.clear()
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="opamp-accept")
+        t.start()
+        self._threads.append(t)
+        if self.sweep_interval_s > 0:
+            ts = threading.Thread(target=self._sweep_loop, daemon=True,
+                                  name="opamp-sweep")
+            ts.start()
+            self._threads.append(ts)
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()  # accept loop sees OSError and exits
+            except OSError:
+                pass
+        # close accepted connections too: same-process agents blocked in
+        # recv would otherwise never see a FIN (their reader threads and
+        # ours leak until process exit)
+        self._conns.close_all()
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ internals
+
+    def _accept_loop(self) -> None:
+        sock = self._sock  # shutdown() closes it; OSError ends the loop
+        while not self._stop.is_set():
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="opamp-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        uids: set[str] = set()
+        self._conns.add(conn)
+
+        def push(msg: dict[str, Any]) -> None:
+            # bound to this connection; also called later by the server's
+            # config_changed fan-out, hence the write lock
+            try:
+                with wlock:
+                    send_msg(conn, msg)
+            except OSError:
+                pass  # connection raced shut; reader notices EOF
+
+        try:
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                if msg is None:
+                    break
+                uid = msg.get("instance_uid")
+                if uid:
+                    uids.add(uid)
+                # handle_message delivers any reply through ``push`` itself
+                self.server.handle_message(msg, push)
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            # the socket IS the liveness signal (handlers.go connection
+            # close): every instance this connection spoke for goes
+            # unhealthy the moment it drops
+            for uid in uids:
+                self.server.agent_disconnected(uid)
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.wait(self.sweep_interval_s):
+            self.server.expire_stale()
+
+
+class OpampSocketAgent:
+    """Out-of-process agent client (the per-language SDK role).
+
+    Mirrors ``opamp.OpampAgent``'s surface (connect/heartbeat/disconnect,
+    ``remote_config`` holding the last applied sections) over the socket.
+    """
+
+    def __init__(self, socket_path: str, instance_uid: str,
+                 description: dict[str, Any],
+                 on_config: Optional[Callable[[dict], None]] = None,
+                 connect_timeout_s: float = 5.0):
+        self.socket_path = socket_path
+        self.instance_uid = instance_uid
+        self.description = description
+        self.on_config = on_config
+        self.connect_timeout_s = connect_timeout_s
+        self.remote_config: Optional[dict[str, Any]] = None
+        self._applied_hash = ""
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._config_event = threading.Event()
+
+    def connect(self) -> None:
+        self._sock = connect_unix_retry(self.socket_path,
+                                        self.connect_timeout_s)
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name="opamp-agent-reader").start()
+        self._send({"instance_uid": self.instance_uid,
+                    "agent_description": self.description})
+
+    def wait_for_config(self, timeout_s: float = 5.0) -> Optional[dict]:
+        """Block until the first remote config lands (first contact pushes
+        one if the workload has an InstrumentationConfig)."""
+        self._config_event.wait(timeout_s)
+        return self.remote_config
+
+    def heartbeat(self, healthy: bool = True, message: str = "ok") -> None:
+        self._send({"instance_uid": self.instance_uid,
+                    "health": {"healthy": healthy, "message": message},
+                    "remote_config_status": {"hash": self._applied_hash,
+                                             "applied": True}})
+
+    def disconnect(self) -> None:
+        if self._sock is not None:
+            # our own reader blocks in recv on this socket; see framing.py
+            shutdown_close(self._sock)
+            self._sock = None
+
+    # ------------------------------------------------------------ internals
+
+    def _send(self, msg: dict[str, Any]) -> None:
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        with self._wlock:
+            send_msg(self._sock, msg)
+
+    def _read_loop(self) -> None:
+        sock = self._sock
+        try:
+            while True:
+                msg = recv_msg(sock)
+                if msg is None:
+                    return
+                rc = msg.get("remote_config")
+                if rc is not None:
+                    self.remote_config = rc["sections"]
+                    self._applied_hash = rc["hash"]
+                    self._config_event.set()
+                    if self.on_config is not None:
+                        self.on_config(rc["sections"])
+                if msg.get("report_full_state"):
+                    self._send({"instance_uid": self.instance_uid,
+                                "agent_description": self.description,
+                                "health": {"healthy": True,
+                                           "message": "full state"}})
+        except (OSError, ValueError):
+            return
+
+
+# ---------------------------------------------------------- standalone agent
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """Standalone agent process for cross-process tests: connect, heartbeat
+    on an interval, exit only when killed."""
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(description="odigos-tpu opamp agent")
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--uid", required=True)
+    ap.add_argument("--namespace", required=True)
+    ap.add_argument("--kind", default="deployment")
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--pod", default="pod-0")
+    ap.add_argument("--container", default="main")
+    ap.add_argument("--pid", type=int, default=os.getpid())
+    ap.add_argument("--language", default="python")
+    ap.add_argument("--interval-s", type=float, default=0.5)
+    args = ap.parse_args(argv)
+
+    agent = OpampSocketAgent(args.socket, args.uid, {
+        "namespace": args.namespace, "workload_kind": args.kind,
+        "workload_name": args.name, "pod_name": args.pod,
+        "container_name": args.container, "pid": args.pid,
+        "language": args.language})
+    agent.connect()
+    print("connected", flush=True)
+    while True:
+        time.sleep(args.interval_s)
+        agent.heartbeat()
+
+
+if __name__ == "__main__":
+    main()

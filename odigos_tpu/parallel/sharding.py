@@ -137,14 +137,17 @@ def make_sharded_packed_score_fn(model, mesh: Mesh):
     collectives from the placements.
     """
     dp = mesh.shape["data"]
-    variables_cache: dict[int, Any] = {}
+    # cache the sharded placement of the last-seen pytree. Keyed by id()
+    # ALONE this is unsound — a GC'd pytree's address can be reused and
+    # serve stale weights — so the cache holds a strong ref to the source
+    # pytree and revalidates by identity against it.
+    cache: dict[str, Any] = {"source": None, "sharded": None}
 
     def score(variables, cat, cont, segments, positions) -> np.ndarray:
-        key = id(variables)
-        if key not in variables_cache:
-            variables_cache.clear()
-            variables_cache[key] = shard_variables(variables, mesh)
-        v = variables_cache[key]
+        if cache["source"] is not variables:
+            cache["source"] = variables
+            cache["sharded"] = shard_variables(variables, mesh)
+        v = cache["sharded"]
         R = np.asarray(segments).shape[0]
         if R % dp:
             raise ValueError(

@@ -41,11 +41,13 @@ from typing import Any, Optional
 import numpy as np
 
 from ..pdata.spans import SpanBatch
+from ..utils.framing import (
+    ConnRegistry, connect_unix_retry, recv_frame, send_frame, shutdown_close)
 from ..utils.telemetry import meter
 from ..wire.codec import decode_batch, encode_batch
 
 MAGIC = b"OTS1"
-_LEN = struct.Struct("<I")
+MAX_FRAME = 256 << 20  # span batches are big; beyond this is corruption
 _REQ = struct.Struct("<IB")  # req_id, op/status
 
 OP_SCORE = 0
@@ -57,36 +59,24 @@ ST_ERROR = 1
 
 REMOTE_ERRORS_METRIC = "odigos_sidecar_client_errors_total"
 SERVED_METRIC = "odigos_sidecar_served_requests_total"
+OVERLOAD_METRIC = "odigos_sidecar_overload_rejections_total"
 
 
 # ----------------------------------------------------------------- framing
 
 def _send_frame(sock: socket.socket, req_id: int, op: int,
                 body: bytes = b"") -> None:
-    payload = _REQ.pack(req_id, op) + body
-    sock.sendall(MAGIC + _LEN.pack(len(payload)) + payload)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf.extend(chunk)
-    return bytes(buf)
+    send_frame(sock, MAGIC, _REQ.pack(req_id, op) + body)
 
 
 def _recv_frame(sock: socket.socket) -> Optional[tuple[int, int, bytes]]:
-    hdr = _recv_exact(sock, 8)
-    if hdr is None:
-        return None
-    if hdr[:4] != MAGIC:
-        raise ValueError("bad sidecar magic")
-    (n,) = _LEN.unpack_from(hdr, 4)
-    payload = _recv_exact(sock, n)
+    payload = recv_frame(sock, MAGIC, MAX_FRAME)
     if payload is None:
         return None
+    if len(payload) < _REQ.size:
+        # struct.error would escape the readers' (OSError, ValueError) nets
+        # and kill the thread without its cleanup path
+        raise ValueError(f"sidecar frame too short: {len(payload)}")
     req_id, op = _REQ.unpack_from(payload, 0)
     return req_id, op, payload[_REQ.size:]
 
@@ -102,13 +92,18 @@ class SidecarServer:
     """
 
     def __init__(self, engine, socket_path: str,
-                 score_timeout_s: float = 5.0):
+                 score_timeout_s: float = 5.0, max_inflight: int = 64):
         self.engine = engine
         self.socket_path = socket_path
         self.score_timeout_s = score_timeout_s
+        # admission control at the accept boundary: without a cap, a slow
+        # engine at north-star rates turns thread-per-request into a thread
+        # bomb (same posture as the engine's bounded queue)
+        self._inflight = threading.Semaphore(max_inflight)
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._conns = ConnRegistry()
 
     def start(self) -> "SidecarServer":
         if os.path.exists(self.socket_path):
@@ -137,6 +132,9 @@ class SidecarServer:
                 self._sock.close()
             except OSError:
                 pass
+        # close accepted connections too, or same-process clients blocked in
+        # recv never see EOF (their FIN only comes at process exit)
+        self._conns.close_all()
         if os.path.exists(self.socket_path):
             try:
                 os.unlink(self.socket_path)
@@ -158,24 +156,42 @@ class SidecarServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         wlock = threading.Lock()  # replies from handler threads interleave
+        self._conns.add(conn)
         try:
             while not self._stop.is_set():
                 got = _recv_frame(conn)
                 if got is None:
                     return
                 req_id, op, body = got
+                if not self._inflight.acquire(blocking=False):
+                    meter.add(OVERLOAD_METRIC)
+                    try:
+                        with wlock:
+                            _send_frame(conn, req_id, ST_ERROR,
+                                        b"sidecar overloaded")
+                    except OSError:
+                        return
+                    continue
                 threading.Thread(
                     target=self._handle, name="sidecar-req", daemon=True,
                     args=(conn, wlock, req_id, op, body)).start()
         except (OSError, ValueError):
             return
         finally:
+            self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
 
     def _handle(self, conn, wlock, req_id: int, op: int, body: bytes) -> None:
+        try:
+            self._handle_inner(conn, wlock, req_id, op, body)
+        finally:
+            self._inflight.release()
+
+    def _handle_inner(self, conn, wlock, req_id: int, op: int,
+                      body: bytes) -> None:
         try:
             if op == OP_PING:
                 reply = (ST_OK, b"")
@@ -230,36 +246,21 @@ class SidecarClient:
             return self._next_id, rec
 
     def connect(self) -> None:
-        import time
-
         with self._clock:  # concurrent first requests connect exactly once
             if self._sock is not None:
                 return
-            deadline = time.monotonic() + self.connect_timeout_s
-            last_err: Optional[Exception] = None
-            while time.monotonic() < deadline:
-                try:
-                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                    s.connect(self.socket_path)
-                    self._sock = s
-                    self._reader = threading.Thread(
-                        target=self._read_loop, args=(s,),
-                        name="sidecar-client-reader", daemon=True)
-                    self._reader.start()
-                    return
-                except OSError as e:
-                    last_err = e
-                    time.sleep(0.05)
-            raise ConnectionError(
-                f"sidecar at {self.socket_path} not reachable: {last_err}")
+            s = connect_unix_retry(self.socket_path, self.connect_timeout_s)
+            self._sock = s
+            self._reader = threading.Thread(
+                target=self._read_loop, args=(s,),
+                name="sidecar-client-reader", daemon=True)
+            self._reader.start()
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        with self._clock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            shutdown_close(sock)  # reader blocks in recv; see framing.py
 
     def _read_loop(self, sock: socket.socket) -> None:
         try:
@@ -275,7 +276,16 @@ class SidecarClient:
                     rec["event"].set()
         except (OSError, ValueError):
             pass
-        # connection died: fail everything in flight
+        # connection died: drop the dead socket first so the next request()
+        # reconnects immediately instead of sending into it and burning the
+        # full timeout, then fail everything in flight
+        with self._clock:
+            if self._sock is sock:
+                self._sock = None
+        try:
+            sock.close()
+        except OSError:
+            pass
         with self._plock:
             pending, self._pending = self._pending, {}
         for rec in pending.values():
@@ -286,10 +296,17 @@ class SidecarClient:
                 timeout_s: float = 30.0) -> bytes:
         if self._sock is None:
             self.connect()
+        # snapshot under the connect lock: the reader thread clears
+        # self._sock asynchronously on connection loss, and sending into a
+        # None must surface as ConnectionError, not AttributeError
+        with self._clock:
+            sock = self._sock
+        if sock is None:
+            raise ConnectionError("sidecar connection lost")
         req_id, rec = self._new_waiter()
         try:
             with self._wlock:
-                _send_frame(self._sock, req_id, op, body)
+                _send_frame(sock, req_id, op, body)
         except OSError as e:
             with self._plock:
                 self._pending.pop(req_id, None)

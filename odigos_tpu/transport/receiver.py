@@ -31,16 +31,24 @@ class ShmSpanReceiver(Receiver):
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         self._rings: dict[str, SpanRing] = {}
+        # names owned by the handoff inventory (vs attach_ring callers):
+        # only these are eligible for stale-detach on refresh
+        self._handoff_names: set[str] = set()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
 
-    def attach_ring(self, name: str, ring: SpanRing) -> None:
+    def attach_ring(self, name: str, ring: SpanRing,
+                    _from_handoff: bool = False) -> None:
         # close-under-lock: drain_once also drains under the lock, so the
         # old ring can never be freed while a native drain is inside it
         with self._lock:
             old = self._rings.get(name)
             self._rings[name] = ring
+            if _from_handoff:
+                self._handoff_names.add(name)
+            else:
+                self._handoff_names.discard(name)
             if old is not None:
                 old.close()
 
@@ -63,7 +71,8 @@ class ShmSpanReceiver(Receiver):
                                                                 st.st_ino):
                     os.close(fd)  # same ring; nothing to do
                     continue
-                self.attach_ring(ring_name, SpanRing.attach(fd))
+                self.attach_ring(ring_name, SpanRing.attach(fd),
+                                 _from_handoff=True)
                 swapped += 1
             except (OSError, ValueError):
                 # not-yet-initialized or torn ring: close the fd, keep the
@@ -72,12 +81,14 @@ class ShmSpanReceiver(Receiver):
                     os.close(fd)
                 except OSError:
                     pass
-        # The handoff is the full current inventory: rings it no longer
-        # names belong to exited producers — detach them so their mmaps and
-        # drain work don't leak for the receiver's lifetime.
+        # The handoff is the full current inventory *of handoff-owned
+        # rings*: ones it no longer names belong to exited producers —
+        # detach them so their mmaps and drain work don't leak. Rings
+        # attached directly (same-process producers) are not its to revoke.
         with self._lock:
-            stale = {n: self._rings.pop(n)
-                     for n in list(self._rings) if n not in handoff}
+            gone = [n for n in self._handoff_names if n not in handoff]
+            stale = {n: self._rings.pop(n) for n in gone if n in self._rings}
+            self._handoff_names -= set(gone)
         for ring in stale.values():
             ring.close()
         if stale:

@@ -1,0 +1,102 @@
+"""Length-prefixed socket framing shared by the unix-socket protocols
+(scoring sidecar, OpAMP transport): ``magic | u32 payload_len | payload``,
+little-endian. One implementation so a framing fix (length cap, recv
+semantics) can never silently diverge between protocols.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional
+
+_LEN = struct.Struct("<I")
+HEADER_SIZE = 8  # 4-byte magic + u32 length
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on clean EOF mid-read."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, magic: bytes, payload: bytes) -> None:
+    sock.sendall(magic + _LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket, magic: bytes,
+               max_len: int) -> Optional[bytes]:
+    """Read one frame's payload; None on EOF. Raises ValueError on a magic
+    mismatch or a length beyond ``max_len`` (stream corruption — callers
+    should drop the connection, not try to resync)."""
+    hdr = recv_exact(sock, HEADER_SIZE)
+    if hdr is None:
+        return None
+    if hdr[:4] != magic:
+        raise ValueError(f"bad frame magic {hdr[:4]!r} (want {magic!r})")
+    (n,) = _LEN.unpack_from(hdr, 4)
+    if n > max_len:
+        raise ValueError(f"frame length {n} exceeds cap {max_len}")
+    return recv_exact(sock, n)
+
+
+def shutdown_close(sock: socket.socket) -> None:
+    """Half-close then close. The shutdown matters whenever ANY thread may
+    be blocked in recv on this socket: close() alone defers the FIN until
+    that recv returns, so the peer would never see EOF."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def connect_unix_retry(path: str, timeout_s: float) -> socket.socket:
+    """Connect to a unix socket, retrying until the deadline (the server
+    may still be binding). Raises ConnectionError at the deadline."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(path)
+            return s
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise ConnectionError(f"unix socket {path} not reachable: {last}")
+
+
+class ConnRegistry:
+    """Tracks accepted connections so a server shutdown can close them all
+    (same-process peers blocked in recv otherwise never see a FIN)."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+
+    def add(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(conn)
+
+    def discard(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns, self._conns = set(self._conns), set()
+        for conn in conns:
+            shutdown_close(conn)

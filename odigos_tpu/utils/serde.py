@@ -102,6 +102,10 @@ def from_jsonable(tp: Any, data: Any) -> Any:
                 kwargs[f.name] = from_jsonable(hints[f.name], data[f.name])
         return tp(**kwargs)
     if tp in (int, float, str, bool):
+        # bool is an int subclass: without this guard a tampered state file
+        # can smuggle True into an int/float field instead of failing loudly
+        if tp is not bool and isinstance(data, bool):
+            raise TypeError(f"expected {tp.__name__}, got bool")
         if tp is float and isinstance(data, int):
             return float(data)
         if not isinstance(data, tp):

@@ -43,6 +43,9 @@ class WireExporter(Exporter):
         super().__init__(name, config)
         self._queue: deque[bytes] = deque(
             maxlen=int(config.get("queue_size", 512)))
+        # guards the queue→inflight handoff so flush()/queued can never
+        # observe the frame in neither place
+        self._qlock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -53,9 +56,10 @@ class WireExporter(Exporter):
 
     def export(self, batch: SpanBatch) -> None:
         buf = frame(batch)  # encode on caller thread; send is async
-        if len(self._queue) == self._queue.maxlen:
-            meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
-        self._queue.append(buf)
+        with self._qlock:
+            if len(self._queue) == self._queue.maxlen:
+                meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+            self._queue.append(buf)
         self._wake.set()
 
     # ------------------------------------------------------------ lifecycle
@@ -85,7 +89,9 @@ class WireExporter(Exporter):
 
     @property
     def queued(self) -> int:
-        return len(self._queue) + (1 if self._inflight is not None else 0)
+        with self._qlock:
+            return len(self._queue) + (1 if self._inflight is not None
+                                       else 0)
 
     # ------------------------------------------------------------ sending
 
@@ -139,9 +145,12 @@ class WireExporter(Exporter):
             # race us into sending a displaced frame or silently losing the
             # one being retried.
             if self._inflight is None:
-                try:
-                    self._inflight = self._queue.popleft()
-                except IndexError:
+                with self._qlock:
+                    try:
+                        self._inflight = self._queue.popleft()
+                    except IndexError:
+                        pass
+                if self._inflight is None:
                     self._wake.wait(timeout=0.1)
                     self._wake.clear()
                     continue

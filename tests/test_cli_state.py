@@ -81,3 +81,19 @@ def test_state_version_mismatch(state_dir):
         json.dump(payload, f)
     with pytest.raises(RuntimeError, match="version mismatch"):
         load_state(state_dir)
+
+
+def test_serde_rejects_bool_for_numeric_fields():
+    """bool is an int subclass: a tampered state file must not smuggle
+    True into int/float fields (round-2 advisor finding)."""
+    import pytest as _pytest
+
+    from odigos_tpu.utils.serde import from_jsonable
+
+    assert from_jsonable(int, 5) == 5
+    assert from_jsonable(float, 5) == 5.0
+    assert from_jsonable(bool, True) is True
+    with _pytest.raises(TypeError, match="bool"):
+        from_jsonable(int, True)
+    with _pytest.raises(TypeError, match="bool"):
+        from_jsonable(float, False)

@@ -152,10 +152,112 @@ def test_sidecar_death_passes_through(tmp_path):
         proc.kill()
         proc.wait(timeout=10)
         meter.reset()
-        # connection lost → engine error → None → caller passes through
+        # connection lost → engine error → None → caller passes through.
+        # The client retries a reconnect for connect_timeout_s before the
+        # error surfaces, so the counter lags the pass-through.
         assert eng.score_sync(batch, timeout_s=2.0) is None
+        deadline = time.time() + 10
+        while (meter.counter("odigos_anomaly_engine_errors_total") == 0
+               and time.time() < deadline):
+            time.sleep(0.05)
         assert meter.counter("odigos_anomaly_engine_errors_total") > 0
     finally:
         eng.shutdown()
         if proc.poll() is None:
             proc.kill()
+
+
+def test_overload_rejection(tmp_path):
+    """Admission control at the accept loop: beyond max_inflight the server
+    replies ST_ERROR instead of spawning an unbounded thread per request
+    (VERDICT r2 weak item 5)."""
+    import threading
+
+    from odigos_tpu.serving.sidecar import (
+        OVERLOAD_METRIC, SidecarClient, SidecarServer)
+    from odigos_tpu.utils.telemetry import meter
+
+    release = threading.Event()
+    entered = threading.Semaphore(0)  # one permit per request in the engine
+
+    class SlowEngine:
+        def start(self):
+            return self
+
+        def shutdown(self):
+            release.set()
+
+        def warmup(self, batch):
+            pass
+
+        def score_sync(self, batch, features=None, timeout_s=None):
+            entered.release()
+            release.wait(10)
+            import numpy as np
+
+            return np.zeros(len(batch), np.float32)
+
+    sock = str(tmp_path / "score.sock")
+    server = SidecarServer(SlowEngine(), sock, max_inflight=2)
+    server.start()
+    before = meter.counter(OVERLOAD_METRIC)
+    try:
+        client = SidecarClient(sock)
+        batch = synthesize_traces(3, seed=0)
+        from odigos_tpu.wire.codec import encode_batch
+        from odigos_tpu.serving.sidecar import OP_SCORE
+
+        body = encode_batch(batch)
+        waiters = []
+        for _ in range(2):  # fill both slots (responses blocked on engine)
+            rid, rec = client._new_waiter()
+            from odigos_tpu.serving.sidecar import _send_frame
+
+            client.connect()
+            with client._wlock:
+                _send_frame(client._sock, rid, OP_SCORE, body)
+            waiters.append(rec)
+        # wait until BOTH handler threads are inside the engine — only then
+        # is the semaphore provably exhausted
+        for _ in range(2):
+            assert entered.acquire(timeout=5), \
+                "handler threads never reached the engine"
+        with pytest.raises(RuntimeError, match="overloaded"):
+            client.score(batch, timeout_s=5.0)
+        assert meter.counter(OVERLOAD_METRIC) == before + 1
+        release.set()
+        for rec in waiters:  # the in-flight two still complete
+            assert rec["event"].wait(5)
+    finally:
+        release.set()
+        server.shutdown()
+
+
+def test_client_reconnects_after_server_restart(tmp_path):
+    """The reader thread clears the dead socket on connection loss so the
+    next request reconnects immediately (round-2 advisor finding)."""
+    from odigos_tpu.serving.engine import EngineConfig, ScoringEngine
+    from odigos_tpu.serving.sidecar import SidecarClient, SidecarServer
+
+    sock = str(tmp_path / "score.sock")
+    server = SidecarServer(
+        ScoringEngine(EngineConfig(model="mock")), sock)
+    server.start()
+    client = SidecarClient(sock)
+    batch = synthesize_traces(3, seed=0)
+    try:
+        assert len(client.score(batch, timeout_s=5.0)) == len(batch)
+        server.shutdown()
+        deadline = time.time() + 5
+        while client._sock is not None and time.time() < deadline:
+            time.sleep(0.02)
+        assert client._sock is None, "dead socket never cleared"
+        server2 = SidecarServer(
+            ScoringEngine(EngineConfig(model="mock")), sock)
+        server2.start()
+        try:
+            assert len(client.score(batch, timeout_s=5.0)) == len(batch)
+        finally:
+            server2.shutdown()
+    finally:
+        client.close()
