@@ -1,0 +1,5 @@
+#!/bin/sh
+# reference: collector/distribution/odigos-otelcol/postinstall.sh
+systemctl daemon-reload
+systemctl enable odigos-tpu-collector.service
+systemctl restart odigos-tpu-collector.service

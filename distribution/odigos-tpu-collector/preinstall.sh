@@ -1,0 +1,3 @@
+#!/bin/sh
+# reference: collector/distribution/odigos-otelcol/preinstall.sh
+getent passwd odigos >/dev/null || useradd --system --user-group --no-create-home --shell /sbin/nologin odigos

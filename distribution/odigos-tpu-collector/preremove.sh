@@ -1,0 +1,4 @@
+#!/bin/sh
+# reference: collector/distribution/odigos-otelcol/preremove.sh
+systemctl stop odigos-tpu-collector.service || true
+systemctl disable odigos-tpu-collector.service || true

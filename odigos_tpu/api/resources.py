@@ -76,6 +76,36 @@ class Condition:
     last_transition: float = field(default_factory=time.time)
 
 
+class ConditionsMixin:
+    """Change-gated condition upsert, shared by every resource carrying a
+    ``conditions`` list. An identical condition is a no-op that preserves
+    last_transition (k8s lastTransitionTime semantics) — reconcilers key
+    their 'did anything change' status-write decision on the return value,
+    which keeps the level-triggered loop quiescent."""
+
+    conditions: list  # provided by the dataclass
+
+    @staticmethod
+    def _condition_order(cond_type: str) -> int:
+        return 0  # insertion order; subclasses impose a logical order
+
+    def set_condition(self, cond: Condition) -> bool:
+        existing = self.condition(cond.type)
+        if existing is not None and (existing.status, existing.reason,
+                                     existing.message) == (
+                cond.status, cond.reason, cond.message):
+            return False
+        self.conditions = [c for c in self.conditions if c.type != cond.type]
+        self.conditions.append(cond)
+        self.conditions.sort(
+            key=lambda c: self._condition_order(c.type))
+        return True
+
+    def condition(self, cond_type: str) -> Optional[Condition]:
+        return next((c for c in self.conditions if c.type == cond_type),
+                    None)
+
+
 # InstrumentationConfig status condition types, in logical order
 # (instrumentationconfig_types.go:26-36, StatusConditionTypeLogicalOrder :39)
 MARKED_FOR_INSTRUMENTATION = "MarkedForInstrumentation"
@@ -238,10 +268,13 @@ class SdkConfig:
     code_attributes: bool = False
     http_headers: list[str] = field(default_factory=list)
     trace_config: dict[str, Any] = field(default_factory=dict)
+    # custom-instrumentation rule probes for this language (validated;
+    # instrumentationrules/custom_instrumentation.go)
+    custom_probes: list[dict[str, str]] = field(default_factory=list)
 
 
 @dataclass
-class InstrumentationConfig(Resource):
+class InstrumentationConfig(Resource, ConditionsMixin):
     """instrumentationconfig_types.go:17 — one per instrumented workload;
     spec written by the instrumentor, runtime details by the node agent."""
 
@@ -255,27 +288,8 @@ class InstrumentationConfig(Resource):
     runtime_details: list[RuntimeDetails] = field(default_factory=list)
     conditions: list[Condition] = field(default_factory=list)
 
-    def set_condition(self, cond: Condition) -> bool:
-        """Upsert a condition; returns True when it changed. An identical
-        condition is a no-op that preserves last_transition (k8s
-        lastTransitionTime semantics) — reconcilers key their 'did anything
-        change' status-write decision on the return value, which keeps the
-        level-triggered loop quiescent."""
-        existing = self.condition(cond.type)
-        if existing is not None and (existing.status, existing.reason,
-                                     existing.message) == (
-                cond.status, cond.reason, cond.message):
-            return False
-        self.conditions = [c for c in self.conditions if c.type != cond.type]
-        self.conditions.append(cond)
-        self.conditions.sort(key=lambda c: condition_logical_order(c.type))
-        return True
-
-    def condition(self, cond_type: str) -> Optional[Condition]:
-        for c in self.conditions:
-            if c.type == cond_type:
-                return c
-        return None
+    # the 4 ordered status conditions (instrumentationconfig_types.go:26-36)
+    _condition_order = staticmethod(condition_logical_order)
 
 
 # ---------------------------------------------- InstrumentationInstance
@@ -342,7 +356,7 @@ class CollectorsGroupRole(str, enum.Enum):
 
 
 @dataclass
-class CollectorsGroup(Resource):
+class CollectorsGroup(Resource, ConditionsMixin):
     """collectorsgroup_types.go:26-37: desired state of one collector tier;
     resources settings resolved by the scheduler from sizing presets."""
 
@@ -363,7 +377,7 @@ class CollectorsGroup(Resource):
 
 
 @dataclass
-class DestinationResource(Resource):
+class DestinationResource(Resource, ConditionsMixin):
     """destination_types.go: a configured destination instance. The
     embedded ``destinations.Destination`` carries type/signals/fields."""
 
@@ -405,7 +419,7 @@ class ActionKind(str, enum.Enum):
 
 
 @dataclass
-class Action(Resource):
+class Action(Resource, ConditionsMixin):
     """action_types.go: a high-level telemetry policy the autoscaler
     compiles into collector processor configs
     (autoscaler/controllers/actions/*.go)."""
@@ -424,6 +438,28 @@ class ConfigMap(Resource):
     configmap.go:150; collectors hot-reload via the odigosk8scmprovider)."""
 
     data: dict[str, Any] = field(default_factory=dict)
+
+
+# ------------------------------------------------------------------ Odigos
+
+
+@dataclass
+class Odigos(Resource, ConditionsMixin):
+    """operator/api/v1alpha1/odigos_types.go:26 OdigosSpec / :105 Odigos —
+    the single resource whose reconciler installs/uninstalls the whole
+    stack (the OLM-operator alternative to CLI/Helm install)."""
+
+    on_prem_token: str = ""
+    ui_mode: str = "normal"
+    telemetry_enabled: bool = False
+    ignored_namespaces: list[str] = field(default_factory=list)
+    ignored_containers: list[str] = field(default_factory=list)
+    profiles: list[str] = field(default_factory=list)
+    agent_env_vars_injection_method: str = ""
+    image_prefix: str = ""
+    mount_method: str = ""
+    # status
+    conditions: list[Condition] = field(default_factory=list)
 
 
 # ------------------------------------------------------------ kind registry

@@ -51,11 +51,22 @@ def cmd_install(args) -> int:
 
     config = Configuration(profiles=list(args.profile or []))
     tier = Tier(args.tier)
+    if tier != Tier.COMMUNITY:
+        # paid tiers require a validated entitlement token
+        # (odigosauth/odigosauth.go:69 ValidateToken at install)
+        from ..utils.auth import TokenError, validate_tier_claim
+
+        try:
+            validate_tier_claim(getattr(args, "onprem_token", None) or "",
+                                tier.value)
+        except TokenError as e:
+            return _err(f"tier {tier.value!r} requires a valid pro token "
+                        f"(--onprem-token): {e}")
     _, unknown = resolve_profiles(config.profiles, tier)
     if unknown:
         return _err(f"unknown or tier-gated profiles: {unknown}")
     state = create_state(path=args.state_dir, nodes=args.nodes,
-                         config=config)
+                         config=config, tier=tier.value)
     state.save()
     print(f"installed odigos-tpu (nodes={args.nodes}, tier={tier.value}, "
           f"profiles={config.profiles or 'none'}) "
@@ -248,9 +259,12 @@ def cmd_profile(args) -> int:
             return _err(f"profile {args.name} already active")
         from ..config.profiles import resolve_profiles
 
-        _, unknown = resolve_profiles([args.name], Tier(args.tier))
+        # the tier validated at install time gates profile-add — a flag on
+        # this command is not an entitlement (odigosauth enforcement)
+        _, unknown = resolve_profiles([args.name], Tier(state.tier))
         if unknown:
-            return _err(f"unknown or tier-gated profile {args.name!r}")
+            return _err(f"unknown or tier-gated profile {args.name!r} "
+                        f"(installed tier: {state.tier})")
         state.config.profiles.append(args.name)
         state.scheduler.apply_authored(state.config)
         state.reconcile()
@@ -308,6 +322,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="append")
     p.add_argument("--tier", default="community",
                    choices=["community", "cloud", "onprem"])
+    p.add_argument("--onprem-token", default=None,
+                   help="pro entitlement token (required for paid tiers)")
     p.set_defaults(fn=cmd_install)
 
     p = sub.add_parser("uninstall", help="delete the installation")

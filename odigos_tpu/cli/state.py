@@ -14,7 +14,7 @@ from typing import Optional
 
 from ..api.resources import advance_uid_floor, resource_class
 from ..api.store import ControllerManager, Store
-from ..config.model import Configuration
+from ..config.model import Configuration, Tier
 from ..controlplane import Autoscaler, Cluster, Instrumentor, Scheduler
 from ..nodeagent import Odiglet
 from ..utils.serde import from_jsonable, to_jsonable
@@ -44,6 +44,9 @@ class CliState:
     instrumentor: Instrumentor
     autoscaler: Autoscaler
     odiglets: list[Odiglet]
+    # tier validated at install time (odigosauth); profile-add trusts THIS,
+    # never a command-line flag
+    tier: str = "community"
 
     def reconcile(self, rounds: int = 3) -> None:
         for _ in range(rounds):
@@ -61,6 +64,7 @@ class CliState:
             "resources": resources,
             "cluster": self.cluster.to_dict(),
             "config": self.config.to_dict(),
+            "tier": self.tier,
         }
         os.makedirs(self.path, exist_ok=True)
         tmp = os.path.join(self.path, STATE_FILE + ".tmp")
@@ -75,10 +79,11 @@ def state_exists(path: Optional[str] = None) -> bool:
 
 
 def _boot(path: str, store: Store, cluster: Cluster,
-          config: Configuration) -> CliState:
+          config: Configuration, tier: str = "community") -> CliState:
     manager = ControllerManager(store)
     scheduler = Scheduler(store, manager)
-    instrumentor = Instrumentor(store, manager, cluster, config)
+    scheduler.tier = Tier(tier)
+    instrumentor = Instrumentor(store, manager, cluster, config, tier=tier)
     autoscaler = Autoscaler(store, manager, config)
     odiglets = [Odiglet(store, manager, cluster, node=n,
                         tpu_chips=int(config.extra.get("tpu_chips", 0)))
@@ -87,14 +92,15 @@ def _boot(path: str, store: Store, cluster: Cluster,
     for od in odiglets:
         od.run()
     return CliState(path, store, cluster, config, manager, scheduler,
-                    instrumentor, autoscaler, odiglets)
+                    instrumentor, autoscaler, odiglets, tier=tier)
 
 
 def create_state(path: Optional[str] = None, nodes: int = 1,
-                 config: Optional[Configuration] = None) -> CliState:
+                 config: Optional[Configuration] = None,
+                 tier: str = "community") -> CliState:
     path = path or default_state_dir()
     state = _boot(path, Store(), Cluster(nodes=nodes),
-                  config or Configuration())
+                  config or Configuration(), tier=tier)
     state.scheduler.apply_authored(state.config)
     state.reconcile()
     return state
@@ -122,7 +128,8 @@ def load_state(path: Optional[str] = None) -> CliState:
     advance_uid_floor(max_uid)
     cluster = Cluster.from_dict(payload["cluster"])
     config = Configuration.from_dict(payload["config"])
-    state = _boot(path, store, cluster, config)
+    state = _boot(path, store, cluster, config,
+                  tier=payload.get("tier", "community"))
     # resync: controllers resume from stored state (level-triggered)
     for kind in list(store._objects):
         state.manager.enqueue_all(kind)

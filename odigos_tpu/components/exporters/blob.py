@@ -1,0 +1,110 @@
+"""Blob-storage exporters: ``azureblobstorage`` + ``googlecloudstorage``.
+
+Reference: collector/exporters/azureblobstorageexporter/exporter.go
+(marshal the batch, write one object per consume through a DataWriter) and
+googlecloudstorageexporter/{exporter,gcs_writer}.go. One generic writer
+serves both types here: the object layout is
+``{container|bucket}/{signal}/{prefix}{unix_ns}-{seq}.json`` with an
+otlp_json-style document per batch.
+
+The cloud SDKs are not part of this build (zero-egress), so the uploader
+is pluggable: an ``endpoint`` of ``file://<dir>`` (or a ``local_dir`` key)
+selects the local-filesystem uploader — the in-tree backend tests and
+air-gapped installs use; without it, start() fails with an actionable
+message instead of silently dropping data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from ...pdata.spans import SpanBatch
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Exporter, Factory, register
+
+WRITTEN_METRIC = "odigos_blob_objects_written_total"
+
+
+class LocalDirUploader:
+    """file:// backend — the DataWriter role against a local directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def upload(self, key: str, payload: bytes) -> None:
+        path = os.path.join(self.root, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)  # objects appear atomically, like a real PUT
+
+
+class BlobExporter(Exporter):
+    """Config:
+    container:  azure container / gcs bucket name (object key prefix)
+    endpoint:   file://<dir> selects the local uploader; https endpoints
+                require the cloud SDK (absent in this build -> start error)
+    local_dir:  alternative spelling of a file:// endpoint
+    prefix:     extra object-name prefix (default "")
+    """
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._uploader = None
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        super().start()
+        endpoint = str(self.config.get("endpoint", ""))
+        local_dir = self.config.get("local_dir")
+        if endpoint.startswith("file://"):
+            local_dir = endpoint[len("file://"):]
+        if local_dir:
+            self._uploader = LocalDirUploader(str(local_dir))
+            return
+        raise ValueError(
+            f"{self.name}: no usable blob backend — cloud storage SDKs "
+            f"are not bundled; point 'endpoint' at file://<dir> (or set "
+            f"'local_dir') for the local uploader")
+
+    def export(self, batch: SpanBatch) -> None:
+        if self._uploader is None:
+            raise RuntimeError(f"{self.name}: export before start")
+        container = str(self.config.get("container", "odigos-otlp"))
+        prefix = str(self.config.get("prefix", ""))
+        doc = json.dumps(
+            {"resourceSpans": list(batch.iter_spans())}, default=str
+        ).encode()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        key = (f"{container}/traces/{prefix}"
+               f"{time.time_ns()}-{seq}.json")
+        self._uploader.upload(key, doc)
+        meter.add(f"{WRITTEN_METRIC}{{exporter={self.name}}}")
+
+
+def _make_blob_config() -> dict:
+    return {"container": "odigos-otlp", "prefix": ""}
+
+
+# both reference exporter types resolve to the same implementation; the
+# type name is what the destination configers emit
+register(Factory(
+    type_name="azureblobstorage",
+    kind=ComponentKind.EXPORTER,
+    create=BlobExporter,
+    default_config=_make_blob_config,
+))
+register(Factory(
+    type_name="googlecloudstorage",
+    kind=ComponentKind.EXPORTER,
+    create=BlobExporter,
+    default_config=_make_blob_config,
+))

@@ -12,9 +12,21 @@ from __future__ import annotations
 import copy
 from dataclasses import dataclass, field
 
+from ..utils.feature import Features
 from .model import Configuration, Tier
 from .profiles import Profile, resolve_profiles
 from .sizing import SIZING_PRESETS, ResolvedResources, gateway_resources, node_resources
+
+
+def _jax_version() -> str:
+    """jax's installed version without importing it (config computation
+    runs in CLI paths where a jax import costs seconds)."""
+    try:
+        from importlib.metadata import version
+
+        return version("jax")
+    except Exception:  # noqa: BLE001 — absent jax = all jax gates off
+        return "0.0"
 
 
 @dataclass
@@ -24,6 +36,9 @@ class EffectiveConfig:
     problems: list[str] = field(default_factory=list)
     gateway: ResolvedResources | None = None
     node: ResolvedResources | None = None
+    # resolved feature-gate snapshot (k8sutils/pkg/feature role): what the
+    # connected platform versions enable, surfaced via describe/diagnose
+    features: dict = field(default_factory=dict)
 
 
 def calculate_effective_config(authored: Configuration,
@@ -40,10 +55,22 @@ def calculate_effective_config(authored: Configuration,
         if preset is None:
             problems.append(f"unknown resource size preset {cfg.resource_size_preset!r}")
 
+    # feature gates keyed on the connected platform versions
+    # (k8sutils/pkg/feature/feature.go:22-48): maturity decides defaults,
+    # and immature paths are clamped rather than silently deployed
+    features = Features(k8s_version=cfg.cluster_version,
+                        jax_version=_jax_version())
+    if cfg.anomaly.devices > 1 and not features.enabled("shard-map-scoring"):
+        problems.append(
+            f"anomaly.devices={cfg.anomaly.devices} requires the "
+            f"shard-map-scoring gate (jax too old) — clamped to 1")
+        cfg.anomaly.devices = 1
+
     return EffectiveConfig(
         config=cfg,
         applied_profiles=[p.name for p in profiles],
         problems=problems,
         gateway=gateway_resources(cfg.collector_gateway, preset),
         node=node_resources(cfg.collector_node, preset),
+        features=features.snapshot(),
     )

@@ -145,6 +145,9 @@ class Configuration:
     ignore_odigos_namespace: bool = True
     image_prefix: str = ""
     cluster_name: str = ""
+    # connected control-plane version (the CLI's autodetect role,
+    # cli/pkg/autodetect); feature gates key on it
+    cluster_version: str = "1.30"
     ui_mode: UiMode = UiMode.NORMAL
     ui_pagination_limit: int = 0
     # where collectors ship their own-telemetry metrics stream (the

@@ -220,6 +220,12 @@ class Autoscaler:
         processors = self._processors(store)
         data_streams = self._data_streams(store, destinations)
         gateway_group = self._gateway_group(store)
+        if gateway_group is None:
+            # no CollectorsGroup = not installed (or uninstalled by the
+            # operator): quiesce instead of re-creating the config the
+            # uninstall just deleted
+            store.delete("ConfigMap", ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)
+            return
 
         eff_cm = store.get("ConfigMap", ODIGOS_NAMESPACE,
                            EFFECTIVE_CONFIG_NAME)
@@ -248,19 +254,12 @@ class Autoscaler:
         # (change-gated: an identical condition must not re-trigger watches)
         for dest_res in dest_resources:
             err = status.destination.get(dest_res.meta.name)
-            cond = Condition(
-                "DestinationConfigured",
-                ConditionStatus.FALSE if err else ConditionStatus.TRUE,
-                "ConfigerError" if err else "TransformedToOtelcolConfig",
-                err or "")
-            prev = next((c for c in dest_res.conditions
-                         if c.type == cond.type), None)
-            if prev is not None and (prev.status, prev.reason, prev.message) \
-                    == (cond.status, cond.reason, cond.message):
-                continue
-            dest_res.conditions = [c for c in dest_res.conditions
-                                   if c.type != cond.type] + [cond]
-            store.update_status(dest_res)
+            if dest_res.set_condition(Condition(
+                    "DestinationConfigured",
+                    ConditionStatus.FALSE if err else ConditionStatus.TRUE,
+                    "ConfigerError" if err else "TransformedToOtelcolConfig",
+                    err or "")):
+                store.update_status(dest_res)
 
         # node collector config follows the gateway's enabled signals
         node_cfg = build_node_collector_config(NodeCollectorOptions(
@@ -360,18 +359,12 @@ class Autoscaler:
         capped = desired if held >= desired else max(
             self.hpa.min_replicas, held)
 
-        cond = Condition(
-            "TpuScheduling",
-            ConditionStatus.FALSE if starved else ConditionStatus.TRUE,
-            "TpuStarved" if starved else "DevicesAllocated",
-            f"{held}/{desired} gateway replicas TPU-backed "
-            f"({total} devices in cluster)")
-        prev = next((c for c in group.conditions if c.type == cond.type),
-                    None)
-        if prev is None or (prev.status, prev.reason, prev.message) != (
-                cond.status, cond.reason, cond.message):
-            group.conditions = [c for c in group.conditions
-                                if c.type != cond.type] + [cond]
+        if group.set_condition(Condition(
+                "TpuScheduling",
+                ConditionStatus.FALSE if starved else ConditionStatus.TRUE,
+                "TpuStarved" if starved else "DevicesAllocated",
+                f"{held}/{desired} gateway replicas TPU-backed "
+                f"({total} devices in cluster)")):
             self.store.update_status(group)
         return capped
 

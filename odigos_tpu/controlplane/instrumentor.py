@@ -85,12 +85,37 @@ class Instrumentor:
              "InstrumentationConfig": None})
         manager.register(
             "agent-enabled", _AgentEnabledReconciler(self),
-            {"InstrumentationConfig": None})
+            # otel-sdk rules change the distro decision without touching
+            # the IC spec, so rule events must re-enqueue every IC here
+            # too; likewise a tier change in the effective config
+            # (operator-validated token) changes distro availability
+            {"InstrumentationConfig": None,
+             "InstrumentationRule": self._all_ic_keys,
+             "ConfigMap": self._effective_config_to_ic_keys})
 
     # ------------------------------------------------------------ helpers
 
     def _all_ic_keys(self, event: Event):
         return [ic.meta.key for ic in self.store.list("InstrumentationConfig")]
+
+    def _effective_config_to_ic_keys(self, event: Event):
+        from .scheduler import EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE
+
+        if event.key != (ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME):
+            return []
+        return self._all_ic_keys(event)
+
+    def sync_tier_from_effective(self) -> None:
+        """The scheduler records the (token-validated) tier in the
+        effective ConfigMap; distro availability must follow it — an
+        operator-managed paid install enables tier-gated distros without
+        this process having been booted with the tier."""
+        from .scheduler import EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE
+
+        cm = self.store.get("ConfigMap", ODIGOS_NAMESPACE,
+                            EFFECTIVE_CONFIG_NAME)
+        if cm is not None and "tier" in cm.data:
+            self.distro_provider.tier = cm.data["tier"]
 
     def set_effective_config(self, cfg: Configuration) -> None:
         self.config = cfg
@@ -298,10 +323,40 @@ class _RulesReconciler:
                     sdk.http_headers = list(rule.details.get("headers", []))
                 elif rule.rule_kind == RuleKind.TRACE_CONFIG:
                     sdk.trace_config.update(rule.details)
+                elif rule.rule_kind == RuleKind.CUSTOM_INSTRUMENTATION:
+                    sdk.custom_probes.extend(_valid_probes(
+                        lang, rule.details.get("probes", {}).get(lang, [])))
+                # OTEL_SDK (distro override) is consumed by the
+                # agent-enabled reconciler, not the SDK config
             new_configs.append(sdk)
         if new_configs != ic.sdk_configs:
             ic.sdk_configs = new_configs
             store.update_status(ic)
+
+
+# required probe fields per language
+# (instrumentationrules/custom_instrumentation.go Verify: java needs
+# className+methodName; golang probes name a package+function)
+_PROBE_FIELDS = {
+    "java": ("class_name", "method_name"),
+    "go": ("package", "function"),
+}
+
+
+def _valid_probes(language: str,
+                  probes: list[dict]) -> list[dict[str, str]]:
+    """Keep only probes carrying every required field, non-empty — an
+    invalid probe is dropped rather than shipped to an agent that would
+    fail to install it (custom_instrumentation.go Verify)."""
+    required = _PROBE_FIELDS.get(language)
+    out = []
+    for probe in probes:
+        if not isinstance(probe, dict):
+            continue
+        fields = required if required is not None else tuple(probe)
+        if fields and all(probe.get(f) for f in fields):
+            out.append({k: str(v) for k, v in probe.items()})
+    return out
 
 
 # ------------------------------------------------ agent enablement
@@ -349,8 +404,12 @@ class _AgentEnabledReconciler:
 
         containers = []
         any_enabled = False
+        self.i.sync_tier_from_effective()
+        overrides = self._distro_overrides(store, ic.workload)
         for rd in ic.runtime_details:
-            c = self._container_config(rd, cfg)
+            c = self._container_config(
+                rd, cfg,
+                overrides.get(rd.language, overrides.get("*")))
             containers.append(c)
             any_enabled = any_enabled or c.agent_enabled
         new_hash = self._hash(containers)
@@ -378,8 +437,33 @@ class _AgentEnabledReconciler:
 
     # -------------------------------------------------------- per-container
 
-    def _container_config(self, rd: RuntimeDetails,
-                          cfg: Configuration) -> ContainerAgentConfig:
+    def _distro_overrides(self, store: Store,
+                          workload: WorkloadRef) -> dict[str, str]:
+        """otel-sdk rules: distro names that take priority over default
+        resolution per language (instrumentationrules/otel-sdk.go
+        OtelDistros.OtelDistroNames)."""
+        out: dict[str, str] = {}
+        for rule in store.list("InstrumentationRule"):
+            if (not isinstance(rule, InstrumentationRule)
+                    or rule.rule_kind != RuleKind.OTEL_SDK):
+                continue
+            for name in rule.details.get("distro_names", []):
+                distro = DISTROS_BY_NAME.get(name)
+                if distro is not None:
+                    if rule.matches(workload, distro.language):
+                        out[distro.language] = name
+                else:
+                    # unknown distro name: the rule's intent can't be
+                    # honored — force NoAvailableAgent via resolve()
+                    # rather than silently using the default distro
+                    for lang in (rule.languages or ["*"]):
+                        if lang == "*" or rule.matches(workload, lang):
+                            out[lang] = name
+        return out
+
+    def _container_config(self, rd: RuntimeDetails, cfg: Configuration,
+                          distro_override: Optional[str] = None
+                          ) -> ContainerAgentConfig:
         """calculateContainerInstrumentationConfig (sync.go:500)."""
         if rd.container_name in cfg.ignored_containers:
             return ContainerAgentConfig(
@@ -392,7 +476,8 @@ class _AgentEnabledReconciler:
                 AgentEnabledReason.OTHER_AGENT_DETECTED,
                 f"{rd.other_agent} already instruments this container")
         distro, problem = self.i.distro_provider.resolve(
-            rd.language, rd.runtime_version, rd.libc_type)
+            rd.language, rd.runtime_version, rd.libc_type,
+            override_name=distro_override)
         if distro is None:
             return ContainerAgentConfig(
                 rd.container_name, False, AgentEnabledReason(problem),

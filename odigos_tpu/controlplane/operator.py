@@ -1,0 +1,154 @@
+"""Operator: single-resource installer.
+
+Reference: operator/internal/controller/odigos_controller.go — apply ONE
+``Odigos`` resource and its reconciler installs the whole stack (there via
+Helm, here by writing the authored configuration the scheduler chain
+consumes); delete it and the stack is uninstalled (:138 uninstall). Status
+lands in conditions on the resource.
+
+The operator sits ABOVE the scheduler: it owns the authored ConfigMap the
+same way the reference's operator owns the Helm release, and the existing
+level-triggered chain (scheduler → effective config → collectors groups →
+autoscaler → gateway config) does the actual install.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.resources import (
+    Condition,
+    ConditionStatus,
+    ConfigMap,
+    ObjectMeta,
+    Odigos,
+)
+from ..api.store import ControllerManager, Store
+from ..config.model import Configuration, Tier
+from ..utils.auth import TokenError, validate_token_audience
+from .scheduler import (
+    AUTHORED_CONFIG_NAME,
+    EFFECTIVE_CONFIG_NAME,
+    GATEWAY_GROUP_NAME,
+    NODE_GROUP_NAME,
+    ODIGOS_NAMESPACE,
+)
+
+INSTALLED_CONDITION = "Installed"
+
+
+class Operator:
+    """Reconciles ``Odigos`` resources into an installed (or uninstalled)
+    stack. One instance per control plane, like Scheduler/Autoscaler."""
+
+    def __init__(self, store: Store, manager: ControllerManager) -> None:
+        self.store = store
+        manager.register("odigos-operator", self, {"Odigos": None})
+
+    # ----------------------------------------------------------- reconcile
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        odigos = store.get("Odigos", *key)
+        if not isinstance(odigos, Odigos):
+            # resource deleted → uninstall (odigos_controller.go:138): tear
+            # down everything the install chain generated
+            self._uninstall(store)
+            return
+
+        tier = Tier.COMMUNITY
+        if odigos.on_prem_token:
+            # the audience claim IS the entitlement — a cloud token must
+            # not escalate to onprem through the operator path any more
+            # than through the CLI (odigosauth.go checkTokenAttributes)
+            try:
+                _, aud = validate_token_audience(odigos.on_prem_token)
+                if aud not in (Tier.ONPREM.value, Tier.CLOUD.value):
+                    raise TokenError(
+                        f"token audience {aud!r} is not a known tier")
+                tier = Tier(aud)
+            except TokenError as e:
+                if odigos.set_condition(Condition(
+                        INSTALLED_CONDITION, ConditionStatus.FALSE,
+                        "InvalidToken", str(e))):
+                    store.update_status(odigos)
+                return
+
+        config = self._config_from_spec(odigos)
+        # the same gate cmd_install applies: unknown / tier-ineligible
+        # profiles block the install loudly instead of being quietly
+        # recorded in the effective config's problems list
+        from ..config.profiles import resolve_profiles
+
+        _, unknown = resolve_profiles(config.profiles, tier)
+        if unknown:
+            if odigos.set_condition(Condition(
+                    INSTALLED_CONDITION, ConditionStatus.FALSE,
+                    "InvalidProfiles",
+                    f"unknown or tier-gated profiles: {unknown} "
+                    f"(tier: {tier.value})")):
+                store.update_status(odigos)
+            return
+        authored = store.get("ConfigMap", ODIGOS_NAMESPACE,
+                             AUTHORED_CONFIG_NAME)
+        desired = {"config": config.to_dict(), "tier": tier.value}
+        if authored is None or authored.data != desired:
+            store.apply(ConfigMap(
+                meta=ObjectMeta(name=AUTHORED_CONFIG_NAME,
+                                namespace=ODIGOS_NAMESPACE),
+                data=desired))
+        if odigos.set_condition(Condition(
+                INSTALLED_CONDITION, ConditionStatus.TRUE,
+                "InstalledSuccessfully",
+                f"tier={tier.value} profiles={odigos.profiles or 'none'}")):
+            store.update_status(odigos)
+
+    # ----------------------------------------------------------- internals
+
+    @staticmethod
+    def _config_from_spec(odigos: Odigos) -> Configuration:
+        """OdigosSpec → authored Configuration (the values.yaml rendering
+        role of odigos_controller.go:162 install)."""
+        from ..config.model import EnvInjectionMethod, MountMethod, UiMode
+
+        cfg = Configuration(
+            telemetry_enabled=odigos.telemetry_enabled,
+            ignored_namespaces=list(odigos.ignored_namespaces),
+            ignored_containers=list(odigos.ignored_containers),
+            image_prefix=odigos.image_prefix,
+            profiles=list(odigos.profiles),
+        )
+        if odigos.ui_mode:
+            cfg.ui_mode = UiMode(odigos.ui_mode)
+        if odigos.mount_method:
+            cfg.mount_method = MountMethod(odigos.mount_method)
+        if odigos.agent_env_vars_injection_method:
+            cfg.agent_env_vars_injection_method = EnvInjectionMethod(
+                odigos.agent_env_vars_injection_method)
+        return cfg
+
+    @staticmethod
+    def _uninstall(store: Store) -> None:
+        """Delete every artifact the install chain generated — the
+        helmUninstall analog. Sources go first: their deletion drives the
+        instrumentor's existing un-instrument path (IC removal + rollout
+        restart stripping agents from running pods), so apps stop
+        exporting into a gateway that no longer exists. Level-triggered
+        consumers observe the deletions and quiesce."""
+        from .autoscaler import GATEWAY_CONFIG_NAME, NODE_CONFIG_NAME
+
+        for src in list(store.list("Source")):
+            store.delete("Source", src.meta.namespace, src.meta.name)
+        for rule in list(store.list("InstrumentationRule")):
+            store.delete("InstrumentationRule", rule.meta.namespace,
+                         rule.meta.name)
+        for name in (AUTHORED_CONFIG_NAME, EFFECTIVE_CONFIG_NAME,
+                     GATEWAY_CONFIG_NAME, NODE_CONFIG_NAME):
+            store.delete("ConfigMap", ODIGOS_NAMESPACE, name)
+        for name in (GATEWAY_GROUP_NAME, NODE_GROUP_NAME):
+            store.delete("CollectorsGroup", ODIGOS_NAMESPACE, name)
+
+
+def single_odigos(store: Store) -> Optional[Odigos]:
+    """Convenience for status surfaces: the (single) Odigos resource."""
+    items = [r for r in store.list("Odigos") if isinstance(r, Odigos)]
+    return items[0] if items else None

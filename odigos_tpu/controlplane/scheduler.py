@@ -61,14 +61,19 @@ class Scheduler:
         if not isinstance(cm, ConfigMap):
             return
         authored = Configuration.from_dict(cm.data.get("config", {}))
-        eff = calculate_effective_config(authored, self.tier)
+        # an operator-managed install records its (token-validated) tier in
+        # the authored ConfigMap; it wins over this process's default
+        tier = Tier(cm.data["tier"]) if "tier" in cm.data else self.tier
+        eff = calculate_effective_config(authored, tier)
 
         store.apply(ConfigMap(
             meta=ObjectMeta(name=EFFECTIVE_CONFIG_NAME,
                             namespace=ODIGOS_NAMESPACE),
             data={"config": eff.config.to_dict(),
                   "applied_profiles": eff.applied_profiles,
-                  "problems": eff.problems}))
+                  "problems": eff.problems,
+                  "features": eff.features,
+                  "tier": tier.value}))
 
         gw = eff.config.collector_gateway
         store.apply(CollectorsGroup(

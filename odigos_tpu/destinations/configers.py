@@ -276,11 +276,24 @@ def _jaeger(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
 
 
 def _azureblob(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
-    # collector/exporters/azureblobstorageexporter — our custom exporter
+    # collector/exporters/azureblobstorageexporter — our blob exporter;
+    # AZURE_BLOB_ENDPOINT=file://<dir> selects the local uploader (tests)
     name = f"azureblobstorage/{dest.id}"
     config["exporters"][name] = {
         "account_name": _require(dest, "AZURE_BLOB_ACCOUNT_NAME"),
-        "container_name": _require(dest, "AZURE_BLOB_CONTAINER_NAME"),
+        "container": _require(dest, "AZURE_BLOB_CONTAINER_NAME"),
+        "endpoint": dest.get("AZURE_BLOB_ENDPOINT", ""),
+    }
+    return _all(dest, [name])
+
+
+def _gcs(dest: Destination, config: GenericMap) -> dict[Signal, list[str]]:
+    # common/config/gcs.go ModifyConfig: bucket defaults to odigos-otlp;
+    # GCS_ENDPOINT=file://<dir> selects the local uploader (tests)
+    name = f"googlecloudstorage/{dest.id}"
+    config["exporters"][name] = {
+        "container": dest.get("GCS_BUCKET", "odigos-otlp"),
+        "endpoint": dest.get("GCS_ENDPOINT", ""),
     }
     return _all(dest, [name])
 
@@ -431,6 +444,7 @@ _CONFIGERS: dict[str, Recipe] = {
         headers=lambda d: {"Authorization": f"Bearer {_secret('AXIOM_API_TOKEN')}",
                            "X-Axiom-Dataset": _require(d, "AXIOM_DATASET")}),
     "azureblob": _azureblob,
+    "gcs": _gcs,
     "azuremonitor": _azuremonitor,
     "betterstack": _otlp_http(
         "BETTERSTACK_TOKEN", endpoint_fn=lambda d: "https://in-otel.logs.betterstack.com",

@@ -87,7 +87,10 @@ _ALL = [
     _spec("axiom", "Axiom", "managed", "TL",
           "AXIOM_DATASET", ("AXIOM_API_TOKEN", 1)),
     _spec("azureblob", "Azure Blob Storage", "managed", "TL",
-          "AZURE_BLOB_ACCOUNT_NAME", "AZURE_BLOB_CONTAINER_NAME"),
+          "AZURE_BLOB_ACCOUNT_NAME", "AZURE_BLOB_CONTAINER_NAME",
+          "AZURE_BLOB_ENDPOINT"),
+    _spec("gcs", "Google Cloud Storage", "managed", "TL",
+          "GCS_BUCKET", "GCS_ENDPOINT"),
     _spec("azuremonitor", "Azure Monitor", "managed", "TML",
           "AZURE_MONITOR_CONNECTION_STRING", "AZURE_MONITOR_ENDPOINT"),
     _spec("betterstack", "Better Stack", "managed", "ML",

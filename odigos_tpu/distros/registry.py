@@ -109,10 +109,19 @@ class DistroProvider:
         return None
 
     def resolve(self, language: str, runtime_version: str = "",
-                libc: str = "") -> tuple[Optional[Distro], str]:
+                libc: str = "", override_name: Optional[str] = None
+                ) -> tuple[Optional[Distro], str]:
         """Returns (distro, problem). problem is "" on success, else an
-        AgentEnabledReason-compatible string."""
-        name = self.default_distro_name(language, libc)
+        AgentEnabledReason-compatible string. ``override_name`` (from an
+        otel-sdk InstrumentationRule) takes priority over default
+        resolution but still passes tier/version checks."""
+        if override_name is not None:
+            if (override_name not in DISTROS_BY_NAME
+                    or DISTROS_BY_NAME[override_name].language != language):
+                return None, "NoAvailableAgent"
+            name: Optional[str] = override_name
+        else:
+            name = self.default_distro_name(language, libc)
         if name is None:
             return None, "UnsupportedProgrammingLanguage"
         distro = DISTROS_BY_NAME[name]

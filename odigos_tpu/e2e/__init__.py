@@ -10,4 +10,8 @@ components (the chaos-mesh network-latency analog).
 
 from .environment import E2EEnvironment  # noqa: F401
 from .scenario import Scenario, Step  # noqa: F401
-from .chaos import inject_exporter_chaos, clear_exporter_chaos  # noqa: F401
+from .chaos import (  # noqa: F401
+    clear_exporter_chaos,
+    inject_exporter_chaos,
+    inject_memory_pressure,
+)

@@ -30,3 +30,15 @@ def inject_exporter_chaos(env: E2EEnvironment, exporter_id: str, *,
 def clear_exporter_chaos(env: E2EEnvironment, exporter_id: str) -> None:
     inject_exporter_chaos(env, exporter_id, reject_fraction=0.0,
                           response_duration_ms=0.0)
+
+
+def inject_memory_pressure(env: E2EEnvironment, on: bool = True) -> None:
+    """Simulate gateway memory-limiter pressure: the otlp front door starts
+    rejecting frames pre-decode (the configgrpc-fork behavior the HPA's
+    rejection metric is built on). ``on=False`` lifts it."""
+    assert env.gateway is not None
+    for rid, recv in env.gateway.graph.receivers.items():
+        if rid.split("/")[0] == "otlp" and hasattr(recv, "admission"):
+            recv.admission.pressure_fn = (lambda: True) if on else None
+            return
+    raise RuntimeError("gateway has no wire otlp receiver")

@@ -147,10 +147,16 @@ class E2EEnvironment:
         dropped); REJECTED frames feed the HPA rejection metric."""
         from ..wire.client import WireExporter
 
+        endpoint = f"127.0.0.1:{self.gateway_otlp_port()}"
+        if (self._wire_tap is not None
+                and self._wire_tap.config["endpoint"] != endpoint):
+            # gateway hot-reload rebuilt the receiver on a new ephemeral
+            # port; the old tap would retry into a dead socket forever
+            self._wire_tap.shutdown()
+            self._wire_tap = None
         if self._wire_tap is None:
             self._wire_tap = WireExporter("otlpwire/e2e", {
-                "endpoint": f"127.0.0.1:{self.gateway_otlp_port()}",
-                "max_elapsed_s": timeout})
+                "endpoint": endpoint, "max_elapsed_s": timeout})
             self._wire_tap.start()
         self._wire_tap.export(batch)
         return self._wire_tap.flush(timeout=timeout)

@@ -232,6 +232,19 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._html(_dashboard_page())
             if path == "/healthz":
                 return self._json({"status": "ok"})
+            if path == "/metrics":
+                # Prometheus text exposition of this process's self
+                # metrics (own-observability ServiceMonitor scrape role)
+                from ..utils.telemetry import prometheus_text
+
+                body = prometheus_text(meter.snapshot()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if path == "/api/sources":
                 return self._json(_resource_list(
                     store, "Source", q.get("namespace")))

@@ -1,0 +1,30 @@
+"""Manual-enrichment hooks — the hooks/go analog.
+
+Reference: hooks/go/go_hooks.go — helpers an instrumented application calls
+to read the current W3C trace context (GetW3CTraceContext/GetTraceID/
+GetSpanID + zero-context predicates) and enrich auto-instrumented traces
+with manual spans (the gin helper's role). Here the same surface is a
+Python API: a context-var-backed ``ManualTracer`` whose spans land in the
+same ``SpanBatch`` pdata the auto-instrumentation path produces, so they
+flow through an ordinary exporter/ring into the collector unchanged.
+"""
+
+from .tracecontext import (  # noqa: F401
+    ZERO_SPAN_ID,
+    ZERO_TRACE_CONTEXT,
+    ZERO_TRACE_ID,
+    current_span_id,
+    current_trace_context,
+    current_trace_id,
+    format_traceparent,
+    is_zero_span_id,
+    is_zero_trace_context,
+    is_zero_trace_id,
+    parse_traceparent,
+)
+from .tracer import (  # noqa: F401
+    ManualTracer,
+    flush,
+    set_default_sink,
+    span,
+)

@@ -1,0 +1,74 @@
+"""W3C trace-context access (hooks/go/go_hooks.go parity).
+
+The zero-context constants, predicates, and traceparent format follow the
+reference exactly so enriched services interoperate with W3C-propagating
+neighbors.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Optional
+
+ZERO_TRACE_CONTEXT = "00-00000000000000000000000000000000-0000000000000000-00"
+ZERO_TRACE_ID = "00000000000000000000000000000000"
+ZERO_SPAN_ID = "0000000000000000"
+
+# (trace_id, span_id, flags) of the active span, set by ManualTracer and by
+# inbound-request middleware that parsed a traceparent header
+_active: contextvars.ContextVar[Optional[tuple[int, int, int]]] = \
+    contextvars.ContextVar("odigos_active_span", default=None)
+
+
+def format_traceparent(trace_id: int, span_id: int,
+                       flags: int = 1) -> str:
+    return f"00-{trace_id:032x}-{span_id:016x}-{flags:02x}"
+
+
+def parse_traceparent(header: str) -> Optional[tuple[int, int, int]]:
+    """Returns (trace_id, span_id, flags) or None on a malformed header."""
+    parts = header.strip().split("-")
+    if len(parts) != 4 or parts[0] != "00":
+        return None
+    if len(parts[1]) != 32 or len(parts[2]) != 16 or len(parts[3]) != 2:
+        return None
+    try:
+        trace_id = int(parts[1], 16)
+        span_id = int(parts[2], 16)
+        flags = int(parts[3], 16)
+    except ValueError:
+        return None
+    if trace_id == 0 or span_id == 0:
+        return None
+    return trace_id, span_id, flags
+
+
+def current_trace_context() -> str:
+    """GetW3CTraceContext: full traceparent of the active span, or the
+    zero context when nothing is active."""
+    active = _active.get()
+    if active is None:
+        return ZERO_TRACE_CONTEXT
+    return format_traceparent(*active)
+
+
+def current_trace_id() -> str:
+    active = _active.get()
+    return f"{active[0]:032x}" if active else ZERO_TRACE_ID
+
+
+def current_span_id() -> str:
+    active = _active.get()
+    return f"{active[1]:016x}" if active else ZERO_SPAN_ID
+
+
+def is_zero_trace_context(ctx: str) -> bool:
+    return ctx == ZERO_TRACE_CONTEXT
+
+
+def is_zero_trace_id(trace_id: str) -> bool:
+    return trace_id == ZERO_TRACE_ID
+
+
+def is_zero_span_id(span_id: str) -> bool:
+    return span_id == ZERO_SPAN_ID

@@ -1,0 +1,133 @@
+"""Manual span creation feeding the standard pdata path.
+
+The gin-helper role of hooks/go: application code opens spans around work
+the auto-instrumentation can't see; the spans join the same trace (via the
+active W3C context) and the same pipeline (via any exporter/ring the app's
+agent already writes to).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+from ..pdata.spans import SpanBatch, SpanBatchBuilder, SpanKind, StatusCode
+from .tracecontext import _active, parse_traceparent
+
+
+class ManualTracer:
+    """Collects manual spans; ``flush()`` hands the batch to a sink
+    (an exporter's ``export``, a ring's ``write_batch``, or a collector
+    pipeline entry's ``consume``).
+
+    >>> tracer = ManualTracer("checkout-svc", sink=ring.write_batch)
+    >>> with tracer.span("charge-card", attrs={"amount": 42}):
+    ...     ...
+    >>> tracer.flush()
+    """
+
+    def __init__(self, service: str,
+                 sink: Optional[Callable[[SpanBatch], Any]] = None,
+                 auto_flush_spans: int = 256,
+                 max_buffered_spans: int = 4096):
+        self.service = service
+        self.sink = sink
+        self.auto_flush_spans = auto_flush_spans
+        # sink-less tracers (app hasn't wired one yet) must not grow
+        # without bound: past this, buffered spans are dropped and counted
+        self.max_buffered_spans = max_buffered_spans
+        self.dropped_spans = 0
+        self._rng = random.Random()
+        self._lock = threading.Lock()
+        self._builder = SpanBatchBuilder()
+
+    @contextmanager
+    def span(self, name: str, attrs: Optional[dict[str, Any]] = None,
+             kind: int = SpanKind.INTERNAL,
+             traceparent: Optional[str] = None):
+        """Open a manual span. Joins the active trace (or ``traceparent``
+        from an inbound request); errors escaping the block set ERROR
+        status and re-raise."""
+        parent = parse_traceparent(traceparent) if traceparent else \
+            _active.get()
+        if parent is not None:
+            trace_id, parent_span_id, flags = parent
+        else:
+            trace_id = self._rng.getrandbits(128)
+            parent_span_id, flags = 0, 1
+        span_id = self._rng.getrandbits(64) or 1
+        token = _active.set((trace_id, span_id, flags))
+        start = time.time_ns()
+        status = StatusCode.UNSET
+        try:
+            yield
+        except BaseException:
+            status = StatusCode.ERROR
+            raise
+        finally:
+            _active.reset(token)
+            end = time.time_ns()
+            with self._lock:
+                if (self.sink is None
+                        and len(self._builder) >= self.max_buffered_spans):
+                    self.dropped_spans += 1
+                    n = len(self._builder)
+                else:
+                    self._builder.add_span(
+                        trace_id=trace_id, span_id=span_id,
+                        parent_span_id=parent_span_id, name=name,
+                        service=self.service, kind=kind, status_code=status,
+                        start_unix_nano=start, end_unix_nano=end,
+                        attrs=attrs, scope="odigos.hooks.manual")
+                    n = len(self._builder)
+            if self.sink is not None and n >= self.auto_flush_spans:
+                self.flush()
+
+    def flush(self) -> Optional[SpanBatch]:
+        """Emit buffered spans to the sink (or return them when no sink is
+        configured). Returns the batch, or None when empty."""
+        with self._lock:
+            if not len(self._builder):
+                return None
+            batch = self._builder.build()
+            self._builder = SpanBatchBuilder()
+        if self.sink is not None:
+            self.sink(batch)
+        return batch
+
+
+_default_tracer: Optional[ManualTracer] = None
+_default_lock = threading.Lock()
+
+
+def _default() -> ManualTracer:
+    global _default_tracer
+    if _default_tracer is None:
+        import os
+
+        with _default_lock:
+            if _default_tracer is None:
+                _default_tracer = ManualTracer(
+                    os.environ.get("ODIGOS_SERVICE_NAME", "manual"))
+    return _default_tracer
+
+
+def span(name: str, attrs: Optional[dict[str, Any]] = None, **kw):
+    """Module-level convenience over a lazily-created default tracer
+    (service name from ODIGOS_SERVICE_NAME or 'manual'). Wire a sink with
+    :func:`set_default_sink` and drain with :func:`flush` — without a
+    sink, the buffer is bounded and overflow spans are dropped."""
+    return _default().span(name, attrs, **kw)
+
+
+def set_default_sink(sink: Callable[[SpanBatch], Any]) -> None:
+    """Point the default tracer at an exporter/ring/pipeline entry."""
+    _default().sink = sink
+
+
+def flush() -> Optional[SpanBatch]:
+    """Flush the default tracer (returns the batch when no sink is set)."""
+    return _default().flush()

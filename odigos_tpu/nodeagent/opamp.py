@@ -72,6 +72,10 @@ def build_remote_config(ic: InstrumentationConfig,
             "payload_collection": sdk.payload_collection if sdk else None,
             "code_attributes": bool(sdk.code_attributes) if sdk else False,
             "http_headers": list(sdk.http_headers) if sdk else [],
+            # custom-instrumentation rule probes (validated control-plane
+            # side; configsections/instrumentationconfig.go role)
+            "custom_instrumentation": (list(sdk.custom_probes)
+                                       if sdk else []),
         },
     }
     return sections

@@ -83,14 +83,34 @@ class Collector:
 
     # ------------------------------------------------------------ hot swap
     def reload(self, new_config: dict[str, Any]) -> None:
-        """Build + start a new graph, swap, drain + stop the old one."""
+        """Swap in a rebuilt graph: drain + stop the old one first, then
+        start the new (otelcol reload semantics). Stop-before-start is
+        required for fixed-port receivers (the VM distribution's otlp
+        port): the old graph still holds the bind until it stops, and
+        allow_reuse_address makes the same-port rebind immediate."""
+        if new_config == self.config:
+            return  # a no-op reload must not bounce intake
         new_graph = build_graph(new_config, self._registry)
         with self._lock:
             old_graph, old_running = self.graph, self._running
             if old_running:
-                for comp in new_graph.all_components():
-                    comp.start()
+                self._stop_graph(old_graph)
+                started = []
+                try:
+                    for comp in new_graph.all_components():
+                        comp.start()
+                        started.append(comp)
+                except Exception:
+                    # bad new config must not leave the collector dead:
+                    # unwind the partial start and resurrect the old graph
+                    for comp in reversed(started):
+                        try:
+                            comp.shutdown()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    for comp in old_graph.all_components():
+                        comp.start()
+                    meter.add("odigos_collector_reload_failures_total")
+                    raise
             self.graph, self.config = new_graph, new_config
-        if old_running:
-            self._stop_graph(old_graph)
         meter.add("odigos_collector_reloads_total")

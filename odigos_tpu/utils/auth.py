@@ -1,0 +1,104 @@
+"""On-prem/pro token validation — the odigosauth analog.
+
+Reference: odigosauth/odigosauth.go:69 ValidateToken — decode the JWT
+payload (no signature verification in the reference either; the token is
+an entitlement record, not an authentication factor), then check exp /
+iss / sub and extract the audience, which names the entitled tier.
+Claim values keep reference parity so an existing odigos pro token is
+accepted unchanged (migration compat).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import time
+from typing import Any
+
+EXPECTED_ISSUER = "https://odigos.io"
+EXPECTED_SUBJECT = "https://odigos.io/onprem"
+
+
+class TokenError(ValueError):
+    """Invalid/expired pro token."""
+
+
+def extract_jwt_payload(token: str) -> dict[str, Any]:
+    """odigosauth.go extractJWTPayload: split, base64url-decode the middle
+    part, parse JSON."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise TokenError("invalid JWT token format")
+    pad = "=" * (-len(parts[1]) % 4)
+    try:
+        # validate=True: non-alphabet bytes are an error, as in Go's
+        # RawURLEncoding, not silently discarded
+        raw = base64.b64decode(parts[1].replace("-", "+").replace("_", "/")
+                               + pad, validate=True)
+    except (binascii.Error, ValueError):
+        raise TokenError("failed to decode JWT payload") from None
+    try:
+        payload = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise TokenError(f"failed to unmarshal JWT payload: {e}") from None
+    if not isinstance(payload, dict):
+        raise TokenError("JWT payload is not an object")
+    return payload
+
+
+def check_token_attributes(payload: dict[str, Any]) -> str:
+    """odigosauth.go checkTokenAttributes: exp/iss/sub checks; returns the
+    audience (string or first element of a list)."""
+    exp = payload.get("exp")
+    if exp is None:
+        raise TokenError("missing exp claim")
+    if isinstance(exp, bool) or not isinstance(exp, (int, float)):
+        raise TokenError("invalid exp claim type")
+    now = time.time()
+    if now > float(exp):
+        minutes = round((now - float(exp)) / 60)
+        raise TokenError(f"token is expired for {minutes}m, contact "
+                         f"support to issue a new one")
+    if payload.get("iss") != EXPECTED_ISSUER:
+        raise TokenError("invalid iss")
+    if payload.get("sub") != EXPECTED_SUBJECT:
+        raise TokenError("invalid sub")
+    aud = payload.get("aud")
+    if isinstance(aud, str) and aud:
+        return aud
+    if isinstance(aud, list) and aud and isinstance(aud[0], str) and aud[0]:
+        return aud[0]
+    raise TokenError("missing aud claim")
+
+
+def validate_token(token: str) -> dict[str, Any]:
+    """odigosauth.go:69 ValidateToken: full validation; returns the
+    payload. Raises TokenError with an operator-actionable message."""
+    payload, _aud = validate_token_audience(token)
+    return payload
+
+
+def validate_token_audience(token: str) -> tuple[dict[str, Any], str]:
+    """Validate and return (payload, audience) in one pass; the audience
+    names the entitled tier."""
+    if not token:
+        raise TokenError("missing pro token")
+    payload = extract_jwt_payload(token.strip())
+    aud = check_token_attributes(payload)
+    return payload, aud
+
+
+def entitled_tiers(aud: str) -> tuple[str, ...]:
+    """Tiers an audience claim entitles: "onprem" also covers "cloud"."""
+    return {"onprem": ("onprem", "cloud"), "cloud": ("cloud",)}.get(aud, ())
+
+
+def validate_tier_claim(token: str, tier: str) -> dict[str, Any]:
+    """Validate the token AND that its audience entitles ``tier`` — the
+    enforcement point cmd_install/cmd_profile use."""
+    payload, aud = validate_token_audience(token)
+    if tier not in entitled_tiers(aud):
+        raise TokenError(
+            f"token audience {aud!r} does not entitle tier {tier!r}")
+    return payload

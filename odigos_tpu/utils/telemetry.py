@@ -103,4 +103,27 @@ class Meter:
             self._hists.clear()
 
 
+def prometheus_text(snapshot: dict[str, float]) -> str:
+    """Render a ``snapshot()`` as Prometheus text exposition (the
+    own-observability scrape surface; reference: own-observability/
+    prometheus ServiceMonitor scraping the collectors' self metrics).
+    Flat ``name{label=value}`` names pass through with values quoted."""
+    lines = []
+    for name in sorted(snapshot):
+        value = snapshot[name]
+        if "{" in name:
+            base, rest = name.split("{", 1)
+            labels = []
+            for part in rest.rstrip("}").split(","):
+                if "=" in part:
+                    k, v = part.split("=", 1)
+                    v = v.strip().replace("\\", "\\\\").replace('"', '\\"')
+                    labels.append(f'{k.strip()}="{v}"')
+            name = base + "{" + ",".join(labels) + "}"
+        # full float precision: {:g} quantizes to 6 significant digits,
+        # which freezes counters past 1e6 on the scrape surface
+        lines.append(f"{name} {float(value)!r}")
+    return "\n".join(lines) + "\n"
+
+
 meter = Meter()

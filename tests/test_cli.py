@@ -73,7 +73,9 @@ def test_destination_validation(run):
 
 
 def test_profiles_and_diagnose(run, tmp_path):
-    run("install", "--tier", "onprem")
+    from test_auth import make_token
+
+    run("install", "--tier", "onprem", "--onprem-token", make_token())
     out = run("profile", "list", "--tier", "onprem")
     assert "small-batches" in out
     run("profile", "add", "--name", "small-batches", "--tier", "onprem")

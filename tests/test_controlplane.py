@@ -8,6 +8,7 @@ import pytest
 
 from odigos_tpu.api import ControllerManager, ObjectMeta, Store, WorkloadKind, WorkloadRef
 from odigos_tpu.api.resources import (
+    AgentEnabledReason,
     AGENT_ENABLED,
     Action,
     ActionKind,
@@ -622,3 +623,117 @@ class TestTpuCoScheduling:
                        GATEWAY_GROUP_NAME)
         cond = next(c for c in gw.conditions if c.type == "TpuScheduling")
         assert cond.reason == "TpuStarved"
+
+
+class TestRemainingRuleKinds:
+    """custom-instrumentation and otel-sdk rules (VERDICT r2 item 6;
+    reference: api/odigos/v1alpha1/instrumentationrules/)."""
+
+    def test_custom_instrumentation_probes_validated(self):
+        store, mgr, cluster, _ = make_env()
+        w = add_python_app(cluster)
+        instrument(store, mgr, w.ref)
+        write_runtime_details(store, mgr, w.ref)
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="probes", namespace="default"),
+            rule_kind=RuleKind.CUSTOM_INSTRUMENTATION,
+            details={"probes": {
+                "python": [{"module": "shop.cart", "function": "checkout"},
+                           {"module": "", "function": "broken"}],
+                "java": [{"class_name": "Cart", "method_name": "buy"}],
+            }}))
+        mgr.run_once()
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        sdk = ic.sdk_configs[0]
+        assert sdk.language == "python"
+        # the valid python probe survives; the empty-field one is dropped;
+        # java probes don't leak into the python SDK config
+        assert sdk.custom_probes == [
+            {"module": "shop.cart", "function": "checkout"}]
+
+    def test_custom_probes_reach_opamp_remote_config(self):
+        from odigos_tpu.nodeagent.opamp import build_remote_config
+
+        store, mgr, cluster, _ = make_env()
+        w = add_python_app(cluster)
+        instrument(store, mgr, w.ref)
+        write_runtime_details(store, mgr, w.ref)
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="probes", namespace="default"),
+            rule_kind=RuleKind.CUSTOM_INSTRUMENTATION,
+            details={"probes": {"python": [
+                {"module": "shop.cart", "function": "checkout"}]}}))
+        mgr.run_once()
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        sections = build_remote_config(ic, "python")
+        assert sections["instrumentation_libraries"][
+            "custom_instrumentation"] == [
+                {"module": "shop.cart", "function": "checkout"}]
+
+    def test_otel_sdk_rule_overrides_distro(self):
+        store, mgr, cluster, instr = make_env()
+        instr.distro_provider.tier = "onprem"  # java-ebpf is tier-gated
+        w = cluster.add_workload("default", "japp", [
+            Container(name="main", language="java",
+                      runtime_version="17")])
+        instrument(store, mgr, w.ref)
+        write_runtime_details(store, mgr, w.ref, details=[
+            RuntimeDetails(container_name="main", language="java",
+                           runtime_version="17")])
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        assert ic.containers[0].distro_name == "java-community"
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="use-ebpf", namespace="default"),
+            rule_kind=RuleKind.OTEL_SDK,
+            details={"distro_names": ["java-ebpf"]}))
+        mgr.run_once()
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        assert ic.containers[0].distro_name == "java-ebpf"
+
+    def test_otel_sdk_override_still_tier_gated(self):
+        store, mgr, cluster, _ = make_env()  # community tier
+        w = cluster.add_workload("default", "japp", [
+            Container(name="main", language="java",
+                      runtime_version="17")])
+        instrument(store, mgr, w.ref)
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="use-ebpf", namespace="default"),
+            rule_kind=RuleKind.OTEL_SDK,
+            details={"distro_names": ["java-ebpf"]}))
+        write_runtime_details(store, mgr, w.ref, details=[
+            RuntimeDetails(container_name="main", language="java",
+                           runtime_version="17")])
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        c = ic.containers[0]
+        assert not c.agent_enabled
+        assert c.reason == AgentEnabledReason.NO_AVAILABLE_AGENT
+
+    def test_otel_sdk_rule_known_distro_resolves(self):
+        store, mgr, cluster, _ = make_env()
+        w = add_python_app(cluster)
+        instrument(store, mgr, w.ref)
+        write_runtime_details(store, mgr, w.ref)
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="explicit", namespace="default"),
+            rule_kind=RuleKind.OTEL_SDK,
+            details={"distro_names": ["python-community"]}))
+        mgr.run_once()
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        assert ic.containers[0].distro_name == "python-community"
+
+    def test_otel_sdk_rule_unknown_distro_disables_with_reason(self):
+        """A typo'd distro name must surface NoAvailableAgent, not fall
+        back silently to the default distro (review finding)."""
+        store, mgr, cluster, _ = make_env()
+        w = add_python_app(cluster)
+        instrument(store, mgr, w.ref)
+        write_runtime_details(store, mgr, w.ref)
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="typo", namespace="default"),
+            rule_kind=RuleKind.OTEL_SDK,
+            details={"distro_names": ["python-comunity"]}))  # typo
+        mgr.run_once()
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        c = ic.containers[0]
+        assert not c.agent_enabled
+        assert c.reason == AgentEnabledReason.NO_AVAILABLE_AGENT

@@ -199,3 +199,54 @@ class TestExtensionsWiring:
         modify_config(d, cfg)
         assert cfg["exporters"]["prometheusremotewrite/logzio-lz2"][
             "endpoint"] == "https://listener-eu.logz.io:8053"
+
+
+class TestBlobExporter:
+    """Generic blob-writer behind the azureblob/gcs entries (VERDICT r2
+    item 10; reference: collector/exporters/azureblobstorageexporter,
+    common/config/gcs.go)."""
+
+    def test_azureblob_writes_objects_via_file_endpoint(self, tmp_path):
+        from odigos_tpu.e2e import E2EEnvironment
+        from odigos_tpu.pdata import synthesize_traces
+
+        with E2EEnvironment(nodes=1) as env:
+            env.add_destination(Destination(
+                id="blob1", dest_type="azureblob", signals=[Signal.TRACES],
+                config={"AZURE_BLOB_ACCOUNT_NAME": "acct",
+                        "AZURE_BLOB_CONTAINER_NAME": "spans",
+                        "AZURE_BLOB_ENDPOINT": f"file://{tmp_path}"}))
+            assert env.send_traces_wire(synthesize_traces(10, seed=0))
+            import json
+            import time
+
+            deadline = time.time() + 10
+            objects = []
+            while time.time() < deadline and not objects:
+                objects = list((tmp_path / "spans" / "traces").glob("*.json")) \
+                    if (tmp_path / "spans" / "traces").exists() else []
+                time.sleep(0.05)
+            assert objects, "no blob objects written"
+            doc = json.loads(objects[0].read_text())
+            assert doc["resourceSpans"], "empty blob payload"
+
+    def test_gcs_defaults_bucket(self, tmp_path):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        factory = registry.get(ComponentKind.EXPORTER, "googlecloudstorage")
+        exp = factory.create("googlecloudstorage/x", {
+            "endpoint": f"file://{tmp_path}"})
+        exp.start()
+        from odigos_tpu.pdata import synthesize_traces
+
+        exp.export(synthesize_traces(3, seed=1))
+        exp.shutdown()
+        assert list((tmp_path / "odigos-otlp" / "traces").glob("*.json"))
+
+    def test_no_backend_fails_loudly(self):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        factory = registry.get(ComponentKind.EXPORTER, "azureblobstorage")
+        exp = factory.create("azureblobstorage/x", {"container": "c"})
+        with pytest.raises(ValueError, match="file://"):
+            exp.start()

@@ -1,0 +1,247 @@
+"""Manual-enrichment hooks + VM distribution entrypoint + own-metrics
+exposition (the hooks/go, collector/distribution, and own-observability
+analogs — the last §2 inventory gaps)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from odigos_tpu.hooks import (
+    ZERO_TRACE_CONTEXT,
+    ManualTracer,
+    current_span_id,
+    current_trace_context,
+    current_trace_id,
+    is_zero_trace_context,
+    parse_traceparent,
+)
+from odigos_tpu.pdata.spans import StatusCode
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTraceContext:
+    def test_zero_context_outside_spans(self):
+        assert current_trace_context() == ZERO_TRACE_CONTEXT
+        assert is_zero_trace_context(current_trace_context())
+
+    def test_active_inside_span(self):
+        tracer = ManualTracer("svc")
+        with tracer.span("work"):
+            ctx = current_trace_context()
+            assert not is_zero_trace_context(ctx)
+            tid, sid, flags = parse_traceparent(ctx)
+            assert f"{tid:032x}" == current_trace_id()
+            assert f"{sid:016x}" == current_span_id()
+        assert current_trace_context() == ZERO_TRACE_CONTEXT
+
+    def test_parse_rejects_malformed(self):
+        assert parse_traceparent("garbage") is None
+        assert parse_traceparent("00-zz-ff-01") is None
+        assert parse_traceparent(ZERO_TRACE_CONTEXT) is None  # zero ids
+
+
+class TestManualTracer:
+    def test_nested_spans_share_trace(self):
+        tracer = ManualTracer("svc")
+        with tracer.span("parent"):
+            parent_ctx = parse_traceparent(current_trace_context())
+            with tracer.span("child"):
+                child_ctx = parse_traceparent(current_trace_context())
+        batch = tracer.flush()
+        assert len(batch) == 2
+        assert parent_ctx[0] == child_ctx[0]  # same trace
+        by_name = {batch.span_names()[i]: i for i in range(len(batch))}
+        child_i, parent_i = by_name["child"], by_name["parent"]
+        assert batch.col("parent_span_id")[child_i] == \
+            batch.col("span_id")[parent_i]
+        assert batch.service_names() == ["svc", "svc"]
+
+    def test_error_sets_status_and_reraises(self):
+        tracer = ManualTracer("svc")
+        with pytest.raises(RuntimeError):
+            with tracer.span("explode"):
+                raise RuntimeError("boom")
+        batch = tracer.flush()
+        assert batch.col("status_code")[0] == StatusCode.ERROR
+
+    def test_joins_inbound_traceparent(self):
+        tracer = ManualTracer("svc")
+        inbound = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        with tracer.span("handle", traceparent=inbound):
+            assert current_trace_id() == "ab" * 16
+        batch = tracer.flush()
+        assert batch.col("parent_span_id")[0] == int("cd" * 8, 16)
+
+    def test_sink_receives_flush(self):
+        got = []
+        tracer = ManualTracer("svc", sink=got.append)
+        with tracer.span("a"):
+            pass
+        tracer.flush()
+        assert len(got) == 1 and len(got[0]) == 1
+
+    def test_manual_spans_flow_through_collector(self):
+        from odigos_tpu.pipeline.service import Collector
+
+        cfg = {
+            "receivers": {"synthetic": {"count": 0}},
+            "processors": {"batch": {}},
+            "exporters": {"tracedb": {}},
+            "service": {"pipelines": {"traces/in": {
+                "receivers": ["synthetic"], "processors": ["batch"],
+                "exporters": ["tracedb"]}}},
+        }
+        with Collector(cfg) as c:
+            tracer = ManualTracer(
+                "enriched",
+                sink=c.graph.pipeline_entries["traces/in"].consume)
+            with tracer.span("manual-op", attrs={"db.system": "redis"}):
+                pass
+            tracer.flush()
+            db = c.component("tracedb")
+            assert db.wait_for_spans(1, timeout=10)
+            assert "enriched" in db.all_spans().service_names()
+
+
+class TestVmDistribution:
+    def test_standalone_collector_process(self, tmp_path):
+        """The VM-distribution entrypoint: config file -> running
+        collector -> wire traffic -> /metrics exposition -> SIGTERM
+        drain (collector/distribution/odigos-otelcol role)."""
+        import socket as socketlib
+
+        free = []
+        for _ in range(2):
+            s = socketlib.socket()
+            s.bind(("127.0.0.1", 0))
+            free.append(s.getsockname()[1])
+            s.close()
+        otlp_port, metrics_port = free
+        cfg = {
+            "receivers": {"otlpwire": {"port": otlp_port}},
+            "processors": {"batch": {}},
+            "exporters": {"debug": {}},
+            "service": {"pipelines": {"traces/in": {
+                "receivers": ["otlpwire"], "processors": ["batch"],
+                "exporters": ["debug"]}}},
+        }
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(cfg))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "odigos_tpu.pipeline",
+             "--config", str(cfg_path), "--metrics-port",
+             str(metrics_port)],
+            env=dict(os.environ, PYTHONPATH=REPO), cwd=REPO,
+            stdout=subprocess.PIPE, text=True)
+        try:
+            assert "collector up" in proc.stdout.readline()
+            from odigos_tpu.pdata import synthesize_traces
+            from odigos_tpu.wire.client import WireExporter
+
+            exp = WireExporter("w", {"endpoint": f"127.0.0.1:{otlp_port}"})
+            exp.start()
+            exp.export(synthesize_traces(5, seed=0))
+            assert exp.flush(timeout=30)
+            exp.shutdown()
+            # generous deadlines + tolerate a not-yet-listening metrics
+            # port: the full suite saturates this 1-core machine
+            deadline = time.time() + 30
+            text = ""
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{metrics_port}/metrics",
+                            timeout=5) as r:
+                        text = r.read().decode()
+                except OSError:
+                    text = ""
+                if "odigos_collector_starts_total" in text:
+                    break
+                time.sleep(0.2)
+            assert "odigos_collector_starts_total 1" in text
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.stdout.close()
+
+
+def test_frontend_metrics_exposition():
+    from odigos_tpu.api.store import Store
+    from odigos_tpu.frontend import FrontendServer
+    from odigos_tpu.utils.telemetry import meter
+
+    meter.add("odigos_test_expo_total{exporter=x}", 3)
+    fe = FrontendServer(Store(), metrics_port=None).start()
+    try:
+        with urllib.request.urlopen(fe.url + "/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert 'odigos_test_expo_total{exporter="x"} 3' in text
+    finally:
+        fe.shutdown()
+
+
+class TestReviewFixes:
+    def test_reload_failure_resurrects_old_graph(self):
+        """A bad new config must not leave the collector dead: the old
+        graph is restarted and the error propagates (review finding)."""
+        from odigos_tpu.pdata import synthesize_traces
+        from odigos_tpu.pipeline.service import Collector
+
+        good = {
+            "receivers": {"synthetic": {"count": 0}},
+            "processors": {"batch": {}},
+            "exporters": {"tracedb": {}},
+            "service": {"pipelines": {"traces/in": {
+                "receivers": ["synthetic"], "processors": ["batch"],
+                "exporters": ["tracedb"]}}},
+        }
+        bad = json.loads(json.dumps(good))
+        bad["exporters"]["file"] = {}  # FileExporter without 'path': start fails
+        bad["service"]["pipelines"]["traces/in"]["exporters"] = ["file"]
+        with Collector(good) as c:
+            with pytest.raises(ValueError):
+                c.reload(bad)
+            # old graph is alive again and still consumes
+            c.graph.pipeline_entries["traces/in"].consume(
+                synthesize_traces(3, seed=0))
+            assert c.component("tracedb").wait_for_spans(1, timeout=10)
+
+    def test_sinkless_default_tracer_is_bounded(self):
+        tracer = ManualTracer("svc", max_buffered_spans=5)
+        for i in range(9):
+            with tracer.span(f"s{i}"):
+                pass
+        assert tracer.dropped_spans == 4
+        batch = tracer.flush()
+        assert len(batch) == 5
+
+    def test_module_level_flush_and_sink(self):
+        import odigos_tpu.hooks as hooks
+
+        got = []
+        hooks.set_default_sink(got.append)
+        try:
+            with hooks.span("module-level"):
+                pass
+            hooks.flush()
+            assert got and got[0].span_names() == ["module-level"]
+        finally:
+            hooks.set_default_sink(lambda b: None)
+            hooks.flush()
+
+    def test_prometheus_text_keeps_counter_precision(self):
+        from odigos_tpu.utils.telemetry import prometheus_text
+
+        text = prometheus_text({"big_total": 10_000_001.0})
+        assert "1e+07" not in text
+        assert "10000001" in text

@@ -1,0 +1,152 @@
+"""Package hygiene: every module in odigos_tpu is imported from somewhere
+(no dead modules — VERDICT r2 item 9's CI check), and the feature-gate
+system actually gates behavior."""
+
+import ast
+import os
+
+import pytest
+
+PKG_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "odigos_tpu")
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+
+# modules that are entrypoints by design: imported by the interpreter
+# (python -m) or the driver, not by other modules
+ENTRYPOINTS = {"odigos_tpu.cli.__main__", "odigos_tpu.pipeline.__main__"}
+
+
+def _module_name(path: str) -> str:
+    rel = os.path.relpath(path, REPO_ROOT)
+    mod = rel[:-3].replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _imports_of(path: str, mod: str) -> set:
+    """Absolute module names this file imports (relative resolved)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), path)
+    pkg_parts = mod.split(".")
+    if not path.endswith("__init__.py"):
+        pkg_parts = pkg_parts[:-1]
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.add(a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                parent = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(parent + ([node.module] if node.module
+                                          else []))
+            if base:
+                out.add(base)
+            for a in node.names:
+                out.add(f"{base}.{a.name}" if base else a.name)
+    return out
+
+
+def test_every_module_is_imported_somewhere():
+    files = {}
+    for dirpath, _dirs, names in os.walk(PKG_ROOT):
+        for n in names:
+            if n.endswith(".py"):
+                p = os.path.join(dirpath, n)
+                files[_module_name(p)] = p
+    # tests and the driver entry also count as importers
+    extra = [os.path.join(REPO_ROOT, "bench.py"),
+             os.path.join(REPO_ROOT, "__graft_entry__.py")]
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    extra += [os.path.join(tests_dir, n) for n in os.listdir(tests_dir)
+              if n.endswith(".py")]
+
+    imported: set = set()
+    for mod, path in files.items():
+        imported |= _imports_of(path, mod)
+    for path in extra:
+        imported |= _imports_of(path, _module_name(path))
+
+    orphans = []
+    for mod in files:
+        if mod == "odigos_tpu" or mod in ENTRYPOINTS:
+            continue
+        if mod in imported:
+            continue
+        # a package is live if any of its submodules is imported (the
+        # import necessarily executes the package __init__)
+        if files[mod].endswith("__init__.py") and any(
+                i.startswith(mod + ".") for i in imported):
+            continue
+        # `from pkg import submodule` arrives as pkg.submodule above, but
+        # `import pkg` alone also loads __init__ re-exports — accept a
+        # parent-package import only for modules the parent re-exports
+        parent = mod.rsplit(".", 1)[0]
+        leaf = mod.rsplit(".", 1)[1]
+        init = files.get(parent)
+        if init and parent in imported:
+            if f".{leaf}" in open(init).read():
+                continue
+        orphans.append(mod)
+    assert not orphans, f"modules nothing imports (dead weight): {orphans}"
+
+
+class TestFeatureGates:
+    def test_gate_stages_by_version(self):
+        from odigos_tpu.utils.feature import Features
+
+        old = Features(k8s_version="1.28", jax_version="0.3")
+        new = Features(k8s_version="1.34", jax_version="0.6")
+        assert not old.enabled("shard-map-scoring")
+        assert new.enabled("shard-map-scoring")
+        assert old.stage("native-sidecar-containers") == "alpha"
+        assert not old.enabled("native-sidecar-containers")  # alpha opt-in
+        assert Features(k8s_version="1.28",
+                        enable_alpha=True).enabled(
+                            "native-sidecar-containers")
+        assert new.stage("native-sidecar-containers") == "ga"
+
+    def test_effective_config_clamps_dp_without_gate(self, monkeypatch):
+        import odigos_tpu.config.effective as eff_mod
+        from odigos_tpu.config.effective import calculate_effective_config
+        from odigos_tpu.config.model import Configuration
+
+        monkeypatch.setattr(eff_mod, "_jax_version", lambda: "0.3")
+        cfg = Configuration()
+        cfg.anomaly.enabled = True
+        cfg.anomaly.devices = 8
+        eff = calculate_effective_config(cfg)
+        assert eff.config.anomaly.devices == 1
+        assert any("shard-map-scoring" in p for p in eff.problems)
+        assert eff.features["shard-map-scoring"]["enabled"] is False
+
+    def test_effective_config_keeps_dp_with_gate(self):
+        from odigos_tpu.config.effective import calculate_effective_config
+        from odigos_tpu.config.model import Configuration
+
+        cfg = Configuration()
+        cfg.anomaly.enabled = True
+        cfg.anomaly.devices = 8
+        eff = calculate_effective_config(cfg)  # real jax is new enough
+        assert eff.config.anomaly.devices == 8
+        assert eff.features["shard-map-scoring"]["enabled"] is True
+
+    def test_snapshot_lands_in_effective_configmap(self):
+        from odigos_tpu.api import ControllerManager, Store
+        from odigos_tpu.config.model import Configuration
+        from odigos_tpu.controlplane import Scheduler
+        from odigos_tpu.controlplane.scheduler import (
+            EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE)
+
+        store = Store()
+        mgr = ControllerManager(store)
+        sched = Scheduler(store, mgr)
+        sched.apply_authored(Configuration())
+        mgr.run_once()
+        cm = store.get("ConfigMap", ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME)
+        assert cm is not None and "features" in cm.data
+        assert "shard-map-scoring" in cm.data["features"]
