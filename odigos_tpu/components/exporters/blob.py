@@ -36,7 +36,12 @@ class LocalDirUploader:
         self.root = root
 
     def upload(self, key: str, payload: bytes) -> None:
-        path = os.path.join(self.root, key)
+        root = os.path.realpath(self.root)
+        path = os.path.realpath(os.path.join(root, key))
+        if not path.startswith(root + os.sep):
+            # container/prefix come from destination config — a '..' in
+            # them must not write outside the uploader root
+            raise ValueError(f"blob key escapes uploader root: {key!r}")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:

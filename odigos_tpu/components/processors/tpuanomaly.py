@@ -102,6 +102,7 @@ class TpuAnomalyProcessor(Processor):
             max_len=int(config.get("max_len", 64)),
             trace_bucket=int(config.get("trace_bucket", 256)),
             online_update=bool(config.get("online_update", True)),
+            quantized=bool(config.get("quantized", False)),
             featurizer=fz,
             model_config=model_config,
             checkpoint_path=config.get("checkpoint_path"),

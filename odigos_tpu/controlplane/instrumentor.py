@@ -455,9 +455,12 @@ class _AgentEnabledReconciler:
                 else:
                     # unknown distro name: the rule's intent can't be
                     # honored — force NoAvailableAgent via resolve()
-                    # rather than silently using the default distro
+                    # rather than silently using the default distro.
+                    # matches() still applies (workload selector +
+                    # disabled): with no language scoping it passes any
+                    # language, so "*" goes through it like the rest
                     for lang in (rule.languages or ["*"]):
-                        if lang == "*" or rule.matches(workload, lang):
+                        if rule.matches(workload, lang):
                             out[lang] = name
         return out
 

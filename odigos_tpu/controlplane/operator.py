@@ -73,7 +73,16 @@ class Operator:
                     store.update_status(odigos)
                 return
 
-        config = self._config_from_spec(odigos)
+        try:
+            config = self._config_from_spec(odigos)
+        except ValueError as e:
+            # bad enum value (ui_mode/mount_method/...) must surface as a
+            # condition, not vanish into the controller error log
+            if odigos.set_condition(Condition(
+                    INSTALLED_CONDITION, ConditionStatus.FALSE,
+                    "InvalidSpec", str(e))):
+                store.update_status(odigos)
+            return
         # the same gate cmd_install applies: unknown / tier-ineligible
         # profiles block the install loudly instead of being quietly
         # recorded in the effective config's problems list
@@ -136,11 +145,10 @@ class Operator:
         consumers observe the deletions and quiesce."""
         from .autoscaler import GATEWAY_CONFIG_NAME, NODE_CONFIG_NAME
 
-        for src in list(store.list("Source")):
-            store.delete("Source", src.meta.namespace, src.meta.name)
-        for rule in list(store.list("InstrumentationRule")):
-            store.delete("InstrumentationRule", rule.meta.namespace,
-                         rule.meta.name)
+        for kind in ("Source", "InstrumentationRule", "DestinationResource",
+                     "Processor", "Action"):
+            for r in list(store.list(kind)):
+                store.delete(kind, r.meta.namespace, r.meta.name)
         for name in (AUTHORED_CONFIG_NAME, EFFECTIVE_CONFIG_NAME,
                      GATEWAY_CONFIG_NAME, NODE_CONFIG_NAME):
             store.delete("ConfigMap", ODIGOS_NAMESPACE, name)

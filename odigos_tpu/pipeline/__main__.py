@@ -62,7 +62,13 @@ def main(argv=None) -> int:
         except (OSError, json.JSONDecodeError) as e:
             print(f"reload skipped: {e}", file=sys.stderr, flush=True)
             return
-        collector.reload(new_config)
+        try:
+            collector.reload(new_config)
+        except Exception as e:  # noqa: BLE001 — bad config must not kill us
+            # reload() resurrected the old graph; report and keep serving
+            print(f"reload failed (old config still serving): {e}",
+                  file=sys.stderr, flush=True)
+            return
         print("config reloaded", flush=True)
 
     signal.signal(signal.SIGTERM, on_term)

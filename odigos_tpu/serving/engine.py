@@ -54,6 +54,9 @@ class EngineConfig:
     max_len: int = 64            # sequence models: spans per trace
     trace_bucket: int = 256      # sequence models: trace-count shape bucket
     online_update: bool = True   # zscore: fit on observed traffic
+    # transformer: serve with int8 (W8A8) matmuls — ~2x MXU rate on v5e;
+    # weights quantize once at load (models/quantized.py)
+    quantized: bool = False
     featurizer: FeaturizerConfig = field(default_factory=FeaturizerConfig)
     model_config: Optional[Any] = None  # TransformerConfig / AutoencoderConfig
     checkpoint_path: Optional[str] = None
@@ -158,6 +161,18 @@ class SequenceBackend:
         self.variables = variables if variables is not None else \
             self.model.init(jax.random.PRNGKey(cfg.seed))
         self._packed_score = None
+        self._quantized = None
+        if cfg.quantized and cfg.model == "transformer":
+            if cfg.data_parallel and cfg.data_parallel > 1:
+                # refusing beats silently serving bf16 while holding an
+                # unused int8 weight copy on device
+                raise ValueError(
+                    "quantized serving does not compose with "
+                    "data_parallel yet; pick one")
+            from ..models.quantized import QuantizedTraceScorer
+
+            self._quantized = QuantizedTraceScorer(self.model,
+                                                   self.variables)
         if cfg.data_parallel and cfg.data_parallel > 1:
             if cfg.trace_bucket % cfg.data_parallel:
                 raise ValueError(
@@ -183,6 +198,12 @@ class SequenceBackend:
                 span_scores = np.asarray(self._packed_score(
                     self.variables, packed.categorical, packed.continuous,
                     packed.segments, packed.positions), dtype=np.float32)
+            elif self._quantized is not None:  # int8 serving path
+                span_scores = np.asarray(self._quantized.score_packed(
+                    jnp.asarray(packed.categorical),
+                    jnp.asarray(packed.continuous),
+                    jnp.asarray(packed.segments),
+                    jnp.asarray(packed.positions)), dtype=np.float32)
             else:
                 span_scores = np.asarray(self.model.score_packed(
                     self.variables, jnp.asarray(packed.categorical),

@@ -737,3 +737,25 @@ class TestRemainingRuleKinds:
         c = ic.containers[0]
         assert not c.agent_enabled
         assert c.reason == AgentEnabledReason.NO_AVAILABLE_AGENT
+
+
+class TestOtelSdkRuleScoping:
+    def test_unknown_distro_respects_workload_selector(self):
+        """A typo'd rule scoped to workload B (or disabled) must not
+        disable instrumentation for workload A (review finding)."""
+        store, mgr, cluster, _ = make_env()
+        w = add_python_app(cluster, "a")
+        instrument(store, mgr, w.ref)
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="scoped-typo", namespace="default"),
+            rule_kind=RuleKind.OTEL_SDK,
+            workloads=[workload_ref("other-app")],
+            details={"distro_names": ["python-comunity"]}))
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="disabled-typo", namespace="default"),
+            rule_kind=RuleKind.OTEL_SDK, disabled=True,
+            details={"distro_names": ["python-comunity"]}))
+        write_runtime_details(store, mgr, w.ref)
+        ic = store.get("InstrumentationConfig", "default", ic_name(w.ref))
+        assert ic.containers[0].agent_enabled, \
+            "rule scoped elsewhere (or disabled) leaked into this workload"

@@ -250,3 +250,11 @@ class TestBlobExporter:
         exp = factory.create("azureblobstorage/x", {"container": "c"})
         with pytest.raises(ValueError, match="file://"):
             exp.start()
+
+
+def test_blob_uploader_rejects_path_escape(tmp_path):
+    from odigos_tpu.components.exporters.blob import LocalDirUploader
+
+    up = LocalDirUploader(str(tmp_path / "root"))
+    with pytest.raises(ValueError, match="escapes"):
+        up.upload("../../etc/evil/x.json", b"{}")

@@ -381,3 +381,47 @@ def test_score_packed_segment_isolation():
                                 jnp.asarray(segs), jnp.asarray(poss))
     np.testing.assert_allclose(np.asarray(alone)[0, :n_a],
                                np.asarray(shared)[0, :n_a], atol=1e-5)
+
+
+class TestQuantizedScorer:
+    """int8 W8A8 serving path (models/quantized.py): parity with the float
+    path on the same checkpoint, and engine integration."""
+
+    def test_score_parity_with_float_path(self):
+        import jax
+        import jax.numpy as jnp
+
+        from odigos_tpu.features import featurize, pack_sequences
+        from odigos_tpu.models import TraceTransformer, TransformerConfig
+        from odigos_tpu.models.quantized import QuantizedTraceScorer
+        from odigos_tpu.pdata import synthesize_traces
+
+        model = TraceTransformer(TransformerConfig(
+            d_model=128, d_ff=256, n_layers=2, dtype=jnp.float32))
+        variables = model.init(jax.random.PRNGKey(0))
+        batch = synthesize_traces(64, seed=3)
+        feats = featurize(batch)
+        p = pack_sequences(batch, feats, max_len=32, pad_rows_to=32)
+        args = (jnp.asarray(p.categorical), jnp.asarray(p.continuous),
+                jnp.asarray(p.segments), jnp.asarray(p.positions))
+        f = np.asarray(model.score_packed(variables, *args))
+        q = np.asarray(QuantizedTraceScorer(model, variables)
+                       .score_packed(*args))
+        m = p.mask
+        assert np.abs(f[m] - q[m]).max() < 0.05, \
+            "int8 probabilities diverge from float path"
+
+    def test_engine_quantized_flag(self):
+        from odigos_tpu.pdata import synthesize_traces
+        from odigos_tpu.serving import EngineConfig, ScoringEngine
+
+        eng = ScoringEngine(EngineConfig(
+            model="transformer", quantized=True, max_len=32,
+            trace_bucket=32)).start()
+        try:
+            batch = synthesize_traces(20, seed=1)
+            scores = eng.score_sync(batch, timeout_s=120.0)
+            assert scores is not None and len(scores) == len(batch)
+            assert ((scores >= 0) & (scores <= 1)).all()
+        finally:
+            eng.shutdown()

@@ -215,3 +215,30 @@ def test_uninstall_strips_agents_from_workloads():
     assert store.get("InstrumentationConfig", "default",
                      ic_name(w.ref)) is None
     assert all(not p.injected_env for p in cluster.pods.values())
+
+
+def test_invalid_spec_enum_surfaces_condition():
+    store, mgr = make_plane()
+    store.apply(Odigos(meta=ObjectMeta(name="odigos",
+                                       namespace=ODIGOS_NAMESPACE),
+                       ui_mode="dark"))
+    mgr.run_once()
+    cond = store.get("Odigos", ODIGOS_NAMESPACE,
+                     "odigos").condition("Installed")
+    assert cond.status == ConditionStatus.FALSE
+    assert cond.reason == "InvalidSpec"
+
+
+def test_uninstall_removes_destinations():
+    from odigos_tpu.api.resources import DestinationResource
+
+    store, mgr = make_plane()
+    store.apply(Odigos(meta=ObjectMeta(name="odigos",
+                                       namespace=ODIGOS_NAMESPACE)))
+    store.apply(DestinationResource(
+        meta=ObjectMeta(name="old-backend", namespace=ODIGOS_NAMESPACE),
+        dest_type="tracedb", signals=["traces"]))
+    mgr.run_once()
+    store.delete("Odigos", ODIGOS_NAMESPACE, "odigos")
+    mgr.run_once()
+    assert store.list("DestinationResource") == []
