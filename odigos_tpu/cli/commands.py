@@ -239,6 +239,52 @@ def cmd_destinations(args) -> int:
     return _err(f"unknown destinations action {args.action}")
 
 
+def cmd_ui(args) -> int:
+    """Serve the operator dashboard over the installed state (the
+    reference's `odigos ui` port-forward/serve, cli/cmd/ui.go)."""
+    state = _load(args)
+    from ..frontend import FrontendServer
+
+    fe = FrontendServer(state.store, cluster=state.cluster,
+                        host=args.address, port=args.port).start()
+    print(f"dashboard: {fe.url} (ctrl-c to stop)", flush=True)
+    if getattr(args, "once", False):  # tests: bind, report, exit
+        fe.shutdown()
+        return 0
+    import signal as _signal
+    import threading
+
+    stop = threading.Event()
+    _signal.signal(_signal.SIGINT, lambda *a: stop.set())
+    _signal.signal(_signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    fe.shutdown()
+    return 0
+
+
+def cmd_pro(args) -> int:
+    """Update the entitlement token of an existing install (the
+    reference's `odigos pro --onprem-token`, cli/cmd/pro.go
+    UpdateOdigosToken)."""
+    from ..config.model import Tier
+    from ..utils.auth import TokenError, validate_token_audience
+
+    state = _load(args)
+    try:
+        _, aud = validate_token_audience(args.onprem_token or "")
+        tier = Tier(aud)
+    except (TokenError, ValueError) as e:
+        return _err(f"invalid pro token: {e}")
+    state.tier = tier.value
+    state.scheduler.tier = tier
+    state.instrumentor.distro_provider.tier = tier.value
+    state.scheduler.apply_authored(state.config)
+    state.reconcile()
+    state.save()
+    print(f"tier updated to {tier.value}")
+    return 0
+
+
 # -------------------------------------------------------------- profiles
 
 
@@ -366,6 +412,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--set", action="append", metavar="KEY=VALUE")
     p.add_argument("--stream", action="append")
     p.set_defaults(fn=cmd_destinations)
+
+    p = sub.add_parser("ui", help="serve the operator dashboard")
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=3000)
+    p.add_argument("--once", action="store_true",
+                   help="bind, print the URL, exit (smoke test)")
+    p.set_defaults(fn=cmd_ui)
+
+    p = sub.add_parser("pro", help="update the entitlement token")
+    p.add_argument("--onprem-token", required=True)
+    p.set_defaults(fn=cmd_pro)
 
     p = sub.add_parser("profile", help="manage config profiles")
     p.add_argument("action", choices=["list", "add", "remove"])

@@ -264,6 +264,12 @@ class ScoringEngine:
 
     def __init__(self, config: Optional[EngineConfig] = None):
         self.cfg = config or EngineConfig()
+        if self.cfg.quantized and self.cfg.model != "transformer":
+            # same refuse-don't-silently-serve stance as quantized+dp:
+            # only the transformer has an int8 path
+            raise ValueError(
+                f"quantized serving is only implemented for the "
+                f"transformer model, not {self.cfg.model!r}")
         try:
             self.backend = _BACKENDS[self.cfg.model](self.cfg)
         except KeyError:

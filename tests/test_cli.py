@@ -96,3 +96,20 @@ def test_missing_name_errors(run):
     run("sources", "add", expect=1)
     run("destinations", "add", "--name", "x", expect=1)  # missing --type
     run("describe", "workload", expect=1)
+
+
+def test_ui_command_binds_and_exits(run):
+    run("install")
+    out = run("ui", "--port", "0", "--once")
+    assert "dashboard: http://127.0.0.1:" in out
+
+
+def test_pro_command_upgrades_tier(run):
+    from test_auth import make_token
+
+    run("install")  # community
+    run("profile", "add", "--name", "java-ebpf-instrumentations",
+        expect=1)  # gated
+    run("pro", "--onprem-token", make_token())
+    run("profile", "add", "--name", "java-ebpf-instrumentations")  # now ok
+    run("pro", "--onprem-token", "garbage", expect=1)

@@ -425,3 +425,12 @@ class TestQuantizedScorer:
             assert ((scores >= 0) & (scores <= 1)).all()
         finally:
             eng.shutdown()
+
+    def test_quantized_flag_refused_for_other_models(self):
+        import pytest as _pytest
+
+        from odigos_tpu.serving import EngineConfig, ScoringEngine
+
+        with _pytest.raises(ValueError, match="transformer"):
+            ScoringEngine(EngineConfig(model="autoencoder",
+                                       quantized=True))
