@@ -74,6 +74,117 @@ def cmd_install(args) -> int:
     return 0
 
 
+def cmd_upgrade(args) -> int:
+    """Upgrade an existing install in place (the reference's
+    install-or-upgrade path, cli/cmd/helm-install.go:21): reload state
+    under the current code, revalidate profiles against the installed
+    tier, re-render everything (level-triggered controllers make the
+    'controller restart' the upgrade), persist."""
+    from ..config.model import Tier
+    from ..config.profiles import resolve_profiles
+
+    state = _load(args)
+    _, unknown = resolve_profiles(state.config.profiles, Tier(state.tier))
+    if unknown:
+        return _err(f"installed profiles no longer resolve: {unknown}")
+    state.scheduler.apply_authored(state.config)
+    state.reconcile()
+    state.save()
+    print(f"upgraded to odigos-tpu {__version__} "
+          f"(tier={state.tier}, profiles={state.config.profiles or 'none'})")
+    return 0
+
+
+def cmd_preflight(args) -> int:
+    """Installation health checks (cli/pkg/preflight/checks.go: is
+    installed, are components ready). Hard failures exit 1; the TPU
+    probe is advisory (the pipeline runs without a chip)."""
+    from ..controlplane.autoscaler import GATEWAY_CONFIG_NAME
+    from ..controlplane.scheduler import (
+        EFFECTIVE_CONFIG_NAME, GATEWAY_GROUP_NAME)
+
+    failures = 0
+
+    def check(desc, fn, hard=True):
+        nonlocal failures
+        try:
+            detail = fn()
+            print(f"  ok  {desc}" + (f" ({detail})" if detail else ""))
+            return True
+        except Exception as e:  # noqa: BLE001 — each check reports
+            mark = "FAIL" if hard else "warn"
+            print(f"{mark:>4}  {desc}: {e}")
+            if hard:
+                failures += 1
+            return False
+
+    def installed():
+        if not state_exists(args.state_dir):
+            raise RuntimeError("no installation (run `install` first)")
+
+    print("preflight:")
+    check("installation exists", installed)
+    if failures:
+        return 1
+    # the load itself is a check: a corrupt/version-mismatched state file
+    # must print FAIL, not a traceback
+    box: dict = {}
+
+    def load():
+        box["state"] = _load(args)
+        return (f"{len(box['state'].cluster.nodes)} nodes, "
+                f"tier {box['state'].tier}")
+
+    if not check("state loads and reconciles", load):
+        return 1
+    state = box["state"]
+    check("effective config rendered", lambda: _must(
+        state.store.get("ConfigMap", ODIGOS_NAMESPACE,
+                        EFFECTIVE_CONFIG_NAME), "missing effective config"))
+    check("gateway config rendered", lambda: _must(
+        state.store.get("ConfigMap", ODIGOS_NAMESPACE,
+                        GATEWAY_CONFIG_NAME), "missing gateway config"))
+    check("collectors group present", lambda: _must(
+        state.store.get("CollectorsGroup", ODIGOS_NAMESPACE,
+                        GATEWAY_GROUP_NAME), "missing gateway group"))
+
+    def ring():
+        from ..transport import SpanRing
+
+        r = SpanRing.create(1 << 14)
+        r.close()
+        return "native C++ ring"
+
+    check("shared-memory span ring", ring)
+
+    def tpu():
+        import subprocess
+        import sys as _sys
+
+        # platform must actually be an accelerator: a CPU-only jax would
+        # otherwise produce a false 'ok'
+        probe = ("import jax, numpy as np; dev = jax.devices()[0]; "
+                 "assert dev.platform != 'cpu', dev.platform; "
+                 "np.asarray(jax.jit(lambda x: x + 1)"
+                 "(jax.numpy.ones((8, 8)))); print(dev)")
+        r = subprocess.run([_sys.executable, "-c", probe], timeout=30,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError("no TPU backend (CPU-only jax, or device "
+                               "unreachable)")
+        return r.stdout.strip().splitlines()[-1]
+
+    if not getattr(args, "skip_device_probe", False):
+        check("TPU device reachable", tpu, hard=False)
+    return 1 if failures else 0
+
+
+def _must(value, msg):
+    if value is None:
+        raise RuntimeError(msg)
+    return ""
+
+
 def cmd_uninstall(args) -> int:
     if not args.yes:
         return _err("refusing to uninstall without --yes")
@@ -372,6 +483,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pro entitlement token (required for paid tiers)")
     p.set_defaults(fn=cmd_install)
 
+    p = sub.add_parser("upgrade", help="upgrade an existing installation")
+    p.set_defaults(fn=cmd_upgrade)
+
+    p = sub.add_parser("preflight", help="installation health checks")
+    p.add_argument("--skip-device-probe", action="store_true",
+                   help="skip the (advisory, up to 30s) TPU probe")
+    p.set_defaults(fn=cmd_preflight)
+
     p = sub.add_parser("uninstall", help="delete the installation")
     p.add_argument("--yes", action="store_true")
     p.set_defaults(fn=cmd_uninstall)
@@ -464,7 +583,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         return _err("--name is required for `describe workload`")
     try:
         return args.fn(args)
-    except (FileNotFoundError, ValueError) as e:
+    except (FileNotFoundError, ValueError, RuntimeError) as e:
+        # RuntimeError covers state-version mismatch: an actionable
+        # message, never a raw traceback
         return _err(str(e))
 
 

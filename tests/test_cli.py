@@ -77,7 +77,7 @@ def test_profiles_and_diagnose(run, tmp_path):
 
     run("install", "--tier", "onprem", "--onprem-token", make_token())
     out = run("profile", "list", "--tier", "onprem")
-    assert "small-batches" in out
+    assert "semconv" in out
     run("profile", "add", "--name", "small-batches", "--tier", "onprem")
     assert "* small-batches" in run("profile", "list", "--tier", "onprem")
     run("profile", "remove", "--name", "small-batches")
@@ -113,3 +113,30 @@ def test_pro_command_upgrades_tier(run):
     run("pro", "--onprem-token", make_token())
     run("profile", "add", "--name", "java-ebpf-instrumentations")  # now ok
     run("pro", "--onprem-token", "garbage", expect=1)
+
+
+def test_upgrade_rerenders_in_place(run):
+    run("install", "--profile", "semconv")
+    out = run("upgrade")
+    assert "upgraded to odigos-tpu" in out
+    assert "semconv" in out
+
+
+def test_preflight_healthy_and_missing(run, tmp_path):
+    run("preflight", "--skip-device-probe", expect=1)  # nothing installed
+    run("install")
+    out = run("preflight", "--skip-device-probe")
+    assert "ok  installation exists" in out
+    assert "ok  state loads and reconciles" in out
+    assert "ok  gateway config rendered" in out
+    assert "ok  shared-memory span ring" in out
+    # a corrupt state file is a FAIL line + rc 1, not a traceback
+    (tmp_path / "state" / "state.json").write_text('{"version": 1}')
+    out_err = run("preflight", "--skip-device-probe", expect=1)
+    assert "FAIL  state loads and reconciles" in out_err
+
+
+def test_upgrade_state_version_mismatch_is_actionable(run, tmp_path, capsys):
+    run("install")
+    (tmp_path / "state" / "state.json").write_text('{"version": 1}')
+    run("upgrade", expect=1)
