@@ -360,11 +360,14 @@ class _Handler(BaseHTTPRequestHandler):
         return self._error("not found", 404)
 
     def do_DELETE(self) -> None:  # noqa: N802
+        from urllib.parse import unquote
+
         fe = self.frontend
         parts = urlparse(self.path).path.rstrip("/").split("/")
-        # /api/sources/<namespace>/<name>
+        # /api/sources/<namespace>/<name> — segments are percent-encoded
+        # by clients (the dashboard encodes; names may hold spaces etc.)
         if len(parts) == 5 and parts[1] == "api" and parts[2] == "sources":
-            _, _, _, ns, name = parts
+            ns, name = unquote(parts[3]), unquote(parts[4])
             if fe.store.delete("Source", ns, name):
                 return self._json({"deleted": name})
             return self._error(f"no source {ns}/{name}", 404)

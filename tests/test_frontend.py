@@ -250,3 +250,29 @@ def test_series_rate_resets_on_counter_reset():
     assert s.rate == 0.0
     s.observe(150.0, 40.0)  # rates resume from the new baseline
     assert s.rate == pytest.approx(10.0)
+
+
+def test_dashboard_source_form_and_sparkline_wiring(env_with_frontend):
+    """The dashboard carries the sources CRUD form (wired to the POST/
+    DELETE endpoints) and the throughput sparkline."""
+    env, fe = env_with_frontend
+    with urllib.request.urlopen(fe.url + "/", timeout=10) as r:
+        page = r.read().decode()
+    for element in ('id="src-add"', 'id="src-ns"', 'id="src-name"',
+                    "data-del-src", "sparkline", 'method: "POST"',
+                    '{method: "DELETE"}'):
+        assert element in page, f"dashboard missing {element}"
+
+
+def test_delete_source_with_encoded_name(env_with_frontend):
+    """Percent-encoded DELETE paths decode server-side: a workload name
+    with a space is removable from the dashboard (review finding)."""
+    env, fe = env_with_frontend
+    status, _ = post_json(f"{fe.url}/api/sources",
+                          {"namespace": "shop", "name": "my app"})
+    assert status == 201
+    req = urllib.request.Request(
+        f"{fe.url}/api/sources/shop/src-my%20app", method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    assert env.store.get("Source", "shop", "src-my app") is None
