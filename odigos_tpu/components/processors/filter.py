@@ -1,0 +1,138 @@
+"""Filter processor — drop spans matching declarative conditions.
+
+The filterprocessor role in the reference's bundle
+(collector/builder-config.yaml:71): operators exclude noisy telemetry
+(health checks, internal endpoints) before it costs pipeline and
+destination capacity. Conditions are evaluated vectorized over the batch
+(numpy masks, no per-span Python loop on the hot fields).
+
+Config:
+  exclude:                 drop spans matching ANY of these conditions
+    - service: <name>          exact service match
+      name: <span name>        exact span-name match
+      name_prefix: <prefix>    span-name prefix match
+      kind: <int>              span kind
+      attr: {key: k, value: v} span attribute equals; a span missing the
+                               key never matches. With ``value`` omitted
+                               the clause matches attribute PRESENCE.
+      min_duration_ms: <ms>    drop only spans FASTER than this
+  include: same shape — when present, spans NOT matching any include
+    condition are dropped first (allowlist), then excludes apply.
+
+Unknown clause keys and empty conditions are rejected at start(): a
+one-character typo must not become a match-everything condition that
+silently drops all telemetry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch
+from ...utils.telemetry import meter
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+DROPPED_METRIC = "odigos_filter_dropped_spans_total"
+_KNOWN_CLAUSES = frozenset(
+    ("service", "name", "name_prefix", "kind", "attr", "min_duration_ms"))
+
+
+def _interned_mask(batch: SpanBatch, col: str,
+                   predicate: Callable[[str], bool]) -> np.ndarray:
+    """Vectorized string-field match: one scan of the (small, deduped)
+    string table, then isin on the interned int32 column — never a
+    per-span Python loop (pdata/traces.py service_span_mask pattern)."""
+    idxs = [i for i, s in enumerate(batch.strings) if predicate(s)]
+    if not idxs:
+        return np.zeros(len(batch), bool)
+    return np.isin(batch.col(col), np.asarray(idxs, dtype=np.int32))
+
+
+def _condition_mask(batch: SpanBatch, cond: dict[str, Any]) -> np.ndarray:
+    """True where the span matches every clause of one condition."""
+    mask = np.ones(len(batch), bool)
+    if "service" in cond:
+        want = str(cond["service"])
+        mask &= _interned_mask(batch, "service", lambda s: s == want)
+    if "name" in cond:
+        want_n = str(cond["name"])
+        mask &= _interned_mask(batch, "name", lambda s: s == want_n)
+    if "name_prefix" in cond:
+        pre = str(cond["name_prefix"])
+        mask &= _interned_mask(batch, "name", lambda s: s.startswith(pre))
+    if "kind" in cond:
+        mask &= batch.col("kind") == int(cond["kind"])
+    if "min_duration_ms" in cond:
+        dur_ms = batch.duration_ns / 1e6
+        mask &= dur_ms < float(cond["min_duration_ms"])
+    if "attr" in cond:
+        key = cond["attr"]["key"]
+        if "value" in cond["attr"]:
+            want_v = cond["attr"]["value"]
+            # sentinel default: a missing key must never equal any value
+            mask &= np.fromiter(
+                (a.get(key, _MISSING) == want_v for a in batch.span_attrs),
+                bool, len(batch))
+        else:  # value omitted = presence check
+            mask &= np.fromiter((key in a for a in batch.span_attrs),
+                                bool, len(batch))
+    return mask
+
+
+_MISSING = object()
+
+
+def _any_match(batch: SpanBatch, conds: list[dict]) -> np.ndarray:
+    out = np.zeros(len(batch), bool)
+    for cond in conds:
+        out |= _condition_mask(batch, cond)
+    return out
+
+
+class FilterProcessor(Processor):
+    capabilities = Capabilities(mutates_data=True)
+
+    def start(self) -> None:
+        super().start()
+        for field in ("include", "exclude"):
+            for cond in self.config.get(field) or []:
+                if not isinstance(cond, dict) or not cond:
+                    raise ValueError(
+                        f"{self.name}: empty {field} condition would "
+                        f"match every span")
+                unknown = set(cond) - _KNOWN_CLAUSES
+                if unknown:
+                    raise ValueError(
+                        f"{self.name}: unknown {field} clause(s) "
+                        f"{sorted(unknown)} (known: "
+                        f"{sorted(_KNOWN_CLAUSES)})")
+                if "attr" in cond and (not isinstance(cond["attr"], dict)
+                                       or "key" not in cond["attr"]):
+                    raise ValueError(
+                        f"{self.name}: attr clause needs a 'key'")
+
+    def process(self, batch: SpanBatch) -> SpanBatch | None:
+        keep = np.ones(len(batch), bool)
+        include = self.config.get("include") or []
+        if include:
+            keep &= _any_match(batch, include)
+        exclude = self.config.get("exclude") or []
+        if exclude:
+            keep &= ~_any_match(batch, exclude)
+        n_dropped = int((~keep).sum())
+        if n_dropped == 0:
+            return batch
+        meter.add(f"{DROPPED_METRIC}{{processor={self.name}}}", n_dropped)
+        if not keep.any():
+            return None  # whole batch filtered: stop the pipeline here
+        return batch.filter(keep)
+
+
+register(Factory(
+    type_name="filter",
+    kind=ComponentKind.PROCESSOR,
+    create=FilterProcessor,
+    default_config=dict,
+))

@@ -352,3 +352,73 @@ def test_spanmetrics_extra_dimensions_emitted():
              for p in out.iter_points()
              if p["name"] == "traces.span.metrics.calls"}
     assert calls == {"/a": 1.0, "/b": 1.0}
+
+
+class TestFilterProcessor:
+    """filterprocessor role (builder-config.yaml:71): declarative span
+    dropping, vectorized."""
+
+    def make(self, **config):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        proc = registry.get(ComponentKind.PROCESSOR, "filter").create(
+            "filter/t", config)
+        return proc
+
+    def test_exclude_by_service_and_prefix(self):
+        batch = synthesize_traces(40, seed=3)
+        services = set(batch.service_names())
+        victim = sorted(services)[0]
+        out = self.make(exclude=[{"service": victim}]).process(batch)
+        assert victim not in out.service_names()
+        assert len(out) == sum(1 for s in batch.service_names()
+                               if s != victim)
+
+    def test_healthcheck_drop_by_prefix_and_duration(self):
+        batch = synthesize_traces(30, seed=4)
+        names = batch.span_names()
+        prefix = names[0][:3]
+        expected = sum(1 for n in names if not n.startswith(prefix))
+        out = self.make(exclude=[{"name_prefix": prefix}]).process(batch)
+        assert len(out) == expected
+        # min_duration_ms drops only FAST spans
+        out2 = self.make(
+            exclude=[{"min_duration_ms": 1e9}]).process(batch)
+        assert out2 is None  # everything is faster than 1e6 seconds
+
+    def test_include_allowlist(self):
+        batch = synthesize_traces(40, seed=5)
+        keep_svc = sorted(set(batch.service_names()))[0]
+        out = self.make(include=[{"service": keep_svc}]).process(batch)
+        assert set(out.service_names()) == {keep_svc}
+
+    def test_attr_condition(self):
+        batch = synthesize_traces(10, seed=6)
+        batch = batch.with_span_attr("http.target", ["/healthz"] * len(batch))
+        out = self.make(exclude=[{
+            "attr": {"key": "http.target", "value": "/healthz"}}]
+        ).process(batch)
+        assert out is None
+
+    def test_noop_returns_same_object(self):
+        batch = synthesize_traces(5, seed=7)
+        assert self.make().process(batch) is batch
+
+    def test_typo_clause_rejected_at_start(self):
+        proc = self.make(exclude=[{"name_prefx": "/healthz"}])
+        with pytest.raises(ValueError, match="unknown"):
+            proc.start()
+        proc2 = self.make(exclude=[{}])
+        with pytest.raises(ValueError, match="empty"):
+            proc2.start()
+
+    def test_attr_missing_key_never_matches_value(self):
+        batch = synthesize_traces(10, seed=8)
+        # value given, attribute absent everywhere: nothing matches
+        out = self.make(exclude=[{
+            "attr": {"key": "nope", "value": None}}]).process(batch)
+        assert out is batch
+        # value omitted = presence check
+        tagged = batch.with_span_attr("flag", [1] * len(batch))
+        out2 = self.make(exclude=[{"attr": {"key": "flag"}}]).process(tagged)
+        assert out2 is None

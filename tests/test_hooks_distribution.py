@@ -91,11 +91,11 @@ class TestManualTracer:
         from odigos_tpu.pipeline.service import Collector
 
         cfg = {
-            "receivers": {"synthetic": {"count": 0}},
+            "receivers": {"otlp": {"port": 0}},
             "processors": {"batch": {}},
             "exporters": {"tracedb": {}},
             "service": {"pipelines": {"traces/in": {
-                "receivers": ["synthetic"], "processors": ["batch"],
+                "receivers": ["otlp"], "processors": ["batch"],
                 "exporters": ["tracedb"]}}},
         }
         with Collector(cfg) as c:
@@ -198,11 +198,11 @@ class TestReviewFixes:
         from odigos_tpu.pipeline.service import Collector
 
         good = {
-            "receivers": {"synthetic": {"count": 0}},
+            "receivers": {"otlp": {"port": 0}},
             "processors": {"batch": {}},
             "exporters": {"tracedb": {}},
             "service": {"pipelines": {"traces/in": {
-                "receivers": ["synthetic"], "processors": ["batch"],
+                "receivers": ["otlp"], "processors": ["batch"],
                 "exporters": ["tracedb"]}}},
         }
         bad = json.loads(json.dumps(good))

@@ -89,11 +89,11 @@ class TestWireStress:
 
         def cfg(n):
             return {
-                "receivers": {"synthetic": {"count": 0}},
+                "receivers": {"otlp": {"port": 0}},
                 "processors": {"batch": {}},
                 "exporters": {"tracedb": {}, "debug": {"verbosity": n % 2}},
                 "service": {"pipelines": {"traces/in": {
-                    "receivers": ["synthetic"], "processors": ["batch"],
+                    "receivers": ["otlp"], "processors": ["batch"],
                     "exporters": ["tracedb", "debug"]}}},
             }
 
