@@ -1,0 +1,24 @@
+# Developer entrypoints (reference: Makefile at the repo root).
+# No install step: the package runs from the repo root.
+
+.PHONY: test test-fast bench dryrun ui preflight
+
+test:            ## full suite on the 8-device virtual CPU mesh (~7 min)
+	python -m pytest tests/ -x -q
+
+test-fast:       ## everything but the slow parallel/e2e/auc suites
+	python -m pytest tests/ -x -q --ignore=tests/test_parallel.py \
+	  --ignore=tests/test_northstar_auc.py --ignore=tests/test_anomaly_e2e.py
+
+bench:           ## north-star record (real TPU when reachable; JSON line)
+	python bench.py
+
+dryrun:          ## multi-chip sharding compile+execute on 8 virtual devices
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+ui:              ## operator dashboard over the local install
+	python -m odigos_tpu.cli ui
+
+preflight:       ## installation health checks
+	python -m odigos_tpu.cli preflight
