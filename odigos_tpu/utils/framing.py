@@ -14,11 +14,16 @@ _LEN = struct.Struct("<I")
 HEADER_SIZE = 8  # 4-byte magic + u32 length
 
 
+_RECV_CHUNK = 1 << 20  # cap per-recv request: CPython allocates the full
+# requested size per call, so asking for a 64 MiB remainder on every
+# iteration of a segment-at-a-time stream churns GBs of transient buffers
+
+
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     """Read exactly n bytes; None on clean EOF mid-read."""
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        chunk = sock.recv(min(n - len(buf), _RECV_CHUNK))
         if not chunk:
             return None
         buf.extend(chunk)

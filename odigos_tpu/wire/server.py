@@ -20,6 +20,7 @@ import threading
 from typing import Any, Callable, Optional
 
 from ..components.api import ComponentKind, Factory, Receiver, Signal, register
+from ..utils.framing import recv_exact as _recv_exact
 from ..utils.telemetry import meter
 from .codec import MAGIC, decode_batch, read_frame_header
 
@@ -59,17 +60,6 @@ class AdmissionController:
     def inflight_bytes(self) -> int:
         with self._lock:
             return self._inflight
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
-            return None
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
 
 
 def _discard_exact(sock: socket.socket, n: int) -> bool:
