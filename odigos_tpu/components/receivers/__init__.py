@@ -1,1 +1,1 @@
-from . import prometheus, synthetic  # noqa: F401  (registers factories on import)
+from . import filelog, prometheus, synthetic  # noqa: F401  (registers factories on import)

@@ -1,0 +1,245 @@
+"""filelog receiver — tail log files into LogBatches.
+
+The intake side of the reference's log pipeline (`filelog` receiver in
+collector/builder-config.yaml feeding odigoslogsresourceattrsprocessor;
+node collectors tail /var/log/pods/...). Tails every file matching the
+include globs, survives rotation (inode identity + truncation detection),
+and emits one LogBatch per poll with ``log.file.path`` on each record —
+exactly what LogsResourceAttrsProcessor keys its pod-uid enrichment on.
+
+Line formats parsed per record (k8s runtimes):
+  CRI:    "2026-01-02T15:04:05.999999999Z stdout F <body>"
+  docker: '{"log": "<body>\\n", "time": "...", "stream": "stdout"}'
+  plain:  anything else — whole line is the body
+Severity is inferred from the body (ERROR/WARN/DEBUG markers; INFO
+otherwise).
+
+Config:
+  include:          list of glob patterns (required)
+  poll_interval_s:  scan cadence (default 0.5)
+  start_at:         "end" (default; only new lines) | "beginning"
+  max_batch_records: records per emitted batch (default 4096)
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import json
+import os
+import threading
+from typing import Any
+
+from ...pdata.logs import LogBatchBuilder, Severity
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Factory, Receiver, Signal, register
+
+LOG_FILE_PATH_ATTR = "log.file.path"
+EMITTED_METRIC = "odigos_filelog_records_total"
+
+
+def parse_line(line: str) -> tuple[str, int, int, bool]:
+    """Returns (body, time_unix_nano, severity, cri_partial). time 0 =
+    unknown; cri_partial=True for a CRI 'P'-flagged fragment that must be
+    joined with the following entries of the same file."""
+    body, t_ns, partial = line, 0, False
+    if line.startswith("{"):
+        try:
+            doc = json.loads(line)
+            body = str(doc.get("log", line)).rstrip("\n")
+            t_ns = _parse_ts(str(doc.get("time", "")))
+        except (json.JSONDecodeError, AttributeError):
+            pass
+    else:
+        parts = line.split(" ", 3)
+        # CRI: ts stream P|F body
+        if (len(parts) == 4 and parts[1] in ("stdout", "stderr")
+                and parts[0][:4].isdigit()):
+            body = parts[3]
+            t_ns = _parse_ts(parts[0])
+            partial = parts[2] == "P"
+    upper = body[:160].upper()
+    if "ERROR" in upper or "FATAL" in upper or "PANIC" in upper:
+        sev = Severity.ERROR
+    elif "WARN" in upper:
+        sev = Severity.WARN
+    elif "DEBUG" in upper or "TRACE" in upper:
+        sev = Severity.DEBUG
+    else:
+        sev = Severity.INFO
+    return body, t_ns, int(sev), partial
+
+
+def _parse_ts(ts: str) -> int:
+    """RFC3339 → epoch nanoseconds with FULL sub-second precision: going
+    through float seconds loses up to ~256 ns at current epoch magnitudes
+    (float64 ULP), so the fraction digits are applied as integers."""
+    from datetime import datetime, timezone
+
+    if not ts:
+        return 0
+    frac = ""
+    base = ts
+    if "." in ts:
+        head, rest = ts.split(".", 1)
+        i = 0
+        while i < len(rest) and rest[i].isdigit():
+            i += 1
+        frac, base = rest[:i], head + rest[i:]
+    try:
+        dt = datetime.fromisoformat(base.replace("Z", "+00:00"))
+    except ValueError:
+        return 0
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    ns = int(frac.ljust(9, "0")[:9]) if frac else 0
+    return int(dt.timestamp()) * 10**9 + ns
+
+
+class _Tail:
+    """Byte offset + identity + CRI partial-line buffer for one file."""
+
+    __slots__ = ("offset", "ino", "cri_pending")
+
+    def __init__(self, offset: int, ino: int):
+        self.offset = offset
+        self.ino = ino
+        self.cri_pending = ""  # joined 'P' fragments awaiting their 'F'
+
+
+class FilelogReceiver(Receiver):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        if not config.get("include"):
+            raise ValueError(f"{name}: 'include' globs are required")
+        self._tails: dict[str, _Tail] = {}
+        self._first_scan_done = False
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"filelog-{self.name}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().shutdown()
+
+    # ------------------------------------------------------------ tailing
+
+    _MAX_READ = 8 << 20  # per-file per-poll read bound (memory cap)
+
+    def poll_once(self) -> int:
+        """One scan over all matching files; returns records emitted
+        (sync test hook, also the loop body).
+
+        At-least-once: per-file offsets are committed only after the
+        consumer accepts the batch; a failed consume re-reads the same
+        bytes next poll (duplicates possible, loss not)."""
+        max_records = int(self.config.get("max_batch_records", 4096))
+        builder = LogBatchBuilder()
+        # (tail, new_offset, pending_before) proposals, committed on success
+        proposals: list[tuple[_Tail, int, str]] = []
+        seen: set[str] = set()
+        for pattern in self.config["include"]:
+            for path in sorted(globlib.glob(pattern)):
+                if path in seen:  # overlapping globs: drain once
+                    continue
+                seen.add(path)
+                self._drain_file(path, builder, max_records, proposals)
+        # files gone from every glob: drop their tail state (pod churn
+        # would otherwise grow _tails without bound)
+        for gone in [p for p in self._tails if p not in seen]:
+            del self._tails[gone]
+        self._first_scan_done = True
+        if not len(builder):
+            return 0
+        batch = builder.build()
+        try:
+            self.next_consumer.consume(batch)
+        except Exception:
+            meter.add("odigos_receiver_refused_batches_total"
+                      f"{{receiver={self.name}}}")
+            for tail, _new_offset, pending_before in proposals:
+                tail.cri_pending = pending_before  # offsets stay put
+            return 0
+        for tail, new_offset, _pending_before in proposals:
+            tail.offset = new_offset
+        meter.add(f"{EMITTED_METRIC}{{receiver={self.name}}}", len(batch))
+        return len(batch)
+
+    def _drain_file(self, path: str, builder: LogBatchBuilder,
+                    max_records: int,
+                    proposals: list[tuple[_Tail, int, str]]) -> None:
+        try:
+            st = os.stat(path)
+        except OSError:
+            self._tails.pop(path, None)
+            return
+        tail = self._tails.get(path)
+        if tail is None:
+            # start_at applies to files present at the FIRST scan only: a
+            # file appearing later is a new pod whose early lines matter
+            at_end = (not self._first_scan_done
+                      and self.config.get("start_at", "end") == "end")
+            tail = self._tails[path] = _Tail(
+                st.st_size if at_end else 0, st.st_ino)
+        elif tail.ino != st.st_ino or st.st_size < tail.offset:
+            # rotated (new inode) or truncated: start over from 0
+            tail.offset, tail.ino, tail.cri_pending = 0, st.st_ino, ""
+        if st.st_size <= tail.offset or len(builder) >= max_records:
+            return
+        try:
+            with open(path, "rb") as f:
+                f.seek(tail.offset)
+                data = f.read(min(st.st_size - tail.offset, self._MAX_READ))
+        except OSError:
+            return
+        lines = data.split(b"\n")
+        lines.pop()  # partial tail piece: stays in the file, re-read later
+        budget = max_records - len(builder)
+        take = lines[:budget]
+        if not take:
+            return
+        # offset advances exactly past the lines consumed — capped-out or
+        # partial lines are re-read next poll, never dropped
+        consumed = sum(len(line) + 1 for line in take)
+        pending_before = tail.cri_pending
+        res_idx = None
+        for raw in take:
+            if not raw:
+                continue
+            body, t_ns, sev, partial = parse_line(
+                raw.decode("utf-8", "replace"))
+            if partial:  # CRI 'P': runtime split one long line
+                tail.cri_pending += body
+                continue
+            if tail.cri_pending:
+                body = tail.cri_pending + body
+                tail.cri_pending = ""
+            if res_idx is None:
+                res_idx = builder.add_resource({LOG_FILE_PATH_ATTR: path})
+            builder.add_record(body=body, time_unix_nano=t_ns,
+                               severity=sev, resource_index=res_idx,
+                               attrs={LOG_FILE_PATH_ATTR: path})
+        proposals.append((tail, tail.offset + consumed, pending_before))
+
+    def _run(self) -> None:
+        interval = float(self.config.get("poll_interval_s", 0.5))
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(interval)
+
+
+register(Factory(
+    type_name="filelog",
+    kind=ComponentKind.RECEIVER,
+    create=FilelogReceiver,
+    signals=(Signal.LOGS,),
+    default_config=lambda: {"poll_interval_s": 0.5, "start_at": "end"},
+))

@@ -422,3 +422,159 @@ class TestFilterProcessor:
         tagged = batch.with_span_attr("flag", [1] * len(batch))
         out2 = self.make(exclude=[{"attr": {"key": "flag"}}]).process(tagged)
         assert out2 is None
+
+
+class TestFilelogReceiver:
+    """filelog receiver: tail -> parse -> LogBatch -> pod-uid enrichment
+    (the reference's node-collector log intake; builder-config filelog +
+    odigoslogsresourceattrsprocessor)."""
+
+    def make(self, tmp_path, **config):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        config.setdefault("include", [str(tmp_path / "*.log")])
+        return registry.get(ComponentKind.RECEIVER, "filelog").create(
+            "filelog/t", config)
+
+    def test_tails_new_lines_and_parses_formats(self, tmp_path):
+        from odigos_tpu.pdata.logs import Severity
+
+        log = tmp_path / "app.log"
+        log.write_text("old line ignored\n")
+        recv = self.make(tmp_path, start_at="end")
+        got = []
+
+        class Sink:
+            def consume(self, b):
+                got.append(b)
+
+        recv.set_consumer(Sink())
+        assert recv.poll_once() == 0  # start_at=end skips history
+        with log.open("a") as f:
+            f.write("plain INFO line\n")
+            f.write('{"log": "docker ERROR body\\n", '
+                    '"time": "2026-07-30T10:00:00.5Z"}\n')
+            f.write("2026-07-30T10:00:01.000000001Z stdout F CRI warn: "
+                    "WARN disk\n")
+            f.write("partial without newline")
+        assert recv.poll_once() == 3
+        b = got[0]
+        assert list(b.bodies) == ["plain INFO line", "docker ERROR body",
+                                  "CRI warn: WARN disk"]
+        assert list(b.col("severity")) == [Severity.INFO, Severity.ERROR,
+                                           Severity.WARN]
+        assert b.col("time_unix_nano")[1] == 1785405600500000000
+        # the partial line arrives once completed
+        with log.open("a") as f:
+            f.write(" done\n")
+        assert recv.poll_once() == 1
+        assert got[1].bodies[0] == "partial without newline done"
+
+    def test_rotation_and_truncation(self, tmp_path):
+        log = tmp_path / "rot.log"
+        log.write_text("")
+        recv = self.make(tmp_path, start_at="beginning")
+        got = []
+        recv.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: got.append(b)})())
+        log.write_text("a\nb\n")
+        assert recv.poll_once() == 2
+        # rotate: replace the file (new inode), new content from 0
+        log.unlink()
+        log.write_text("c\n")
+        assert recv.poll_once() == 1
+        assert got[-1].bodies[0] == "c"
+
+    def test_feeds_logsresourceattrs_enrichment(self, tmp_path):
+        """End-to-end: k8s-style pod log path -> filelog -> enrichment
+        resolves the pod uid to workload metadata."""
+        from odigos_tpu.components.api import ComponentKind, registry
+        from odigos_tpu.components.processors.logsresourceattrs import (
+            PodWorkloadMeta)
+
+        poddir = (tmp_path / "pods" / "shop_cart-abc_uid-123" / "main")
+        poddir.mkdir(parents=True)
+        (poddir / "0.log").write_text("hello from cart\n")
+        recv = self.make(tmp_path, include=[str(tmp_path / "pods/*/*/*.log")],
+                         start_at="beginning")
+        proc = registry.get(ComponentKind.PROCESSOR,
+                            "odigoslogsresourceattrs").create(
+            "lra/t", {"resolver": None, "pod_metadata": {
+                "uid-123": PodWorkloadMeta(
+                    namespace="shop", pod_name="cart-abc",
+                    workload_name="cart", workload_kind="Deployment")}})
+        out = []
+        proc.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: out.append(b)})())
+        recv.set_consumer(proc)
+        assert recv.poll_once() == 1
+        enriched = out[0].resources[0]
+        assert enriched["k8s.pod.name"] == "cart-abc"
+        assert enriched["service.name"] == "cart"
+
+    def test_record_cap_never_loses_lines(self, tmp_path):
+        log = tmp_path / "big.log"
+        log.write_text("".join(f"line-{i}\n" for i in range(10)))
+        recv = self.make(tmp_path, start_at="beginning",
+                         max_batch_records=4)
+        got = []
+        recv.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: got.append(b)})())
+        counts = [recv.poll_once() for _ in range(4)]
+        assert counts == [4, 4, 2, 0]
+        bodies = [b for batch in got for b in batch.bodies]
+        assert bodies == [f"line-{i}" for i in range(10)]
+
+    def test_late_file_reads_from_beginning(self, tmp_path):
+        """start_at=end applies only to files present at the FIRST scan; a
+        pod starting later must not lose its startup lines."""
+        early = tmp_path / "early.log"
+        early.write_text("history\n")
+        recv = self.make(tmp_path, start_at="end")
+        got = []
+        recv.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: got.append(b)})())
+        assert recv.poll_once() == 0  # history skipped
+        late = tmp_path / "late.log"
+        late.write_text("startup-1\nstartup-2\n")
+        assert recv.poll_once() == 2
+        assert list(got[0].bodies) == ["startup-1", "startup-2"]
+
+    def test_consume_failure_is_at_least_once(self, tmp_path):
+        log = tmp_path / "a.log"
+        log.write_text("precious\n")
+        recv = self.make(tmp_path, start_at="beginning")
+        calls = {"n": 0}
+        got = []
+
+        class FlakySink:
+            def consume(self, b):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("downstream hiccup")
+                got.append(b)
+
+        recv.set_consumer(FlakySink())
+        assert recv.poll_once() == 0  # consume failed: offset NOT advanced
+        assert recv.poll_once() == 1  # re-read, delivered
+        assert got[0].bodies[0] == "precious"
+
+    def test_cri_partial_lines_reassembled(self, tmp_path):
+        log = tmp_path / "cri.log"
+        log.write_text(
+            "2026-07-30T10:00:00Z stdout P frag-one-\n"
+            "2026-07-30T10:00:00Z stdout P frag-two-\n"
+            "2026-07-30T10:00:00Z stdout F frag-final\n")
+        recv = self.make(tmp_path, start_at="beginning")
+        got = []
+        recv.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: got.append(b)})())
+        assert recv.poll_once() == 1
+        assert got[0].bodies[0] == "frag-one-frag-two-frag-final"
+
+    def test_timestamp_nanosecond_precision(self):
+        from odigos_tpu.components.receivers.filelog import parse_line
+
+        body, t_ns, _sev, _p = parse_line(
+            "2026-07-30T10:00:01.000000001Z stdout F x")
+        assert t_ns == 1785405601000000001  # the 1 ns survives
