@@ -16,6 +16,11 @@ otherwise).
 
 Config:
   include:          list of glob patterns (required)
+  exclude:          patterns to skip, matched with fnmatch semantics
+                    (``*`` crosses ``/`` — broader than include's glob).
+                    The generated node config excludes odigos-system's
+                    own pod logs so the collector never tails itself
+                    (a feedback loop)
   poll_interval_s:  scan cadence (default 0.5)
   start_at:         "end" (default; only new lines) | "beginning"
   max_batch_records: records per emitted batch (default 4096)
@@ -23,6 +28,7 @@ Config:
 
 from __future__ import annotations
 
+import fnmatch
 import glob as globlib
 import json
 import os
@@ -111,6 +117,15 @@ class FilelogReceiver(Receiver):
         super().__init__(name, config)
         if not config.get("include"):
             raise ValueError(f"{name}: 'include' globs are required")
+        for field in ("include", "exclude"):
+            value = config.get(field)
+            # a bare string iterates per-character: "*" would exclude
+            # everything and anything else silently no-ops
+            if value is not None and (isinstance(value, str)
+                                      or not isinstance(value, (list,
+                                                                tuple))):
+                raise ValueError(
+                    f"{name}: '{field}' must be a list of patterns")
         self._tails: dict[str, _Tail] = {}
         self._first_scan_done = False
         self._thread: threading.Thread | None = None
@@ -146,11 +161,14 @@ class FilelogReceiver(Receiver):
         # (tail, new_offset, pending_before) proposals, committed on success
         proposals: list[tuple[_Tail, int, str]] = []
         seen: set[str] = set()
+        exclude = self.config.get("exclude") or []
         for pattern in self.config["include"]:
             for path in sorted(globlib.glob(pattern)):
                 if path in seen:  # overlapping globs: drain once
                     continue
                 seen.add(path)
+                if any(fnmatch.fnmatch(path, ex) for ex in exclude):
+                    continue
                 self._drain_file(path, builder, max_records, proposals)
         # files gone from every glob: drop their tail state (pod churn
         # would otherwise grow _tails without bound)

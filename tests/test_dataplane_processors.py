@@ -578,3 +578,32 @@ class TestFilelogReceiver:
         body, t_ns, _sev, _p = parse_line(
             "2026-07-30T10:00:01.000000001Z stdout F x")
         assert t_ns == 1785405601000000001  # the 1 ns survives
+
+    def test_exclude_patterns_skip_own_logs(self, tmp_path):
+        """The generated node config excludes odigos-system pod logs so
+        the collector never tails itself."""
+        pods = tmp_path / "pods"
+        (pods / "shop_app-1_u1" / "main").mkdir(parents=True)
+        (pods / "odigos-system_gw-1_u2" / "collector").mkdir(parents=True)
+        (pods / "shop_app-1_u1" / "main" / "0.log").write_text("app line\n")
+        (pods / "odigos-system_gw-1_u2" / "collector" / "0.log").write_text(
+            "own noisy log\n")
+        recv = self.make(
+            tmp_path, include=[str(pods / "*/*/*.log")],
+            exclude=[str(pods / "odigos-system_*/**")],
+            start_at="beginning")
+        got = []
+        recv.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: got.append(b)})())
+        assert recv.poll_once() == 1
+        assert list(got[0].bodies) == ["app line"]
+
+    def test_string_patterns_rejected(self, tmp_path):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        factory = registry.get(ComponentKind.RECEIVER, "filelog")
+        with pytest.raises(ValueError, match="list"):
+            factory.create("filelog/t", {"include": "/var/log/*.log"})
+        with pytest.raises(ValueError, match="list"):
+            factory.create("filelog/t", {
+                "include": [str(tmp_path / "*.log")], "exclude": "*"})
