@@ -1,7 +1,7 @@
 # Developer entrypoints (reference: Makefile at the repo root).
 # No install step: the package runs from the repo root.
 
-.PHONY: test test-fast bench dryrun ui preflight
+.PHONY: test test-fast bench dryrun ui preflight tpu-snapshot tpu-snapshot-watch
 
 test:            ## full suite on the 8-device virtual CPU mesh (~7 min)
 	python -m pytest tests/ -x -q
@@ -12,6 +12,12 @@ test-fast:       ## everything but the slow parallel/e2e/auc suites
 
 bench:           ## north-star record (real TPU when reachable; JSON line)
 	python bench.py
+
+tpu-snapshot:    ## one-shot TPU bench capture (exit 3 if tunnel down)
+	python tools/tpu_snapshot.py --once
+
+tpu-snapshot-watch: ## keep probing; write BENCH_tpu_snapshot.json when up
+	python tools/tpu_snapshot.py
 
 dryrun:          ## multi-chip sharding compile+execute on 8 virtual devices
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

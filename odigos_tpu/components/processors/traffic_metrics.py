@@ -12,7 +12,7 @@ from collections import Counter
 from typing import Any
 
 from ...pdata.spans import SpanBatch
-from ...utils.telemetry import meter
+from ...utils.telemetry import label_value, meter
 from ..api import ComponentKind, Factory, Processor, register
 from .memory_limiter import batch_nbytes
 
@@ -26,7 +26,9 @@ class TrafficMetricsProcessor(Processor):
         if self.config.get("per_service", True):
             counts = Counter(batch.col("service").tolist())
             for sid, n in counts.items():
-                svc = batch.string_at(int(sid))
+                # service names are span data — sanitize before flattening
+                # into the metric name (',' would corrupt the label block)
+                svc = label_value(batch.string_at(int(sid)))
                 meter.add(f"odigos_traffic_spans_total{{service={svc}}}", n)
         return batch
 

@@ -176,6 +176,12 @@ class FilelogReceiver(Receiver):
             del self._tails[gone]
         self._first_scan_done = True
         if not len(builder):
+            # record-less drains still advance: a poll that parsed only CRI
+            # 'P' fragments has already buffered them in tail.cri_pending —
+            # without committing here the same bytes are re-read and the
+            # fragment re-appended every poll, corrupting the joined line
+            for tail, new_offset, _pending_before in proposals:
+                tail.offset = new_offset
             return 0
         batch = builder.build()
         try:
@@ -219,14 +225,23 @@ class FilelogReceiver(Receiver):
         except OSError:
             return
         lines = data.split(b"\n")
-        lines.pop()  # partial tail piece: stays in the file, re-read later
+        leftover = lines.pop()  # partial tail: stays in the file, re-read later
+        oversize = not lines and len(data) >= self._MAX_READ
+        if oversize:
+            # a single line longer than the read window has no newline to
+            # split on; without this it would never advance and the tail
+            # would stall forever. Emit it truncated and move past it
+            # (the stanza filelog max_log_size truncation semantics).
+            lines = [leftover]
         budget = max_records - len(builder)
         take = lines[:budget]
         if not take:
             return
         # offset advances exactly past the lines consumed — capped-out or
-        # partial lines are re-read next poll, never dropped
-        consumed = sum(len(line) + 1 for line in take)
+        # partial lines are re-read next poll, never dropped (the oversize
+        # chunk has no trailing newline, so count its bytes exactly)
+        consumed = (len(take[0]) if oversize
+                    else sum(len(line) + 1 for line in take))
         pending_before = tail.cri_pending
         res_idx = None
         for raw in take:

@@ -50,9 +50,17 @@ class Operator:
     def reconcile(self, store: Store, key: tuple[str, str]) -> None:
         odigos = store.get("Odigos", *key)
         if not isinstance(odigos, Odigos):
-            # resource deleted → uninstall (odigos_controller.go:138): tear
-            # down everything the install chain generated
-            self._uninstall(store)
+            # resource deleted → uninstall (odigos_controller.go:138), but
+            # only when NO Odigos resource remains: deleting one of two
+            # must not tear down the survivor's stack. Re-reconcile the
+            # survivor so its install state is restored immediately.
+            remaining = store.list("Odigos")
+            if not remaining:
+                self._uninstall(store)
+                return
+            survivor = remaining[0]
+            self.reconcile(store, (survivor.meta.namespace,
+                                   survivor.meta.name))
             return
 
         tier = Tier.COMMUNITY

@@ -62,9 +62,20 @@ class Scheduler:
             return
         authored = Configuration.from_dict(cm.data.get("config", {}))
         # an operator-managed install records its (token-validated) tier in
-        # the authored ConfigMap; it wins over this process's default
-        tier = Tier(cm.data["tier"]) if "tier" in cm.data else self.tier
+        # the authored ConfigMap; it wins over this process's default. A
+        # value this process doesn't know (hand-edited state, version skew)
+        # must degrade like any other bad config — surface a problem, keep
+        # reconciling — not crash the loop
+        tier, tier_problem = self.tier, None
+        if "tier" in cm.data:
+            try:
+                tier = Tier(cm.data["tier"])
+            except ValueError:
+                tier_problem = (f"unknown tier {cm.data['tier']!r} in "
+                                f"authored config; using {self.tier.value}")
         eff = calculate_effective_config(authored, tier)
+        if tier_problem:
+            eff.problems.append(tier_problem)
 
         store.apply(ConfigMap(
             meta=ObjectMeta(name=EFFECTIVE_CONFIG_NAME,

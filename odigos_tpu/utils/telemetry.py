@@ -103,6 +103,19 @@ class Meter:
             self._hists.clear()
 
 
+def label_value(v: str) -> str:
+    """Sanitize a label VALUE for the flat ``name{key=value}`` encoding.
+
+    The flat encoding is ambiguous if a value contains the structural
+    characters — ``name{exporter=a,b}`` reads as two labels — so callers
+    whose label values come from data (service names, exporter names from
+    config) must route them through here at record time. Structural chars
+    are replaced, not escaped: the flat string is the registry key and
+    must round-trip through naive split."""
+    return (v.replace(",", "_").replace("=", "_")
+             .replace("{", "_").replace("}", "_"))
+
+
 def prometheus_text(snapshot: dict[str, float]) -> str:
     """Render a ``snapshot()`` as Prometheus text exposition (the
     own-observability scrape surface; reference: own-observability/
@@ -119,6 +132,13 @@ def prometheus_text(snapshot: dict[str, float]) -> str:
                     k, v = part.split("=", 1)
                     v = v.strip().replace("\\", "\\\\").replace('"', '\\"')
                     labels.append(f'{k.strip()}="{v}"')
+                elif labels:
+                    # a ',' inside a legacy unsanitized value: splice the
+                    # fragment back into the previous value (same escaping
+                    # as the normal path) rather than emit a bare fragment
+                    frag = (part.strip().replace("\\", "\\\\")
+                            .replace('"', '\\"'))
+                    labels[-1] = labels[-1][:-1] + "," + frag + '"'
             name = base + "{" + ",".join(labels) + "}"
         # full float precision: {:g} quantizes to 6 significant digits,
         # which freezes counters past 1e6 on the scrape surface

@@ -315,6 +315,25 @@ class TestScheduler:
         assert gw.resources["min_replicas"] == 2  # size_m preset
         assert gw.resources["gomemlimit_mib"] > 0
 
+    def test_unknown_tier_string_degrades_not_crashes(self):
+        """A hand-edited/version-skewed tier value in the authored ConfigMap
+        must surface as an effective-config problem, not crash reconcile
+        (advisor r3: Tier(...) ValueError killed the loop)."""
+        from odigos_tpu.controlplane.scheduler import AUTHORED_CONFIG_NAME
+
+        store = Store()
+        mgr = ControllerManager(store)
+        sched = Scheduler(store, mgr)
+        store.apply(ConfigMap(
+            meta=ObjectMeta(name=AUTHORED_CONFIG_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            data={"config": {}, "tier": "enterprise-plus"}))
+        mgr.run_once()  # must not raise
+        eff = store.get("ConfigMap", ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME)
+        assert eff is not None
+        assert any("enterprise-plus" in p for p in eff.data["problems"])
+        assert eff.data["tier"] == sched.tier.value  # fell back
+
     def test_anomaly_enables_tpu_coscheduling(self):
         store = Store()
         mgr = ControllerManager(store)

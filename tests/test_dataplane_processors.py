@@ -512,6 +512,48 @@ class TestFilelogReceiver:
         assert enriched["k8s.pod.name"] == "cart-abc"
         assert enriched["service.name"] == "cart"
 
+    def test_oversize_line_truncates_and_advances(self, tmp_path):
+        """A single line longer than the read window must be emitted
+        truncated and the offset advanced — not stall the tail forever
+        (advisor r3 liveness wedge; stanza filelog max_log_size
+        semantics). Later lines must still arrive."""
+        log = tmp_path / "huge.log"
+        log.write_bytes(b"x" * 200 + b"\nafter\n")
+        recv = self.make(tmp_path, start_at="beginning")
+        recv._MAX_READ = 64  # shrink the window instead of an 8 MiB fixture
+        got = []
+        recv.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: got.append(b)})())
+        total = 0
+        for _ in range(10):
+            total += recv.poll_once()
+            if total >= 5:
+                break
+        bodies = [b for batch in got for b in batch.bodies]
+        # the 200-byte line arrives as >=1 truncated chunk(s), each a full
+        # window; the line AFTER it is not lost
+        assert bodies[-1] == "after"
+        assert all(set(c) == {"x"} for c in bodies[:-1])
+        assert sum(len(c) for c in bodies[:-1]) == 200
+
+    def test_cri_pending_not_duplicated_by_recordless_polls(self, tmp_path):
+        """A poll that parses ONLY CRI 'P' fragments emits no records but
+        must still advance the offset: leaving it behind re-reads and
+        re-appends the fragment each poll, corrupting the joined line
+        (code-review r4 finding, reproduced)."""
+        log = tmp_path / "cri.log"
+        log.write_text("2026-07-30T10:00:00Z stdout P hello\n")
+        recv = self.make(tmp_path, start_at="beginning")
+        got = []
+        recv.set_consumer(type("S", (), {"consume":
+                                         lambda s, b: got.append(b)})())
+        for _ in range(3):  # record-less polls must be idempotent
+            assert recv.poll_once() == 0
+        with log.open("a") as f:
+            f.write("2026-07-30T10:00:01Z stdout F  world\n")
+        assert recv.poll_once() == 1
+        assert got[0].bodies[0] == "hello world"
+
     def test_record_cap_never_loses_lines(self, tmp_path):
         log = tmp_path / "big.log"
         log.write_text("".join(f"line-{i}\n" for i in range(10)))

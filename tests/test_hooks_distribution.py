@@ -245,3 +245,40 @@ class TestReviewFixes:
         text = prometheus_text({"big_total": 10_000_001.0})
         assert "1e+07" not in text
         assert "10000001" in text
+
+    def test_label_values_with_structural_chars(self):
+        """Label values containing ','/'=' must neither corrupt the flat
+        registry encoding (sanitized at record time) nor produce malformed
+        exposition lines for legacy unsanitized names (advisor r3)."""
+        from odigos_tpu.utils.telemetry import label_value, prometheus_text
+
+        # record-time sanitizer: structural chars become '_'
+        assert label_value("svc,a=b{x}") == "svc_a_b_x_"
+
+        # render-time defense: a ',' already inside a value is spliced back
+        # into the previous label instead of emitting a bare fragment
+        text = prometheus_text(
+            {"spans_total{exporter=kafka,topic-a}": 3.0})
+        line = text.strip()
+        assert line == 'spans_total{exporter="kafka,topic-a"} 3.0'
+
+    def test_traffic_metrics_sanitizes_service_label(self):
+        from odigos_tpu.components.api import ComponentKind, registry
+        from odigos_tpu.pdata import synthesize_traces
+        from odigos_tpu.utils.telemetry import meter
+
+        from dataclasses import replace
+
+        batch = synthesize_traces(4, seed=3)
+        svc_idx = int(batch.col("service")[0])
+        strings = tuple(
+            "cart,env=prod" if i == svc_idx else s
+            for i, s in enumerate(batch.strings))
+        batch = replace(batch, strings=strings)
+        proc = registry.get(ComponentKind.PROCESSOR,
+                            "odigostrafficmetrics").create(
+            "tm/t", {"per_service": True})
+        proc.process(batch)
+        keys = [k for k in meter.snapshot() if "service=" in k]
+        assert not any("cart,env=prod" in k for k in keys), keys
+        assert any("cart_env_prod" in k for k in keys), keys

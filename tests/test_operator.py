@@ -65,6 +65,32 @@ def test_delete_resource_uninstalls():
                      GATEWAY_GROUP_NAME) is None
 
 
+def test_delete_one_of_two_keeps_survivor_installed():
+    """Deleting one Odigos resource while another exists must not tear
+    down the survivor's stack (advisor r3: reconcile ran the full
+    uninstall whenever the event's key no longer resolved)."""
+    store, mgr = make_plane()
+    store.apply(Odigos(meta=ObjectMeta(name="primary",
+                                       namespace=ODIGOS_NAMESPACE),
+                       telemetry_enabled=True))
+    store.apply(Odigos(meta=ObjectMeta(name="secondary",
+                                       namespace=ODIGOS_NAMESPACE)))
+    mgr.run_once()
+    assert store.get("ConfigMap", ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME)
+    store.delete("Odigos", ODIGOS_NAMESPACE, "secondary")
+    mgr.run_once()
+    # the survivor's install is intact (re-reconciled, not uninstalled)
+    eff = store.get("ConfigMap", ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME)
+    assert eff is not None
+    assert store.get("CollectorsGroup", ODIGOS_NAMESPACE,
+                     GATEWAY_GROUP_NAME) is not None
+    # deleting the LAST one still uninstalls
+    store.delete("Odigos", ODIGOS_NAMESPACE, "primary")
+    mgr.run_once()
+    assert store.get("ConfigMap", ODIGOS_NAMESPACE,
+                     EFFECTIVE_CONFIG_NAME) is None
+
+
 def test_valid_token_installs_onprem_tier():
     store, mgr = make_plane()
     store.apply(Odigos(meta=ObjectMeta(name="odigos",
