@@ -1,17 +1,29 @@
 """Blob-storage exporters: ``azureblobstorage`` + ``googlecloudstorage``.
 
 Reference: collector/exporters/azureblobstorageexporter/exporter.go
-(marshal the batch, write one object per consume through a DataWriter) and
-googlecloudstorageexporter/{exporter,gcs_writer}.go. One generic writer
+(marshal the batch, write one object per consume through a DataWriter —
+with separate traces and logs writer paths) and
+googlecloudstorageexporter/{exporter,gcs_writer}.go. One generic exporter
 serves both types here: the object layout is
 ``{container|bucket}/{signal}/{prefix}{unix_ns}-{seq}.json`` with an
-otlp_json-style document per batch.
+otlp_json-style document per batch; the signal segment is ``traces`` for
+SpanBatch and ``logs`` for LogBatch, dispatched on batch type (the
+reference dispatches by registering distinct consumeTraces/consumeLogs
+functions; here one consume fans out on the pdata type).
 
-The cloud SDKs are not part of this build (zero-egress), so the uploader
-is pluggable: an ``endpoint`` of ``file://<dir>`` (or a ``local_dir`` key)
-selects the local-filesystem uploader — the in-tree backend tests and
-air-gapped installs use; without it, start() fails with an actionable
-message instead of silently dropping data.
+Two uploaders:
+
+* ``endpoint: file://<dir>`` (or ``local_dir``) — local-filesystem
+  DataWriter double used by air-gapped installs and as the storage layer
+  behind the test blob server.
+* ``endpoint: http(s)://host[:port][/base]`` — HTTP PUT per object with
+  an optional ``Authorization: Bearer <auth_token>`` header, bounded
+  retry with backoff on 5xx/connection errors, and a hard failure on
+  4xx (bad credentials must surface, not spin). This is the shape of the
+  reference's cloud-SDK writers (both ultimately PUT over HTTPS with a
+  bearer token); the SDKs themselves are absent in this zero-egress
+  build, so the exporter speaks the HTTP contract directly and tests run
+  it against ``odigos_tpu.e2e.blobstore``.
 """
 
 from __future__ import annotations
@@ -20,13 +32,16 @@ import json
 import os
 import threading
 import time
-from typing import Any
+from typing import Any, Union
 
+from ...pdata.logs import LogBatch
 from ...pdata.spans import SpanBatch
+from ...utils.httpsend import send_with_retry
 from ...utils.telemetry import meter
-from ..api import ComponentKind, Exporter, Factory, register
+from ..api import ComponentKind, Exporter, Factory, Signal, register
 
 WRITTEN_METRIC = "odigos_blob_objects_written_total"
+RETRY_METRIC = "odigos_blob_upload_retries_total"
 
 
 class LocalDirUploader:
@@ -49,13 +64,49 @@ class LocalDirUploader:
         os.replace(tmp, path)  # objects appear atomically, like a real PUT
 
 
+class HttpUploader:
+    """PUT ``{base}/{key}`` with bearer auth and bounded 5xx retry.
+
+    Retry policy mirrors the reference exporters' sending-queue defaults:
+    transient server/network errors are retried with exponential backoff
+    up to ``max_retries``; client errors (4xx) are terminal — a bad token
+    retried forever would silently wedge the pipeline behind it.
+    """
+
+    def __init__(self, base: str, token: str = "",
+                 max_retries: int = 4, backoff_s: float = 0.05,
+                 timeout_s: float = 10.0, exporter_name: str = ""):
+        self.base = base.rstrip("/")
+        self.token = token
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.timeout_s = float(timeout_s)
+        self.exporter_name = exporter_name
+
+    def upload(self, key: str, payload: bytes) -> None:
+        headers = ({"Authorization": f"Bearer {self.token}"}
+                   if self.token else {})
+        send_with_retry(
+            f"{self.base}/{key}", payload, method="PUT", headers=headers,
+            max_retries=self.max_retries, backoff_s=self.backoff_s,
+            timeout_s=self.timeout_s, who="blob",
+            on_retry=lambda: meter.add(
+                f"{RETRY_METRIC}{{exporter={self.exporter_name}}}"))
+
+
+Batch = Union[SpanBatch, LogBatch]
+
+
 class BlobExporter(Exporter):
     """Config:
-    container:  azure container / gcs bucket name (object key prefix)
-    endpoint:   file://<dir> selects the local uploader; https endpoints
-                require the cloud SDK (absent in this build -> start error)
-    local_dir:  alternative spelling of a file:// endpoint
-    prefix:     extra object-name prefix (default "")
+    container:    azure container / gcs bucket name (object key prefix)
+    endpoint:     file://<dir> selects the local uploader;
+                  http(s)://... selects the HTTP PUT uploader
+    local_dir:    alternative spelling of a file:// endpoint
+    prefix:       extra object-name prefix (default "")
+    auth_token:   bearer token for the HTTP uploader (default "")
+    max_retries:  HTTP 5xx/connection retry budget (default 4)
+    retry_backoff_s: initial backoff, doubled per retry (default 0.05)
     """
 
     def __init__(self, name: str, config: dict[str, Any]):
@@ -73,25 +124,41 @@ class BlobExporter(Exporter):
         if local_dir:
             self._uploader = LocalDirUploader(str(local_dir))
             return
+        if endpoint.startswith(("http://", "https://")):
+            self._uploader = HttpUploader(
+                endpoint,
+                token=str(self.config.get("auth_token", "")),
+                max_retries=int(self.config.get("max_retries", 4)),
+                backoff_s=float(self.config.get("retry_backoff_s", 0.05)),
+                timeout_s=float(self.config.get("timeout_s", 10.0)),
+                exporter_name=self.name,
+            )
+            return
         raise ValueError(
-            f"{self.name}: no usable blob backend — cloud storage SDKs "
-            f"are not bundled; point 'endpoint' at file://<dir> (or set "
-            f"'local_dir') for the local uploader")
+            f"{self.name}: no usable blob backend — point 'endpoint' at "
+            f"http(s)://<blob-api> for the HTTP uploader or file://<dir> "
+            f"(or set 'local_dir') for the local one")
 
-    def export(self, batch: SpanBatch) -> None:
+    def _marshal(self, batch: Batch) -> tuple[str, bytes]:
+        """(signal segment, otlp_json-style document) for the batch type."""
+        if isinstance(batch, LogBatch):
+            doc = {"resourceLogs": list(batch.iter_records())}
+            return "logs", json.dumps(doc, default=str).encode()
+        doc = {"resourceSpans": list(batch.iter_spans())}
+        return "traces", json.dumps(doc, default=str).encode()
+
+    def export(self, batch: Batch) -> None:
         if self._uploader is None:
             raise RuntimeError(f"{self.name}: export before start")
         container = str(self.config.get("container", "odigos-otlp"))
         prefix = str(self.config.get("prefix", ""))
-        doc = json.dumps(
-            {"resourceSpans": list(batch.iter_spans())}, default=str
-        ).encode()
+        signal, payload = self._marshal(batch)
         with self._lock:
             self._seq += 1
             seq = self._seq
-        key = (f"{container}/traces/{prefix}"
+        key = (f"{container}/{signal}/{prefix}"
                f"{time.time_ns()}-{seq}.json")
-        self._uploader.upload(key, doc)
+        self._uploader.upload(key, payload)
         meter.add(f"{WRITTEN_METRIC}{{exporter={self.name}}}")
 
 
@@ -106,10 +173,12 @@ register(Factory(
     kind=ComponentKind.EXPORTER,
     create=BlobExporter,
     default_config=_make_blob_config,
+    signals=(Signal.TRACES, Signal.LOGS),
 ))
 register(Factory(
     type_name="googlecloudstorage",
     kind=ComponentKind.EXPORTER,
     create=BlobExporter,
     default_config=_make_blob_config,
+    signals=(Signal.TRACES, Signal.LOGS),
 ))

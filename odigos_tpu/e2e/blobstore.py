@@ -1,0 +1,135 @@
+"""Minimal blob-storage / vendor-ingest HTTP API for exporter tests.
+
+The reference exporters talk to Azure Blob / GCS over HTTPS through the
+cloud SDKs (collector/exporters/azureblobstorageexporter/exporter.go,
+googlecloudstorageexporter/gcs_writer.go); this build has zero egress, so
+tests stand up this server instead: a PUT-per-object API with bearer-token
+auth and injectable 5xx faults, storing objects through the same
+LocalDirUploader double the file:// exporter path uses. It plays the role
+of the cloud service in tests — upload success, retry-on-5xx, and
+auth-rejection semantics are exercised over a real socket. PUT is the
+blob contract (path = object key); POST is the vendor-ingest contract
+(components/exporters/vendor.py) where each request appends an object,
+with ``require_header`` standing in for vendor auth schemes.
+
+Usage:
+    store = BlobStoreServer(root_dir, token="secret")
+    store.start()                      # -> listening on 127.0.0.1:<port>
+    store.fail_next(2)                 # next 2 PUTs answer 503
+    ... exporter PUTs to store.url ...
+    store.stop()
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..components.exporters.blob import LocalDirUploader
+
+
+class BlobStoreServer:
+    def __init__(self, root: str, token: str = "", host: str = "127.0.0.1"):
+        self._uploader = LocalDirUploader(root)
+        self.token = token
+        self._host = host
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._fail_budget = 0
+        self.put_count = 0
+        self.auth_failures = 0
+        self.bodies: list[bytes] = []  # accepted payloads, arrival order
+        # vendor exporters send vendor-shaped auth (DD-API-KEY: ... etc.);
+        # set to (header_name, value) to require that instead of bearer
+        self.require_header: tuple[str, str] | None = None
+
+    def _next_seq(self) -> int:
+        """Atomically count the request and reserve its sequence number."""
+        with self._lock:
+            seq = self.put_count
+            self.put_count += 1
+            return seq
+
+    def _auth_ok(self, headers) -> bool:
+        if self.require_header is not None:
+            name, value = self.require_header
+            return headers.get(name, "") == value
+        if self.token:
+            return headers.get("Authorization", "") == f"Bearer {self.token}"
+        return True
+
+    # --- fault injection -------------------------------------------------
+    def fail_next(self, n: int) -> None:
+        """The next ``n`` PUTs answer 503 (transient server fault)."""
+        with self._lock:
+            self._fail_budget = int(n)
+
+    def _take_fault(self) -> bool:
+        with self._lock:
+            if self._fail_budget > 0:
+                self._fail_budget -= 1
+                return True
+            return False
+
+    # --- lifecycle -------------------------------------------------------
+    @property
+    def url(self) -> str:
+        assert self._httpd is not None, "start() first"
+        return f"http://{self._host}:{self._httpd.server_address[1]}"
+
+    def start(self) -> "BlobStoreServer":
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet test output
+                pass
+
+            def _ingest(self, key: str):
+                if not store._auth_ok(self.headers):
+                    with store._lock:
+                        store.auth_failures += 1
+                    self.send_error(401, "bad or missing credentials")
+                    return
+                if store._take_fault():
+                    self.send_error(503, "injected transient fault")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    store._uploader.upload(key, body)
+                except ValueError as e:  # path-escape attempt
+                    self.send_error(400, str(e))
+                    return
+                with store._lock:
+                    store.bodies.append(body)
+                self.send_response(201)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_PUT(self):
+                # blob semantics: the path IS the object key
+                store._next_seq()
+                self._ingest(self.path.lstrip("/"))
+
+            def do_POST(self):
+                # vendor-ingest semantics: POSTs to one URL append objects
+                # (seq reserved atomically — concurrent handler threads
+                # must not derive colliding object keys)
+                seq = store._next_seq()
+                key = (self.path.strip("/") or "ingest").replace("/", "_")
+                self._ingest(f"{key}/{seq}.json")
+
+        self._httpd = ThreadingHTTPServer((self._host, 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="blobstore-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

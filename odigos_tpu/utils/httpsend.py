@@ -1,0 +1,60 @@
+"""Shared HTTP delivery with bounded retry.
+
+One implementation of the exporter send policy (the reference exporters'
+sending-queue/retry defaults): transient faults — 5xx, connection errors,
+timeouts — retry with doubling backoff up to a budget; client errors (4xx)
+are terminal (a bad credential retried forever silently wedges the
+pipeline behind it). Used by the blob uploader (PUT-per-object) and the
+vendor exporter family (POST-per-batch).
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+
+def send_with_retry(url: str, payload: bytes, *,
+                    method: str = "POST",
+                    headers: Optional[dict[str, str]] = None,
+                    max_retries: int = 4,
+                    backoff_s: float = 0.05,
+                    timeout_s: float = 10.0,
+                    content_type: str = "application/json",
+                    on_retry: Optional[Callable[[], None]] = None,
+                    who: str = "") -> None:
+    """Deliver ``payload`` to ``url``; raises PermissionError on 4xx,
+    ConnectionError when the retry budget is exhausted. ``on_retry`` is
+    invoked once per retry (metric hook)."""
+    attempt = 0
+    while True:
+        req = urllib.request.Request(url, data=payload, method=method)
+        req.add_header("Content-Type", content_type)
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                if 200 <= r.status < 300:
+                    return
+                last = f"status {r.status}"
+        except urllib.error.HTTPError as e:
+            # 408 (request timeout) and 429 (throttling) are transient by
+            # contract — the reference retry policy retries them; other
+            # 4xx (bad auth/request) will never succeed on retry
+            if 400 <= e.code < 500 and e.code not in (408, 429):
+                raise PermissionError(
+                    f"{who}: {method} {url} rejected with {e.code} "
+                    f"({e.reason}) — not retrying a client error") from None
+            last = f"HTTP {e.code} {e.reason}"
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            last = repr(e)
+        attempt += 1
+        if attempt > max_retries:
+            raise ConnectionError(
+                f"{who}: {method} {url} failed after {attempt} "
+                f"attempts: {last}")
+        if on_retry is not None:
+            on_retry()
+        time.sleep(backoff_s * (2 ** (attempt - 1)))
