@@ -28,6 +28,8 @@ class MockDestinationExporter(Exporter):
         self._rng = np.random.default_rng(int(config.get("seed", 0)))
         self.accepted_spans = 0
         self.rejected_batches = 0
+        # capture: retain accepted batches for test inspection (bounded)
+        self.batches: list[Any] = []
 
     def export(self, batch: SpanBatch) -> None:
         dur_ms = float(self.config.get("response_duration_ms", 0))
@@ -37,6 +39,10 @@ class MockDestinationExporter(Exporter):
             self.rejected_batches += 1
             raise MockDestinationError(f"{self.name}: injected rejection")
         self.accepted_spans += len(batch)
+        if self.config.get("capture"):
+            if len(self.batches) >= int(self.config.get("capture_max", 256)):
+                self.batches.pop(0)
+            self.batches.append(batch)
 
 
 register(Factory(

@@ -1,4 +1,4 @@
 from . import (  # noqa: F401
     batch, memory_limiter, attributes, traffic_metrics, tpuanomaly,
     groupbytrace, sampling, urltemplate, sqldboperation,
-    conditionalattributes, logsresourceattrs, filter)
+    conditionalattributes, logsresourceattrs, filter, resourcename)

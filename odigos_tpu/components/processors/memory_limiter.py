@@ -27,9 +27,17 @@ REJECTION_METRIC = "odigos_gateway_memory_limiter_rejections_total"
 
 
 def batch_nbytes(batch: SpanBatch) -> int:
+    # generic over pdata batch types: spans/metrics carry a string table +
+    # per-row attr dicts (span_attrs/point_attrs), logs carry bodies
     n = sum(col.nbytes for col in batch.columns.values())
-    n += sum(len(s) for s in batch.strings)
-    n += 64 * len(batch.span_attrs)  # rough per-span attr overhead
+    n += sum(len(s) for s in getattr(batch, "strings", ()))
+    n += sum(len(b) for b in getattr(batch, "bodies", ()))
+    rows = getattr(batch, "span_attrs", None)
+    if rows is None:
+        rows = getattr(batch, "point_attrs", None)
+    if rows is None:
+        rows = getattr(batch, "record_attrs", ())
+    n += 64 * len(rows)  # rough per-row attr overhead
     return n
 
 

@@ -1,0 +1,67 @@
+"""``odigosresourcename`` processor — on-node resource identity stamping.
+
+Role analog of the reference node collector's resource-identity pair
+(autoscaler/controllers/nodecollector/collectorconfig/common.go:25-29:
+``resource/node-name`` upsert + ``resourcedetection`` env detector):
+guarantee every batch leaving the node carries a usable service identity
+and the node it came from, so the gateway never needs a per-span k8s
+lookup.
+
+Per resource:
+* ``service.name`` — if absent, derived from the workload identity attrs
+  the agents stamp (``odigos.workload.name`` / ``k8s.deployment.name`` /
+  ``k8s.pod.name``), else ``unknown_service`` (otel SDK convention).
+* ``k8s.node.name`` — upserted from config ``node`` or $NODE_NAME.
+
+Works on any pdata batch type: spans, logs and metrics all carry a
+``resources`` tuple of attr dicts (structure-of-arrays design, pdata/).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+from typing import Any
+
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+_FALLBACK_KEYS = ("odigos.workload.name", "k8s.deployment.name",
+                  "k8s.statefulset.name", "k8s.daemonset.name",
+                  "k8s.pod.name")
+
+
+class ResourceNameProcessor(Processor):
+    """Config:
+    node:         k8s.node.name value (default $NODE_NAME, else skipped)
+    service_key:  attr to write the identity to (default service.name)
+    """
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def process(self, batch: Any) -> Any:
+        node = str(self.config.get("node", "")
+                   or os.environ.get("NODE_NAME", ""))
+        service_key = str(self.config.get("service_key", "service.name"))
+        resources = []
+        changed = False
+        for r in batch.resources:
+            out = dict(r)
+            if not out.get(service_key):
+                out[service_key] = next(
+                    (str(out[k]) for k in _FALLBACK_KEYS if out.get(k)),
+                    "unknown_service")
+            if node and out.get("k8s.node.name") != node:
+                out["k8s.node.name"] = node
+            changed = changed or out != r
+            resources.append(out)
+        if not changed:
+            return batch
+        return replace(batch, resources=tuple(resources))
+
+
+register(Factory(
+    type_name="odigosresourcename",
+    kind=ComponentKind.PROCESSOR,
+    create=ResourceNameProcessor,
+    default_config=dict,
+))

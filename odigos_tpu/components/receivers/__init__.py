@@ -1,1 +1,2 @@
-from . import filelog, prometheus, synthetic  # noqa: F401  (registers factories on import)
+from . import (  # noqa: F401  (registers factories on import)
+    filelog, hostmetrics, kubeletstats, prometheus, synthetic)

@@ -139,6 +139,13 @@ class Odiglet:
             f"runtime-details@{self.node}", _RuntimeDetailsReconciler(self),
             watches={"InstrumentationConfig": None})
         self.detector.start(self.instrumentation.on_process_event)
+        # publish this node's kubelet stats/summary source so a node
+        # collector with the kubeletstats receiver enabled can scrape it
+        # (the kubelet-on-NODE_IP:10250 role, collectorconfig/metrics.go:27)
+        from ..components.receivers.kubeletstats import (
+            ClusterKubeletSource, attach_kubelet_source)
+        attach_kubelet_source(self.node,
+                              ClusterKubeletSource(self.cluster, self.node))
 
     def start_ring_server(self, socket_path: str):
         """Own the span-ring FD handoff socket (the unixfd server role,
@@ -155,6 +162,8 @@ class Odiglet:
         self.instrumentation.stop()
         if getattr(self, "ring_server", None) is not None:
             self.ring_server.stop()
+        from ..components.receivers.kubeletstats import attach_kubelet_source
+        attach_kubelet_source(self.node, None)
 
     def poll(self) -> None:
         """One deterministic step: sync pod churn, detect process churn,
@@ -230,8 +239,21 @@ class Odiglet:
                    if r.container_name == container_name), None)
         sdk = next((s.trace_config for s in ic.sdk_configs
                     if rd is not None and s.language == rd.language), {})
-        return cc.distro_name, {"service_name": ic.service_name,
-                                "trace_config": dict(sdk)}
+        cfg: dict[str, Any] = {"service_name": ic.service_name,
+                               "trace_config": dict(sdk)}
+        # pro-tier installs sync a model/feature compatibility artifact
+        # (controlplane/pro.py, odigospro offsets ConfigMap analog); the
+        # agent pins the schema hash so bundle/schema skew is detectable
+        # at the process boundary
+        from ..controlplane.pro import PRO_ARTIFACT_NAME
+        from ..controlplane.scheduler import ODIGOS_NAMESPACE
+        artifact = self.store.get("ConfigMap", ODIGOS_NAMESPACE,
+                                  PRO_ARTIFACT_NAME)
+        if artifact is not None:
+            content = artifact.data.get("content", {})
+            cfg["feature_schema_hash"] = content.get("feature_schema_hash")
+            cfg["model_offsets_version"] = artifact.data.get("version")
+        return cc.distro_name, cfg
 
     def _report_health(self, pid: int, details: _ProcessDetails,
                        healthy: Optional[bool], message: str) -> None:

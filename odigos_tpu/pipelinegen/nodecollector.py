@@ -103,11 +103,13 @@ def build_node_collector_config(opts: NodeCollectorOptions) -> GenericMap:
     if opts.host_metrics_enabled:
         config["receivers"]["hostmetrics"] = {
             "collection_interval_s": 10,
+            "node": "${NODE_NAME}",
             "scrapers": ["cpu", "memory", "disk", "network", "filesystem"]}
         metrics_receivers.append("hostmetrics")
     if opts.kubelet_stats_enabled:
         config["receivers"]["kubeletstats"] = {
             "collection_interval_s": 10,
+            "node": "${NODE_NAME}",
             "metric_groups": ["pod", "container"]}
         metrics_receivers.append("kubeletstats")
     if Signal.METRICS in opts.enabled_signals and metrics_receivers:

@@ -56,7 +56,15 @@ class ShmSpanReceiver(Receiver):
         """Re-request the handoff and swap in any ring whose memfd identity
         changed (or is new). Returns rings (re)attached. The reference's
         reader-swap on odiglet restart (odigosebpfreceiver.go:74-93)."""
-        path = self.config.get("socket_path")
+        # "socket" is the generated-config spelling (pipelinegen
+        # nodecollector.py), "socket_path" the programmatic one
+        path = str(self.config.get("socket_path")
+                   or self.config.get("socket") or "")
+        if path.startswith("${") and path.endswith("}"):
+            # "${SPANRING_SOCKET}" — the odiglet injects the handoff path
+            # into the node collector's env (unixfd server wiring)
+            import os as _os
+            path = _os.environ.get(path[2:-1], "")
         if not path:
             return 0
         import os
@@ -160,5 +168,13 @@ class ShmSpanReceiver(Receiver):
 
 register(Factory(
     type_name="shmspan", kind=ComponentKind.RECEIVER,
+    create=ShmSpanReceiver, signals=(Signal.TRACES,),
+    default_config=lambda: {"interval_s": 0.01, "max_records": 65536}))
+
+# the name the generated node-collector config uses for this receiver
+# (pipelinegen/nodecollector.py emits "spanring"; this is the same
+# component under its config-facing name — odigosebpfreceiver analog)
+register(Factory(
+    type_name="spanring", kind=ComponentKind.RECEIVER,
     create=ShmSpanReceiver, signals=(Signal.TRACES,),
     default_config=lambda: {"interval_s": 0.01, "max_records": 65536}))
