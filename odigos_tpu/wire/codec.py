@@ -1,14 +1,15 @@
-"""Columnar wire codec for SpanBatch / MetricBatch.
+"""Columnar wire codec for SpanBatch / MetricBatch / LogBatch.
 
 Frame layout (little-endian):
     u32 magic "OTW1"
     u32 payload length
 payload:
     u32 header length, header JSON:
-        {"n": points, "kind": "spans"|"metrics" (absent = spans),
+        {"n": points, "kind": "spans"|"metrics"|"logs" (absent = spans),
          "strings": [...], "resources": [...],
          "attrs": {row_idx: {...}},        # sparse — empties omitted
          "hists": {row_idx: {...}},        # metrics only, sparse
+         "bodies": [...],                  # logs only
          "cols": [[name, dtype], ...]}     # order = byte layout
     raw column bytes, concatenated in header order
 
@@ -28,6 +29,7 @@ import struct
 
 import numpy as np
 
+from ..pdata.logs import LogBatch
 from ..pdata.metrics import MetricBatch
 from ..pdata.spans import SpanBatch
 
@@ -39,7 +41,7 @@ def encode_batch(batch) -> bytes:
     cols = [(name, arr) for name, arr in batch.columns.items()]
     header = {
         "n": len(batch),
-        "strings": list(batch.strings),
+        "strings": list(getattr(batch, "strings", ())),
         "resources": [dict(r) for r in batch.resources],
         "cols": [[name, arr.dtype.str] for name, arr in cols],
     }
@@ -49,6 +51,13 @@ def encode_batch(batch) -> bytes:
                            for i, a in enumerate(batch.point_attrs) if a}
         header["hists"] = {str(i): h
                            for i, h in enumerate(batch.histograms) if h}
+    elif isinstance(batch, LogBatch):
+        # log bodies are the bulk payload; they ride the JSON header (like
+        # the string table) — raw-buffer framing is for the numeric columns
+        header["kind"] = "logs"
+        header["bodies"] = list(batch.bodies)
+        header["attrs"] = {str(i): a
+                           for i, a in enumerate(batch.record_attrs) if a}
     else:
         header["attrs"] = {str(i): a
                            for i, a in enumerate(batch.span_attrs) if a}
@@ -79,6 +88,12 @@ def decode_batch(payload: bytes):
             resources=tuple(header["resources"]),
             point_attrs=attrs,
             histograms=tuple(hists_sparse.get(i) for i in range(n)),
+            columns=columns)
+    if header.get("kind") == "logs":
+        return LogBatch(
+            resources=tuple(header["resources"]),
+            bodies=tuple(header["bodies"]),
+            record_attrs=attrs,
             columns=columns)
     return SpanBatch(
         strings=tuple(header["strings"]),

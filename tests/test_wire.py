@@ -57,6 +57,48 @@ class TestCodec:
         out = decode_batch(encode_batch(batch))
         assert_batches_equal(out, batch)
 
+    def test_logs_roundtrip(self):
+        from odigos_tpu.pdata.logs import LogBatch, LogBatchBuilder
+
+        b = LogBatchBuilder()
+        ri = b.add_resource({"service.name": "websvc"})
+        for i in range(12):
+            b.add_record(body=f"log line {i}", time_unix_nano=100 + i,
+                         trace_id=i + 1, resource_index=ri,
+                         attrs={"log.file.path": "/var/log/x"} if i % 3 == 0
+                         else None)
+        batch = b.build()
+        out = decode_batch(encode_batch(batch))
+        assert isinstance(out, LogBatch)
+        assert out.bodies == batch.bodies
+        assert list(out.record_attrs) == list(batch.record_attrs)
+        assert [dict(r) for r in out.resources] == \
+            [dict(r) for r in batch.resources]
+        for col in batch.columns:
+            assert (out.col(col) == batch.col(col)).all(), col
+
+    def test_logs_over_tcp(self):
+        """The node logs pipeline ships filelog output to the gateway via
+        the otlp wire exporter (pipelinegen/nodecollector.py logs pipeline)
+        — LogBatch must survive the real socket path end to end."""
+        from odigos_tpu.pdata.logs import LogBatch, LogBatchBuilder
+
+        recv, sink = start_receiver()
+        exp = WireExporter("otlpwire", {
+            "endpoint": f"127.0.0.1:{recv.port}"})
+        exp.start()
+        try:
+            b = LogBatchBuilder()
+            ri = b.add_resource({"k8s.pod.name": "web-1"})
+            b.add_record(body="hello", time_unix_nano=7, resource_index=ri)
+            exp.export(b.build())
+            assert wait_for(lambda: sink.batches)
+            out = sink.batches[0]
+            assert isinstance(out, LogBatch) and out.bodies == ("hello",)
+        finally:
+            exp.shutdown()
+            recv.shutdown()
+
     def test_empty_attrs_stay_sparse(self):
         from odigos_tpu.pdata.spans import SpanBatchBuilder
         b = SpanBatchBuilder()
