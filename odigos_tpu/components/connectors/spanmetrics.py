@@ -33,6 +33,11 @@ class SpanMetricsConnector(Connector):
     (extra span-attr keys to group by — off the vectorized path, use
     sparingly)."""
 
+    # metric names — subclasses re-skin the same aggregation (the datadog
+    # connector emits identical RED stats under APM-stats names)
+    CALLS_NAME = "traces.span.metrics.calls"
+    DURATION_NAME = "traces.span.metrics.duration"
+
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         self.bounds = np.asarray(
@@ -93,11 +98,11 @@ class SpanMetricsConnector(Connector):
                 v = dim_values[j][int(uniq[g, 4 + j])]
                 if v is not None:
                     attrs[dim] = v
-            mb.add_point(name="traces.span.metrics.calls",
+            mb.add_point(name=self.CALLS_NAME,
                          metric_type=MetricType.SUM,
                          value=float(calls[g]), time_unix_nano=now,
                          attrs=attrs)
-            mb.add_point(name="traces.span.metrics.duration",
+            mb.add_point(name=self.DURATION_NAME,
                          metric_type=MetricType.HISTOGRAM,
                          value=float(dur_sum[g]), time_unix_nano=now,
                          attrs=attrs,

@@ -1,1 +1,1 @@
-from . import blob, debug, filelog, mock, tracedb  # noqa: F401
+from . import blob, debug, filelog, mock, tracedb, vendor  # noqa: F401

@@ -1,0 +1,240 @@
+"""Vendor destination exporters — the upstream-exporter-set role.
+
+The reference distro compiles one upstream exporter per backend into the
+collector (collector/builder-config.yaml: datadogexporter,
+prometheusremotewriteexporter, lokiexporter, ...); the destination
+configers (common/config/*.go) only *emit config* for them. Our configers
+(destinations/configers.py) reproduce those config shapes — this module
+supplies the factories so every emitted exporter type actually builds and
+runs (without it, adding any real-backend destination produced a config
+the graph builder rejected and the hot-reloader silently kept the old
+graph).
+
+One generic implementation serves every vendor:
+
+* Types whose ingest protocol is HTTP(S) derive ``(url, headers)`` from
+  their vendor-specific config shape via the extractor table below
+  (datadog api.site/api.key, logzio regional listener + bearer token,
+  prometheusremotewrite endpoint+headers, ...), then POST otlp-json
+  documents with bounded 5xx/connection retry and terminal 4xx — the same
+  delivery semantics as the blob exporter's uploader. ``endpoint_override``
+  redirects delivery to any URL (tests point it at a local mock; air-gapped
+  installs at their relay).
+* Types whose transport is an SDK or a non-HTTP protocol (AWS services,
+  googlecloud, azuremonitor connection strings, kafka brokers) have no
+  derivable URL in this zero-egress build: the exporter still builds and
+  starts (the collector must boot with an unreachable backend, exactly like
+  the reference's lazily-connecting exporters), but export() counts and
+  drops (``odigos_vendor_dropped_total``) and ``healthy()`` reports False —
+  visible degradation instead of a boot failure or a silent stall.
+
+Also here: the ``nop`` exporter (upstream's nop component) and the
+``datadog`` connector (traces→APM-stats bridge the datadog configer wires
+when traces+metrics are both enabled) — the same vectorized RED
+aggregation as the spanmetrics connector under APM-stats metric names.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Optional
+
+from ...pdata.logs import LogBatch
+from ...pdata.metrics import MetricBatch
+from ...utils.httpsend import send_with_retry
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Exporter, Factory, Signal, register
+from ..connectors.spanmetrics import SpanMetricsConnector
+
+DROPPED_METRIC = "odigos_vendor_dropped_total"
+SENT_METRIC = "odigos_vendor_batches_sent_total"
+RETRY_METRIC = "odigos_vendor_send_retries_total"
+
+_ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)\}")
+
+
+def expand_env(value: str) -> str:
+    """Resolve ``${NAME}`` placeholders from the process environment — the
+    configers emit secrets as env references (destinations/configers.py
+    _secret), delivered to the collector via its pod env exactly like the
+    reference's secret-ref'd env vars. Unset names stay as-is (visible in
+    the failed-auth error rather than silently empty)."""
+    return _ENV_RE.sub(
+        lambda m: os.environ.get(m.group(1), m.group(0)), value)
+
+# config dict -> (url or None, headers). None = not HTTP-derivable.
+_Extractor = Callable[[dict[str, Any]], tuple[Optional[str], dict[str, str]]]
+
+
+def _hdr_endpoint(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    return c.get("endpoint"), dict(c.get("headers") or {})
+
+
+def _datadog(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    api = c.get("api") or {}
+    site = api.get("site") or "datadoghq.com"
+    return f"https://api.{site}", {"DD-API-KEY": str(api.get("key", ""))}
+
+
+def _logzio(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    region = c.get("region") or "us"
+    suffix = "" if region == "us" else f"-{region}"
+    return (f"https://listener{suffix}.logz.io:8071",
+            {"Authorization": f"Bearer {c.get('account_token', '')}"})
+
+
+def _coralogix(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    domain = c.get("domain")
+    if not domain:
+        return None, {}
+    return (f"https://ingress.{domain}",
+            {"Authorization": f"Bearer {c.get('private_key', '')}"})
+
+
+def _elasticsearch(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    eps = c.get("endpoints") or []
+    headers = {}
+    if c.get("user"):
+        import base64
+        cred = f"{c['user']}:{c.get('password', '')}".encode()
+        headers["Authorization"] = \
+            f"Basic {base64.b64encode(cred).decode()}"
+    return (eps[0] if eps else None), headers
+
+
+def _sdk_only(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    return None, {}
+
+
+EXTRACTORS: dict[str, _Extractor] = {
+    "otlphttp": _hdr_endpoint,
+    "prometheusremotewrite": _hdr_endpoint,
+    "loki": _hdr_endpoint,
+    "clickhouse": _hdr_endpoint,
+    "signalfx": _hdr_endpoint,
+    "sapm": _hdr_endpoint,
+    "datadog": _datadog,
+    "logzio": _logzio,
+    "coralogix": _coralogix,
+    "elasticsearch": _elasticsearch,
+    # SDK / non-HTTP transports: build + run degraded in this build
+    "awsxray": _sdk_only,
+    "awsemf": _sdk_only,
+    "awscloudwatchlogs": _sdk_only,
+    "awss3": _sdk_only,
+    "googlecloud": _sdk_only,
+    "azuremonitor": _sdk_only,
+    "kafka": _sdk_only,
+}
+
+
+def _marshal(batch) -> bytes:
+    if isinstance(batch, MetricBatch):
+        doc = {"resourceMetrics": list(batch.iter_points())}
+    elif isinstance(batch, LogBatch):
+        doc = {"resourceLogs": list(batch.iter_records())}
+    else:
+        doc = {"resourceSpans": list(batch.iter_spans())}
+    return json.dumps(doc, default=str).encode()
+
+
+class VendorExporter(Exporter):
+    """Shared config keys (on top of the vendor shape the configer emits):
+    endpoint_override: deliver to this URL instead of the derived one
+    max_retries:       5xx/connection retry budget (default 4)
+    retry_backoff_s:   initial backoff, doubled per retry (default 0.05)
+    timeout_s:         per-request timeout (default 10)
+    """
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._url: Optional[str] = None
+        self._headers: dict[str, str] = {}
+
+    @property
+    def vendor_type(self) -> str:
+        return self.name.split("/", 1)[0]
+
+    def start(self) -> None:
+        super().start()
+        override = self.config.get("endpoint_override")
+        extractor = EXTRACTORS.get(self.vendor_type)
+        if extractor is None:
+            raise ValueError(
+                f"{self.name}: no vendor extractor for "
+                f"{self.vendor_type!r} (known: {sorted(EXTRACTORS)})")
+        self._url, self._headers = extractor(self.config)
+        if override:
+            # redirection keeps the derived headers: auth must survive so
+            # tests exercise it against the local ingest mock
+            self._url = str(override)
+        if self._url is not None:
+            self._url = expand_env(self._url)
+        self._headers = {k: expand_env(str(v))
+                         for k, v in self._headers.items()}
+
+    def healthy(self) -> bool:
+        # degraded (SDK-only transport, nothing deliverable) -> unhealthy
+        return (not self._started) or self._url is not None
+
+    def export(self, batch) -> None:
+        if self._url is None:
+            # SDK-only transport in a zero-egress build: run degraded,
+            # never wedge the pipeline behind an impossible send
+            meter.add(f"{DROPPED_METRIC}{{exporter={self.name}}}",
+                      max(len(batch), 1))
+            return
+        send_with_retry(
+            self._url, _marshal(batch), method="POST",
+            headers=self._headers,
+            max_retries=int(self.config.get("max_retries", 4)),
+            backoff_s=float(self.config.get("retry_backoff_s", 0.05)),
+            timeout_s=float(self.config.get("timeout_s", 10.0)),
+            who=self.name,
+            on_retry=lambda: meter.add(
+                f"{RETRY_METRIC}{{exporter={self.name}}}"))
+        meter.add(f"{SENT_METRIC}{{exporter={self.name}}}")
+
+
+class NopExporter(Exporter):
+    """Upstream's nop exporter: accepts and discards (the configers emit it
+    for explicitly-disabled signals)."""
+
+    def export(self, batch) -> None:
+        pass
+
+
+class DatadogAPMStatsConnector(SpanMetricsConnector):
+    """datadog/connector: the traces→metrics APM-stats bridge the datadog
+    configer wires when traces+metrics are both enabled
+    (common/config/datadog.go). Same vectorized RED aggregation as
+    spanmetrics, emitted under Datadog APM-stats names."""
+
+    CALLS_NAME = "datadog.trace.hits"
+    DURATION_NAME = "datadog.trace.duration"
+
+
+_ALL_SIGNALS = (Signal.TRACES, Signal.METRICS, Signal.LOGS)
+
+for _type in sorted(EXTRACTORS):
+    register(Factory(
+        type_name=_type,
+        kind=ComponentKind.EXPORTER,
+        create=VendorExporter,
+        signals=_ALL_SIGNALS,
+    ))
+
+register(Factory(
+    type_name="nop",
+    kind=ComponentKind.EXPORTER,
+    create=NopExporter,
+    signals=_ALL_SIGNALS,
+))
+
+register(Factory(
+    type_name="datadog",
+    kind=ComponentKind.CONNECTOR,
+    create=DatadogAPMStatsConnector,
+))
