@@ -20,16 +20,22 @@ from .memory_limiter import batch_nbytes
 class TrafficMetricsProcessor(Processor):
     def process(self, batch: SpanBatch) -> SpanBatch:
         pipeline = self.config.get("pipeline", self.name)
+        nbytes = batch_nbytes(batch)
         meter.add(f"odigos_traffic_spans_total{{pipeline={pipeline}}}", len(batch))
-        meter.add(f"odigos_traffic_bytes_total{{pipeline={pipeline}}}",
-                  batch_nbytes(batch))
-        if self.config.get("per_service", True):
+        meter.add(f"odigos_traffic_bytes_total{{pipeline={pipeline}}}", nbytes)
+        if self.config.get("per_service", True) and "service" in batch.columns:
             counts = Counter(batch.col("service").tolist())
             for sid, n in counts.items():
                 # service names are span data — sanitize before flattening
                 # into the metric name (',' would corrupt the label block)
                 svc = label_value(batch.string_at(int(sid)))
                 meter.add(f"odigos_traffic_spans_total{{service={svc}}}", n)
+                # per-source byte share prorated by span count (the
+                # reference estimates marshaled size per resource,
+                # processor.go:71; columnar batches make an exact split
+                # meaningless — spans share column buffers)
+                meter.add(f"odigos_traffic_bytes_total{{service={svc}}}",
+                          int(nbytes * n / len(batch)))
         return batch
 
 

@@ -114,12 +114,24 @@ class CollectorMetricsConsumer:
 
     def throughput(self) -> dict[str, Any]:
         with self._lock:
+            totals = self._render(self._totals)
+            # cluster-wide traffic = sum of the per-service labeled series
+            # (traffic counters always carry a service label, so they never
+            # land in the unlabeled totals bucket on their own — without
+            # this the UI's hero spans/s tile reads zero forever)
+            for base in (TRAFFIC_SPANS, TRAFFIC_BYTES):
+                series = [b[base] for b in self._by_service.values()
+                          if base in b]
+                if series and base not in totals:
+                    totals[base] = {
+                        "total": sum(s.value for s in series),
+                        "per_sec": round(sum(s.rate for s in series), 3)}
             return {
                 "services": {svc: self._render(b)
                              for svc, b in self._by_service.items()},
                 "pipelines": {p: self._render(b)
                               for p, b in self._by_pipeline.items()},
-                "totals": self._render(self._totals),
+                "totals": totals,
                 "batches_received": self._batches,
                 "last_batch_age_s": (round(time.time()
                                            - self._last_batch_time, 3)

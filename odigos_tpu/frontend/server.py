@@ -19,8 +19,11 @@ Endpoints:
     GET  /api/anomalies                    (flagged/scored counters + rates)
     GET  /api/describe/workload?namespace=&kind=&name=
     GET  /api/events                       (SSE stream of store events)
+    GET  /api/destination-types            (63-backend registry + schemas)
     POST /api/sources                      {namespace,name,kind,...}
+    POST /api/destinations                 {name,type,signals,fields}
     DELETE /api/sources/<ns>/<name>
+    DELETE /api/destinations/<name>
 """
 
 from __future__ import annotations
@@ -251,6 +254,21 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/api/destinations":
                 return self._json(_resource_list(
                     store, "DestinationResource"))
+            if path == "/api/destination-types":
+                # the setup-wizard catalog: every backend with its field
+                # schema so the UI renders a data-driven form (reference:
+                # frontend/webapp/app/(setup) destinations flow over the
+                # destinations/data/*.yaml registry)
+                from ..destinations.registry import SPECS
+
+                return self._json([
+                    {"type": s.dest_type, "display_name": s.display_name,
+                     "category": s.category,
+                     "signals": sorted(sig.value for sig in s.signals),
+                     "fields": [{"name": f.name, "secret": f.secret}
+                                for f in s.fields]}
+                    for s in sorted(SPECS.values(),
+                                    key=lambda s: s.display_name.lower())])
             if path == "/api/instrumentation-configs":
                 return self._json(_resource_list(
                     store, "InstrumentationConfig", q.get("namespace")))
@@ -357,7 +375,64 @@ class _Handler(BaseHTTPRequestHandler):
                 otel_service_name=body.get("otel_service_name", ""),
                 data_stream_names=list(body.get("data_stream_names", []))))
             return self._json({"applied": f"src-{body['name']}"}, 201)
+        if path == "/api/destinations":
+            return self._create_destination(body)
         return self._error("not found", 404)
+
+    def _create_destination(self, body: dict) -> None:
+        """The setup-wizard submit: schema-validate + configer dry-run,
+        returning field-level problems on 400 so the form can annotate
+        (reference: cypress/e2e/04-destinations.cy.ts connect flow)."""
+        from ..api.resources import DestinationResource
+        from ..components.api import Signal
+        from ..destinations.registry import (
+            Destination, SPECS, validate_destination)
+
+        fe = self.frontend
+        missing = [k for k in ("name", "type") if not body.get(k)]
+        if missing:
+            return self._error(f"missing fields: {missing}")
+        name = str(body["name"])
+        spec = SPECS.get(str(body["type"]))
+        if spec is None:
+            return self._error(f"unknown destination type {body['type']!r}")
+        try:
+            signals = [Signal(s) for s in body.get("signals", [])]
+        except ValueError as e:
+            return self._error(str(e))
+        fields = {str(k): str(v) for k, v in (body.get("fields") or {}).items()
+                  if v not in (None, "")}
+        secret_names = [f.name for f in spec.fields
+                        if f.secret and f.name in fields]
+        dest = Destination(
+            id=name, dest_type=spec.dest_type, signals=signals,
+            config=fields, secret_fields=secret_names)
+        problems = validate_destination(dest)
+        if problems:
+            return self._json({"error": "destination invalid",
+                               "problems": problems}, 400)
+        if fe.store.get("DestinationResource", ODIGOS_NAMESPACE,
+                        name) is not None:
+            return self._json({"error": f"destination {name!r} exists",
+                               "problems": []}, 409)
+        # secret values never enter the store (GET /api/destinations echoes
+        # config verbatim, and generated ConfigMaps embed it): configers
+        # reference secrets as ${NAME} env vars, so deliver the submitted
+        # values into the collector environment — the single-process analog
+        # of the reference's Secret-backed pod env (destination_types.go
+        # SecretRef) — and persist only the non-secret fields.
+        import os
+
+        for sname in secret_names:
+            os.environ[sname] = fields.pop(sname)
+        fe.store.apply(DestinationResource(
+            meta=ObjectMeta(name=name, namespace=ODIGOS_NAMESPACE),
+            dest_type=dest.dest_type,
+            signals=[s.value for s in signals],
+            config=fields,
+            secret_ref=f"odigos-{name}-secret" if secret_names else "",
+            data_stream_names=list(body.get("data_stream_names", []))))
+        return self._json({"applied": name}, 201)
 
     def do_DELETE(self) -> None:  # noqa: N802
         from urllib.parse import unquote
@@ -371,6 +446,27 @@ class _Handler(BaseHTTPRequestHandler):
             if fe.store.delete("Source", ns, name):
                 return self._json({"deleted": name})
             return self._error(f"no source {ns}/{name}", 404)
+        if (len(parts) == 4 and parts[1] == "api"
+                and parts[2] == "destinations"):
+            name = unquote(parts[3])
+            existing = fe.store.get("DestinationResource", ODIGOS_NAMESPACE,
+                                    name)
+            if existing is not None and fe.store.delete(
+                    "DestinationResource", ODIGOS_NAMESPACE, name):
+                # revoke the env-delivered secrets with the destination —
+                # a lingering credential would silently re-authenticate a
+                # later destination of the same type
+                if existing.secret_ref:
+                    import os
+
+                    from ..destinations.registry import SPECS
+
+                    spec = SPECS.get(existing.dest_type)
+                    for f in (spec.fields if spec else ()):
+                        if f.secret:
+                            os.environ.pop(f.name, None)
+                return self._json({"deleted": name})
+            return self._error(f"no destination {name}", 404)
         return self._error("not found", 404)
 
 

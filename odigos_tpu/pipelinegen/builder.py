@@ -322,12 +322,20 @@ def build_gateway_config(
 
     # --- self telemetry (configmap.go:42,86-126): traffic metrics on every
     # data pipeline + an own-metrics pipeline to the internal store.
+    # Per-pipeline instances with explicit pipeline labels; per-SERVICE
+    # counters only on the root (ingest) pipelines — a span traverses
+    # root -> router -> data-stream pipelines, and counting the same
+    # service series once per hop would over-report cluster ingest (the
+    # UI's hero tile sums the per-service series).
     if options.self_telemetry:
-        config["processors"][TRAFFIC_METRICS] = {}
+        roots = {root_pipeline_name(sig) for sig in enabled_signals}
         for pname, pipe in config["service"]["pipelines"].items():
             if pname == "metrics/servicegraph":
                 continue
-            pipe["processors"] = list(pipe["processors"]) + [TRAFFIC_METRICS]
+            pid = f"{TRAFFIC_METRICS}/{pname}"
+            config["processors"][pid] = {
+                "pipeline": pname, "per_service": pname in roots}
+            pipe["processors"] = list(pipe["processors"]) + [pid]
         config["receivers"]["prometheus/self-metrics"] = {
             "scrape_interval_s": 10}
         config["exporters"]["otlp/ui"] = {"endpoint": options.ui_endpoint}

@@ -125,7 +125,15 @@ class TestGatewayConfig:
         for pname, pipe in cfg["service"]["pipelines"].items():
             if pname in ("metrics/servicegraph", "metrics/otelcol"):
                 continue
-            assert pipe["processors"][-1] == "odigostrafficmetrics", pname
+            pid = f"odigostrafficmetrics/{pname}"
+            assert pipe["processors"][-1] == pid, pname
+            # per-pipeline instance carries its pipeline label; per-service
+            # ingest counters only on root pipelines (a span traverses
+            # root -> data-stream; counting per hop would double the
+            # hero-tile totals)
+            pconf = cfg["processors"][pid]
+            assert pconf["pipeline"] == pname
+            assert pconf["per_service"] == pname.startswith("traces/in")
         assert "metrics/otelcol" in cfg["service"]["pipelines"]
 
     def test_small_batches_profile(self):
