@@ -1,0 +1,73 @@
+"""Process-startup configurator for the odigos-tpu Python agent.
+
+Role analog of /root/reference/agents/python/configurator/__init__.py
+(OdigosPythonConfigurator._configure -> initialize_components): called in
+an instrumented process, it wires the hooks tracer's default sink to the
+delivery the webhook-injected env selects and registers an atexit flush.
+
+Env contract (injected by the instrumentor webhook / distro registry,
+distros/registry.py python-community):
+
+    ODIGOS_SERVICE_NAME    logical service (default: process name)
+    ODIGOS_WIRE_ENDPOINT   host:port of the node collector's otlp wire
+                           front door; spans ship as framed-TCP batches
+    ODIGOS_AUTO_INIT=1     sitecustomize runs initialize() automatically
+
+Without an endpoint the tracer buffers (bounded, drop-counted) — the app
+can still call odigos_tpu.hooks.flush() after wiring its own sink.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Any, Optional
+
+MINIMUM_PYTHON_SUPPORTED_VERSION = (3, 8)
+
+_state: dict[str, Any] = {"initialized": False, "exporter": None}
+
+
+def initialize(service: Optional[str] = None,
+               endpoint: Optional[str] = None) -> bool:
+    """Idempotent agent init; returns True when a sink was wired."""
+    if _state["initialized"]:
+        return _state["exporter"] is not None
+    _state["initialized"] = True
+
+    service = service or os.environ.get("ODIGOS_SERVICE_NAME", "")
+    if service:
+        os.environ.setdefault("ODIGOS_SERVICE_NAME", service)
+    endpoint = endpoint or os.environ.get("ODIGOS_WIRE_ENDPOINT", "")
+    if not endpoint:
+        return False
+
+    from odigos_tpu.hooks import tracer as hooks
+    from odigos_tpu.wire.client import WireExporter
+
+    exporter = WireExporter("otlpwire/agent", {"endpoint": endpoint})
+    exporter.start()
+    _state["exporter"] = exporter
+    hooks.set_default_sink(exporter.export)
+
+    def _shutdown() -> None:
+        try:
+            hooks.flush()
+            exporter.flush(timeout=5.0)
+        finally:
+            exporter.shutdown()
+
+    atexit.register(_shutdown)
+    return True
+
+
+class OdigosTpuConfigurator:
+    """Entry-point class (the reference's _BaseConfigurator shape): the
+    loader instantiates it and calls ``configure()``."""
+
+    def configure(self, **kwargs: Any) -> None:
+        initialize()
+
+    # reference spelling (sdk_config._BaseConfigurator API)
+    def _configure(self, **kwargs: Any) -> None:
+        initialize()

@@ -1,0 +1,29 @@
+"""Installable Python agent shim — agents/python analog.
+
+Reference: /root/reference/agents/python/setup.py installs
+``odigos-python-configurator``, a thin package whose opentelemetry
+configurator entry point wires the vendored SDK into a user process at
+startup. This is the odigos-tpu equivalent: the package the odiglet
+init phase copies under ``{agent_dir}/python`` (distros/registry.py
+python-community PYTHONPATH injection) and that user environments can
+``pip install`` directly.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="odigos-tpu-configurator",
+    version="0.1.0",
+    description=("Odigos-TPU configurator: auto-wires the manual tracer "
+                 "and wire exporter into a Python process at startup"),
+    packages=find_packages(include=["odigos_tpu_configurator",
+                                    "odigos_tpu_configurator.*"]),
+    py_modules=["sitecustomize"],
+    python_requires=">=3.8",
+    entry_points={
+        "odigos_configurator": [
+            "odigos-tpu-configurator = "
+            "odigos_tpu_configurator:OdigosTpuConfigurator",
+        ],
+    },
+)

@@ -1,0 +1,14 @@
+"""Auto-init hook: PYTHONPATH delivery runs this at interpreter startup
+(the distro env mechanism, distros/registry.py python-community). Gated on
+ODIGOS_AUTO_INIT so merely having the agent dir on PYTHONPATH does not
+instrument unrelated tooling processes. Failures never break the app."""
+
+import os
+
+if os.environ.get("ODIGOS_AUTO_INIT") == "1":
+    try:
+        from odigos_tpu_configurator import initialize
+
+        initialize()
+    except Exception:
+        pass  # instrumentation must never take the application down

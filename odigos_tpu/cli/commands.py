@@ -462,6 +462,78 @@ def cmd_diagnose(args) -> int:
     return 0
 
 
+# ----------------------------------------------------------- central stack
+
+CENTRAL_NAMESPACE = "central-odigos"
+# the enterprise central stack (cli/cmd/resources/centralodigos/
+# {centralbackend,centralproxy,centralui,keycloak,redis}.go): component
+# name -> (container image role, replicas)
+CENTRAL_COMPONENTS = (
+    ("central-backend", 1),
+    ("central-proxy", 1),
+    ("central-ui", 1),
+    ("keycloak", 1),
+    ("redis", 1),
+)
+
+
+def cmd_central(args) -> int:
+    """`central install|uninstall|status` — the enterprise central stack
+    (reference: cli/cmd/pro-dep.go centralCmdDep + centralodigos resource
+    managers). Installing requires an onprem entitlement; components are
+    scheduled as workloads in the cluster so status/describe see them."""
+    from ..controlplane.cluster import Container
+    from ..api.resources import WorkloadRef, WorkloadKind
+
+    state = _load(args)
+
+    def refs():
+        return [WorkloadRef(CENTRAL_NAMESPACE, WorkloadKind.DEPLOYMENT, n)
+                for n, _ in CENTRAL_COMPONENTS]
+
+    installed = [r for r in refs()
+                 if state.cluster.get_workload(r) is not None]
+
+    if args.action == "status":
+        if not installed:
+            print("central stack: not installed")
+            return 0
+        for ref in installed:
+            pods = state.cluster.pods_of(ref)
+            phases = ",".join(p.phase.value for p in pods) or "no pods"
+            print(f"{ref.name}: {phases}")
+        return 0
+
+    if args.action == "uninstall":
+        if not installed:
+            return _err("central stack is not installed")
+        for ref in refs():
+            state.cluster.remove_workload(ref)
+        state.save()
+        print(f"central stack removed from {CENTRAL_NAMESPACE}")
+        return 0
+
+    # install: enterprise entitlement required (pro-dep.go onprem-token)
+    from ..utils.auth import TokenError, validate_tier_claim
+
+    try:
+        validate_tier_claim(getattr(args, "onprem_token", None) or "",
+                            "onprem")
+    except TokenError as e:
+        return _err(f"central stack requires a valid onprem token "
+                    f"(--onprem-token): {e}")
+    if installed:
+        return _err("central stack already installed")
+    for name, replicas in CENTRAL_COMPONENTS:
+        state.cluster.add_workload(
+            CENTRAL_NAMESPACE, name,
+            [Container(name, language="central")], replicas=replicas)
+    state.save()
+    print(f"central stack installed in {CENTRAL_NAMESPACE} "
+          f"({', '.join(n for n, _ in CENTRAL_COMPONENTS)})")
+    return 0
+
+
 # ---------------------------------------------------------------- parser
 
 
@@ -497,6 +569,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("status", help="installation summary")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("central",
+                       help="manage the enterprise central stack")
+    p.add_argument("action", choices=["install", "uninstall", "status"])
+    p.add_argument("--onprem-token", default=None,
+                   help="enterprise entitlement (required for install)")
+    p.set_defaults(fn=cmd_central)
 
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)

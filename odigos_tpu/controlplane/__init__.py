@@ -16,11 +16,14 @@ api.Store/ControllerManager runtime:
 from .cluster import Cluster, Container, Pod, PodPhase, Workload
 from .instrumentor import Instrumentor
 from .operator import Operator
+from .pro import ProArtifactReconciler, PRO_ARTIFACT_NAME
 from .scheduler import Scheduler, EFFECTIVE_CONFIG_NAME
 from .autoscaler import Autoscaler, HpaDecider, GATEWAY_CONFIG_NAME, NODE_CONFIG_NAME
 
 __all__ = [
     "Operator",
+    "ProArtifactReconciler",
+    "PRO_ARTIFACT_NAME",
     "Cluster",
     "Container",
     "Pod",

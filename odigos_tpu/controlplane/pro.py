@@ -1,0 +1,96 @@
+"""Pro-tier artifact sync — the odigospro controller analog.
+
+Reference: scheduler/controllers/odigospro/{odigospro_controller,
+offsets_controller}.go — for pro-tier installs, a controller keeps a
+versioned artifact (the go-auto instrumentation offsets ConfigMap) in the
+cluster for node agents to consume; community installs never get it, and
+losing the entitlement removes it.
+
+TPU-native translation: the artifact our agents consume is not Go struct
+offsets but the *model/feature compatibility table* — the featurizer
+schema hash and the distro inventory that a serving bundle was built
+against. Node agents stamp the schema hash into each instrumented
+process's config so a bundle/schema mismatch is detectable at the agent
+boundary instead of as silent feature skew (the same failure class go
+offsets prevent: instrumentation reading wrong memory layout).
+
+``ProArtifactReconciler`` watches the effective-config ConfigMap (where
+the scheduler records the token-validated tier, scheduler.py:87) and:
+
+* pro tiers (cloud/onprem): applies the ``odigos-model-offsets``
+  ConfigMap, bumping ``version`` whenever the content hash changes;
+* community: deletes it (entitlement loss revokes the artifact).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+from ..api.resources import ConfigMap, ObjectMeta
+from ..api.store import Store
+from ..config.model import Tier
+from .scheduler import EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE
+
+PRO_ARTIFACT_NAME = "odigos-model-offsets"
+_PRO_TIERS = (Tier.CLOUD, Tier.ONPREM)
+
+
+def compute_artifact_content() -> dict[str, Any]:
+    """The versioned payload: featurizer schema identity + distro
+    inventory. Deterministic for a given build — the hash only moves when
+    the feature schema or distro set changes (offsets_controller.go's
+    fetched offsets file role)."""
+    from ..distros.registry import DISTROS_BY_NAME
+    from ..features.featurizer import CAT_FIELDS, CONT_FIELDS
+
+    distros = sorted(DISTROS_BY_NAME)
+    schema = {"categorical": list(CAT_FIELDS),
+              "continuous": list(CONT_FIELDS)}
+    payload = {"feature_schema": schema, "distros": distros}
+    digest = hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+    payload["feature_schema_hash"] = digest
+    return payload
+
+
+class ProArtifactReconciler:
+    """Watches ConfigMaps; reconciles on the effective-config (tier
+    changes) and on the artifact itself (drift — a hand-edited or deleted
+    artifact converges back)."""
+
+    def __init__(self, store: Store, manager=None):
+        self.store = store
+        if manager is not None:
+            manager.register("odigos-pro-artifact", self,
+                             {"ConfigMap": None})
+
+    def reconcile(self, store: Store, key: tuple[str, str]) -> None:
+        if key not in ((ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME),
+                       (ODIGOS_NAMESPACE, PRO_ARTIFACT_NAME)):
+            return
+        eff = store.get("ConfigMap", ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME)
+        tier = Tier.COMMUNITY
+        if eff is not None:
+            try:
+                tier = Tier(eff.data.get("tier", "community"))
+            except ValueError:
+                tier = Tier.COMMUNITY  # unknown tier = least entitlement
+        existing = store.get("ConfigMap", ODIGOS_NAMESPACE, PRO_ARTIFACT_NAME)
+
+        if tier not in _PRO_TIERS:
+            if existing is not None:
+                store.delete("ConfigMap", ODIGOS_NAMESPACE, PRO_ARTIFACT_NAME)
+            return
+
+        content = compute_artifact_content()
+        if (existing is not None
+                and existing.data.get("content") == content):
+            return  # converged
+        version = int(existing.data.get("version", 0)) + 1 if existing else 1
+        store.apply(ConfigMap(
+            meta=ObjectMeta(name=PRO_ARTIFACT_NAME,
+                            namespace=ODIGOS_NAMESPACE),
+            data={"content": content, "version": version,
+                  "tier": tier.value}))

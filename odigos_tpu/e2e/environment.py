@@ -16,6 +16,7 @@ from ..api.resources import DestinationResource, ObjectMeta, Source, WorkloadRef
 from ..api.store import ControllerManager, Store
 from ..config.model import Configuration, RolloutConfiguration
 from ..controlplane import Autoscaler, Cluster, Instrumentor, Scheduler
+from ..controlplane.pro import ProArtifactReconciler
 from ..controlplane.scheduler import ODIGOS_NAMESPACE
 from ..controlplane.autoscaler import GATEWAY_CONFIG_NAME
 from ..destinations import Destination
@@ -37,6 +38,7 @@ class E2EEnvironment:
         self.instrumentor = Instrumentor(self.store, self.manager,
                                          self.cluster, self.config)
         self.autoscaler = Autoscaler(self.store, self.manager, self.config)
+        self.pro_artifacts = ProArtifactReconciler(self.store, self.manager)
         self.odiglets = [
             Odiglet(self.store, self.manager, self.cluster, node=n,
                     tpu_chips=tpu_chips_per_node)

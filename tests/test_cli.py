@@ -104,6 +104,28 @@ def test_ui_command_binds_and_exits(run):
     assert "dashboard: http://127.0.0.1:" in out
 
 
+def test_central_stack_lifecycle(run):
+    """central install/uninstall/status (reference: cli/cmd/pro-dep.go
+    central command over centralodigos resource managers) — entitlement-
+    gated install schedules the five central components."""
+    from test_auth import make_token
+
+    run("install")
+    assert "not installed" in run("central", "status")
+    run("central", "install", expect=1)  # no entitlement
+    run("central", "install", "--onprem-token", "garbage", expect=1)
+    out = run("central", "install", "--onprem-token", make_token())
+    assert "central-backend" in out and "keycloak" in out
+    status = run("central", "status")
+    for comp in ("central-backend", "central-proxy", "central-ui",
+                 "keycloak", "redis"):
+        assert f"{comp}: Running" in status
+    run("central", "install", "--onprem-token", make_token(), expect=1)
+    run("central", "uninstall")
+    assert "not installed" in run("central", "status")
+    run("central", "uninstall", expect=1)
+
+
 def test_pro_command_upgrades_tier(run):
     from test_auth import make_token
 

@@ -1,0 +1,170 @@
+"""Pro-tier artifact sync (controlplane/pro.py) — the odigospro offsets
+controller analog (reference: scheduler/controllers/odigospro/
+offsets_controller.go): pro installs sync a versioned model/feature
+compatibility ConfigMap; community installs never get it; losing the
+entitlement revokes it; node agents stamp the hash into agent configs."""
+
+from __future__ import annotations
+
+from odigos_tpu.config.model import Configuration, Tier
+from odigos_tpu.controlplane import PRO_ARTIFACT_NAME
+from odigos_tpu.controlplane.pro import compute_artifact_content
+from odigos_tpu.controlplane.scheduler import ODIGOS_NAMESPACE
+from odigos_tpu.e2e.environment import E2EEnvironment
+
+
+def _artifact(env):
+    return env.store.get("ConfigMap", ODIGOS_NAMESPACE, PRO_ARTIFACT_NAME)
+
+
+def test_content_is_deterministic_and_hashed():
+    a, b = compute_artifact_content(), compute_artifact_content()
+    assert a == b
+    assert a["feature_schema_hash"] and len(a["feature_schema_hash"]) == 16
+    assert "python" in " ".join(a["distros"])
+
+
+def test_community_install_has_no_artifact():
+    with E2EEnvironment(nodes=1) as env:
+        env.reconcile()
+        assert _artifact(env) is None
+
+
+def test_pro_install_syncs_artifact_and_revokes_on_downgrade():
+    with E2EEnvironment(nodes=1) as env:
+        env.scheduler.tier = Tier.ONPREM
+        env.scheduler.apply_authored(env.config)
+        env.reconcile()
+        art = _artifact(env)
+        assert art is not None, "pro install did not sync the artifact"
+        assert art.data["version"] == 1
+        assert art.data["content"]["feature_schema_hash"]
+
+        # converged: further reconciles do not bump the version
+        env.reconcile()
+        assert _artifact(env).data["version"] == 1
+
+        # drift: artifact deleted by hand -> converges back, version bumps
+        env.store.delete("ConfigMap", ODIGOS_NAMESPACE, PRO_ARTIFACT_NAME)
+        env.reconcile()
+        assert _artifact(env) is not None
+
+        # entitlement loss: downgrade to community revokes the artifact
+        env.scheduler.tier = Tier.COMMUNITY
+        env.scheduler.apply_authored(env.config)
+        env.reconcile()
+        assert _artifact(env) is None
+
+
+def _agent_config(env):
+    """The config any instrumented agent receives — the odiglet's
+    config_for_group seam (manager.py apply_config input)."""
+    from odigos_tpu.api.resources import WorkloadKind, WorkloadRef
+
+    od = env.odiglets[0]
+    group = (WorkloadRef("shop", WorkloadKind.DEPLOYMENT, "cart"), "main")
+    resolved = od._config_for_container(group)
+    assert resolved is not None, "workload not instrumented"
+    return resolved[1]
+
+
+def test_agents_pin_schema_hash_on_pro_installs():
+    from odigos_tpu.controlplane.cluster import Container
+
+    with E2EEnvironment(nodes=1) as env:
+        env.scheduler.tier = Tier.CLOUD
+        env.scheduler.apply_authored(env.config)
+        env.reconcile()
+        env.cluster.add_workload("shop", "cart",
+                                 [Container("main", language="python")])
+        env.instrument_workload("shop", "cart")
+        env.reconcile()
+        cfg = _agent_config(env)
+        expected = compute_artifact_content()["feature_schema_hash"]
+        assert cfg.get("feature_schema_hash") == expected
+        assert cfg.get("model_offsets_version") == 1
+
+
+def test_agents_unpinned_on_community():
+    from odigos_tpu.controlplane.cluster import Container
+
+    with E2EEnvironment(nodes=1) as env:
+        env.cluster.add_workload("shop", "cart",
+                                 [Container("main", language="python")])
+        env.instrument_workload("shop", "cart")
+        env.reconcile()
+        cfg = _agent_config(env)
+        assert "feature_schema_hash" not in cfg
+
+
+class TestAgentShim:
+    """agents/python installable shim (reference:
+    /root/reference/agents/python/setup.py configurator package): a real
+    user process with the injected env ships hooks spans over the wire."""
+
+    def test_shim_auto_init_ships_spans_cross_process(self):
+        import os
+        import subprocess
+        import sys
+        import time
+
+        from odigos_tpu.wire.server import WireReceiver
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        agent_dir = os.path.join(repo, "agents", "python")
+
+        got = []
+
+        class Sink:
+            def consume(self, batch):
+                got.append(batch)
+
+        recv = WireReceiver("otlpwire", {"port": 0})
+        recv.set_consumer(Sink())
+        recv.start()
+        try:
+            app = (
+                "from odigos_tpu.hooks import span\n"
+                "with span('charge-card', attrs={'amount': 42}):\n"
+                "    pass\n"
+            )
+            env = dict(
+                os.environ,
+                PYTHONPATH=f"{agent_dir}{os.pathsep}{repo}",
+                ODIGOS_AUTO_INIT="1",
+                ODIGOS_SERVICE_NAME="checkout-svc",
+                ODIGOS_WIRE_ENDPOINT=f"127.0.0.1:{recv.port}",
+                JAX_PLATFORMS="cpu")
+            r = subprocess.run([sys.executable, "-c", app], env=env,
+                               cwd=repo, capture_output=True, text=True,
+                               timeout=120)
+            assert r.returncode == 0, r.stderr
+            deadline = time.time() + 15
+            while time.time() < deadline and not got:
+                time.sleep(0.05)
+            assert got, "no spans arrived from the instrumented process"
+            batch = got[0]
+            assert batch.service_names() == ["checkout-svc"]
+            names = [batch.string_at(int(i)) for i in batch.col("name")]
+            assert names == ["charge-card"]
+        finally:
+            recv.shutdown()
+
+    def test_shim_without_auto_init_is_inert(self):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        agent_dir = os.path.join(repo, "agents", "python")
+        app = ("import odigos_tpu_configurator as c\n"
+               "assert not c._state['initialized']\n"
+               "print('inert')\n")
+        env = dict(os.environ,
+                   PYTHONPATH=f"{agent_dir}{os.pathsep}{repo}",
+                   JAX_PLATFORMS="cpu")
+        env.pop("ODIGOS_AUTO_INIT", None)
+        r = subprocess.run([sys.executable, "-c", app], env=env, cwd=repo,
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "inert" in r.stdout
