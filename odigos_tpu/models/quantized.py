@@ -20,8 +20,12 @@ packed rows): parity max |dp| 0.0095, but 0.67x the bf16 throughput — the
 per-token quantize/dequantize (VPU, elementwise over every activation)
 costs more than the halved MXU time saves at these matmul sizes. The path
 therefore stays OPT-IN (``EngineConfig.quantized`` / processor config
-``quantized: true``); it pays off at larger d_model/d_ff or when HBM is
-the constraint, not here. Kept honest rather than advertised as a win.
+``quantized: true``). The payoff claim was measured across geometries
+(tools/quant_geometry.py, v5e-1, 2026-07-30): ~0.89x at d_model 512/
+d_ff 2048 and ~1.1x (int8 faster) at d_model 1024/d_ff 4096, parity
+max |dp| <= 0.011 throughout — the crossover exists but sits above the
+flagship size. AUC on the injected-fault eval is asserted at the same
+>=0.95 bar as the float path (tests/test_northstar_auc.py).
 """
 
 from __future__ import annotations

@@ -65,6 +65,33 @@ def transformer_scorer(model, variables, *, max_len: int = 32) -> Scorer:
     return score
 
 
+def quantized_transformer_scorer(model, variables, *, max_len: int = 32
+                                 ) -> Scorer:
+    """Adapt the int8 serving path (models/quantized.py) to a per-span
+    scorer — lets the injected-fault AUC bar apply to quantized serving
+    exactly as it does to the float path."""
+    import jax.numpy as jnp
+
+    from ..features import pack_sequences
+    from ..models.quantized import QuantizedTraceScorer
+
+    scorer = QuantizedTraceScorer(model, variables)
+
+    def score(batch: SpanBatch) -> np.ndarray:
+        feats = featurize(batch)
+        p = pack_sequences(batch, feats, max_len=max_len)
+        probs = np.asarray(scorer.score_packed(
+            jnp.asarray(p.categorical), jnp.asarray(p.continuous),
+            jnp.asarray(p.segments), jnp.asarray(p.positions)))
+        out = np.zeros(len(batch), dtype=np.float32)
+        idx = p.span_index
+        valid = idx >= 0
+        out[idx[valid]] = probs[valid]
+        return out
+
+    return score
+
+
 def zscore_scorer(detector, *, warmup_batch: Optional[SpanBatch] = None
                   ) -> Scorer:
     if warmup_batch is not None:

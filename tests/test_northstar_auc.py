@@ -37,6 +37,19 @@ def test_northstar_auc(trained):
     assert ev["auc"] >= 0.95, ev
 
 
+def test_northstar_auc_quantized(trained):
+    """The int8 serving path meets the same AUC bar on the same trained
+    checkpoint (VERDICT r3 item 6: max-|dp| parity alone does not bound
+    ranking quality; assert the detection metric directly)."""
+    from odigos_tpu.training.evaluate import quantized_transformer_scorer
+
+    trainer, res, _ = trained
+    scorer = quantized_transformer_scorer(trainer.model, res.variables,
+                                          max_len=32)
+    ev = evaluate_detector(scorer, n_traces=1000, seed=999)
+    assert ev["auc"] >= 0.95, ev
+
+
 def test_train_serve_loop_flags_faults_into_tracedb(trained):
     """The VERDICT-r1 critical path: checkpoint → pipeline → anomaly stream."""
     _, _, bundle_path = trained
