@@ -28,7 +28,8 @@ from ..wire.hotreload import watch_configmap
 class E2EEnvironment:
     def __init__(self, nodes: int = 1,
                  config: Optional[Configuration] = None,
-                 tpu_chips_per_node: int = 0):
+                 tpu_chips_per_node: int = 0,
+                 node_collectors: bool = False):
         self.store = Store()
         self.manager = ControllerManager(self.store)
         self.cluster = Cluster(nodes=nodes)
@@ -47,6 +48,10 @@ class E2EEnvironment:
         self.autoscaler.attach_device_registries(
             [od.devices for od in self.odiglets])
         self.gateway: Optional[Collector] = None
+        self._boot_node_collectors = node_collectors
+        # node -> Collector booted from the generated DaemonSet config
+        self.node_collectors: dict[str, Collector] = {}
+        self._node_unsubs: list = []
         self._unsub = None
         self._wire_tap = None  # lazy WireExporter into the gateway
 
@@ -67,16 +72,64 @@ class E2EEnvironment:
         self._unsub = watch_configmap(
             self.store, ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME, self.gateway,
             extract=lambda data: data["collector-conf"])
+        # cluster-DNS role: the generated node configs address the gateway
+        # by service name; register its real wire listener
+        from ..wire.servicemap import register_service
+        try:
+            register_service("odigos-gateway.odigos-system",
+                             [f"127.0.0.1:{self.gateway_otlp_port()}"])
+        except RuntimeError:
+            pass  # gateway has no otlp front door (no sources yet)
+        if self._boot_node_collectors:
+            self._start_node_collectors()
         return self
+
+    def _start_node_collectors(self) -> None:
+        """Boot one Collector per node from the autoscaler's generated
+        DaemonSet config (NODE_CONFIG_NAME), hot-reloading on changes —
+        the in-process analog of the data-collection DaemonSet pods."""
+        from ..controlplane.autoscaler import NODE_CONFIG_NAME
+
+        def extract_for(node: str):
+            def extract(data):
+                return _expand_downward_api(
+                    data["collector-conf"], node)
+            return extract
+
+        cm = self.store.get("ConfigMap", ODIGOS_NAMESPACE, NODE_CONFIG_NAME)
+        for node in self.cluster.nodes:
+            initial = (extract_for(node)(cm.data) if cm is not None
+                       else _IDLE_CONFIG)
+            collector = Collector(initial).start()
+            self.node_collectors[node] = collector
+            self._node_unsubs.append(watch_configmap(
+                self.store, ODIGOS_NAMESPACE, NODE_CONFIG_NAME, collector,
+                extract=extract_for(node)))
+
+    def node_otlp_port(self, node: str) -> int:
+        """TCP port of a node collector's otlp front door."""
+        collector = self.node_collectors[node]
+        for rid, recv in collector.graph.receivers.items():
+            if rid.split("/")[0] == "otlp" and hasattr(recv, "port"):
+                return recv.port
+        raise RuntimeError(f"node {node} collector has no otlp receiver")
 
     def shutdown(self) -> None:
         if self._wire_tap is not None:
             self._wire_tap.shutdown()
             self._wire_tap = None
+        for unsub in self._node_unsubs:
+            unsub()
+        self._node_unsubs = []
+        for collector in self.node_collectors.values():
+            collector.shutdown()
+        self.node_collectors = {}
         if self._unsub:
             self._unsub()
         if self.gateway is not None:
             self.gateway.shutdown()
+        from ..wire.servicemap import unregister_service
+        unregister_service("odigos-gateway.odigos-system")
         for od in self.odiglets:
             od.stop()
 
@@ -95,6 +148,20 @@ class E2EEnvironment:
             self.manager.run_once()
             for od in self.odiglets:
                 od.poll()
+        self._refresh_gateway_service()
+
+    def _refresh_gateway_service(self) -> None:
+        """Keep the service registration pointing at the gateway's CURRENT
+        wire listener — hot reloads rebuild the receiver on a new
+        ephemeral port (the endpoints-watch role of the k8s resolver)."""
+        if self.gateway is None:
+            return
+        from ..wire.servicemap import register_service
+        try:
+            register_service("odigos-gateway.odigos-system",
+                             [f"127.0.0.1:{self.gateway_otlp_port()}"])
+        except RuntimeError:
+            pass
 
     # ------------------------------------------------------------ fixtures
 
@@ -166,3 +233,17 @@ class E2EEnvironment:
 
 _IDLE_CONFIG: dict[str, Any] = {
     "receivers": {}, "exporters": {}, "service": {"pipelines": {}}}
+
+
+def _expand_downward_api(config: Any, node: str) -> Any:
+    """Replace ``${NODE_NAME}`` throughout a generated config — the
+    downward-API env substitution the DaemonSet pod spec performs
+    (common.go nodeNameProcessorName value). Per-collector because all
+    simulated nodes share this process's environment."""
+    if isinstance(config, dict):
+        return {k: _expand_downward_api(v, node) for k, v in config.items()}
+    if isinstance(config, list):
+        return [_expand_downward_api(v, node) for v in config]
+    if isinstance(config, str) and "${NODE_NAME}" in config:
+        return config.replace("${NODE_NAME}", node)
+    return config

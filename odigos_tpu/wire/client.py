@@ -97,7 +97,13 @@ class WireExporter(Exporter):
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            host, port = self.config["endpoint"].rsplit(":", 1)
+            # service-name endpoints (generated configs address the
+            # gateway as odigos-gateway.odigos-system:4317) resolve
+            # through the process service registry first, then real DNS
+            from .servicemap import resolve_endpoint
+
+            host, port = resolve_endpoint(
+                self.config["endpoint"]).rsplit(":", 1)
             self._sock = socket.create_connection((host, int(port)),
                                                   timeout=5.0)
         return self._sock
@@ -198,16 +204,42 @@ class LoadBalancingExporter(Exporter):
         # (ring points, endpoints, vnode -> endpoint index)
         self._ring: tuple[np.ndarray, list[str], np.ndarray] = (
             np.zeros(0, np.uint64), [], np.zeros(0, np.int64))
-        self._resolver: Optional[Callable[[], list[str]]] = \
-            config.get("resolver")
+        resolver = config.get("resolver")
+        self._watched_service = ""
+        if isinstance(resolver, dict):
+            # generated-config spelling (traces.go:26): resolve the k8s
+            # service through the process service registry (the cluster-
+            # DNS seam the e2e environment populates)
+            service = str(resolver.get("k8s", {}).get("service", ""))
+            from .servicemap import resolve_service
+
+            if service:
+                self._watched_service = service
+                resolver = lambda: resolve_service(service)  # noqa: E731
+            else:
+                resolver = None
+        self._resolver: Optional[Callable[[], list[str]]] = resolver
+        self._unwatch = None
         self._last_resolve = 0.0
         self._lock = threading.Lock()
 
     def start(self) -> None:
         super().start()
+        if self._watched_service:
+            # endpoints-watch semantics: a registration change resolves
+            # immediately instead of waiting out the poll interval
+            from .servicemap import watch_services
+
+            svc = self._watched_service
+            self._unwatch = watch_services(
+                lambda name: self._resolve(force=True)
+                if name == svc else None)
         self._resolve(force=True)
 
     def shutdown(self) -> None:
+        if self._unwatch is not None:
+            self._unwatch()
+            self._unwatch = None
         with self._lock:
             children = list(self._children.values())
             self._children = {}

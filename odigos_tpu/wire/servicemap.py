@@ -1,0 +1,80 @@
+"""Process-local service registry — the cluster-DNS / k8s-resolver seam.
+
+Generated configs reference collectors by service name
+("odigos-gateway.odigos-system:4317",
+``resolver: {k8s: {service: ...}}`` — traces.go:26 loadbalancing
+resolver). In a cluster those resolve through DNS / the k8s endpoints
+API; in-process, the e2e environment registers the real listener
+addresses here and the wire components resolve through this map:
+
+* ``LoadBalancingExporter`` turns a ``{"k8s": {"service": name}}``
+  resolver dict into a lookup against this registry (re-resolved on its
+  normal interval, so scale-out/in propagates like endpoint watches);
+* service-shaped ``host:port`` endpoints in generated configs rewrite to
+  the registered address at collector boot (the env's DNS role).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+_services: dict[str, list[str]] = {}
+_watchers: list[Callable[[str], None]] = []
+_lock = threading.Lock()
+
+
+def watch_services(callback: Callable[[str], None]) -> Callable[[], None]:
+    """Subscribe to registration changes (the endpoints-watch role — the
+    reference resolver reacts to endpoint updates, it does not poll).
+    Returns an unsubscribe function."""
+    with _lock:
+        _watchers.append(callback)
+
+    def unsubscribe() -> None:
+        with _lock:
+            if callback in _watchers:
+                _watchers.remove(callback)
+
+    return unsubscribe
+
+
+def _notify(name: str) -> None:
+    with _lock:
+        watchers = list(_watchers)
+    for cb in watchers:
+        try:
+            cb(name)
+        except Exception:
+            pass  # one broken watcher must not break registration
+
+
+def register_service(name: str, endpoints: list[str]) -> None:
+    """Register/replace the endpoint list for a service name."""
+    with _lock:
+        changed = _services.get(name) != list(endpoints)
+        _services[name] = list(endpoints)
+    if changed:
+        _notify(name)
+
+
+def unregister_service(name: str) -> None:
+    with _lock:
+        existed = _services.pop(name, None) is not None
+    if existed:
+        _notify(name)
+
+
+def resolve_service(name: str) -> list[str]:
+    """Current endpoints for the service ([] when unknown — exporters
+    idle and re-resolve, matching an empty k8s endpoints object)."""
+    with _lock:
+        return list(_services.get(name, ()))
+
+
+def resolve_endpoint(endpoint: str) -> str:
+    """Map a ``service-name:port`` endpoint to a registered address;
+    unknown names pass through unchanged (real DNS may still work)."""
+    host = endpoint.rsplit(":", 1)[0]
+    eps = resolve_service(host)
+    return eps[0] if eps else endpoint
