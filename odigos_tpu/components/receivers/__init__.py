@@ -1,2 +1,2 @@
 from . import (  # noqa: F401  (registers factories on import)
-    filelog, hostmetrics, kubeletstats, prometheus, synthetic)
+    filelog, hostmetrics, kubeletstats, prometheus, synthetic, zipkin)

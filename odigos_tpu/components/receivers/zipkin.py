@@ -1,0 +1,156 @@
+"""``zipkin`` receiver — Zipkin v2 JSON span intake over HTTP.
+
+Reference: the upstream zipkinreceiver shipped in the collector distro
+(collector/builder-config.yaml zipkinreceiver) — apps instrumented with
+zipkin/brave SDKs POST JSON arrays to ``/api/v2/spans`` and the collector
+translates them into the pipeline. This analog accepts the same contract
+(POST /api/v2/spans, JSON array of zipkin v2 spans, 202 on accept) and
+translates straight into a columnar SpanBatch:
+
+    traceId/id/parentId   hex -> int ids
+    timestamp/duration    microseconds -> start/end unix nanos
+    kind                  SERVER/CLIENT/PRODUCER/CONSUMER -> SpanKind
+    localEndpoint.serviceName -> service (resource service.name)
+    tags                  span attributes (tags.error -> STATUS ERROR,
+                          the zipkin convention)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from ...pdata.spans import SpanBatchBuilder, SpanKind, StatusCode
+from ...utils.telemetry import meter
+from ..api import ComponentKind, Factory, Receiver, Signal, register
+
+ACCEPTED_METRIC = "odigos_zipkin_spans_accepted_total"
+REJECTED_METRIC = "odigos_zipkin_requests_rejected_total"
+
+_KINDS = {"SERVER": SpanKind.SERVER, "CLIENT": SpanKind.CLIENT,
+          "PRODUCER": SpanKind.PRODUCER, "CONSUMER": SpanKind.CONSUMER}
+
+
+def _hex_id(value: Any) -> int:
+    try:
+        return int(str(value), 16)
+    except (TypeError, ValueError):
+        return 0
+
+
+def translate_spans(docs: list[dict[str, Any]]):
+    """Zipkin v2 JSON array -> SpanBatch (one resource per service)."""
+    b = SpanBatchBuilder()
+    resources: dict[str, int] = {}
+    for doc in docs:
+        service = str((doc.get("localEndpoint") or {})
+                      .get("serviceName") or "unknown")
+        res = resources.get(service)
+        if res is None:
+            res = resources[service] = b.add_resource(
+                {"service.name": service})
+        ts_us = int(doc.get("timestamp") or 0)
+        dur_us = int(doc.get("duration") or 0)
+        tags = {str(k): v for k, v in (doc.get("tags") or {}).items()}
+        status = (StatusCode.ERROR if tags.get("error")
+                  else StatusCode.UNSET)
+        b.add_span(
+            trace_id=_hex_id(doc.get("traceId")),
+            span_id=_hex_id(doc.get("id")),
+            parent_span_id=_hex_id(doc.get("parentId")),
+            name=str(doc.get("name") or "unknown"),
+            service=service,
+            kind=_KINDS.get(str(doc.get("kind") or "").upper(),
+                            SpanKind.INTERNAL),
+            status_code=status,
+            start_unix_nano=ts_us * 1000,
+            end_unix_nano=(ts_us + dur_us) * 1000,
+            resource_index=res,
+            attrs=tags or None,
+        )
+    return b.build()
+
+
+class ZipkinReceiver(Receiver):
+    """Config: host (default 127.0.0.1), port (default 0 = ephemeral; the
+    zipkin convention is 9411), max_body_bytes (default 16 MiB)."""
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None, "not started"
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        super().start()
+        recv = self
+        max_body = int(self.config.get("max_body_bytes", 16 << 20))
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/api/v2/spans":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                if length > max_body:
+                    meter.add(f"{REJECTED_METRIC}{{receiver={recv.name}}}")
+                    self.send_error(413, "body too large")
+                    return
+                try:
+                    docs = json.loads(self.rfile.read(length))
+                    if not isinstance(docs, list):
+                        raise ValueError("expected a JSON array of spans")
+                    batch = translate_spans(docs)
+                except (ValueError, KeyError, TypeError) as e:
+                    meter.add(f"{REJECTED_METRIC}{{receiver={recv.name}}}")
+                    self.send_error(400, str(e)[:200])
+                    return
+                if len(batch):
+                    try:
+                        recv.next_consumer.consume(batch)
+                    except Exception:
+                        # downstream refusal (memory limiter): zipkin
+                        # clients understand 5xx as retryable
+                        self.send_error(503, "pipeline refused the batch")
+                        return
+                    meter.add(f"{ACCEPTED_METRIC}{{receiver={recv.name}}}",
+                              len(batch))
+                self.send_response(202)  # the zipkin collector contract
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        host = str(self.config.get("host", "127.0.0.1"))
+        self._httpd = ThreadingHTTPServer(
+            (host, int(self.config.get("port", 0))), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"zipkin-{self.name}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        super().shutdown()
+
+
+register(Factory(
+    type_name="zipkin",
+    kind=ComponentKind.RECEIVER,
+    create=ZipkinReceiver,
+    signals=(Signal.TRACES,),
+    default_config=lambda: {"host": "127.0.0.1", "port": 0},
+))

@@ -45,7 +45,7 @@ def main(argv=None) -> int:
 
     metrics_server = None
     if args.metrics_port:
-        metrics_server = _serve_metrics(args.metrics_port)
+        metrics_server = _serve_metrics(args.metrics_port, collector)
         print(f"self-metrics on :{metrics_server.server_address[1]}"
               f"/metrics", flush=True)
 
@@ -82,9 +82,12 @@ def main(argv=None) -> int:
     return 0
 
 
-def _serve_metrics(port: int):
-    """Prometheus-text self-metrics endpoint (own-observability analog:
-    the ServiceMonitor scrapes this on a VM install)."""
+def _serve_metrics(port: int, collector=None):
+    """Prometheus-text self-metrics endpoint plus /healthz — the
+    own-observability + healthcheckextension roles (the reference distro
+    compiles healthcheckextension into the collector,
+    builder-config.yaml; systemd/k8s probes poll it)."""
+    import json as _json
     import socketserver
     from http.server import BaseHTTPRequestHandler
 
@@ -95,7 +98,23 @@ def _serve_metrics(port: int):
             pass
 
         def do_GET(self):  # noqa: N802
-            if self.path.rstrip("/") not in ("", "/metrics"):
+            path = self.path.rstrip("/")
+            if path == "/healthz":
+                unhealthy = []
+                if collector is not None:
+                    unhealthy = sorted(
+                        c.name for c in collector.graph.all_components()
+                        if not c.healthy())
+                body = _json.dumps(
+                    {"status": "ok" if not unhealthy else "unhealthy",
+                     "unhealthy_components": unhealthy}).encode()
+                self.send_response(200 if not unhealthy else 503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path not in ("", "/metrics"):
                 self.send_response(404)
                 self.end_headers()
                 return

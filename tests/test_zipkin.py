@@ -1,0 +1,185 @@
+"""Zipkin v2 intake (components/receivers/zipkin.py — the upstream
+zipkinreceiver of the distro, collector/builder-config.yaml) and the VM
+collector's /healthz (healthcheckextension role)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from odigos_tpu.components.receivers.zipkin import (
+    ZipkinReceiver, translate_spans)
+from odigos_tpu.pdata.spans import SpanKind, StatusCode
+
+ZIPKIN_DOC = [
+    {"traceId": "0af7651916cd43dd8448eb211c80319c", "id": "b7ad6b7169203331",
+     "name": "get /cart", "timestamp": 1_700_000_000_000_000,
+     "duration": 25_000, "kind": "SERVER",
+     "localEndpoint": {"serviceName": "cart"},
+     "tags": {"http.method": "GET", "http.path": "/cart"}},
+    {"traceId": "0af7651916cd43dd8448eb211c80319c", "id": "c8be6c8270314442",
+     "parentId": "b7ad6b7169203331", "name": "hgetall",
+     "timestamp": 1_700_000_000_005_000, "duration": 3_000,
+     "kind": "CLIENT", "localEndpoint": {"serviceName": "redis"},
+     "tags": {"error": "timeout"}},
+]
+
+
+class TestTranslate:
+    def test_ids_times_kinds_services(self):
+        batch = translate_spans(ZIPKIN_DOC)
+        assert len(batch) == 2
+        assert set(batch.service_names()) == {"cart", "redis"}
+        assert int(batch.col("trace_id_lo")[0]) == \
+            int("8448eb211c80319c", 16)
+        assert int(batch.col("parent_span_id")[1]) == \
+            int("b7ad6b7169203331", 16)
+        assert int(batch.col("start_unix_nano")[0]) == \
+            1_700_000_000_000_000_000
+        assert int(batch.col("end_unix_nano")[0] -
+                   batch.col("start_unix_nano")[0]) == 25_000_000
+        assert int(batch.col("kind")[0]) == SpanKind.SERVER
+        assert int(batch.col("kind")[1]) == SpanKind.CLIENT
+        # tags.error -> ERROR status (zipkin convention)
+        assert int(batch.col("status_code")[1]) == StatusCode.ERROR
+        assert batch.span_attrs[0]["http.path"] == "/cart"
+
+    def test_malformed_entries_degrade(self):
+        batch = translate_spans([{"name": "orphan"}])
+        assert len(batch) == 1  # ids default to 0, service unknown
+        assert batch.service_names() == ["unknown"]
+
+
+class _Sink:
+    def __init__(self):
+        self.batches = []
+
+    def consume(self, batch):
+        self.batches.append(batch)
+
+
+@pytest.fixture
+def receiver():
+    r = ZipkinReceiver("zipkin", {"port": 0})
+    sink = _Sink()
+    r.set_consumer(sink)
+    r.start()
+    yield r, sink
+    r.shutdown()
+
+
+def _post(port, payload, path="/api/v2/spans"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode() if not isinstance(payload, bytes)
+        else payload,
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=10)
+
+
+class TestHttpIntake:
+    def test_post_spans_202_and_batch_flows(self, receiver):
+        r, sink = receiver
+        with _post(r.port, ZIPKIN_DOC) as resp:
+            assert resp.status == 202
+        assert len(sink.batches) == 1 and len(sink.batches[0]) == 2
+
+    def test_bad_json_is_400(self, receiver):
+        r, sink = receiver
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(r.port, b"{not json")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(r.port, {"not": "a list"})
+        assert e.value.code == 400
+        assert not sink.batches
+
+    def test_wrong_path_is_404(self, receiver):
+        r, _ = receiver
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(r.port, [], path="/api/v1/spans")
+        assert e.value.code == 404
+
+    def test_downstream_refusal_is_503(self, receiver):
+        r, sink = receiver
+
+        class Refuses:
+            def consume(self, batch):
+                raise RuntimeError("memory limiter")
+
+        r.set_consumer(Refuses())
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(r.port, ZIPKIN_DOC)
+        assert e.value.code == 503
+
+    def test_in_collector_pipeline(self):
+        from odigos_tpu.pipeline.service import Collector
+
+        c = Collector({
+            "receivers": {"zipkin": {}},
+            "processors": {"batch": {"timeout_s": 0.05}},
+            "exporters": {"tracedb": {}},
+            "service": {"pipelines": {"traces": {
+                "receivers": ["zipkin"], "processors": ["batch"],
+                "exporters": ["tracedb"]}}},
+        }).start()
+        try:
+            port = c.graph.receivers["zipkin"].port
+            with _post(port, ZIPKIN_DOC) as resp:
+                assert resp.status == 202
+            db = c.graph.exporters["tracedb"]
+            assert db.wait_for_spans(2, timeout=15)
+        finally:
+            c.shutdown()
+
+
+def test_vm_collector_healthz(tmp_path):
+    """/healthz on the VM collector's local endpoint reports component
+    health (healthcheckextension role)."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = {"receivers": {"synthetic": {"traces_per_batch": 1,
+                                       "n_batches": 1}},
+           "exporters": {"debug": {}},
+           "service": {"pipelines": {"traces": {
+               "receivers": ["synthetic"], "exporters": ["debug"]}}}}
+    cfg_path = tmp_path / "c.json"
+    cfg_path.write_text(json.dumps(cfg))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "odigos_tpu.pipeline", "--config",
+         str(cfg_path), "--metrics-port", str(port)],
+        env=dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu"),
+        cwd=repo, stdout=subprocess.PIPE, text=True)
+    try:
+        assert "collector up" in proc.stdout.readline()
+        deadline = time.time() + 30
+        doc = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=5) as resp:
+                    assert resp.status == 200
+                    doc = json.loads(resp.read())
+                    break
+            except OSError:
+                time.sleep(0.2)
+        assert doc == {"status": "ok", "unhealthy_components": []}
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
