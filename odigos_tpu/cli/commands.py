@@ -309,7 +309,7 @@ def cmd_destinations(args) -> int:
         return 0
     if args.action == "add":
         try:
-            get_spec(args.type)
+            spec = get_spec(args.type)
         except KeyError:
             return _err(f"unknown destination type {args.type!r} "
                         "(see `destinations types`)")
@@ -329,19 +329,34 @@ def cmd_destinations(args) -> int:
         problems = validate_destination(dest)
         if problems:
             return _err("; ".join(problems))
+        # secret fields never enter state.json (it travels in diagnose
+        # bundles); they land in the 0600 secrets file + collector env —
+        # the Secret analog, matching the UI wizard path
+        secret_names = [f.name for f in spec.fields
+                        if f.secret and f.name in config]
+        state.set_secrets({n: config.pop(n) for n in secret_names})
         state.store.apply(DestinationResource(
             meta=ObjectMeta(name=args.name, namespace=ODIGOS_NAMESPACE),
             dest_type=args.type,
             signals=[s.value for s in dest.signals],
             config=config,
+            secret_ref=(f"odigos-{args.name}-secret"
+                        if secret_names else ""),
             data_stream_names=list(dest.data_stream_names)))
         state.reconcile()
         state.save()
         print(f"destination {args.name} ({args.type}) applied")
         return 0
     if args.action == "remove":
-        if state.store.delete("DestinationResource", ODIGOS_NAMESPACE,
-                              args.name):
+        existing = state.store.get("DestinationResource", ODIGOS_NAMESPACE,
+                                   args.name)
+        if existing is not None and state.store.delete(
+                "DestinationResource", ODIGOS_NAMESPACE, args.name):
+            if existing.secret_ref:
+                spec = SPECS.get(existing.dest_type)
+                state.drop_secrets([f.name for f in
+                                    (spec.fields if spec else ())
+                                    if f.secret])
             state.reconcile()
             state.save()
             print("destination removed")

@@ -23,6 +23,10 @@ from ..utils.serde import from_jsonable, to_jsonable
 # tampered state file, and fragile across code changes)
 STATE_VERSION = 2
 STATE_FILE = "state.json"
+# destination secrets live OUTSIDE state.json, mode 0600 — the k8s Secret
+# analog (destination_types.go SecretRef); state.json stays shareable in
+# diagnose bundles without leaking credentials
+SECRETS_FILE = "secrets.json"
 
 
 def default_state_dir() -> str:
@@ -47,12 +51,29 @@ class CliState:
     # tier validated at install time (odigosauth); profile-add trusts THIS,
     # never a command-line flag
     tier: str = "community"
+    # env-name -> value, persisted to SECRETS_FILE (0600) and delivered
+    # into the collector environment on load
+    secrets: dict[str, str] = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.secrets is None:
+            self.secrets = {}
 
     def reconcile(self, rounds: int = 3) -> None:
         for _ in range(rounds):
             self.manager.run_once()
             for od in self.odiglets:
                 od.poll()
+
+    def set_secrets(self, values: dict[str, str]) -> None:
+        """Store + deliver secrets (the Secret-mounted-as-env role)."""
+        self.secrets.update(values)
+        os.environ.update(values)
+
+    def drop_secrets(self, names: list[str]) -> None:
+        for name in names:
+            self.secrets.pop(name, None)
+            os.environ.pop(name, None)
 
     def save(self) -> None:
         resources = {
@@ -71,6 +92,15 @@ class CliState:
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=1)
         os.replace(tmp, os.path.join(self.path, STATE_FILE))
+        spath = os.path.join(self.path, SECRETS_FILE)
+        if self.secrets:
+            stmp = spath + ".tmp"
+            fd = os.open(stmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                json.dump(self.secrets, f)
+            os.replace(stmp, spath)
+        elif os.path.exists(spath):
+            os.unlink(spath)
 
 
 def state_exists(path: Optional[str] = None) -> bool:
@@ -130,6 +160,10 @@ def load_state(path: Optional[str] = None) -> CliState:
     config = Configuration.from_dict(payload["config"])
     state = _boot(path, store, cluster, config,
                   tier=payload.get("tier", "community"))
+    spath = os.path.join(path, SECRETS_FILE)
+    if os.path.exists(spath):
+        with open(spath) as f:
+            state.set_secrets(json.load(f))
     # resync: controllers resume from stored state (level-triggered)
     for kind in list(store._objects):
         state.manager.enqueue_all(kind)

@@ -97,3 +97,57 @@ def test_serde_rejects_bool_for_numeric_fields():
         from_jsonable(int, True)
     with _pytest.raises(TypeError, match="bool"):
         from_jsonable(float, False)
+
+
+def test_destination_secrets_never_enter_state_json(tmp_path):
+    """CLI destination secrets persist to the 0600 secrets file (the k8s
+    Secret analog), not state.json (which travels in diagnose bundles);
+    load re-delivers them to the collector env; remove revokes them."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sd = str(tmp_path / "state")
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("DATADOG_API_KEY", None)
+
+    def run(*a, expect=0):
+        r = subprocess.run(
+            [sys.executable, "-m", "odigos_tpu.cli", "--state-dir", sd, *a],
+            env=env, capture_output=True, text=True, cwd=repo, timeout=120)
+        assert r.returncode == expect, r.stderr + r.stdout
+        return r.stdout
+
+    run("install")
+    run("destinations", "add", "--name", "dd", "--type", "datadog",
+        "--signal", "traces",
+        "--set", "DATADOG_SITE=datadoghq.com",
+        "--set", "DATADOG_API_KEY=sup3rsecret")
+    state_json = (tmp_path / "state" / "state.json").read_text()
+    assert "sup3rsecret" not in state_json, "secret leaked into state.json"
+    secrets_path = tmp_path / "state" / "secrets.json"
+    assert secrets_path.exists()
+    assert oct(secrets_path.stat().st_mode & 0o777) == "0o600"
+    assert "sup3rsecret" in secrets_path.read_text()
+
+    # load in a fresh process: the secret is delivered to the env (the
+    # Secret-mounted-as-env role) — observable via the generated config
+    # still validating + a probe command
+    probe = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import os, sys\n"
+        f"sys.argv = ['x', '--state-dir', {sd!r}, 'status']\n"
+        "from odigos_tpu.cli.commands import build_parser\n"
+        "a = build_parser().parse_args(sys.argv[1:])\n"
+        "a.fn(a)\n"
+        "assert os.environ.get('DATADOG_API_KEY') == 'sup3rsecret'\n"
+        "print('delivered')\n")
+    r = subprocess.run([sys.executable, "-c", probe], env=env,
+                       capture_output=True, text=True, cwd=repo,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "delivered" in r.stdout
+
+    run("destinations", "remove", "--name", "dd")
+    assert not secrets_path.exists(), "secrets not revoked on remove"
