@@ -176,6 +176,22 @@ class WireExporter(Exporter):
 # ------------------------------------------------------------ loadbalancing
 
 
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized: spreads arbitrary key values
+    uniformly over the u64 ring space. Trace ids are NOT uniform (agents
+    and the synthesizer hand out small/sequential ids) — placing raw ids
+    on an md5-pointed ring sends every trace to the owner of the lowest
+    vnode (measured: 100% hot-spotting on one replica)."""
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
 def _ring_points(endpoints: list[str], vnodes: int = 64) -> tuple[np.ndarray, list[str]]:
     """Consistent-hash ring: vnodes points per endpoint, sorted."""
     points = []
@@ -289,8 +305,9 @@ class LoadBalancingExporter(Exporter):
         if not endpoints:
             meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
             return
-        # vectorized ring lookup on the trace id: same trace -> same replica
-        keys = batch.col("trace_id_lo")
+        # vectorized ring lookup on the HASHED trace id: same trace ->
+        # same replica, uniform spread regardless of id distribution
+        keys = _mix64(batch.col("trace_id_lo"))
         idx = np.searchsorted(points, keys, side="right") % len(ep_of_point)
         span_ep = ep_of_point[idx]  # vnode -> endpoint, one frame per replica
         for i, ep in enumerate(endpoints):

@@ -89,6 +89,70 @@ def test_node_metrics_reach_gateway_destination(full_stack):
     assert mock.accepted_spans > 0, "no metrics reached the gateway"
 
 
+def test_scaleout_routes_whole_traces_per_replica(full_stack):
+    """Two gateway replicas: the node collector's consistent-hash
+    loadbalancer must keep every trace intact on ONE replica (whole-trace
+    operations — tail sampling, trace-tree models — depend on it;
+    traces.go:26 routing_key traceID) while both replicas take traffic."""
+    import numpy as np
+
+    from odigos_tpu.pipeline.service import Collector
+    from odigos_tpu.wire.hotreload import watch_configmap
+    from odigos_tpu.wire.servicemap import register_service
+    from odigos_tpu.controlplane.autoscaler import GATEWAY_CONFIG_NAME
+    from odigos_tpu.controlplane.scheduler import ODIGOS_NAMESPACE
+
+    env = full_stack
+    # second replica from the same generated ConfigMap
+    cm = env.store.get("ConfigMap", ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)
+    replica2 = Collector(cm.data["collector-conf"]).start()
+    unsub = watch_configmap(env.store, ODIGOS_NAMESPACE,
+                            GATEWAY_CONFIG_NAME, replica2,
+                            extract=lambda d: d["collector-conf"])
+    try:
+        def port_of(collector):
+            for rid, recv in collector.graph.receivers.items():
+                if rid.split("/")[0] == "otlp" and hasattr(recv, "port"):
+                    return recv.port
+            raise AssertionError("no wire front door")
+
+        register_service("odigos-gateway.odigos-system", [
+            f"127.0.0.1:{env.gateway_otlp_port()}",
+            f"127.0.0.1:{port_of(replica2)}"])
+
+        port = env.node_otlp_port("node-0")
+        exp = WireExporter("otlpwire/scale",
+                           {"endpoint": f"127.0.0.1:{port}"})
+        exp.start()
+        try:
+            batch = synthesize_traces(120, seed=21)
+            exp.export(batch)
+            assert exp.flush(timeout=15)
+        finally:
+            exp.shutdown()
+
+        db1 = env.gateway_component("tracedb/tracedb-db")
+        db2 = replica2.component("tracedb/tracedb-db")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if db1.span_count + db2.span_count >= len(batch):
+                break
+            time.sleep(0.1)
+        assert db1.span_count + db2.span_count == len(batch), \
+            f"{db1.span_count}+{db2.span_count} != {len(batch)}"
+        assert db1.span_count and db2.span_count, \
+            "one replica took all traffic — ring not spreading"
+        # whole traces: no trace id appears on both replicas
+        t1 = set(np.unique(db1.all_spans().col("trace_id_lo")).tolist())
+        t2 = set(np.unique(db2.all_spans().col("trace_id_lo")).tolist())
+        assert not (t1 & t2), f"split traces: {sorted(t1 & t2)[:5]}"
+    finally:
+        unsub()
+        replica2.shutdown()
+        # restore the single-replica registration for other tests
+        env._refresh_gateway_service()
+
+
 def test_gateway_restart_reresolves_service(full_stack):
     """The k8s-resolver seam: after a gateway hot-reload moves the wire
     listener, reconcile refreshes the service registration and node

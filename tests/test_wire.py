@@ -223,6 +223,14 @@ class TestLoadBalancing:
                     for t in np.unique(b.col("trace_id_lo")):
                         assert trace_to_replica.setdefault(int(t), i) == i
             assert len(trace_to_replica) == 100
+            # ...and spread across replicas even with small sequential
+            # trace ids (the hot-spotting bug: raw ids on an md5 ring all
+            # landed below the first vnode -> one replica took 100%)
+            per_replica = np.bincount(
+                np.asarray(list(trace_to_replica.values())),
+                minlength=len(sinks))
+            assert (per_replica > 0).all(), \
+                f"replica(s) starved: {per_replica.tolist()}"
             # routing is deterministic: a second export lands identically
             sent_before = [sum(len(b) for b in s.batches) for s in sinks]
             lb.export(batch)
