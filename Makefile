@@ -1,7 +1,7 @@
 # Developer entrypoints (reference: Makefile at the repo root).
 # No install step: the package runs from the repo root.
 
-.PHONY: test test-fast bench dryrun ui preflight tpu-snapshot tpu-snapshot-watch
+.PHONY: test test-fast bench dryrun ui preflight tpu-snapshot tpu-snapshot-watch soak quant-geometry ablation
 
 test:            ## full suite on the 8-device virtual CPU mesh (~7 min)
 	python -m pytest tests/ -x -q
@@ -18,6 +18,15 @@ tpu-snapshot:    ## one-shot TPU bench capture (exit 3 if tunnel down)
 
 tpu-snapshot-watch: ## keep probing; write BENCH_tpu_snapshot.json when up
 	python tools/tpu_snapshot.py
+
+soak:            ## e2e wire-path throughput soak (CPU; writes SOAK.json)
+	python tools/e2e_soak.py --seconds 30 --senders 2
+
+quant-geometry:  ## int8-vs-bf16 sweep on TPU (writes QUANT_GEOMETRY.json)
+	python tools/quant_geometry.py
+
+ablation:        ## per-encoder-block timing on TPU (LAYER_ABLATION.json)
+	python tools/layer_ablation.py
 
 dryrun:          ## multi-chip sharding compile+execute on 8 virtual devices
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

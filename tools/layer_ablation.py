@@ -1,0 +1,81 @@
+"""Per-stage ablation of the flagship scoring path on the real device.
+
+Times the packed forward at n_layers = 0..4 on the SAME parameter tree
+(flax apply ignores params the truncated module never references), at
+the bench geometry, with the forced-execution methodology (rotated
+inputs, scalar accumulation, one fetch — block_until_ready does not
+synchronize through the axon tunnel). n_layers=0 is the embed+mask+heads
+trunk; successive deltas are true per-encoder-block costs.
+
+Output: one JSON line + LAYER_ABLATION.json. This is the evidence base
+for kernel work — optimize what measures slow, not what looks slow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ROWS, MAX_LEN, N_LAYERS = 3072, 64, 4  # bench.py flagship geometry
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from odigos_tpu.features import featurize, pack_sequences
+    from odigos_tpu.models import TraceTransformer, TransformerConfig
+    from odigos_tpu.pdata import synthesize_traces
+
+    dev = jax.devices()[0]
+    full_model = TraceTransformer(TransformerConfig(
+        dtype=jnp.bfloat16, max_len=MAX_LEN, n_layers=N_LAYERS))
+    variables = full_model.init(jax.random.PRNGKey(0))
+
+    packs = []
+    for s in range(4):
+        b = synthesize_traces(16384, seed=7 + s)
+        p = pack_sequences(b, featurize(b), max_len=MAX_LEN,
+                           pad_rows_to=ROWS)
+        packs.append(tuple(jnp.asarray(a) for a in (
+            p.categorical, p.continuous, p.segments, p.positions)))
+    n_spans = int(np.asarray(packs[0][2] > 0).sum())
+
+    def timeit(fn, n=20):
+        np.asarray(fn(*packs[0]).astype(jnp.float32).sum())  # compile+sync
+        t0 = time.perf_counter()
+        acc = None
+        for i in range(n):
+            s = fn(*packs[i % len(packs)]).astype(jnp.float32).sum()
+            acc = s if acc is None else acc + s
+        float(acc)
+        return (time.perf_counter() - t0) / n * 1e3  # ms
+
+    out = {"platform": dev.platform, "device": str(dev),
+           "rows": ROWS, "max_len": MAX_LEN, "n_spans": n_spans,
+           "stages_ms": {}, "per_block_ms": {}}
+    prev = None
+    for k in range(N_LAYERS + 1):
+        model_k = TraceTransformer(TransformerConfig(
+            dtype=jnp.bfloat16, max_len=MAX_LEN, n_layers=k))
+        ms = timeit(lambda *a, m=model_k: m.score_packed(variables, *a))
+        out["stages_ms"][f"n_layers={k}"] = round(ms, 3)
+        if prev is not None:
+            out["per_block_ms"][f"block_{k - 1}"] = round(ms - prev, 3)
+        prev = ms
+        print(f"n_layers={k}: {ms:.3f} ms", file=sys.stderr, flush=True)
+    full_ms = out["stages_ms"][f"n_layers={N_LAYERS}"]
+    out["spans_per_sec"] = round(n_spans / (full_ms / 1e3))
+    with open(os.path.join(REPO, "LAYER_ABLATION.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
