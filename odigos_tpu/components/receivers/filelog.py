@@ -24,6 +24,11 @@ Config:
   poll_interval_s:  scan cadence (default 0.5)
   start_at:         "end" (default; only new lines) | "beginning"
   max_batch_records: records per emitted batch (default 4096)
+  storage_dir:      persist per-file offsets here and resume from them on
+                    restart (the file_storage checkpoint extension the
+                    reference's filelog uses). Without it, a collector
+                    restart with start_at=end silently loses every line
+                    written while the collector was down.
 """
 
 from __future__ import annotations
@@ -101,14 +106,30 @@ def _parse_ts(ts: str) -> int:
     return int(dt.timestamp()) * 10**9 + ns
 
 
+_FP_LEN = 64  # identity fingerprint: first bytes of the file
+
+
+def _fingerprint(path: str, length: int = _FP_LEN) -> str:
+    """Hex of the file's first bytes — rotation detection that survives
+    inode reuse (unlink+create commonly hands back the freed inode, so
+    ino equality alone misreads a rotated file as the old one and resumes
+    mid-line; the stanza filelog uses the same first-bytes fingerprint)."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(length).hex()
+    except OSError:
+        return ""
+
+
 class _Tail:
     """Byte offset + identity + CRI partial-line buffer for one file."""
 
-    __slots__ = ("offset", "ino", "cri_pending")
+    __slots__ = ("offset", "ino", "fp", "cri_pending")
 
-    def __init__(self, offset: int, ino: int):
+    def __init__(self, offset: int, ino: int, fp: str = ""):
         self.offset = offset
         self.ino = ino
+        self.fp = fp  # hex of the first bytes at adoption time
         self.cri_pending = ""  # joined 'P' fragments awaiting their 'F'
 
 
@@ -130,9 +151,63 @@ class FilelogReceiver(Receiver):
         self._first_scan_done = False
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self._offsets_dirty = False
+
+    # --------------------------------------------------- offset checkpoint
+
+    def _storage_path(self) -> str | None:
+        d = str(self.config.get("storage_dir") or "")
+        if d.startswith("${") and d.endswith("}"):
+            # generated configs reference the install's storage root as an
+            # env var (the DaemonSet hostPath / systemd StateDirectory);
+            # unset means no durable storage — checkpointing off
+            d = os.environ.get(d[2:-1], "")
+        if not d:
+            return None
+        safe = self.name.replace("/", "_")
+        return os.path.join(d, f"filelog-offsets-{safe}.json")
+
+    def _load_offsets(self) -> None:
+        path = self._storage_path()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                saved = json.load(f)
+        except (OSError, ValueError):
+            return  # torn checkpoint: degrade to a fresh start
+        for fpath, rec in saved.items():
+            tail = _Tail(int(rec.get("offset", 0)), int(rec.get("ino", 0)),
+                         str(rec.get("fp", "")))
+            tail.cri_pending = str(rec.get("pending", ""))
+            self._tails[fpath] = tail
+        # checkpointed files resume where they left off; files unseen by
+        # the checkpoint appeared while the collector was down — read them
+        # from the start (at-least-once), never from the end
+        self._first_scan_done = True
+
+    def _save_offsets(self) -> None:
+        path = self._storage_path()
+        if path is None or not self._offsets_dirty:
+            return
+        self._offsets_dirty = False
+        doc = {p: {"offset": t.offset, "ino": t.ino, "fp": t.fp,
+                   "pending": t.cri_pending}
+               for p, t in self._tails.items()}
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)  # torn-write-proof, like the blob PUT
+        except OSError:
+            meter.add("odigos_filelog_checkpoint_errors_total"
+                      f"{{receiver={self.name}}}")
+            self._offsets_dirty = True  # retry on the next poll
 
     def start(self) -> None:
         super().start()
+        self._load_offsets()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"filelog-{self.name}")
@@ -143,6 +218,8 @@ class FilelogReceiver(Receiver):
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._offsets_dirty = True  # final checkpoint always lands
+        self._save_offsets()
         super().shutdown()
 
     # ------------------------------------------------------------ tailing
@@ -181,7 +258,10 @@ class FilelogReceiver(Receiver):
             # without committing here the same bytes are re-read and the
             # fragment re-appended every poll, corrupting the joined line
             for tail, new_offset, _pending_before in proposals:
-                tail.offset = new_offset
+                if new_offset != tail.offset:
+                    tail.offset = new_offset
+                    self._offsets_dirty = True
+            self._save_offsets()
             return 0
         batch = builder.build()
         try:
@@ -193,7 +273,10 @@ class FilelogReceiver(Receiver):
                 tail.cri_pending = pending_before  # offsets stay put
             return 0
         for tail, new_offset, _pending_before in proposals:
-            tail.offset = new_offset
+            if new_offset != tail.offset:
+                tail.offset = new_offset
+                self._offsets_dirty = True
+        self._save_offsets()
         meter.add(f"{EMITTED_METRIC}{{receiver={self.name}}}", len(batch))
         return len(batch)
 
@@ -212,10 +295,17 @@ class FilelogReceiver(Receiver):
             at_end = (not self._first_scan_done
                       and self.config.get("start_at", "end") == "end")
             tail = self._tails[path] = _Tail(
-                st.st_size if at_end else 0, st.st_ino)
-        elif tail.ino != st.st_ino or st.st_size < tail.offset:
-            # rotated (new inode) or truncated: start over from 0
+                st.st_size if at_end else 0, st.st_ino,
+                _fingerprint(path))
+            self._offsets_dirty = True
+        elif (tail.ino != st.st_ino or st.st_size < tail.offset
+                or (tail.fp
+                    and _fingerprint(path, len(tail.fp) // 2) != tail.fp)):
+            # rotated (new inode OR changed leading bytes — inode numbers
+            # get reused) or truncated: start over from 0
             tail.offset, tail.ino, tail.cri_pending = 0, st.st_ino, ""
+            tail.fp = _fingerprint(path)
+            self._offsets_dirty = True
         if st.st_size <= tail.offset or len(builder) >= max_records:
             return
         try:

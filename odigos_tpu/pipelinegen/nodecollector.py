@@ -125,6 +125,10 @@ def build_node_collector_config(opts: NodeCollectorOptions) -> GenericMap:
         config["receivers"]["filelog"] = {
             "include": ["/var/log/pods/*/*/*.log"],
             "exclude": ["/var/log/pods/odigos-system_*/**"],
+            # offset checkpointing across collector restarts (the
+            # file_storage extension of the reference's filelog);
+            # resolved from the env, off when unset
+            "storage_dir": "${ODIGOS_STORAGE_DIR}",
         }
         config["processors"]["odigoslogsresourceattrs"] = {}
         config["exporters"].setdefault("otlp/gateway", dict(otlp_exporter))

@@ -649,3 +649,95 @@ class TestFilelogReceiver:
         with pytest.raises(ValueError, match="list"):
             factory.create("filelog/t", {
                 "include": [str(tmp_path / "*.log")], "exclude": "*"})
+
+
+class TestFilelogCheckpoint:
+    """Offset persistence across collector restarts (the file_storage
+    checkpoint extension the reference's filelog rides; without it a
+    restart with start_at=end loses every line written while down)."""
+
+    def _recv(self, tmp_path, storage):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        r = registry.get(ComponentKind.RECEIVER, "filelog").create(
+            "filelog/t", {"include": [str(tmp_path / "*.log")],
+                          "start_at": "end",
+                          "storage_dir": str(storage)})
+        got = []
+
+        class Sink:
+            def consume(self, batch):
+                got.extend(batch.bodies)
+
+        r.set_consumer(Sink())
+        return r, got
+
+    def test_restart_resumes_without_loss_or_dupes(self, tmp_path):
+        storage = tmp_path / "ckpt"
+        log = tmp_path / "app.log"
+        log.write_text("before-start\n")
+
+        r1, got1 = self._recv(tmp_path, storage)
+        r1.start()
+        r1.poll_once()          # adopts the file at its end
+        with log.open("a") as f:
+            f.write("line-1\n")
+        r1.poll_once()
+        assert got1 == ["line-1"]
+        r1.shutdown()           # checkpoint lands
+
+        # lines written while the collector is DOWN
+        with log.open("a") as f:
+            f.write("while-down-1\nwhile-down-2\n")
+
+        r2, got2 = self._recv(tmp_path, storage)
+        r2.start()
+        r2.poll_once()
+        r2.shutdown()
+        assert got2 == ["while-down-1", "while-down-2"], \
+            "restart lost or duplicated lines"
+
+    def test_new_file_during_downtime_reads_from_start(self, tmp_path):
+        storage = tmp_path / "ckpt"
+        r1, _ = self._recv(tmp_path, storage)
+        r1.start()
+        r1.poll_once()
+        r1.shutdown()
+        # a pod that appeared while the collector was down: its early
+        # lines matter (start_at=end must NOT apply across restarts)
+        (tmp_path / "new.log").write_text("early-line\n")
+        r2, got = self._recv(tmp_path, storage)
+        r2.start()
+        r2.poll_once()
+        r2.shutdown()
+        assert got == ["early-line"]
+
+    def test_rotation_across_restart(self, tmp_path):
+        storage = tmp_path / "ckpt"
+        log = tmp_path / "app.log"
+        log.write_text("a\n")
+        r1, got1 = self._recv(tmp_path, storage)
+        r1.start()
+        r1.poll_once()
+        r1.shutdown()
+        # rotated while down: same path, new inode, fresh content
+        log.unlink()
+        log.write_text("fresh-after-rotation\n")
+        r2, got2 = self._recv(tmp_path, storage)
+        r2.start()
+        r2.poll_once()
+        r2.shutdown()
+        assert got2 == ["fresh-after-rotation"]
+
+    def test_torn_checkpoint_degrades(self, tmp_path):
+        storage = tmp_path / "ckpt"
+        storage.mkdir()
+        (storage / "filelog-offsets-filelog_t.json").write_text("{oops")
+        log = tmp_path / "app.log"
+        log.write_text("x\n")
+        r, got = self._recv(tmp_path, storage)
+        r.start()   # must not raise
+        r.poll_once()
+        r.shutdown()
+        # fresh-start semantics (start_at=end on the first scan)
+        assert got == []
