@@ -109,16 +109,18 @@ def _parse_ts(ts: str) -> int:
 _FP_LEN = 64  # identity fingerprint: first bytes of the file
 
 
-def _fingerprint(path: str, length: int = _FP_LEN) -> str:
+def _fingerprint(path: str, length: int = _FP_LEN) -> str | None:
     """Hex of the file's first bytes — rotation detection that survives
     inode reuse (unlink+create commonly hands back the freed inode, so
     ino equality alone misreads a rotated file as the old one and resumes
-    mid-line; the stanza filelog uses the same first-bytes fingerprint)."""
+    mid-line; the stanza filelog uses the same first-bytes fingerprint).
+    None on read failure — an ERROR must not look like a rotation (it
+    would reset the offset and re-ingest the whole file as duplicates)."""
     try:
         with open(path, "rb") as f:
             return f.read(length).hex()
     except OSError:
-        return ""
+        return None
 
 
 class _Tail:
@@ -174,13 +176,17 @@ class FilelogReceiver(Receiver):
         try:
             with open(path) as f:
                 saved = json.load(f)
-        except (OSError, ValueError):
-            return  # torn checkpoint: degrade to a fresh start
-        for fpath, rec in saved.items():
-            tail = _Tail(int(rec.get("offset", 0)), int(rec.get("ino", 0)),
-                         str(rec.get("fp", "")))
-            tail.cri_pending = str(rec.get("pending", ""))
-            self._tails[fpath] = tail
+            for fpath, rec in saved.items():
+                tail = _Tail(int(rec.get("offset", 0)),
+                             int(rec.get("ino", 0)),
+                             str(rec.get("fp", "")))
+                tail.cri_pending = str(rec.get("pending", ""))
+                self._tails[str(fpath)] = tail
+        except (OSError, ValueError, TypeError, AttributeError):
+            # torn/foreign-shaped checkpoint: degrade to a fresh start —
+            # a bad state file must never prevent the pipeline booting
+            self._tails.clear()
+            return
         # checkpointed files resume where they left off; files unseen by
         # the checkpoint appeared while the collector was down — read them
         # from the start (at-least-once), never from the end
@@ -193,7 +199,7 @@ class FilelogReceiver(Receiver):
         self._offsets_dirty = False
         doc = {p: {"offset": t.offset, "ino": t.ino, "fp": t.fp,
                    "pending": t.cri_pending}
-               for p, t in self._tails.items()}
+               for p, t in list(self._tails.items())}
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             tmp = path + ".tmp"
@@ -296,16 +302,27 @@ class FilelogReceiver(Receiver):
                       and self.config.get("start_at", "end") == "end")
             tail = self._tails[path] = _Tail(
                 st.st_size if at_end else 0, st.st_ino,
-                _fingerprint(path))
+                _fingerprint(path) or "")
             self._offsets_dirty = True
-        elif (tail.ino != st.st_ino or st.st_size < tail.offset
-                or (tail.fp
-                    and _fingerprint(path, len(tail.fp) // 2) != tail.fp)):
-            # rotated (new inode OR changed leading bytes — inode numbers
-            # get reused) or truncated: start over from 0
-            tail.offset, tail.ino, tail.cri_pending = 0, st.st_ino, ""
-            tail.fp = _fingerprint(path)
-            self._offsets_dirty = True
+        else:
+            cur_fp = _fingerprint(path)  # None = transient read error
+            rotated = (tail.ino != st.st_ino
+                       or st.st_size < tail.offset
+                       or (cur_fp is not None and tail.fp
+                           and not cur_fp.startswith(tail.fp)))
+            if rotated:
+                # new inode OR changed leading bytes (inode numbers get
+                # reused) or truncated: start over from 0
+                tail.offset, tail.ino, tail.cri_pending = 0, st.st_ino, ""
+                tail.fp = cur_fp or ""
+                self._offsets_dirty = True
+            elif (cur_fp is not None
+                    and len(cur_fp) > len(tail.fp)):
+                # adopted short/empty (file predated its first write):
+                # extend the fingerprint as the file grows so rotation
+                # detection actually engages
+                tail.fp = cur_fp
+                self._offsets_dirty = True
         if st.st_size <= tail.offset or len(builder) >= max_records:
             return
         try:

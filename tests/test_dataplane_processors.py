@@ -662,6 +662,9 @@ class TestFilelogCheckpoint:
         r = registry.get(ComponentKind.RECEIVER, "filelog").create(
             "filelog/t", {"include": [str(tmp_path / "*.log")],
                           "start_at": "end",
+                          # the tests drive poll_once() themselves; a live
+                          # 0.5s poll thread would race them on _tails
+                          "poll_interval_s": 3600,
                           "storage_dir": str(storage)})
         got = []
 
@@ -741,3 +744,36 @@ class TestFilelogCheckpoint:
         r.shutdown()
         # fresh-start semantics (start_at=end on the first scan)
         assert got == []
+
+    def test_empty_adoption_then_inode_reuse_rotation(self, tmp_path):
+        """A file adopted at 0 bytes has no fingerprint yet; it must be
+        extended as the file grows so inode-reuse rotation is still
+        caught later (review finding: one-shot fp capture disabled the
+        check for exactly the empty-adoption case)."""
+        import os
+
+        storage = tmp_path / "ckpt"
+        log = tmp_path / "app.log"
+        log.write_text("")  # adopted empty
+        r1, got1 = self._recv(tmp_path, storage)
+        r1.start()
+        r1.poll_once()
+        with log.open("a") as f:
+            f.write("first-generation-line\n")
+        r1.poll_once()      # fp extends now that bytes exist
+        assert got1 == ["first-generation-line"]
+        r1.shutdown()
+
+        # rotate while down; force the inode-reuse hazard by recreating
+        # immediately (tmpfs hands back the freed inode)
+        old_ino = os.stat(log).st_ino
+        log.unlink()
+        log.write_text("second-generation longer than before\n")
+        r2, got2 = self._recv(tmp_path, storage)
+        r2.start()
+        r2.poll_once()
+        r2.shutdown()
+        # regardless of whether the inode was actually reused, the
+        # fingerprint mismatch must reset the tail to the file start
+        assert got2 == ["second-generation longer than before"], \
+            f"ino reuse={os.stat(log).st_ino == old_ino}, got {got2}"
