@@ -477,6 +477,99 @@ def cmd_diagnose(args) -> int:
     return 0
 
 
+def cmd_actions(args) -> int:
+    """Telemetry-policy actions (api/actions/v1alpha1; compiled into
+    collector processors by the autoscaler)."""
+    import json as _json
+
+    from ..api.resources import Action, ActionKind
+
+    state = _load(args)
+    if args.action == "list":
+        actions = state.store.list("Action")
+        for a in actions:
+            flag = " (disabled)" if a.disabled else ""
+            print(f"{a.meta.name}: {a.action_kind.value}"
+                  f" signals={a.signals or 'all'}{flag}")
+        if not actions:
+            print("(no actions)")
+        return 0
+    if args.action == "add":
+        try:
+            kind = ActionKind(args.kind)
+        except ValueError:
+            return _err(f"unknown action kind {args.kind!r} "
+                        f"(known: {[k.value for k in ActionKind]})")
+        try:
+            details = _json.loads(args.details or "{}")
+        except ValueError as e:
+            return _err(f"--details must be JSON: {e}")
+        state.store.apply(Action(
+            meta=ObjectMeta(name=args.name, namespace=ODIGOS_NAMESPACE),
+            action_kind=kind, signals=list(args.signal or []),
+            details=details))
+        state.reconcile()
+        state.save()
+        print(f"action {args.name} ({kind.value}) applied")
+        return 0
+    if args.action == "remove":
+        if state.store.delete("Action", ODIGOS_NAMESPACE, args.name):
+            state.reconcile()
+            state.save()
+            print("action removed")
+            return 0
+        return _err(f"no action {args.name}")
+    return _err(f"unknown actions action {args.action}")
+
+
+def cmd_rules(args) -> int:
+    """Instrumentation rules (instrumentationrule_type.go; scoped SDK
+    behavior consumed by the instrumentor)."""
+    import json as _json
+
+    from ..api.resources import InstrumentationRule, RuleKind
+
+    state = _load(args)
+    if args.action == "list":
+        rules = state.store.list("InstrumentationRule")
+        for r in rules:
+            flag = " (disabled)" if r.disabled else ""
+            scope = (f" workloads={len(r.workloads)}" if r.workloads
+                     else " all-workloads")
+            print(f"{r.meta.name}: {r.rule_kind.value}{scope}"
+                  f" languages={r.languages or 'all'}{flag}")
+        if not rules:
+            print("(no rules)")
+        return 0
+    if args.action == "add":
+        try:
+            kind = RuleKind(args.kind)
+        except ValueError:
+            return _err(f"unknown rule kind {args.kind!r} "
+                        f"(known: {[k.value for k in RuleKind]})")
+        try:
+            details = _json.loads(args.details or "{}")
+        except ValueError as e:
+            return _err(f"--details must be JSON: {e}")
+        state.store.apply(InstrumentationRule(
+            meta=ObjectMeta(name=args.name, namespace=ODIGOS_NAMESPACE),
+            rule_kind=kind, languages=list(args.language or []),
+            details=details))
+        state.reconcile()
+        state.save()
+        print(f"rule {args.name} ({kind.value}) applied")
+        return 0
+    if args.action == "remove":
+        if state.store.delete("InstrumentationRule", ODIGOS_NAMESPACE,
+                              args.name):
+            state.reconcile()
+            state.save()
+            print("rule removed")
+            return 0
+        return _err(f"no rule {args.name}")
+    return _err(f"unknown rules action {args.action}")
+
+
 # ----------------------------------------------------------- central stack
 
 CENTRAL_NAMESPACE = "central-odigos"
@@ -584,6 +677,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("status", help="installation summary")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("actions", help="manage telemetry-policy actions")
+    p.add_argument("action", choices=["list", "add", "remove"])
+    p.add_argument("--name")
+    p.add_argument("--kind")
+    p.add_argument("--signal", action="append")
+    p.add_argument("--details", help="JSON details object")
+    p.set_defaults(fn=cmd_actions)
+
+    p = sub.add_parser("rules", help="manage instrumentation rules")
+    p.add_argument("action", choices=["list", "add", "remove"])
+    p.add_argument("--name")
+    p.add_argument("--kind")
+    p.add_argument("--language", action="append")
+    p.add_argument("--details", help="JSON details object")
+    p.set_defaults(fn=cmd_rules)
 
     p = sub.add_parser("central",
                        help="manage the enterprise central stack")

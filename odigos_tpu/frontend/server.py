@@ -20,10 +20,14 @@ Endpoints:
     GET  /api/describe/workload?namespace=&kind=&name=
     GET  /api/events                       (SSE stream of store events)
     GET  /api/destination-types            (63-backend registry + schemas)
+    GET  /api/actions                      GET /api/rules
     POST /api/sources                      {namespace,name,kind,...}
     POST /api/destinations                 {name,type,signals,fields}
-    DELETE /api/sources/<ns>/<name>
-    DELETE /api/destinations/<name>
+    POST /api/actions                      {name,kind,signals,details}
+    POST /api/rules                        {name,kind,workloads,languages,
+                                            details}
+    DELETE /api/sources/<ns>/<name>        DELETE /api/actions/<name>
+    DELETE /api/destinations/<name>        DELETE /api/rules/<name>
 """
 
 from __future__ import annotations
@@ -254,6 +258,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/api/destinations":
                 return self._json(_resource_list(
                     store, "DestinationResource"))
+            if path == "/api/actions":
+                return self._json(_resource_list(store, "Action"))
+            if path == "/api/rules":
+                return self._json(_resource_list(
+                    store, "InstrumentationRule"))
             if path == "/api/destination-types":
                 # the setup-wizard catalog: every backend with its field
                 # schema so the UI renders a data-driven form (reference:
@@ -377,7 +386,70 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json({"applied": f"src-{body['name']}"}, 201)
         if path == "/api/destinations":
             return self._create_destination(body)
+        if path == "/api/actions":
+            return self._create_action(body)
+        if path == "/api/rules":
+            return self._create_rule(body)
         return self._error("not found", 404)
+
+    def _create_action(self, body: dict) -> None:
+        """Action policies (the reference UI's actions page,
+        cypress/e2e/05; compiled into processors by the autoscaler)."""
+        from ..api.resources import Action, ActionKind
+
+        fe = self.frontend
+        missing = [k for k in ("name", "kind") if not body.get(k)]
+        if missing:
+            return self._error(f"missing fields: {missing}")
+        try:
+            kind = ActionKind(body["kind"])
+        except ValueError:
+            return self._error(
+                f"unknown action kind {body['kind']!r} "
+                f"(known: {[k.value for k in ActionKind]})")
+        fe.store.apply(Action(
+            meta=ObjectMeta(name=str(body["name"]),
+                            namespace=ODIGOS_NAMESPACE),
+            action_kind=kind,
+            signals=[str(s) for s in body.get("signals", [])],
+            disabled=bool(body.get("disabled", False)),
+            details=dict(body.get("details") or {})))
+        return self._json({"applied": body["name"]}, 201)
+
+    def _create_rule(self, body: dict) -> None:
+        """Instrumentation rules (the reference UI's rules page,
+        cypress/e2e/06-rules.cy.ts; consumed by the instrumentor)."""
+        from ..api.resources import (
+            InstrumentationRule, RuleKind, WorkloadKind, WorkloadRef)
+
+        fe = self.frontend
+        missing = [k for k in ("name", "kind") if not body.get(k)]
+        if missing:
+            return self._error(f"missing fields: {missing}")
+        try:
+            kind = RuleKind(body["kind"])
+        except ValueError:
+            return self._error(
+                f"unknown rule kind {body['kind']!r} "
+                f"(known: {[k.value for k in RuleKind]})")
+        workloads = []
+        for w in body.get("workloads", []):
+            try:
+                workloads.append(WorkloadRef(
+                    str(w["namespace"]),
+                    WorkloadKind.parse(w.get("kind", "deployment")),
+                    str(w["name"])))
+            except (KeyError, ValueError) as e:
+                return self._error(f"bad workload selector {w}: {e}")
+        fe.store.apply(InstrumentationRule(
+            meta=ObjectMeta(name=str(body["name"]),
+                            namespace=ODIGOS_NAMESPACE),
+            rule_kind=kind,
+            disabled=bool(body.get("disabled", False)),
+            workloads=workloads,
+            languages=[str(x) for x in body.get("languages", [])],
+            details=dict(body.get("details") or {})))
+        return self._json({"applied": body["name"]}, 201)
 
     def _create_destination(self, body: dict) -> None:
         """The setup-wizard submit: schema-validate + configer dry-run,
@@ -446,6 +518,17 @@ class _Handler(BaseHTTPRequestHandler):
             if fe.store.delete("Source", ns, name):
                 return self._json({"deleted": name})
             return self._error(f"no source {ns}/{name}", 404)
+        if len(parts) == 4 and parts[1] == "api" and parts[2] == "actions":
+            name = unquote(parts[3])
+            if fe.store.delete("Action", ODIGOS_NAMESPACE, name):
+                return self._json({"deleted": name})
+            return self._error(f"no action {name}", 404)
+        if len(parts) == 4 and parts[1] == "api" and parts[2] == "rules":
+            name = unquote(parts[3])
+            if fe.store.delete("InstrumentationRule", ODIGOS_NAMESPACE,
+                               name):
+                return self._json({"deleted": name})
+            return self._error(f"no rule {name}", 404)
         if (len(parts) == 4 and parts[1] == "api"
                 and parts[2] == "destinations"):
             name = unquote(parts[3])

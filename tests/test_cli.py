@@ -162,3 +162,28 @@ def test_upgrade_state_version_mismatch_is_actionable(run, tmp_path, capsys):
     run("install")
     (tmp_path / "state" / "state.json").write_text('{"version": 1}')
     run("upgrade", expect=1)
+
+
+def test_actions_and_rules_lifecycle(run):
+    """actions/rules CLI (reference UI pages cypress/e2e/05+06; CRDs
+    api/actions/v1alpha1 + instrumentationrules) — create, observe the
+    compiled effect, remove."""
+    run("install")
+    assert "(no actions)" in run("actions", "list")
+    run("actions", "add", "--name", "x", "--kind", "Nope", expect=1)
+    run("actions", "add", "--name", "errs", "--kind", "ErrorSampler",
+        "--signal", "traces", "--details", '{"fallback_sampling_ratio": 10}')
+    out = run("actions", "list")
+    assert "errs: ErrorSampler" in out
+    run("actions", "remove", "--name", "errs")
+    assert "(no actions)" in run("actions", "list")
+    run("actions", "remove", "--name", "errs", expect=1)
+
+    assert "(no rules)" in run("rules", "list")
+    run("rules", "add", "--name", "r1", "--kind", "payload-collection",
+        "--language", "python", "--details", '{"max_payload_len": 512}')
+    out = run("rules", "list")
+    assert "r1: payload-collection" in out and "python" in out
+    run("rules", "add", "--name", "bad", "--kind", "wat", expect=1)
+    run("rules", "remove", "--name", "r1")
+    assert "(no rules)" in run("rules", "list")

@@ -346,6 +346,63 @@ def test_destination_create_validation_errors(populated):
     assert exc.value.code == 400
 
 
+def test_actions_and_rules_api(populated):
+    """Actions/rules management over the JSON API (the reference UI's
+    actions + rules pages, cypress/e2e/05+06): create an action and see
+    its compiled processor appear in the gateway pipeline."""
+    env, fe = populated
+
+    body = json.dumps({"name": "errs", "kind": "ErrorSampler",
+                       "signals": ["traces"],
+                       "details": {"fallback_sampling_ratio": 10}}).encode()
+    req = urllib.request.Request(
+        f"{fe.url}/api/actions", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+    env.reconcile()
+    actions = get_json(f"{fe.url}/api/actions")
+    assert any(a["meta"]["name"] == "errs" for a in actions)
+    # the autoscaler compiled it into a sampling processor in the gateway
+    topo = get_json(f"{fe.url}/api/pipeline")
+    assert any("odigossampling" in n["id"] for n in topo["nodes"]), \
+        [n["id"] for n in topo["nodes"]]
+
+    # unknown kind -> 400
+    bad = json.dumps({"name": "x", "kind": "Nope"}).encode()
+    req = urllib.request.Request(
+        f"{fe.url}/api/actions", data=bad,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+
+    req = urllib.request.Request(f"{fe.url}/api/actions/errs",
+                                 method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    env.reconcile()
+    assert not get_json(f"{fe.url}/api/actions")
+
+    # rules round trip with a workload selector
+    body = json.dumps({"name": "pc", "kind": "payload-collection",
+                       "workloads": [{"namespace": "shop",
+                                      "name": "cart"}],
+                       "languages": ["python"],
+                       "details": {"max_payload_len": 256}}).encode()
+    req = urllib.request.Request(
+        f"{fe.url}/api/rules", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+    rules = get_json(f"{fe.url}/api/rules")
+    assert rules[0]["workloads"][0]["name"] == "cart"
+    req = urllib.request.Request(f"{fe.url}/api/rules/pc",
+                                 method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+
+
 def test_post_source_body_matches_server_expectation(populated):
     """The add-source form posts {namespace, name, kind} — assert the
     server accepts exactly that body (cypress/e2e/03-sources.cy.ts role)."""
