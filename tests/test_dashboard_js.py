@@ -89,6 +89,11 @@ CONTRACT: dict[str, dict] = {
           "fields": ["type", "display_name", "signals", "fields"]},
     "f": {"endpoint": "/api/destination-types", "each": True,
           "at": ["fields", "*"], "fields": ["name", "secret"]},
+    # policies section (the reference UI's actions + rules pages)
+    "ac": {"endpoint": "/api/actions", "each": True,
+           "fields": ["meta", "action_kind", "signals", "disabled"]},
+    "ru": {"endpoint": "/api/rules", "each": True,
+           "fields": ["meta", "rule_kind", "languages", "disabled"]},
     # SSE store-event JSON (validated in test_sse_event_shape)
     "e": {"endpoint": "/api/events",
           "fields": ["type", "kind", "namespace", "name"]},
@@ -172,6 +177,18 @@ def populated():
         env.instrument_workload("shop", "cart")
         env.add_destination(Destination(
             id="db", dest_type="tracedb", signals=[Signal.TRACES]))
+        from odigos_tpu.api.resources import (
+            Action, ActionKind, InstrumentationRule, ObjectMeta, RuleKind)
+        from odigos_tpu.controlplane.scheduler import ODIGOS_NAMESPACE
+
+        env.store.apply(Action(
+            meta=ObjectMeta(name="errs", namespace=ODIGOS_NAMESPACE),
+            action_kind=ActionKind.ERROR_SAMPLER, signals=["traces"],
+            details={"fallback_sampling_ratio": 10}))
+        env.store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="pc0", namespace=ODIGOS_NAMESPACE),
+            rule_kind=RuleKind.PAYLOAD_COLLECTION, languages=["python"]))
+        env.reconcile()
         env.send_traces(synthesize_traces(80, seed=3))
         env.gateway_component("prometheus/self-metrics").scrape_once()
         assert env.gateway_component("otlp/ui").flush(timeout=10)
@@ -352,7 +369,7 @@ def test_actions_and_rules_api(populated):
     its compiled processor appear in the gateway pipeline."""
     env, fe = populated
 
-    body = json.dumps({"name": "errs", "kind": "ErrorSampler",
+    body = json.dumps({"name": "errs2", "kind": "ErrorSampler",
                        "signals": ["traces"],
                        "details": {"fallback_sampling_ratio": 10}}).encode()
     req = urllib.request.Request(
@@ -362,7 +379,7 @@ def test_actions_and_rules_api(populated):
         assert r.status == 201
     env.reconcile()
     actions = get_json(f"{fe.url}/api/actions")
-    assert any(a["meta"]["name"] == "errs" for a in actions)
+    assert any(a["meta"]["name"] == "errs2" for a in actions)
     # the autoscaler compiled it into a sampling processor in the gateway
     topo = get_json(f"{fe.url}/api/pipeline")
     assert any("odigossampling" in n["id"] for n in topo["nodes"]), \
@@ -377,12 +394,13 @@ def test_actions_and_rules_api(populated):
         urllib.request.urlopen(req, timeout=10)
     assert e.value.code == 400
 
-    req = urllib.request.Request(f"{fe.url}/api/actions/errs",
+    req = urllib.request.Request(f"{fe.url}/api/actions/errs2",
                                  method="DELETE")
     with urllib.request.urlopen(req, timeout=10) as r:
         assert r.status == 200
     env.reconcile()
-    assert not get_json(f"{fe.url}/api/actions")
+    assert not any(a["meta"]["name"] == "errs2"
+                   for a in get_json(f"{fe.url}/api/actions"))
 
     # rules round trip with a workload selector
     body = json.dumps({"name": "pc", "kind": "payload-collection",
@@ -396,7 +414,8 @@ def test_actions_and_rules_api(populated):
     with urllib.request.urlopen(req, timeout=10) as r:
         assert r.status == 201
     rules = get_json(f"{fe.url}/api/rules")
-    assert rules[0]["workloads"][0]["name"] == "cart"
+    pc = next(r for r in rules if r["meta"]["name"] == "pc")
+    assert pc["workloads"][0]["name"] == "cart"
     req = urllib.request.Request(f"{fe.url}/api/rules/pc",
                                  method="DELETE")
     with urllib.request.urlopen(req, timeout=10) as r:
