@@ -198,3 +198,8 @@ class InstrumentationManager:
 
     def live_for_group(self, group: Any) -> list[int]:
         return sorted(l.pid for l in self._live.values() if l.group == group)
+
+    def live_groups(self) -> set:
+        """Distinct config groups with at least one live instrumentation
+        (the remote-config push targets)."""
+        return {l.group for l in self._live.values()}

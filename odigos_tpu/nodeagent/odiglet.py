@@ -97,9 +97,20 @@ class _RuntimeDetailsReconciler:
 
     def reconcile(self, store: Store, key: tuple[str, str]) -> None:
         ic = store.get("InstrumentationConfig", *key)
-        if ic is None or ic.runtime_details:
+        if ic is None:
+            return
+        # remote config push (the OpAMP ServerToAgent remote-config role,
+        # opampserver): an IC change — rules recompiled, sdk configs
+        # updated — must reach agents already RUNNING, not only new
+        # processes. The manager re-reads config_for_group lazily, so
+        # enqueueing the live groups is sufficient.
+        od = self.odiglet
+        for group in od.instrumentation.live_groups():
+            if group[0] == ic.workload:
+                od.instrumentation.on_config_update(group)
+        if ic.runtime_details:
             return  # inspected once per workload generation, like :308
-        details = self.odiglet.inspect_workload(ic.workload)
+        details = od.inspect_workload(ic.workload)
         if details:
             ic.runtime_details = details
             store.update_status(ic)

@@ -485,3 +485,44 @@ class TestRealProcAuxv:
         ctx2 = src.context(102)
         assert not ctx2.secure_execution
         assert not inspect_process(ctx2).secure_execution_mode
+
+
+class TestRemoteConfigPush:
+    """A rule/IC change must reach agents ALREADY RUNNING, not only new
+    processes (the OpAMP ServerToAgent remote-config role, opampserver;
+    without the push a trace-config rule only applies after pod churn)."""
+
+    def test_rule_change_reapplies_config_to_live_agents(self):
+        from odigos_tpu.api.resources import (
+            InstrumentationRule, RuleKind)
+
+        store, mgr, cluster, _, odiglet = odiglet_env()
+        factory = FakeFactory()
+        odiglet.instrumentation.options.factories["python-community"] = \
+            factory
+        w = cluster.add_workload("default", "app", [
+            Container(name="main", language="python",
+                      runtime_version="3.11")])
+        for pod in cluster.pods.values():
+            odiglet.spawn_pod_processes(pod)
+        store.apply(Source(meta=ObjectMeta(name="s", namespace="default"),
+                           workload=w.ref))
+        mgr.run_once()
+        odiglet.poll()
+        assert factory.created, "agent not instrumented"
+        inst = factory.created[0]
+        n_before = len(inst.configs)
+        assert n_before >= 1
+        # an SDK-behavior rule lands: instrumentor recompiles the IC,
+        # odiglet pushes the updated config into the LIVE agent
+        store.apply(InstrumentationRule(
+            meta=ObjectMeta(name="tc", namespace="odigos-system"),
+            rule_kind=RuleKind.TRACE_CONFIG,
+            details={"sampler": "parentbased_traceidratio",
+                     "sampler_arg": "0.5"}))
+        mgr.run_once()
+        odiglet.poll()
+        assert len(inst.configs) > n_before, \
+            "live agent never received the recompiled config"
+        latest = inst.configs[-1]
+        assert latest["trace_config"], latest
