@@ -20,12 +20,14 @@ packed rows): parity max |dp| 0.0095, but 0.67x the bf16 throughput — the
 per-token quantize/dequantize (VPU, elementwise over every activation)
 costs more than the halved MXU time saves at these matmul sizes. The path
 therefore stays OPT-IN (``EngineConfig.quantized`` / processor config
-``quantized: true``). The payoff claim was measured across geometries
-(tools/quant_geometry.py, v5e-1, 2026-07-30): ~0.89x at d_model 512/
-d_ff 2048 and ~1.1x (int8 faster) at d_model 1024/d_ff 4096, parity
-max |dp| <= 0.011 throughout — the crossover exists but sits above the
-flagship size. AUC on the injected-fault eval is asserted at the same
->=0.95 bar as the float path (tests/test_northstar_auc.py).
+``quantized: true``). A geometry sweep (tools/quant_geometry.py, v5e-1,
+2026-07-30) indicated ~0.89x at d_model 512/d_ff 2048 and ~1.1x (int8
+faster) at d_model 1024/d_ff 4096 with parity max |dp| <= 0.011
+throughout — provisional: the sweep's timing predates the discovery
+that block_until_ready does not synchronize on the axon tunnel (see
+docs/benchmarks.md for the full caveat). AUC on the injected-fault eval
+is asserted at the same >=0.95 bar as the float path
+(tests/test_northstar_auc.py).
 """
 
 from __future__ import annotations
