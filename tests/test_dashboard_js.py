@@ -94,6 +94,8 @@ CONTRACT: dict[str, dict] = {
            "fields": ["meta", "action_kind", "signals", "disabled"]},
     "ru": {"endpoint": "/api/rules", "each": True,
            "fields": ["meta", "rule_kind", "languages", "disabled"]},
+    # workload drill-down (the reference UI's describe view)
+    "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)
     "e": {"endpoint": "/api/events",
           "fields": ["type", "kind", "namespace", "name"]},
@@ -141,7 +143,10 @@ def test_every_fetched_endpoint_is_declared():
     """Every fetch()/EventSource URL in the script is a CONTRACT endpoint
     (and vice-versa nothing is stale)."""
     script = _script()
-    fetched = set(re.findall(r"""["'](/api/[a-z-]+)["']""", script))
+    # catches quoted urls AND the static prefix of template literals
+    # (`/api/sources/${key}` -> /api/sources)
+    fetched = {m.rstrip("/") for m in
+               re.findall(r"/api/[a-z-]+(?:/[a-z-]+)?", script)}
     declared = {spec["endpoint"] for spec in CONTRACT.values()}
     assert fetched == declared, (
         f"page fetches {sorted(fetched)} but contract declares "
@@ -220,7 +225,10 @@ def _resolve(payload, at):
 
 def test_contract_paths_exist_in_live_payloads(populated):
     env, fe = populated
-    payloads = {ep: get_json(fe.url + ep)
+    # parameterized endpoints need the query the JS would send
+    _QUERY = {"/api/describe/workload":
+              "?namespace=shop&kind=deployment&name=cart"}
+    payloads = {ep: get_json(fe.url + ep + _QUERY.get(ep, ""))
                 for ep in {s["endpoint"] for s in CONTRACT.values()}
                 - {"/api/events"}}
     failures = []
