@@ -100,7 +100,9 @@ def main() -> None:
         # hang with no record at all
         infra_note = ("TPU tunnel unreachable at run time; numbers are "
                       "CPU-fallback and NOT comparable to the 1M/chip "
-                      "target")
+                      "target — see BENCH_tpu_snapshot.json for the TPU "
+                      "record captured opportunistically mid-round "
+                      "(tools/tpu_snapshot.py)")
         log(f"WARNING: {infra_note}")
         import jax
 
