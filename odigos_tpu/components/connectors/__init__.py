@@ -1,1 +1,2 @@
-from . import forward, router, anomalyrouter, spanmetrics, servicegraph  # noqa: F401
+from . import (  # noqa: F401
+    forward, router, anomalyrouter, spanmetrics, servicegraph, count)

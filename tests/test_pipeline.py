@@ -260,3 +260,54 @@ def test_resource_intern_type_fidelity():
     i1 = b.add_resource({"port": 80})
     i2 = b.add_resource({"port": "80"})
     assert i1 != i2
+
+
+class TestCountConnector:
+    """count connector (upstream countconnector of the distro,
+    builder-config.yaml): telemetry in -> SUM count metrics out, wired
+    through a real two-pipeline collector."""
+
+    def test_span_counts_per_service_reach_metrics_pipeline(self):
+        from odigos_tpu.pdata import synthesize_traces
+        from odigos_tpu.pipeline.service import Collector
+
+        c = Collector({
+            "receivers": {"synthetic": {"traces_per_batch": 8,
+                                        "n_batches": 1}},
+            "connectors": {"count": {}},
+            "exporters": {"mockdestination": {"capture": True}},
+            "service": {"pipelines": {
+                "traces/in": {"receivers": ["synthetic"],
+                              "exporters": ["count"]},
+                "metrics/counts": {"receivers": ["count"],
+                                   "exporters": ["mockdestination"]},
+            }},
+        }).start()
+        try:
+            c.drain_receivers(timeout=30)
+            mock = c.graph.exporters["mockdestination"]
+            assert mock.batches, "no count metrics arrived"
+            points = [p for b in mock.batches for p in b.iter_points()]
+            assert all(p["name"] == "trace.span.count" for p in points)
+            assert all(p["type"] == "SUM" for p in points)
+            by_service = {p["attributes"]["service.name"]: p["value"]
+                          for p in points}
+            assert len(by_service) > 1, by_service
+            assert sum(by_service.values()) > 0
+        finally:
+            c.shutdown()
+
+    def test_log_batch_counted(self):
+        from odigos_tpu.components.api import ComponentKind, registry
+        from odigos_tpu.pdata.logs import LogBatchBuilder
+
+        conn = registry.get(ComponentKind.CONNECTOR, "count").build(
+            "count", None)
+        b = LogBatchBuilder()
+        for i in range(7):
+            b.add_record(body=f"l{i}")
+        out = conn.aggregate(b.build())
+        pts = list(out.iter_points())
+        assert len(pts) == 1
+        assert pts[0]["name"] == "log.record.count"
+        assert pts[0]["value"] == 7.0
