@@ -1,4 +1,5 @@
 from . import (  # noqa: F401
     batch, memory_limiter, attributes, traffic_metrics, tpuanomaly,
     groupbytrace, sampling, urltemplate, sqldboperation,
-    conditionalattributes, logsresourceattrs, filter, resourcename)
+    conditionalattributes, logsresourceattrs, filter, resourcename,
+    cumulativetodelta)

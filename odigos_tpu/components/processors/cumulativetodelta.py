@@ -1,0 +1,85 @@
+"""``cumulativetodelta`` processor — cumulative SUM points to deltas.
+
+Upstream's cumulativetodeltaprocessor (collector/builder-config.yaml):
+several vendor backends (datadog among them) ingest delta counters, while
+everything in-process emits cumulative sums. Per-series state keyed on
+(metric name, resource service, sorted point attrs); the first
+observation of a series is emitted as-is (the upstream initial-value
+behavior), a drop below the last value is a counter reset and passes
+through unchanged. Gauges and histograms are untouched.
+
+Metrics batches here are self-telemetry scale (tens of points), so the
+per-point walk is off every hot path by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ...pdata.metrics import MetricBatch, MetricType
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+class CumulativeToDeltaProcessor(Processor):
+    """Config: include (optional list of metric-name prefixes; default:
+    every SUM metric)."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._last: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def _series_key(self, batch: MetricBatch, i: int, mname: str) -> tuple:
+        ri = int(batch.col("resource_index")[i])
+        res = (batch.resources[ri].get("service.name", "")
+               if 0 <= ri < len(batch.resources) else "")
+        attrs = tuple(sorted(
+            (str(k), str(v)) for k, v in batch.point_attrs[i].items()))
+        return (mname, res, attrs)
+
+    def process(self, batch: Any) -> Any:
+        if not isinstance(batch, MetricBatch) or not len(batch):
+            return batch
+        include = self.config.get("include")
+        types = batch.col("type")
+        values = batch.col("value").copy()
+        names = batch.metric_names()
+        changed = False
+        with self._lock:
+            for i in range(len(batch)):
+                if int(types[i]) != MetricType.SUM:
+                    continue
+                if include and not any(names[i].startswith(p)
+                                       for p in include):
+                    continue
+                key = self._series_key(batch, i, names[i])
+                last = self._last.get(key)
+                cur = float(values[i])
+                self._last[key] = cur
+                if last is None or cur < last:
+                    # first observation / counter reset: pass through
+                    # (upstream initial-value + reset semantics)
+                    changed = True  # value column already copied
+                    continue
+                values[i] = cur - last
+                changed = True
+        if not changed:
+            return batch
+        from dataclasses import replace
+
+        cols = dict(batch.columns)
+        cols["value"] = values.astype(np.float64)
+        return replace(batch, columns=cols)
+
+
+register(Factory(
+    type_name="cumulativetodelta",
+    kind=ComponentKind.PROCESSOR,
+    create=CumulativeToDeltaProcessor,
+    default_config=dict,
+))

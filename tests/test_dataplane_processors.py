@@ -777,3 +777,63 @@ class TestFilelogCheckpoint:
         # fingerprint mismatch must reset the tail to the file start
         assert got2 == ["second-generation longer than before"], \
             f"ino reuse={os.stat(log).st_ino == old_ino}, got {got2}"
+
+
+class TestCumulativeToDelta:
+    """cumulativetodelta processor (upstream cumulativetodeltaprocessor):
+    SUM counters become deltas per series; first observation and counter
+    resets pass through; gauges untouched."""
+
+    def _proc(self, **cfg):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        p = registry.get(ComponentKind.PROCESSOR,
+                         "cumulativetodelta").build("c2d", cfg or None)
+        got = []
+
+        class Sink:
+            def consume(self, batch):
+                got.append(batch)
+
+        p.set_consumer(Sink())
+        return p, got
+
+    def _batch(self, value, gauge=7.5, svc="cart"):
+        from odigos_tpu.pdata.metrics import MetricBatchBuilder, MetricType
+        import time
+
+        b = MetricBatchBuilder()
+        res = b.add_resource({"service.name": svc})
+        b.add_point(name="odigos_traffic_spans_total", value=value,
+                    metric_type=MetricType.SUM,
+                    time_unix_nano=time.time_ns(), resource_index=res)
+        b.add_point(name="queue_depth", value=gauge,
+                    metric_type=MetricType.GAUGE,
+                    time_unix_nano=time.time_ns(), resource_index=res)
+        return b.build()
+
+    def test_deltas_per_series_and_reset(self):
+        p, got = self._proc()
+        p.consume(self._batch(100))
+        p.consume(self._batch(250))
+        p.consume(self._batch(10))   # counter reset (collector restart)
+        p.consume(self._batch(40))
+        sums = [float(b.col("value")[0]) for b in got]
+        assert sums == [100.0, 150.0, 10.0, 30.0]
+        gauges = [float(b.col("value")[1]) for b in got]
+        assert gauges == [7.5] * 4, "gauge must pass through untouched"
+
+    def test_series_isolation(self):
+        p, got = self._proc()
+        p.consume(self._batch(100, svc="cart"))
+        p.consume(self._batch(50, svc="pay"))   # different series: first obs
+        p.consume(self._batch(120, svc="cart"))
+        sums = [float(b.col("value")[0]) for b in got]
+        assert sums == [100.0, 50.0, 20.0]
+
+    def test_include_prefix_filter(self):
+        p, got = self._proc(include=["other_"])
+        p.consume(self._batch(100))
+        p.consume(self._batch(250))
+        sums = [float(b.col("value")[0]) for b in got]
+        assert sums == [100.0, 250.0], "excluded series must stay cumulative"
