@@ -2,4 +2,4 @@ from . import (  # noqa: F401
     batch, memory_limiter, attributes, traffic_metrics, tpuanomaly,
     groupbytrace, sampling, urltemplate, sqldboperation,
     conditionalattributes, logsresourceattrs, filter, resourcename,
-    cumulativetodelta)
+    cumulativetodelta, deltatorate)

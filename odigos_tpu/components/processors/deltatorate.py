@@ -1,0 +1,84 @@
+"""``deltatorate`` processor — delta SUM points to per-second rates.
+
+Upstream's deltatorateprocessor (collector/builder-config.yaml): behind a
+``cumulativetodelta`` stage, converts delta counters into per-second rate
+gauges for backends that chart rates directly. Per-series state keyed the
+same way as cumulativetodelta (name, resource service, sorted attrs); the
+rate divides the delta by the wall-time since the series' previous point
+(the upstream timestamp-delta behavior). The first observation of a
+series has no interval and passes through unchanged as a SUM; zero or
+negative intervals (clock skew, duplicate timestamps) leave the point
+untouched rather than emitting an infinite rate.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from ...pdata.metrics import MetricBatch, MetricType
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+class DeltaToRateProcessor(Processor):
+    """Config: include (optional list of metric-name prefixes; default:
+    every SUM metric)."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._last_t: dict[tuple, int] = {}  # series -> last time_unix_nano
+        self._lock = threading.Lock()
+
+    def _series_key(self, batch: MetricBatch, i: int, mname: str) -> tuple:
+        ri = int(batch.col("resource_index")[i])
+        res = (batch.resources[ri].get("service.name", "")
+               if 0 <= ri < len(batch.resources) else "")
+        attrs = tuple(sorted(
+            (str(k), str(v)) for k, v in batch.point_attrs[i].items()))
+        return (mname, res, attrs)
+
+    def process(self, batch: Any) -> Any:
+        if not isinstance(batch, MetricBatch) or not len(batch):
+            return batch
+        include = self.config.get("include")
+        types = batch.col("type").copy()
+        values = batch.col("value").copy()
+        times = batch.col("time_unix_nano")
+        names = batch.metric_names()
+        changed = False
+        with self._lock:
+            for i in range(len(batch)):
+                if int(types[i]) != MetricType.SUM:
+                    continue
+                if include and not any(names[i].startswith(p)
+                                       for p in include):
+                    continue
+                key = self._series_key(batch, i, names[i])
+                t = int(times[i])
+                last_t = self._last_t.get(key)
+                self._last_t[key] = t
+                if last_t is None or t <= last_t:
+                    continue  # no interval yet / non-advancing clock
+                values[i] = float(values[i]) / ((t - last_t) / 1e9)
+                types[i] = MetricType.GAUGE  # a rate is not monotonic
+                changed = True
+        if not changed:
+            return batch
+        from dataclasses import replace
+
+        cols = dict(batch.columns)
+        cols["value"] = values.astype(np.float64)
+        cols["type"] = types.astype(np.int8)
+        return replace(batch, columns=cols)
+
+
+register(Factory(
+    type_name="deltatorate",
+    kind=ComponentKind.PROCESSOR,
+    create=DeltaToRateProcessor,
+    default_config=dict,
+))

@@ -837,3 +837,52 @@ class TestCumulativeToDelta:
         p.consume(self._batch(250))
         sums = [float(b.col("value")[0]) for b in got]
         assert sums == [100.0, 250.0], "excluded series must stay cumulative"
+
+
+class TestDeltaToRate:
+    """deltatorate processor (upstream deltatorateprocessor): delta SUMs
+    become per-second rate GAUGES over the series' timestamp interval;
+    first observations and non-advancing clocks pass through."""
+
+    def _proc(self):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        p = registry.get(ComponentKind.PROCESSOR, "deltatorate").build(
+            "d2r", None)
+        got = []
+
+        class Sink:
+            def consume(self, batch):
+                got.append(batch)
+
+        p.set_consumer(Sink())
+        return p, got
+
+    def _batch(self, value, t_ns):
+        from odigos_tpu.pdata.metrics import MetricBatchBuilder, MetricType
+
+        b = MetricBatchBuilder()
+        res = b.add_resource({"service.name": "cart"})
+        b.add_point(name="spans_delta", value=value,
+                    metric_type=MetricType.SUM, time_unix_nano=t_ns,
+                    resource_index=res)
+        return b.build()
+
+    def test_rate_over_interval_and_type_flip(self):
+        from odigos_tpu.pdata.metrics import MetricType
+
+        p, got = self._proc()
+        t0 = 1_700_000_000_000_000_000
+        p.consume(self._batch(100.0, t0))          # first obs: unchanged
+        p.consume(self._batch(500.0, t0 + 2 * 10**9))  # 500 over 2s
+        assert float(got[0].col("value")[0]) == 100.0
+        assert int(got[0].col("type")[0]) == MetricType.SUM
+        assert float(got[1].col("value")[0]) == 250.0
+        assert int(got[1].col("type")[0]) == MetricType.GAUGE
+
+    def test_non_advancing_clock_passes_through(self):
+        p, got = self._proc()
+        t0 = 1_700_000_000_000_000_000
+        p.consume(self._batch(100.0, t0))
+        p.consume(self._batch(50.0, t0))  # duplicate timestamp
+        assert float(got[1].col("value")[0]) == 50.0
