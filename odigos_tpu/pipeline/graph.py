@@ -104,6 +104,20 @@ def validate_config(config: dict[str, Any]) -> list[str]:
             if eid not in declared[ComponentKind.EXPORTER] and eid not in conn_ids:
                 problems.append(f"pipeline {pname}: unknown exporter {eid}")
 
+    # authenticator references must resolve to a defined+enabled extension
+    # (the collector fails startup on a dangling authenticator; an auth'd
+    # exporter silently sending unauthenticated would be worse)
+    extensions = config.get("extensions", {})
+    enabled_ext = set(config.get("service", {}).get("extensions", []))
+    for eid, ecfg in config.get("exporters", {}).items():
+        ref = (ecfg or {}).get("auth", {}).get("authenticator")
+        if ref and ref not in extensions:
+            problems.append(f"exporter {eid}: authenticator {ref!r} "
+                            f"is not a defined extension")
+        elif ref and ref not in enabled_ext:
+            problems.append(f"exporter {eid}: authenticator {ref!r} "
+                            f"defined but not listed in service.extensions")
+
     # connector DAG check: edge pipeline_A -> pipeline_B when a connector is
     # exporter in A and receiver in B
     in_pipelines: dict[str, list[str]] = {}
@@ -171,8 +185,15 @@ def build_graph(config: dict[str, Any],
     pipelines = config.get("service", {}).get("pipelines", {})
     conn_cfgs = config.get("connectors", {})
 
-    # 1. singletons: exporters and connectors
+    # 1. singletons: exporters and connectors. Authenticator references
+    # resolve NOW (the collector's extension-resolution step): the
+    # extension's settings are inlined into the exporter config as
+    # auth_resolved so components never need the global document.
+    extensions = config.get("extensions", {})
     for eid, ecfg in config.get("exporters", {}).items():
+        ref = (ecfg or {}).get("auth", {}).get("authenticator")
+        if ref:
+            ecfg = {**ecfg, "auth_resolved": extensions[ref]}
         g.exporters[eid] = reg.get(ComponentKind.EXPORTER, eid).build(eid, ecfg)
     for cid, ccfg in conn_cfgs.items():
         g.connectors[cid] = reg.get(ComponentKind.CONNECTOR, cid).build(cid, ccfg)
