@@ -170,17 +170,22 @@ class VendorExporter(Exporter):
             # redirection keeps the derived headers: auth must survive so
             # tests exercise it against the local ingest mock
             self._url = str(override)
-        # authenticator extension resolved by the graph builder
-        # (basicauth client_auth — the grafana-cloud configers): becomes
-        # the Authorization header the HTTP transport actually sends
-        client = (self.config.get("auth_resolved") or {}).get(
-            "client_auth") or {}
+        # authenticator extension resolved by the graph builder into the
+        # Authorization header the HTTP transport actually sends:
+        # basicauth client_auth (grafana-cloud configers) or
+        # bearertokenauth token (upstream bearertokenauthextension shape)
+        auth = self.config.get("auth_resolved") or {}
+        client = auth.get("client_auth") or {}
         if client.get("username") is not None:
             import base64
             cred = (f"{expand_env(str(client['username']))}:"
                     f"{expand_env(str(client.get('password', '')))}")
             self._headers["Authorization"] = \
                 f"Basic {base64.b64encode(cred.encode()).decode()}"
+        elif auth.get("token") is not None:
+            scheme = str(auth.get("scheme", "Bearer"))
+            self._headers["Authorization"] = \
+                f"{scheme} {expand_env(str(auth['token']))}"
         if self._url is not None:
             self._url = expand_env(self._url)
         self._headers = {k: expand_env(str(v))

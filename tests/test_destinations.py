@@ -630,3 +630,37 @@ class TestAuthenticatorExtension:
                 "extensions": []}}
         problems = validate_config(cfg)
         assert any("service.extensions" in p for p in problems), problems
+
+    def test_bearertokenauth_extension_resolved(self, tmp_path,
+                                                monkeypatch):
+        """bearertokenauth (upstream bearertokenauthextension): the
+        resolved token becomes the Bearer Authorization header."""
+        from odigos_tpu.e2e.blobstore import BlobStoreServer
+        from odigos_tpu.pdata import synthesize_traces
+        from odigos_tpu.pipeline.graph import build_graph
+
+        monkeypatch.setenv("MY_TOKEN", "t0k3n")
+        store = BlobStoreServer(str(tmp_path)).start()
+        store.require_header = ("Authorization", "Bearer t0k3n")
+        try:
+            cfg = {"receivers": {"synthetic": {"traces_per_batch": 1,
+                                               "n_batches": 1}},
+                   "processors": {}, "connectors": {},
+                   "extensions": {"bearertokenauth/x": {
+                       "token": "${MY_TOKEN}"}},
+                   "exporters": {"otlphttp/x": {
+                       "endpoint": store.url,
+                       "retry_backoff_s": 0.01,
+                       "auth": {"authenticator": "bearertokenauth/x"}}},
+                   "service": {"pipelines": {"traces/t": {
+                       "receivers": ["synthetic"],
+                       "exporters": ["otlphttp/x"]}},
+                    "extensions": ["bearertokenauth/x"]}}
+            graph = build_graph(cfg)
+            exp = graph.exporters["otlphttp/x"]
+            exp.start()
+            exp.export(synthesize_traces(2, seed=9))
+            exp.shutdown()
+            assert store.put_count == 1 and store.auth_failures == 0
+        finally:
+            store.stop()
