@@ -21,44 +21,54 @@ from __future__ import annotations
 
 import atexit
 import os
+import threading
 from typing import Any, Optional
 
 MINIMUM_PYTHON_SUPPORTED_VERSION = (3, 8)
 
 _state: dict[str, Any] = {"initialized": False, "exporter": None}
+_init_lock = threading.Lock()
 
 
 def initialize(service: Optional[str] = None,
                endpoint: Optional[str] = None) -> bool:
-    """Idempotent agent init; returns True when a sink was wired."""
-    if _state["initialized"]:
-        return _state["exporter"] is not None
-    _state["initialized"] = True
+    """Idempotent agent init; returns True when a sink was wired.
 
-    service = service or os.environ.get("ODIGOS_SERVICE_NAME", "")
-    if service:
-        os.environ.setdefault("ODIGOS_SERVICE_NAME", service)
-    endpoint = endpoint or os.environ.get("ODIGOS_WIRE_ENDPOINT", "")
-    if not endpoint:
-        return False
+    Only a *successful* wiring latches: when sitecustomize auto-runs with
+    no ODIGOS_WIRE_ENDPOINT, a later explicit ``initialize(endpoint=...)``
+    from app code (the documented pip-install flow) must still work.
+    The lock keeps concurrent first-use calls (lazy init from request
+    handlers) from wiring two exporters.
+    """
+    with _init_lock:
+        if _state["exporter"] is not None:
+            return True
 
-    from odigos_tpu.hooks import tracer as hooks
-    from odigos_tpu.wire.client import WireExporter
+        service = service or os.environ.get("ODIGOS_SERVICE_NAME", "")
+        if service:
+            os.environ.setdefault("ODIGOS_SERVICE_NAME", service)
+        endpoint = endpoint or os.environ.get("ODIGOS_WIRE_ENDPOINT", "")
+        if not endpoint:
+            return False
 
-    exporter = WireExporter("otlpwire/agent", {"endpoint": endpoint})
-    exporter.start()
-    _state["exporter"] = exporter
-    hooks.set_default_sink(exporter.export)
+        from odigos_tpu.hooks import tracer as hooks
+        from odigos_tpu.wire.client import WireExporter
 
-    def _shutdown() -> None:
-        try:
-            hooks.flush()
-            exporter.flush(timeout=5.0)
-        finally:
-            exporter.shutdown()
+        exporter = WireExporter("otlpwire/agent", {"endpoint": endpoint})
+        exporter.start()
+        _state["exporter"] = exporter
+        _state["initialized"] = True  # informational: a sink is wired
+        hooks.set_default_sink(exporter.export)
 
-    atexit.register(_shutdown)
-    return True
+        def _shutdown() -> None:
+            try:
+                hooks.flush()
+                exporter.flush(timeout=5.0)
+            finally:
+                exporter.shutdown()
+
+        atexit.register(_shutdown)
+        return True
 
 
 class OdigosTpuConfigurator:

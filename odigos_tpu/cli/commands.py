@@ -334,6 +334,22 @@ def cmd_destinations(args) -> int:
         # the Secret analog, matching the UI wizard path
         secret_names = [f.name for f in spec.fields
                         if f.secret and f.name in config]
+        # secret env names are type-scoped: a second destination of the
+        # same type shares them, so a differing value silently rebinds the
+        # first destination's credentials — surface that
+        for n in secret_names:
+            old = state.secrets.get(n)
+            if old is not None and old != config[n]:
+                others = [d.meta.name for d in
+                          state.store.list("DestinationResource")
+                          if d.meta.name != args.name
+                          and any(f.secret and f.name == n for f in
+                                  (SPECS[d.dest_type].fields
+                                   if d.dest_type in SPECS else ()))]
+                if others:
+                    print(f"warning: {n} is shared with destination(s) "
+                          f"{', '.join(others)}; the new value replaces "
+                          "theirs", file=sys.stderr)
         state.set_secrets({n: config.pop(n) for n in secret_names})
         state.store.apply(DestinationResource(
             meta=ObjectMeta(name=args.name, namespace=ODIGOS_NAMESPACE),
@@ -352,11 +368,17 @@ def cmd_destinations(args) -> int:
                                    args.name)
         if existing is not None and state.store.delete(
                 "DestinationResource", ODIGOS_NAMESPACE, args.name):
-            if existing.secret_ref:
-                spec = SPECS.get(existing.dest_type)
-                state.drop_secrets([f.name for f in
-                                    (spec.fields if spec else ())
-                                    if f.secret])
+            # revoke every stored secret no longer referenced by any
+            # surviving destination (env names are type-scoped, so a
+            # same-type survivor — even one added without re-supplying
+            # the credential — keeps the var; round-4 advisor, medium)
+            from ..destinations.registry import (
+                referenced_secret_env_names)
+
+            keep = referenced_secret_env_names(
+                state.store.list("DestinationResource"))
+            state.drop_secrets([n for n in list(state.secrets)
+                                if n not in keep])
             state.reconcile()
             state.save()
             print("destination removed")

@@ -10,28 +10,36 @@ through unchanged. Gauges and histograms are untouched.
 
 Metrics batches here are self-telemetry scale (tens of points), so the
 per-point walk is off every hot path by construction.
+
+``max_staleness`` (seconds; default 0 = never evict, upstream parity)
+bounds per-series state under churn — see seriesstate.StaleSeriesMap.
+Caveat when enabled: a series slower than the window re-starts as new on
+every point (raw cumulative passes through as if it were a delta), so
+set it well above the slowest legitimate scrape cadence.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 import numpy as np
 
 from ...pdata.metrics import MetricBatch, MetricType
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
+from .seriesstate import StaleSeriesMap
 
 
 class CumulativeToDeltaProcessor(Processor):
     """Config: include (optional list of metric-name prefixes; default:
-    every SUM metric)."""
+    every SUM metric); max_staleness (seconds, 0 = never evict)."""
 
     capabilities = Capabilities(mutates_data=True)
 
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
-        self._last: dict[tuple, float] = {}
+        self._last = StaleSeriesMap(float(config.get("max_staleness", 0.0)))
         self._lock = threading.Lock()
 
     def _series_key(self, batch: MetricBatch, i: int, mname: str) -> tuple:
@@ -50,7 +58,9 @@ class CumulativeToDeltaProcessor(Processor):
         values = batch.col("value").copy()
         names = batch.metric_names()
         changed = False
+        now = time.monotonic()
         with self._lock:
+            self._last.sweep(now)
             for i in range(len(batch)):
                 if int(types[i]) != MetricType.SUM:
                     continue
@@ -60,7 +70,7 @@ class CumulativeToDeltaProcessor(Processor):
                 key = self._series_key(batch, i, names[i])
                 last = self._last.get(key)
                 cur = float(values[i])
-                self._last[key] = cur
+                self._last.put(key, cur, now)
                 if last is None or cur < last:
                     # first observation / counter reset: pass through
                     # (upstream initial-value + reset semantics)

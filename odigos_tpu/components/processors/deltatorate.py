@@ -2,35 +2,56 @@
 
 Upstream's deltatorateprocessor (collector/builder-config.yaml): behind a
 ``cumulativetodelta`` stage, converts delta counters into per-second rate
-gauges for backends that chart rates directly. Per-series state keyed the
-same way as cumulativetodelta (name, resource service, sorted attrs); the
-rate divides the delta by the wall-time since the series' previous point
-(the upstream timestamp-delta behavior). The first observation of a
-series has no interval and passes through unchanged as a SUM; zero or
-negative intervals (clock skew, duplicate timestamps) leave the point
-untouched rather than emitting an infinite rate.
+gauges for backends that chart rates directly.
+
+Two documented deviations from upstream, both deliberate:
+
+* **Interval source.** Upstream divides a delta point by its own
+  ``(end - start)`` window; our columnar MetricBatch carries a single
+  ``time_unix_nano`` per point (pdata/metrics.py COLUMN_DTYPES), so the
+  rate divides by the inter-arrival time since the series' previous
+  point.  For the steady self-telemetry/scraper cadence these feed, the
+  two agree; under irregular delivery inter-arrival smears a burst over
+  the gap.
+* **First observation.** With no previous point there is no interval, so
+  the first point of a series is *held* (dropped from the batch) rather
+  than passed through as a SUM — emitting it unchanged would make the
+  series flip point types over time (SUM once, GAUGE after), which
+  backends mis-type.  Rate series therefore start one interval late, the
+  price of emitting a single consistent type.
+
+``max_staleness`` (seconds; default 0 = never evict, upstream parity)
+bounds per-series state under churn — see seriesstate.StaleSeriesMap.
+Caveat when enabled: a series slower than the window is evicted between
+points, so every point becomes a held first observation and the series
+emits NOTHING — only enable with the window well above the slowest
+legitimate cadence.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 import numpy as np
 
 from ...pdata.metrics import MetricBatch, MetricType
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
+from .seriesstate import StaleSeriesMap
 
 
 class DeltaToRateProcessor(Processor):
     """Config: include (optional list of metric-name prefixes; default:
-    every SUM metric)."""
+    every SUM metric); max_staleness (seconds, 0 = never evict)."""
 
     capabilities = Capabilities(mutates_data=True)
 
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
-        self._last_t: dict[tuple, int] = {}  # series -> last time_unix_nano
+        # series key -> last time_unix_nano
+        self._last_t = StaleSeriesMap(
+            float(config.get("max_staleness", 0.0)))
         self._lock = threading.Lock()
 
     def _series_key(self, batch: MetricBatch, i: int, mname: str) -> tuple:
@@ -50,7 +71,10 @@ class DeltaToRateProcessor(Processor):
         times = batch.col("time_unix_nano")
         names = batch.metric_names()
         changed = False
+        keep = np.ones(len(batch), dtype=bool)
+        now = time.monotonic()
         with self._lock:
+            self._last_t.sweep(now)
             for i in range(len(batch)):
                 if int(types[i]) != MetricType.SUM:
                     continue
@@ -60,9 +84,14 @@ class DeltaToRateProcessor(Processor):
                 key = self._series_key(batch, i, names[i])
                 t = int(times[i])
                 last_t = self._last_t.get(key)
-                self._last_t[key] = t
+                self._last_t.put(key, t, now)
                 if last_t is None or t <= last_t:
-                    continue  # no interval yet / non-advancing clock
+                    # no interval yet (first obs) or non-advancing clock:
+                    # hold rather than emit an infinite/negative rate or a
+                    # type-inconsistent SUM point (see docstring)
+                    keep[i] = False
+                    changed = True
+                    continue
                 values[i] = float(values[i]) / ((t - last_t) / 1e9)
                 types[i] = MetricType.GAUGE  # a rate is not monotonic
                 changed = True
@@ -73,7 +102,8 @@ class DeltaToRateProcessor(Processor):
         cols = dict(batch.columns)
         cols["value"] = values.astype(np.float64)
         cols["type"] = types.astype(np.int8)
-        return replace(batch, columns=cols)
+        out = replace(batch, columns=cols)
+        return out.filter(keep) if not keep.all() else out
 
 
 register(Factory(

@@ -1,0 +1,65 @@
+"""Staleness-bounded per-series state map, shared by the stateful metric
+processors (cumulativetodelta, deltatorate — upstream's max_staleness
+knob, cumulativetodeltaprocessor/processor.go tracker semantics).
+
+``max_staleness=0`` (the default, upstream parity) never evicts.  A
+positive value bounds memory under series churn (pod-labeled series from
+kubeletstats/hostmetrics come and go with workloads) by dropping series
+unseen for that many seconds — with the documented caveat that a series
+whose inter-arrival exceeds the window re-starts as new on every point,
+so the bound must be set above the slowest legitimate cadence.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+
+class StaleSeriesMap:
+    """key -> value with a last-seen timestamp; O(1) amortized sweeps.
+
+    Not thread-safe on its own — callers hold their processor lock (the
+    same discipline the per-point walk already requires).
+    """
+
+    def __init__(self, max_staleness: float = 0.0):
+        self.max_staleness = float(max_staleness)
+        self._data: dict[Any, tuple[Any, float]] = {}
+        self._next_sweep = (time.monotonic() + self.max_staleness
+                            if self.max_staleness > 0 else float("inf"))
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._data
+
+    def get(self, key: Any) -> Optional[Any]:
+        entry = self._data.get(key)
+        return entry[0] if entry is not None else None
+
+    def put(self, key: Any, value: Any,
+            now: Optional[float] = None) -> None:
+        self._data[key] = (value, time.monotonic() if now is None else now)
+
+    def sweep(self, now: Optional[float] = None) -> None:
+        """Evict entries unseen for max_staleness; cheap when not due."""
+        now = time.monotonic() if now is None else now
+        if now < self._next_sweep:
+            return
+        cutoff = now - self.max_staleness
+        for key in [k for k, (_, seen) in self._data.items()
+                    if seen < cutoff]:
+            del self._data[key]
+        self._next_sweep = now + max(self.max_staleness / 2.0, 1.0)
+
+    # test/introspection hooks
+    def age(self, key: Any, seen: float) -> None:
+        """Backdate a key's last-seen time (tests force staleness)."""
+        value, _ = self._data[key]
+        self._data[key] = (value, seen)
+        self._next_sweep = 0.0
+
+    def keys(self):
+        return self._data.keys()

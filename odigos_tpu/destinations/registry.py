@@ -263,3 +263,26 @@ def validate_destination(dest: Destination) -> list[str]:
             # value, parse error) IS the validation failure to report
             problems.append(f"destination {dest.id}: {e}")
     return problems
+
+
+def referenced_secret_env_names(destinations) -> set[str]:
+    """Env-var names still needed by the given destination resources.
+
+    Secret env names are type-scoped (field names in SPECS match the
+    reference's env-var names 1:1, destinations/data/*.yaml), so two
+    destinations of the same type share them.  Deletion paths must not
+    revoke an env var another surviving destination's generated config
+    still references as ``${NAME}`` — this computes the keep-set.  The
+    spec-level field list is a safe overapproximation (keeping an unused
+    var is harmless; dropping an in-use one breaks the survivor's auth).
+    Survivors count even without a secret_ref of their own: configers
+    always emit ``${NAME}`` for secret fields, so a destination added
+    without re-supplying the credential still depends on the shared var.
+    """
+    names: set[str] = set()
+    for r in destinations:
+        spec = SPECS.get(getattr(r, "dest_type", ""))
+        for f in (spec.fields if spec else ()):
+            if f.secret:
+                names.add(f.name)
+    return names

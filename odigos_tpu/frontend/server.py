@@ -110,6 +110,11 @@ class FrontendServer:
         # SSE fan-out: every connected client owns a queue fed by one store
         # watch (the /api/events push channel, frontend/main.go:217)
         self._sse_clients: list[queue.Queue] = []
+        # env names THIS server delivered via destination creation (the
+        # CLI's state.secrets analog): revocation consults this, never the
+        # deleted resource's secret_ref, so ambient operator env vars are
+        # never popped and odigos-delivered ones never linger
+        self.delivered_secret_envs: set[str] = set()
         self._sse_lock = threading.Lock()
 
     # ----------------------------------------------------------- lifecycle
@@ -497,6 +502,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         for sname in secret_names:
             os.environ[sname] = fields.pop(sname)
+            fe.delivered_secret_envs.add(sname)
         fe.store.apply(DestinationResource(
             meta=ObjectMeta(name=name, namespace=ODIGOS_NAMESPACE),
             dest_type=dest.dest_type,
@@ -536,18 +542,24 @@ class _Handler(BaseHTTPRequestHandler):
                                     name)
             if existing is not None and fe.store.delete(
                     "DestinationResource", ODIGOS_NAMESPACE, name):
-                # revoke the env-delivered secrets with the destination —
-                # a lingering credential would silently re-authenticate a
-                # later destination of the same type
-                if existing.secret_ref:
-                    import os
+                # revoke env secrets THIS server delivered (tracked in
+                # delivered_secret_envs — the CLI's state.secrets analog)
+                # that no surviving destination still references as
+                # ${NAME} (env names are type-scoped, so a same-type
+                # survivor keeps the var; round-4 advisor, medium).
+                # Ambient operator env vars are never in the tracked set
+                # and therefore never popped.
+                import os
 
-                    from ..destinations.registry import SPECS
+                from ..destinations.registry import (
+                    referenced_secret_env_names)
 
-                    spec = SPECS.get(existing.dest_type)
-                    for f in (spec.fields if spec else ()):
-                        if f.secret:
-                            os.environ.pop(f.name, None)
+                keep = referenced_secret_env_names(
+                    fe.store.list("DestinationResource"))
+                for env_name in list(fe.delivered_secret_envs):
+                    if env_name not in keep:
+                        os.environ.pop(env_name, None)
+                        fe.delivered_secret_envs.discard(env_name)
                 return self._json({"deleted": name})
             return self._error(f"no destination {name}", 404)
         return self._error("not found", 404)

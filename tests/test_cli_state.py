@@ -151,3 +151,39 @@ def test_destination_secrets_never_enter_state_json(tmp_path):
 
     run("destinations", "remove", "--name", "dd")
     assert not secrets_path.exists(), "secrets not revoked on remove"
+
+
+def test_shared_secret_env_survives_same_type_destination_removal(
+        tmp_path, monkeypatch):
+    """Secret env names are type-scoped (registry field names match the
+    reference's env vars 1:1), so two destinations of one type share
+    them: removing either must not revoke the survivor's credentials
+    (round-4 advisor, medium). Removing the last one still revokes."""
+    import os
+
+    from odigos_tpu.cli.commands import build_parser
+
+    monkeypatch.delenv("DATADOG_API_KEY", raising=False)
+    sd = str(tmp_path / "state")
+
+    def run(*a):
+        args = build_parser().parse_args(["--state-dir", sd, *a])
+        rc = args.fn(args)
+        assert rc == 0, f"command {a} failed rc={rc}"
+
+    run("install")
+    run("destinations", "add", "--name", "dd-a", "--type", "datadog",
+        "--signal", "traces", "--set", "DATADOG_SITE=datadoghq.com",
+        "--set", "DATADOG_API_KEY=shared-key")
+    # dd-b relies on the already-delivered credential (configers always
+    # emit ${DATADOG_API_KEY}; only the site is required at add time)
+    run("destinations", "add", "--name", "dd-b", "--type", "datadog",
+        "--signal", "traces", "--set", "DATADOG_SITE=datadoghq.eu")
+    run("destinations", "remove", "--name", "dd-a")
+    # dd-b still references ${DATADOG_API_KEY}: the env + secrets file
+    # must keep it even though dd-b carries no secret_ref of its own
+    assert os.environ.get("DATADOG_API_KEY") == "shared-key"
+    assert (tmp_path / "state" / "secrets.json").exists()
+    run("destinations", "remove", "--name", "dd-b")
+    assert "DATADOG_API_KEY" not in os.environ
+    assert not (tmp_path / "state" / "secrets.json").exists()

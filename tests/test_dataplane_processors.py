@@ -838,11 +838,26 @@ class TestCumulativeToDelta:
         sums = [float(b.col("value")[0]) for b in got]
         assert sums == [100.0, 250.0], "excluded series must stay cumulative"
 
+    def test_stale_series_evicted(self):
+        """Pod-labeled series churn with workloads; state must be bounded
+        by max_staleness (round-4 advisor, low)."""
+        p, got = self._proc(max_staleness=60.0)
+        p.consume(self._batch(100))
+        assert len(p._last) == 1
+        key = next(iter(p._last.keys()))
+        p._last.age(key, -1e9)     # age past staleness, open sweep window
+        p.consume(self._batch(250, svc="pay"))  # different series
+        assert key not in p._last, "stale series not evicted"
+        # the evicted series restarts as new: first obs passes through
+        p.consume(self._batch(300))
+        assert float(got[-1].col("value")[0]) == 300.0
+
 
 class TestDeltaToRate:
     """deltatorate processor (upstream deltatorateprocessor): delta SUMs
     become per-second rate GAUGES over the series' timestamp interval;
-    first observations and non-advancing clocks pass through."""
+    first observations and non-advancing clocks are HELD (dropped) so the
+    emitted series carries a single consistent point type."""
 
     def _proc(self):
         from odigos_tpu.components.api import ComponentKind, registry
@@ -873,16 +888,38 @@ class TestDeltaToRate:
 
         p, got = self._proc()
         t0 = 1_700_000_000_000_000_000
-        p.consume(self._batch(100.0, t0))          # first obs: unchanged
+        p.consume(self._batch(100.0, t0))          # first obs: held
+        assert got == []  # no interval yet -> point dropped, not forwarded
         p.consume(self._batch(500.0, t0 + 2 * 10**9))  # 500 over 2s
-        assert float(got[0].col("value")[0]) == 100.0
-        assert int(got[0].col("type")[0]) == MetricType.SUM
-        assert float(got[1].col("value")[0]) == 250.0
-        assert int(got[1].col("type")[0]) == MetricType.GAUGE
+        assert float(got[0].col("value")[0]) == 250.0
+        assert int(got[0].col("type")[0]) == MetricType.GAUGE
 
-    def test_non_advancing_clock_passes_through(self):
+    def test_non_advancing_clock_holds_point(self):
         p, got = self._proc()
         t0 = 1_700_000_000_000_000_000
         p.consume(self._batch(100.0, t0))
-        p.consume(self._batch(50.0, t0))  # duplicate timestamp
-        assert float(got[1].col("value")[0]) == 50.0
+        p.consume(self._batch(50.0, t0))  # duplicate timestamp: no interval
+        assert got == []
+
+    def test_stale_series_evicted_and_restart_as_new(self):
+        from odigos_tpu.components.api import ComponentKind, registry
+
+        p = registry.get(ComponentKind.PROCESSOR, "deltatorate").build(
+            "d2r", {"max_staleness": 60.0})
+        got = []
+
+        class Sink:
+            def consume(self, batch):
+                got.append(batch)
+
+        p.set_consumer(Sink())
+        t0 = 1_700_000_000_000_000_000
+        p.consume(self._batch(100.0, t0))
+        assert len(p._last_t) == 1
+        # age the entry past staleness (opens the sweep window too)
+        key = next(iter(p._last_t.keys()))
+        p._last_t.age(key, -1e9)
+        p.consume(self._batch(7.0, t0 + 10**9))
+        # old entry evicted, the new point restarted the series (held)
+        assert got == []
+        assert len(p._last_t) == 1

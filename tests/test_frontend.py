@@ -276,3 +276,56 @@ def test_delete_source_with_encoded_name(env_with_frontend):
     with urllib.request.urlopen(req, timeout=10) as r:
         assert r.status == 200
     assert env.store.get("Source", "shop", "src-my app") is None
+
+
+def test_destination_secret_env_lifecycle_over_socket(monkeypatch):
+    """Env-secret delivery/revocation through the JSON API (round-4
+    advisor, medium): env names are type-scoped, so deleting one of two
+    same-type destinations must keep the survivor's credential; deleting
+    the last one revokes exactly what the server delivered — never an
+    ambient operator env var."""
+    import os
+
+    from odigos_tpu.api.store import Store
+
+    monkeypatch.delenv("DATADOG_API_KEY", raising=False)
+    fe = FrontendServer(Store(), metrics_port=None).start()
+    base = fe.url
+    try:
+        def delete(path):
+            req = urllib.request.Request(base + path, method="DELETE")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = json.loads(r.read())
+                # the response names the deleted DESTINATION (clients
+                # confirm against it), never an env-var name
+                assert body["deleted"] == path.rsplit("/", 1)[-1], body
+                return r.status
+
+        status, _ = post_json(f"{base}/api/destinations", {
+            "name": "dd-a", "type": "datadog", "signals": ["traces"],
+            "fields": {"DATADOG_SITE": "datadoghq.com",
+                       "DATADOG_API_KEY": "delivered-key"}})
+        assert status == 201
+        assert os.environ["DATADOG_API_KEY"] == "delivered-key"
+        # dd-b rides the already-delivered credential (no key supplied)
+        status, _ = post_json(f"{base}/api/destinations", {
+            "name": "dd-b", "type": "datadog", "signals": ["traces"],
+            "fields": {"DATADOG_SITE": "datadoghq.eu"}})
+        assert status == 201
+        assert delete("/api/destinations/dd-a") == 200
+        assert os.environ.get("DATADOG_API_KEY") == "delivered-key", \
+            "survivor's shared credential revoked"
+        assert delete("/api/destinations/dd-b") == 200
+        assert "DATADOG_API_KEY" not in os.environ, \
+            "delivered credential lingered after last same-type delete"
+
+        # ambient env vars the server never delivered are never popped
+        monkeypatch.setenv("DATADOG_API_KEY", "operator-ambient")
+        status, _ = post_json(f"{base}/api/destinations", {
+            "name": "dd-c", "type": "datadog", "signals": ["traces"],
+            "fields": {"DATADOG_SITE": "datadoghq.com"}})
+        assert status == 201
+        assert delete("/api/destinations/dd-c") == 200
+        assert os.environ.get("DATADOG_API_KEY") == "operator-ambient"
+    finally:
+        fe.shutdown()

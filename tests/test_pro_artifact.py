@@ -168,3 +168,51 @@ class TestAgentShim:
                            capture_output=True, text=True, timeout=60)
         assert r.returncode == 0, r.stderr
         assert "inert" in r.stdout
+
+    def test_explicit_initialize_works_after_endpointless_auto_init(self):
+        """ODIGOS_AUTO_INIT=1 with no ODIGOS_WIRE_ENDPOINT must not latch:
+        the documented pip-install flow calls initialize(endpoint=...)
+        from app code afterwards (round-4 advisor, low)."""
+        import os
+        import subprocess
+        import sys
+        import time
+
+        from odigos_tpu.wire.server import WireReceiver
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        agent_dir = os.path.join(repo, "agents", "python")
+
+        got = []
+
+        class Sink:
+            def consume(self, batch):
+                got.append(batch)
+
+        recv = WireReceiver("otlpwire", {"port": 0})
+        recv.set_consumer(Sink())
+        recv.start()
+        try:
+            app = (
+                "import odigos_tpu_configurator as c\n"
+                "assert c.initialize() is False  # auto-init had no endpoint\n"
+                f"assert c.initialize(endpoint='127.0.0.1:{recv.port}')\n"
+                "from odigos_tpu.hooks import span\n"
+                "with span('late-wired'):\n"
+                "    pass\n")
+            env = dict(os.environ,
+                       PYTHONPATH=f"{agent_dir}{os.pathsep}{repo}",
+                       ODIGOS_AUTO_INIT="1",
+                       ODIGOS_SERVICE_NAME="late-svc",
+                       JAX_PLATFORMS="cpu")
+            env.pop("ODIGOS_WIRE_ENDPOINT", None)
+            r = subprocess.run([sys.executable, "-c", app], env=env,
+                               cwd=repo, capture_output=True, text=True,
+                               timeout=120)
+            assert r.returncode == 0, r.stderr
+            deadline = time.time() + 15
+            while time.time() < deadline and not got:
+                time.sleep(0.05)
+            assert got, "late explicit initialize() wired no sink"
+        finally:
+            recv.shutdown()

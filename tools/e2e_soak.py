@@ -108,7 +108,17 @@ def main() -> None:
                 time.sleep(0.001)
         ok = exp.flush(timeout=60.0)
         if not ok:
-            dropped_spans[i] = exp.queued * batch_spans[0]
+            # the residual queue holds the most recently enqueued batches
+            # (FIFO drains from the front); this sender enqueued indices
+            # i, i+senders, i+2*senders, ... so walk back from the last
+            # one (k - senders) to count the exact spans still queued —
+            # batches differ in span count per seed, so multiplying by
+            # batch_spans[0] would mis-state conservation precisely in
+            # the failure case this check exists to catch
+            q = exp.queued
+            dropped_spans[i] = sum(
+                batch_spans[(k - args.senders * (j + 1)) % len(batches)]
+                for j in range(q))
         exp.shutdown()
 
     threads = [threading.Thread(target=sender, args=(i,), daemon=True)
