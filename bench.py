@@ -93,6 +93,30 @@ def _device_reachable(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def _snapshot_drift() -> dict:
+    """Compare the committed TPU snapshot's code identity against HEAD
+    (VERDICT r4 item 8): a CPU-fallback run must say explicitly whether
+    the standing TPU record was captured from the same tree."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "BENCH_tpu_snapshot.json")) as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    head = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                          capture_output=True, text=True,
+                          cwd=here).stdout.strip()
+    snap_git = snap.get("git", "")
+    return {
+        "snapshot_git": snap_git or "(not recorded)",
+        "snapshot_captured_at": snap.get("captured_at", ""),
+        "snapshot_drift": (snap_git != head) if snap_git else True,
+    }
+
+
 def main() -> None:
     infra_note = None
     if not _device_reachable():
@@ -117,6 +141,7 @@ def main() -> None:
     result["platform"] = dev.platform
     if infra_note:
         result["infra_note"] = infra_note
+        result.update(_snapshot_drift())
     # partial record first: a latency-stage failure must not erase this
     print(json.dumps(result), flush=True)
 
@@ -271,6 +296,9 @@ def latency_bench(on_tpu: bool) -> dict:
                          "up to 5 hops/call)"),
     }
     headline = None
+    headline_total = None
+    headline_packs = None
+    headline_dev = None
     for n in sizes:
         vs = variants[n]
         n_spans = sum(len(b) for b in vs) // len(vs)
@@ -311,6 +339,9 @@ def latency_bench(on_tpu: bool) -> dict:
             f"{p50:.2f} / p95 {p95:.2f} / p99 {p99:.2f} ms")
         if headline is None or n_spans <= 2500:
             headline = (p50, p95, p99, a50, a99)  # the ~2k-span batch
+            headline_total = total
+            headline_packs = packs
+            headline_dev = dev_ms
     p50, p95, p99, a50, a99 = headline
     out.update({
         "latency_p50_ms": round(p50, 3),
@@ -318,7 +349,53 @@ def latency_bench(on_tpu: bool) -> dict:
         "latency_p99_ms": round(p99, 3),
         "latency_axon_p50_ms": round(a50, 2),
         "latency_axon_p99_ms": round(a99, 2),
+        # estimated fraction of per-call totals inside the RAW 5 ms
+        # budget, no tunnel allowance (VERDICT r4 item 1: report under
+        # the raw budget; the composed samples are the co-located model)
+        "scored_fraction_raw_5ms_est": round(
+            float(np.mean(headline_total < BUDGET_MS)), 4),
     })
+
+    # ---- 3b. DIRECT per-call device time: one long-running dispatch
+    # drives many scoring steps over DISTINCT pre-staged inputs (axon
+    # pitfall: identical dispatches are elided), so the tunnel's ~70 ms
+    # RPC cost is amortized to noise. A measurement, not a composition.
+    try:
+        direct = _device_direct_per_call(
+            proc.engine.backend, headline_packs,
+            n_calls=256 if on_tpu else 8, samples=5 if on_tpu else 2)
+        out["latency_device_direct_ms"] = round(
+            float(np.mean(direct)), 3)
+        out["latency_device_direct_note"] = (
+            "per-call device time measured by one dispatch chaining many "
+            "distinct-input scoring steps (tunnel amortized out); "
+            "cross-checks the chained-pair device distribution")
+        log(f"latency: device per-call DIRECT "
+            f"{np.mean(direct):.3f} ms (chained-pair dist p50 on the "
+            f"same headline batch was "
+            f"{np.percentile(headline_dev, 50):.3f} ms)")
+    except Exception as e:  # noqa: BLE001 — cross-check must not zero run
+        log(f"direct device measurement failed: {type(e).__name__}: {e}")
+
+    # ---- 3c. measured error bound for the composed estimate (CPU
+    # ground-truth validation, tools/estimator_validation.py artifact)
+    try:
+        import os
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "ESTIMATOR_VALIDATION.json")) as f:
+            val = json.load(f)
+        err = float(val["max_rel_err"])
+        out.update({
+            "estimator_max_rel_err": err,
+            "latency_p99_ms_upper": round(p99 * (1.0 + err), 3),
+            "estimator_validation_git": val.get("git", ""),
+        })
+        log(f"estimator error bound {err * 100:.1f}% (CPU ground truth) "
+            f"-> p99 upper {p99 * (1 + err):.3f} ms")
+    except (OSError, KeyError, ValueError, json.JSONDecodeError):
+        log("no ESTIMATOR_VALIDATION.json — composed estimate carries "
+            "no measured error bound")
 
     # ---- 4. scored_fraction OBSERVED from engine counters. Budget = 5 ms
     # + explicit tunnel allowance (5 round trips/call), reported alongside.
@@ -352,6 +429,68 @@ def latency_bench(on_tpu: bool) -> dict:
         "scored_fraction": round(float(frac), 4),
         "axon_budget_ms": round(budget_ms, 1),
     })
+    return out
+
+
+def _device_direct_per_call(backend, packs, n_calls: int,
+                            samples: int) -> np.ndarray:
+    """Per-call device time MEASURED with the tunnel out of the per-call
+    path: one jitted dispatch runs ``n_calls`` scoring steps inside a
+    fori_loop, rotating over V DISTINCT pre-staged input sets (stacked on
+    a leading axis; the axon tunnel elides duplicate executions, so the
+    inputs must genuinely differ) and chaining a data dependency through
+    the loop carry (block_until_ready lies on axon; fetching the final
+    scalar transitively forces every step). Timing T(n_calls) - T(1) and
+    dividing by n_calls-1 removes the constant per-dispatch RPC cost, so
+    what remains is measured per-call device time — a direct measurement,
+    unlike the composed estimate (VERDICT r4 item 1a).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    model, variables = backend.model, backend.variables
+    # stack only packs sharing the modal shape (pad_rows_to buckets rows,
+    # but an outlier variant can land in the next bucket)
+    by_shape: dict = {}
+    for p in packs:
+        by_shape.setdefault(p.categorical.shape, []).append(p)
+    group = max(by_shape.values(), key=len)
+    if len(group) < 2:
+        raise ValueError("need >=2 same-shape distinct input sets")
+    cat = jax.device_put(jnp.stack([jnp.asarray(p.categorical)
+                                    for p in group]))
+    cont = jax.device_put(jnp.stack([jnp.asarray(p.continuous)
+                                     for p in group]))
+    seg = jax.device_put(jnp.stack([jnp.asarray(p.segments)
+                                    for p in group]))
+    pos = jax.device_put(jnp.stack([jnp.asarray(p.positions)
+                                    for p in group]))
+    v = len(group)
+
+    @partial(jax.jit, static_argnums=5)
+    def loop(variables, cat, cont, seg, pos, n):
+        def body(i, carry):
+            idx = jax.lax.rem(i, v)
+            c = jax.lax.dynamic_index_in_dim(cont, idx, keepdims=False)
+            ca = jax.lax.dynamic_index_in_dim(cat, idx, keepdims=False)
+            s = jax.lax.dynamic_index_in_dim(seg, idx, keepdims=False)
+            p = jax.lax.dynamic_index_in_dim(pos, idx, keepdims=False)
+            c = c.at[0, 0, 0].add(carry * 1e-12)  # chain the carry in
+            span_p = model.module.apply(
+                variables, ca, c, s > 0, positions=p, segments=s)[0]
+            return carry + span_p[0, 0].astype(jnp.float32)
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0))
+
+    float(loop(variables, cat, cont, seg, pos, 1))        # compile both
+    float(loop(variables, cat, cont, seg, pos, n_calls))
+    out = np.empty(samples)
+    for j in range(samples):
+        t0 = time.perf_counter()
+        float(loop(variables, cat, cont, seg, pos, 1))
+        t1 = time.perf_counter()
+        float(loop(variables, cat, cont, seg, pos, n_calls))
+        t2 = time.perf_counter()
+        out[j] = max((t2 - t1) - (t1 - t0), 0.0) / (n_calls - 1) * 1e3
     return out
 
 

@@ -2,4 +2,6 @@ from . import (  # noqa: F401
     batch, memory_limiter, attributes, traffic_metrics, tpuanomaly,
     groupbytrace, sampling, urltemplate, sqldboperation,
     conditionalattributes, logsresourceattrs, filter, resourcename,
-    cumulativetodelta, deltatorate)
+    cumulativetodelta, deltatorate, transform, resourcedetection,
+    probabilisticsampler, groupbyattrs, metricstransform,
+    metricsgeneration, span, redaction, remotetap)

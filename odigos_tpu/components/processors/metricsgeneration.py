@@ -1,0 +1,137 @@
+"""``metricsgeneration`` processor — derive new metrics from existing ones.
+
+Upstream's metricsgenerationprocessor (collector/builder-config.yaml:75):
+create a metric as a binary operation over two existing metrics (e.g.
+memory utilization = used / total) or a scaled copy of one.
+
+Config (upstream rule shape)::
+
+    metricsgeneration:
+      rules:
+        - name: system.memory.utilization
+          type: calculate              # calculate | scale
+          metric1: system.memory.usage
+          metric2: system.memory.limit
+          operation: divide            # add|subtract|multiply|divide|percent
+        - name: system.disk.io.kb
+          type: scale
+          metric1: system.disk.io
+          scale_by: 0.001
+
+``calculate`` aligns metric1 points with metric2 by (resource, point
+attrs); a metric2 match must exist or the point is skipped (upstream
+skips too).  Generated points append to the batch; originals pass
+through untouched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+import numpy as np
+
+from ...pdata.metrics import (MetricBatch, compact_resources,
+                              concat_metric_batches)
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+_OPS = {
+    "add": lambda a, b: a + b,
+    "subtract": lambda a, b: a - b,
+    "multiply": lambda a, b: a * b,
+    "divide": lambda a, b: np.divide(
+        a, b, out=np.zeros_like(a), where=b != 0),
+    "percent": lambda a, b: np.divide(
+        a, b, out=np.zeros_like(a), where=b != 0) * 100.0,
+}
+
+
+class MetricsGenerationProcessor(Processor):
+    """See module docstring."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.rules = []
+        for r in config.get("rules") or []:
+            kind = r.get("type", "calculate")
+            if kind not in ("calculate", "scale"):
+                raise ValueError(f"bad metricsgeneration type {kind!r}")
+            if not r.get("name") or not r.get("metric1"):
+                raise ValueError("metricsgeneration rule needs name+metric1")
+            if kind == "calculate":
+                if not r.get("metric2"):
+                    raise ValueError("calculate rule needs metric2")
+                if r.get("operation", "divide") not in _OPS:
+                    raise ValueError(
+                        f"bad operation {r.get('operation')!r}")
+            self.rules.append(dict(r))
+
+    def process(self, batch: Any) -> Any:
+        if not isinstance(batch, MetricBatch) or not len(batch):
+            return batch
+        generated = []
+        names = batch.metric_names()
+        for rule in self.rules:
+            m1 = np.array([nm == rule["metric1"] for nm in names])
+            if not m1.any():
+                continue
+            if rule.get("type", "calculate") == "scale":
+                g = self._renamed(batch.filter(m1), rule["name"])
+                cols = dict(g.columns)
+                cols["value"] = (g.col("value")
+                                 * float(rule.get("scale_by", 1.0)))
+                generated.append(replace(g, columns=cols))
+                continue
+            m2 = np.array([nm == rule["metric2"] for nm in names])
+            if not m2.any():
+                continue  # upstream: no pair metric -> rule is a no-op
+            # align by (resource, sorted point attrs)
+            rhs: dict[tuple, float] = {}
+            ridx = batch.col("resource_index")
+            vals = batch.col("value")
+            for i in np.nonzero(m2)[0]:
+                key = (int(ridx[i]), tuple(sorted(
+                    (k, str(v))
+                    for k, v in batch.point_attrs[int(i)].items())))
+                rhs[key] = float(vals[i])
+            keep_rows, rhs_vals = [], []
+            for i in np.nonzero(m1)[0]:
+                key = (int(ridx[i]), tuple(sorted(
+                    (k, str(v))
+                    for k, v in batch.point_attrs[int(i)].items())))
+                if key in rhs:
+                    keep_rows.append(int(i))
+                    rhs_vals.append(rhs[key])
+            if not keep_rows:
+                continue
+            g = self._renamed(batch.take(np.array(keep_rows)),
+                              rule["name"])
+            cols = dict(g.columns)
+            op = _OPS[rule.get("operation", "divide")]
+            cols["value"] = op(g.col("value").astype(np.float64),
+                               np.array(rhs_vals, dtype=np.float64))
+            generated.append(replace(g, columns=cols))
+        if not generated:
+            return batch
+        return compact_resources(concat_metric_batches([batch,
+                                                        *generated]))
+
+    @staticmethod
+    def _renamed(b: MetricBatch, new_name: str) -> MetricBatch:
+        from .ottl import MetricContext, Path
+
+        ctx = MetricContext(b)
+        ctx.set_values(Path(("name",)),
+                       np.full(len(b), new_name, dtype=object),
+                       np.ones(len(b), dtype=bool))
+        return ctx.result()
+
+
+register(Factory(
+    type_name="metricsgeneration",
+    kind=ComponentKind.PROCESSOR,
+    create=MetricsGenerationProcessor,
+    default_config=lambda: {"rules": []},
+))

@@ -1,0 +1,185 @@
+"""``metricstransform`` processor — rename/relabel/aggregate metrics.
+
+Upstream's metricstransformprocessor (collector/builder-config.yaml:76).
+The supported surface (the operations users actually put in Processor
+CRs)::
+
+    metricstransform:
+      transforms:
+        - include: system.cpu.usage       # exact, or regexp w/ match_type
+          match_type: strict              # strict | regexp
+          action: update                  # update | insert
+          new_name: system.cpu.usage_time
+          operations:
+            - action: add_label
+              new_label: plane
+              new_value: data
+            - action: update_label
+              label: cpu
+              new_label: core
+            - action: delete_label_value
+              label: state
+              label_value: idle           # drops matching points
+            - action: aggregate_labels
+              label_set: [state]          # labels to KEEP
+              aggregation_type: sum       # sum | mean | max | min
+
+``action: insert`` copies the matched points first (new name applies to
+the copy), ``update`` edits in place — upstream semantics.  Aggregation
+merges points whose kept-label values coincide, combining values with
+the chosen reducer; timestamps take the max.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import replace
+from typing import Any
+
+import numpy as np
+
+from ...pdata.metrics import (MetricBatch, compact_resources,
+                              concat_metric_batches)
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+_AGGS = {"sum": np.sum, "mean": np.mean, "max": np.max, "min": np.min}
+
+
+class MetricsTransformProcessor(Processor):
+    """See module docstring."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.transforms = []
+        for t in config.get("transforms") or []:
+            include = t.get("include")
+            if not include:
+                raise ValueError("metricstransform transform needs include")
+            match_type = t.get("match_type", "strict")
+            if match_type not in ("strict", "regexp"):
+                raise ValueError(f"bad match_type {match_type!r}")
+            action = t.get("action", "update")
+            if action not in ("update", "insert"):
+                raise ValueError(f"bad transform action {action!r}")
+            ops = list(t.get("operations") or [])
+            for op in ops:
+                kind = op.get("action")
+                if kind not in ("add_label", "update_label",
+                                "delete_label_value", "aggregate_labels"):
+                    raise ValueError(f"bad operation action {kind!r}")
+                # required keys checked NOW: a malformed operation must
+                # reject the config, not crash the first batch through
+                required = {"add_label": ("new_label", "new_value"),
+                            "update_label": ("label", "new_label"),
+                            "delete_label_value": ("label", "label_value"),
+                            "aggregate_labels": ("label_set",)}[kind]
+                missing = [k for k in required if op.get(k) is None]
+                if missing:
+                    raise ValueError(
+                        f"operation {kind} missing {missing}")
+                if kind == "aggregate_labels" and \
+                        op.get("aggregation_type", "sum") not in _AGGS:
+                    raise ValueError(
+                        f"bad aggregation_type "
+                        f"{op.get('aggregation_type')!r}")
+            self.transforms.append({
+                "match": (re.compile(include).search
+                          if match_type == "regexp"
+                          else lambda s, _inc=include: s == _inc),
+                "action": action,
+                "new_name": t.get("new_name"),
+                "operations": ops,
+            })
+
+    def process(self, batch: Any) -> Any:
+        if not isinstance(batch, MetricBatch) or not len(batch):
+            return batch
+        reassembled = False
+        for t in self.transforms:
+            names = batch.metric_names()
+            mask = np.array([bool(t["match"](nm)) for nm in names])
+            if not mask.any():
+                continue
+            if t["action"] == "insert":
+                copy = batch.filter(mask)
+                copy = self._apply_ops(copy, t)
+                batch = concat_metric_batches([batch, copy])
+            else:
+                hit = self._apply_ops(batch.filter(mask), t)
+                rest = batch.filter(~mask)
+                batch = concat_metric_batches([rest, hit])
+            reassembled = True
+        # filter+concat reassembly duplicates the resources tuple per
+        # transform; compact once at the end
+        return compact_resources(batch) if reassembled else batch
+
+    def _apply_ops(self, b: MetricBatch, t: dict) -> MetricBatch:
+        if t["new_name"]:
+            from .ottl import MetricContext, Path
+
+            ctx = MetricContext(b)
+            ctx.set_values(Path(("name",)),
+                           np.full(len(b), t["new_name"], dtype=object),
+                           np.ones(len(b), dtype=bool))
+            b = ctx.result()
+        for op in t["operations"]:
+            kind = op["action"]
+            if kind == "add_label":
+                attrs = tuple(
+                    {**d, str(op["new_label"]): str(op.get("new_value"))}
+                    for d in b.point_attrs)
+                b = replace(b, point_attrs=attrs)
+            elif kind == "update_label":
+                old, new = str(op["label"]), str(op["new_label"])
+                attrs = tuple(
+                    {(new if k == old else k): v for k, v in d.items()}
+                    for d in b.point_attrs)
+                b = replace(b, point_attrs=attrs)
+            elif kind == "delete_label_value":
+                lab, val = str(op["label"]), str(op["label_value"])
+                keep = np.array([str(d.get(lab)) != val
+                                 for d in b.point_attrs])
+                b = b.filter(keep)
+            elif kind == "aggregate_labels":
+                b = self._aggregate(b, [str(k) for k in
+                                        (op.get("label_set") or [])],
+                                    _AGGS[op.get("aggregation_type",
+                                                 "sum")])
+        return b
+
+    def _aggregate(self, b: MetricBatch, label_set: list[str],
+                   agg) -> MetricBatch:
+        if not len(b):
+            return b
+        names = b.metric_names()
+        ridx = b.col("resource_index")
+        groups: dict[tuple, list[int]] = {}
+        for i in range(len(b)):
+            kept = tuple(sorted(
+                (k, str(v)) for k, v in b.point_attrs[i].items()
+                if k in label_set))
+            groups.setdefault((names[i], int(ridx[i]), kept),
+                              []).append(i)
+        values = b.col("value")
+        times = b.col("time_unix_nano")
+        reps, new_vals, new_times, new_attrs = [], [], [], []
+        for (nm, ri, kept), idxs in groups.items():
+            reps.append(idxs[0])
+            new_vals.append(float(agg(values[idxs])))
+            new_times.append(int(times[idxs].max()))
+            new_attrs.append(dict(kept))
+        out = b.take(np.array(reps))
+        cols = dict(out.columns)
+        cols["value"] = np.array(new_vals, dtype=np.float64)
+        cols["time_unix_nano"] = np.array(new_times, dtype=np.uint64)
+        return replace(out, columns=cols, point_attrs=tuple(new_attrs))
+
+
+register(Factory(
+    type_name="metricstransform",
+    kind=ComponentKind.PROCESSOR,
+    create=MetricsTransformProcessor,
+    default_config=lambda: {"transforms": []},
+))

@@ -1,0 +1,845 @@
+"""Scoped OTTL-analog expression language for the ``transform`` processor.
+
+The reference distro compiles the upstream ``transformprocessor``
+(collector/builder-config.yaml:84), whose statements are OTTL — the
+OpenTelemetry Transformation Language (``set(attributes["env"], "prod")
+where name == "GET /api"``).  This module is a from-scratch, scoped
+re-design of that surface for our columnar batches, NOT a port of the
+Go ottl package: statements are parsed once at build time into an AST,
+and conditions evaluate **vectorized over the whole batch** — a
+where-clause produces one numpy boolean mask per batch (string-table
+columns compare as arrays; attribute lookups materialize one object
+array per path), and edit functions apply under that mask.  Attribute
+dicts live on host-side side lists by design (pdata/spans.py), so none
+of this ever touches the device hot path.
+
+Grammar (recursive descent, no dependencies)::
+
+    statement  := call ("where" expr)?
+    call       := IDENT "(" (arg ("," arg)*)? ")"
+    arg        := expr | "[" (expr ("," expr)*)? "]"
+    expr       := and_expr ("or" and_expr)*
+    and_expr   := not_expr ("and" not_expr)*
+    not_expr   := "not" not_expr | comparison
+    comparison := operand (CMP operand)?          CMP: == != < <= > >=
+    operand    := literal | call | path | "(" expr ")"
+    path       := IDENT ("." IDENT)* ("[" STRING "]")?
+    literal    := STRING | NUMBER | true | false | nil
+
+Paths by context (the subset the docs promise):
+
+* span:    ``name``, ``kind``, ``status_code``/``status.code``,
+           ``service``, ``duration_ms`` (read-only),
+           ``attributes["k"]``, ``resource.attributes["k"]``
+* metric:  ``metric.name``/``name``, ``value``, ``attributes["k"]``,
+           ``resource.attributes["k"]``
+* log:     ``body``, ``severity``, ``attributes["k"]``,
+           ``resource.attributes["k"]``
+* resource context: ``attributes["k"]``
+
+Edit functions: ``set(path, value)``, ``delete_key(attributes, "k")``,
+``delete_matching_keys(attributes, regex)``, ``keep_keys(attributes,
+["a", "b"])``, ``truncate_all(attributes, limit)``,
+``replace_pattern(path, regex, replacement)``,
+``replace_all_patterns(attributes, "value"|"key", regex, replacement)``.
+Condition functions: ``IsMatch(expr, regex)``, ``Concat([...], sep)``.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class OttlError(ValueError):
+    """Parse or bind failure — raised at processor BUILD time so a bad
+    statement rejects the config, never a running pipeline."""
+
+
+# ------------------------------------------------------------- tokenizer
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<string>"(?:[^"\\]|\\.)*")
+    | (?P<number>-?\d+(?:\.\d+)?)
+    | (?P<op>==|!=|<=|>=|<|>|\(|\)|\[|\]|,)
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*)
+    )""", re.VERBOSE)
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            if src[pos:].strip() == "":
+                break
+            raise OttlError(f"bad token at {src[pos:pos + 20]!r}")
+        pos = m.end()
+        kind = m.lastgroup or ""
+        out.append((kind, m.group(kind)))
+    out.append(("eof", ""))
+    return out
+
+
+# ------------------------------------------------------------------- AST
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Any
+
+
+@dataclass(frozen=True)
+class Path:
+    parts: tuple[str, ...]          # e.g. ("resource", "attributes")
+    key: Optional[str] = None       # the ["k"] index, if any
+
+
+@dataclass(frozen=True)
+class Call:
+    name: str
+    args: tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class ListExpr:
+    items: tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class BinOp:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class Not:
+    expr: Any
+
+
+@dataclass(frozen=True)
+class Statement:
+    call: Call
+    where: Optional[Any]
+    source: str
+
+
+class _Parser:
+    def __init__(self, src: str):
+        self.src = src
+        self.toks = _tokenize(src)
+        self.i = 0
+
+    def peek(self) -> tuple[str, str]:
+        return self.toks[self.i]
+
+    def next(self) -> tuple[str, str]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, value: str) -> None:
+        kind, v = self.next()
+        if v != value:
+            raise OttlError(f"expected {value!r}, got {v!r} in {self.src!r}")
+
+    def parse_statement(self) -> Statement:
+        call = self.parse_operand()
+        if not isinstance(call, Call):
+            raise OttlError(f"statement must be a function call: {self.src!r}")
+        where = None
+        kind, v = self.peek()
+        if kind == "ident" and v == "where":
+            self.next()
+            where = self.parse_expr()
+        kind, v = self.peek()
+        if kind != "eof":
+            raise OttlError(f"trailing input {v!r} in {self.src!r}")
+        return Statement(call=call, where=where, source=self.src)
+
+    def parse_expr(self) -> Any:
+        left = self.parse_and()
+        while self.peek() == ("ident", "or"):
+            self.next()
+            left = BinOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Any:
+        left = self.parse_not()
+        while self.peek() == ("ident", "and"):
+            self.next()
+            left = BinOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Any:
+        if self.peek() == ("ident", "not"):
+            self.next()
+            return Not(self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Any:
+        left = self.parse_operand()
+        kind, v = self.peek()
+        if kind == "op" and v in ("==", "!=", "<", "<=", ">", ">="):
+            self.next()
+            return BinOp(v, left, self.parse_operand())
+        return left
+
+    def parse_operand(self) -> Any:
+        kind, v = self.next()
+        if kind == "string":
+            return Literal(_unquote(v))
+        if kind == "number":
+            return Literal(float(v) if "." in v else int(v))
+        if kind == "op" and v == "(":
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        if kind == "op" and v == "[":
+            items = []
+            if self.peek() != ("op", "]"):
+                items.append(self.parse_expr())
+                while self.peek() == ("op", ","):
+                    self.next()
+                    items.append(self.parse_expr())
+            self.expect("]")
+            return ListExpr(tuple(items))
+        if kind == "ident":
+            if v == "true":
+                return Literal(True)
+            if v == "false":
+                return Literal(False)
+            if v == "nil":
+                return Literal(None)
+            # call?
+            if self.peek() == ("op", "("):
+                self.next()
+                args = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.parse_arg())
+                    while self.peek() == ("op", ","):
+                        self.next()
+                        args.append(self.parse_arg())
+                self.expect(")")
+                return Call(v, tuple(args))
+            # path, possibly with ["key"] index
+            parts = tuple(v.split("."))
+            key = None
+            if self.peek() == ("op", "["):
+                self.next()
+                k_kind, k_v = self.next()
+                if k_kind != "string":
+                    raise OttlError(
+                        f"path index must be a string literal: {self.src!r}")
+                key = _unquote(k_v)
+                self.expect("]")
+            return Path(parts, key)
+        raise OttlError(f"unexpected {v!r} in {self.src!r}")
+
+    def parse_arg(self) -> Any:
+        return self.parse_expr()
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return body.replace('\\"', '"').replace("\\\\", "\\")
+
+
+def parse_statement(src: str) -> Statement:
+    return _Parser(src).parse_statement()
+
+
+# ----------------------------------------------------- context adapters
+#
+# A context presents one batch scope as:
+#   values(path)  -> np.ndarray (len(batch),) of per-row values
+#   attr_dicts(path, mask) -> the MUTABLE dicts the mask touches (CoW)
+#   set_values(path, per_row_values, mask)
+# and finishes with .result() -> rebuilt batch.  All keyed-attribute
+# machinery, the resource fan-out, and the string-table re-intern are
+# shared in _BaseContext; subclasses declare their scalar fields.
+
+_ATTR_PATHS = (("attributes",), ("resource", "attributes"))
+
+
+def _reintern(strings: tuple, names: Sequence[str]) -> tuple[tuple,
+                                                             np.ndarray]:
+    """Re-intern edited names into a fresh string table; one pass."""
+    table = list(strings)
+    intern = {s: i for i, s in enumerate(table)}
+    idx = np.empty(len(names), dtype=np.int32)
+    for i, nm in enumerate(names):
+        j = intern.get(nm)
+        if j is None:
+            j = len(table)
+            table.append(nm)
+            intern[nm] = j
+        idx[i] = j
+    return tuple(table), idx
+
+
+class _BaseContext:
+    # subclass contract
+    SCOPE = ""                 # for error messages
+    ATTR_FIELD = ""            # batch field holding per-row attr dicts
+    READABLE: frozenset = frozenset()   # read-only scalar paths
+    SETTABLE: frozenset = frozenset()   # read+write scalar paths
+
+    def __init__(self, batch):
+        self.batch = batch
+        self._attrs: Optional[list[dict]] = None
+        self._resources: Optional[list[dict]] = None
+        self._cols: Optional[dict[str, np.ndarray]] = None
+
+    # ---- build-time validation (no batch needed)
+    @classmethod
+    def check_path(cls, path: Path, settable: bool) -> None:
+        if path.key is not None:
+            if path.parts in _ATTR_PATHS:
+                return
+            raise OttlError(
+                f"unknown attributes path {'.'.join(path.parts)} "
+                f"in {cls.SCOPE} context")
+        if path.parts in cls.SETTABLE:
+            return
+        if not settable and path.parts in cls.READABLE:
+            return
+        verb = "settable" if settable else "known"
+        raise OttlError(f"{cls.SCOPE} path {'.'.join(path.parts)} "
+                        f"is not {verb}")
+
+    def _col(self, name: str) -> np.ndarray:
+        """Read a column honoring edits staged earlier in this SAME
+        statement group — a later where-clause must see an earlier
+        set()'s result (upstream OTTL sequencing)."""
+        if self._cols is not None and name in self._cols:
+            return self._cols[name]
+        return self.batch.col(name)
+
+    # ---- shared keyed-attribute machinery
+    def _attr_view(self, path: Path) -> list[dict]:
+        if path.parts[:1] == ("resource",):
+            if self._resources is None:
+                self._resources = [dict(r) for r in self.batch.resources]
+            return self._resources
+        if path.parts == ("attributes",):
+            if self._attrs is None:
+                self._attrs = [dict(d) for d in
+                               getattr(self.batch, self.ATTR_FIELD)]
+            return self._attrs
+        raise OttlError(
+            f"unknown attributes path {'.'.join(path.parts)}")
+
+    def attr_dicts(self, path: Path, mask: np.ndarray) -> list[dict]:
+        dicts = self._attr_view(path)
+        if path.parts[:1] == ("resource",):
+            ridx = self.batch.col("resource_index")
+            seen = {int(i) for i in np.unique(ridx[mask])}
+            return [dicts[i] for i in sorted(seen)]
+        return [d for d, m in zip(dicts, mask) if m]
+
+    def values(self, path: Path) -> np.ndarray:
+        if path.key is not None:
+            dicts = self._attr_view(path)
+            if path.parts[:1] == ("resource",):
+                ridx = self.batch.col("resource_index")
+                return np.array(
+                    [dicts[int(i)].get(path.key) for i in ridx],
+                    dtype=object)
+            return np.array([d.get(path.key) for d in dicts],
+                            dtype=object)
+        self.check_path(path, settable=False)
+        return self._field_values(path.parts)
+
+    def set_values(self, path: Path, vals: Sequence[Any],
+                   mask: np.ndarray) -> None:
+        if path.key is not None:
+            dicts = self._attr_view(path)
+            if path.parts[:1] == ("resource",):
+                ridx = self.batch.col("resource_index")
+                for i in np.nonzero(mask)[0]:
+                    dicts[int(ridx[i])][path.key] = vals[i]
+            else:
+                for i in np.nonzero(mask)[0]:
+                    dicts[int(i)][path.key] = vals[i]
+            return
+        self.check_path(path, settable=True)
+        self._field_set(path.parts, vals, mask)
+
+    def _set_numeric_col(self, col: str, vals: Sequence[Any],
+                         mask: np.ndarray, cast) -> None:
+        if self._cols is None:
+            self._cols = dict(self.batch.columns)
+        arr = self._cols[col].copy()
+        arr[mask] = [cast(v) for v in np.asarray(vals)[mask]]
+        self._cols[col] = arr
+
+    def result(self):
+        from dataclasses import replace
+
+        out = self._finalize(self.batch)
+        fields = {}
+        if self._cols is not None:
+            fields["columns"] = self._cols
+        if self._attrs is not None:
+            fields[self.ATTR_FIELD] = tuple(self._attrs)
+        if self._resources is not None:
+            fields["resources"] = tuple(self._resources)
+        return replace(out, **fields) if fields else out
+
+    # ---- subclass hooks
+    def _field_values(self, parts: tuple[str, ...]) -> np.ndarray:
+        raise OttlError(f"unknown {self.SCOPE} path {'.'.join(parts)}")
+
+    def _field_set(self, parts: tuple[str, ...], vals, mask) -> None:
+        raise OttlError(
+            f"{self.SCOPE} path {'.'.join(parts)} is not settable")
+
+    def _finalize(self, batch):
+        """Fold subclass lazy state (edited names/bodies) into the batch
+        BEFORE the shared field replacement; must merge into self._cols
+        when it touches columns."""
+        return batch
+
+
+class SpanContext(_BaseContext):
+    """span / resource scope over a SpanBatch."""
+
+    SCOPE = "span"
+    ATTR_FIELD = "span_attrs"
+    READABLE = frozenset({("service",), ("duration_ms",)})
+    SETTABLE = frozenset({("name",), ("status_code",),
+                          ("status", "code"), ("kind",)})
+
+    def __init__(self, batch):
+        super().__init__(batch)
+        self._names: Optional[list[str]] = None
+
+    def _field_values(self, p: tuple[str, ...]) -> np.ndarray:
+        b = self.batch
+        if p == ("name",):
+            names = (self._names if self._names is not None
+                     else b.span_names())
+            return np.array(names, dtype=object)
+        if p == ("service",):
+            return np.array(b.service_names(), dtype=object)
+        if p in (("status_code",), ("status", "code")):
+            return self._col("status_code").astype(np.int64)
+        if p == ("kind",):
+            return self._col("kind").astype(np.int64)
+        if p == ("duration_ms",):
+            return b.duration_ns / 1e6
+        return super()._field_values(p)
+
+    def _field_set(self, p: tuple[str, ...], vals, mask) -> None:
+        if p == ("name",):
+            if self._names is None:
+                self._names = self.batch.span_names()
+            for i in np.nonzero(mask)[0]:
+                self._names[int(i)] = str(vals[i])
+            return
+        col = "kind" if p == ("kind",) else "status_code"
+        self._set_numeric_col(col, vals, mask, int)
+
+    def _finalize(self, batch):
+        from dataclasses import replace
+
+        if self._names is None:
+            return batch
+        strings, idx = _reintern(batch.strings, self._names)
+        if self._cols is None:
+            self._cols = dict(batch.columns)
+        self._cols["name"] = idx
+        return replace(batch, strings=strings)
+
+
+class MetricContext(_BaseContext):
+    """metric / datapoint / resource scope over a MetricBatch."""
+
+    SCOPE = "metric"
+    ATTR_FIELD = "point_attrs"
+    READABLE = frozenset()
+    SETTABLE = frozenset({("name",), ("metric", "name"), ("value",)})
+
+    def __init__(self, batch):
+        super().__init__(batch)
+        self._names: Optional[list[str]] = None
+
+    def _field_values(self, p: tuple[str, ...]) -> np.ndarray:
+        b = self.batch
+        if p in (("name",), ("metric", "name")):
+            names = (self._names if self._names is not None
+                     else b.metric_names())
+            return np.array(names, dtype=object)
+        if p == ("value",):
+            return self._col("value").astype(np.float64)
+        return super()._field_values(p)
+
+    def _field_set(self, p: tuple[str, ...], vals, mask) -> None:
+        if p in (("name",), ("metric", "name")):
+            if self._names is None:
+                self._names = self.batch.metric_names()
+            for i in np.nonzero(mask)[0]:
+                self._names[int(i)] = str(vals[i])
+            return
+        self._set_numeric_col("value", vals, mask, float)
+
+    def _finalize(self, batch):
+        from dataclasses import replace
+
+        if self._names is None:
+            return batch
+        strings, idx = _reintern(batch.strings, self._names)
+        if self._cols is None:
+            self._cols = dict(batch.columns)
+        self._cols["name"] = idx
+        return replace(batch, strings=strings)
+
+
+class LogContext(_BaseContext):
+    """log / resource scope over a LogBatch."""
+
+    SCOPE = "log"
+    ATTR_FIELD = "record_attrs"
+    READABLE = frozenset()
+    SETTABLE = frozenset({("body",), ("severity",)})
+
+    def __init__(self, batch):
+        super().__init__(batch)
+        self._bodies: Optional[list[str]] = None
+
+    def _field_values(self, p: tuple[str, ...]) -> np.ndarray:
+        b = self.batch
+        if p == ("body",):
+            bodies = (self._bodies if self._bodies is not None
+                      else list(b.bodies))
+            return np.array(bodies, dtype=object)
+        if p == ("severity",):
+            return self._col("severity").astype(np.int64)
+        return super()._field_values(p)
+
+    def _field_set(self, p: tuple[str, ...], vals, mask) -> None:
+        if p == ("body",):
+            if self._bodies is None:
+                self._bodies = list(self.batch.bodies)
+            for i in np.nonzero(mask)[0]:
+                self._bodies[int(i)] = str(vals[i])
+            return
+        self._set_numeric_col("severity", vals, mask, int)
+
+    def _finalize(self, batch):
+        from dataclasses import replace
+
+        if self._bodies is None:
+            return batch
+        return replace(batch, bodies=tuple(self._bodies))
+
+
+# ----------------------------------------------------------- evaluation
+
+
+def _eval(node: Any, ctx, n: int) -> Any:
+    """Evaluate an expression to a scalar or a length-n numpy array."""
+    if isinstance(node, Literal):
+        return node.value
+    if isinstance(node, Path):
+        return ctx.values(node)
+    if isinstance(node, ListExpr):
+        return [_eval(it, ctx, n) for it in node.items]
+    if isinstance(node, Not):
+        return ~_as_mask(_eval(node.expr, ctx, n), n)
+    if isinstance(node, BinOp):
+        if node.op == "and":
+            return (_as_mask(_eval(node.left, ctx, n), n)
+                    & _as_mask(_eval(node.right, ctx, n), n))
+        if node.op == "or":
+            return (_as_mask(_eval(node.left, ctx, n), n)
+                    | _as_mask(_eval(node.right, ctx, n), n))
+        left = _eval(node.left, ctx, n)
+        right = _eval(node.right, ctx, n)
+        return _compare(node.op, left, right, n)
+    if isinstance(node, Call):
+        return _eval_condition_call(node, ctx, n)
+    raise OttlError(f"cannot evaluate {node!r}")
+
+
+def _as_mask(v: Any, n: int) -> np.ndarray:
+    if isinstance(v, np.ndarray) and v.dtype == bool:
+        return v
+    if isinstance(v, (bool, np.bool_)):
+        return np.full(n, bool(v))
+    raise OttlError(f"expected a boolean condition, got {type(v).__name__}")
+
+
+def _compare(op: str, left: Any, right: Any, n: int) -> np.ndarray:
+    lv = left if isinstance(left, np.ndarray) else np.full(n, left,
+                                                           dtype=object)
+    rv = right if isinstance(right, np.ndarray) else right
+    if op in ("==", "!="):
+        with np.errstate(invalid="ignore"):
+            eq = lv == rv
+        eq = np.asarray(eq, dtype=bool)
+        return eq if op == "==" else ~eq
+    # ordering: numeric comparison; None rows are always False
+    lf = _to_float(lv, n)
+    rf = _to_float(rv if isinstance(rv, np.ndarray) else np.full(n, rv), n)
+    with np.errstate(invalid="ignore"):
+        if op == "<":
+            return np.asarray(lf < rf, dtype=bool)
+        if op == "<=":
+            return np.asarray(lf <= rf, dtype=bool)
+        if op == ">":
+            return np.asarray(lf > rf, dtype=bool)
+        if op == ">=":
+            return np.asarray(lf >= rf, dtype=bool)
+    raise OttlError(f"unknown comparison {op}")
+
+
+def _to_float(arr: np.ndarray, n: int) -> np.ndarray:
+    if arr.dtype != object:
+        return arr.astype(np.float64)
+    out = np.full(n, np.nan)
+    for i, v in enumerate(arr):
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[i] = float(v)
+        elif isinstance(v, str):
+            try:
+                out[i] = float(v)
+            except ValueError:
+                pass
+    return out
+
+
+def _eval_condition_call(call: Call, ctx, n: int) -> Any:
+    if call.name == "IsMatch":
+        if len(call.args) != 2 or not isinstance(call.args[1], Literal):
+            raise OttlError("IsMatch(expr, \"regex\")")
+        pat = re.compile(str(call.args[1].value))
+        vals = _eval(call.args[0], ctx, n)
+        if not isinstance(vals, np.ndarray):
+            vals = np.full(n, vals, dtype=object)
+        return np.array([v is not None and bool(pat.search(str(v)))
+                         for v in vals], dtype=bool)
+    if call.name == "Concat":
+        if len(call.args) != 2:
+            raise OttlError("Concat([exprs...], sep)")
+        sep = _eval(call.args[1], ctx, n)
+        items = _eval(call.args[0], ctx, n)
+        if not isinstance(items, list):
+            raise OttlError("Concat first arg must be a list")
+        cols = [v if isinstance(v, np.ndarray) else np.full(n, v,
+                                                            dtype=object)
+                for v in items]
+        return np.array(
+            [str(sep).join("" if c[i] is None else str(c[i])
+                           for c in cols) for i in range(n)], dtype=object)
+    raise OttlError(f"unknown function {call.name!r} in expression")
+
+
+# ------------------------------------------------------ edit functions
+
+
+def _run_edit(call: Call, ctx, mask: np.ndarray, n: int) -> None:
+    name = call.name
+    if name == "set":
+        if len(call.args) != 2 or not isinstance(call.args[0], Path):
+            raise OttlError("set(path, value)")
+        vals = _eval(call.args[1], ctx, n)
+        if not isinstance(vals, np.ndarray):
+            vals = np.full(n, vals, dtype=object)
+        ctx.set_values(call.args[0], vals, mask)
+        return
+    if name == "delete_key":
+        path, key = _attr_and_literal(call, "delete_key")
+        for d in ctx.attr_dicts(path, mask):
+            d.pop(str(key), None)
+        return
+    if name == "delete_matching_keys":
+        path, pat = _attr_and_literal(call, "delete_matching_keys")
+        rx = re.compile(str(pat))
+        for d in ctx.attr_dicts(path, mask):
+            for k in [k for k in d if rx.search(k)]:
+                del d[k]
+        return
+    if name == "keep_keys":
+        if (len(call.args) != 2 or not isinstance(call.args[0], Path)
+                or not isinstance(call.args[1], ListExpr)):
+            raise OttlError('keep_keys(attributes, ["a", "b"])')
+        keep = {str(it.value) for it in call.args[1].items
+                if isinstance(it, Literal)}
+        for d in ctx.attr_dicts(call.args[0], mask):
+            for k in [k for k in d if k not in keep]:
+                del d[k]
+        return
+    if name == "truncate_all":
+        path, limit = _attr_and_literal(call, "truncate_all")
+        lim = int(limit)
+        for d in ctx.attr_dicts(path, mask):
+            for k, v in d.items():
+                if isinstance(v, str) and len(v) > lim:
+                    d[k] = v[:lim]
+        return
+    if name == "replace_pattern":
+        if (len(call.args) != 3 or not isinstance(call.args[0], Path)
+                or not isinstance(call.args[1], Literal)
+                or not isinstance(call.args[2], Literal)):
+            raise OttlError('replace_pattern(path, "regex", "replacement")')
+        rx = re.compile(str(call.args[1].value))
+        repl = str(call.args[2].value)
+        path = call.args[0]
+        vals = ctx.values(path)
+        out = np.array([rx.sub(repl, str(v)) if isinstance(v, str) else v
+                        for v in vals], dtype=object)
+        ctx.set_values(path, out, mask & np.array(
+            [isinstance(v, str) for v in vals]))
+        return
+    if name == "replace_all_patterns":
+        if (len(call.args) != 4 or not isinstance(call.args[0], Path)
+                or not all(isinstance(a, Literal) for a in call.args[1:])):
+            raise OttlError('replace_all_patterns(attributes, "value"|"key",'
+                            ' "regex", "replacement")')
+        mode = str(call.args[1].value)
+        rx = re.compile(str(call.args[2].value))
+        repl = str(call.args[3].value)
+        for d in ctx.attr_dicts(call.args[0], mask):
+            if mode == "key":
+                for k in list(d):
+                    nk = rx.sub(repl, k)
+                    if nk != k:
+                        d[nk] = d.pop(k)
+            else:
+                for k, v in d.items():
+                    if isinstance(v, str):
+                        d[k] = rx.sub(repl, v)
+        return
+    raise OttlError(f"unknown edit function {name!r}")
+
+
+def _attr_and_literal(call: Call, fname: str) -> tuple[Path, Any]:
+    if (len(call.args) != 2 or not isinstance(call.args[0], Path)
+            or not isinstance(call.args[1], Literal)):
+        raise OttlError(f"{fname}(attributes, literal)")
+    return call.args[0], call.args[1].value
+
+
+# --------------------------------------------------------------- binder
+
+_EDIT_FUNCTIONS = {
+    "set", "delete_key", "delete_matching_keys", "keep_keys",
+    "truncate_all", "replace_pattern", "replace_all_patterns",
+}
+
+
+def rebase_resource(node: Any) -> Any:
+    """Rewrite bare ``attributes[...]`` paths to ``resource.attributes``:
+    in the upstream ``resource`` context, unqualified attributes ARE the
+    resource's (ottl contexts doc semantics)."""
+    if isinstance(node, Statement):
+        return Statement(call=rebase_resource(node.call),
+                         where=(rebase_resource(node.where)
+                                if node.where is not None else None),
+                         source=node.source)
+    if isinstance(node, Call):
+        return Call(node.name,
+                    tuple(rebase_resource(a) for a in node.args))
+    if isinstance(node, ListExpr):
+        return ListExpr(tuple(rebase_resource(a) for a in node.items))
+    if isinstance(node, BinOp):
+        return BinOp(node.op, rebase_resource(node.left),
+                     rebase_resource(node.right))
+    if isinstance(node, Not):
+        return Not(rebase_resource(node.expr))
+    if isinstance(node, Path) and node.parts == ("attributes",):
+        return Path(("resource", "attributes"), node.key)
+    return node
+
+
+def compile_statements(
+        sources: Sequence[str]) -> list[Statement]:
+    """Parse + validate at build time; raises OttlError on any problem so
+    a bad Processor CR rejects its config instead of crashing a running
+    pipeline."""
+    stmts = []
+    for src in sources:
+        st = parse_statement(src)
+        if st.call.name not in _EDIT_FUNCTIONS:
+            raise OttlError(
+                f"{st.call.name!r} is not an edit function: {src!r}")
+        stmts.append(st)
+    return stmts
+
+
+def _walk_paths(node: Any, fn) -> None:
+    if isinstance(node, Path):
+        fn(node)
+    elif isinstance(node, Call):
+        for a in node.args:
+            _walk_paths(a, fn)
+    elif isinstance(node, ListExpr):
+        for a in node.items:
+            _walk_paths(a, fn)
+    elif isinstance(node, BinOp):
+        _walk_paths(node.left, fn)
+        _walk_paths(node.right, fn)
+    elif isinstance(node, Not):
+        _walk_paths(node.expr, fn)
+
+
+def validate_statements(stmts: Sequence[Statement], ctx_cls) -> None:
+    """Bind every path against the context's tables at BUILD time: a
+    typo'd path (``set(nme, ...)``) must reject the config, not crash the
+    first batch through a running pipeline."""
+    attr_first = {"delete_key", "delete_matching_keys", "keep_keys",
+                  "truncate_all", "replace_all_patterns"}
+    for st in stmts:
+        try:
+            call = st.call
+            for k, arg in enumerate(call.args):
+                if k == 0 and isinstance(arg, Path):
+                    if call.name in attr_first:
+                        # whole-dict arg: attributes / resource.attributes
+                        if arg.parts not in _ATTR_PATHS or \
+                                arg.key is not None:
+                            raise OttlError(
+                                f"{call.name} needs an attributes path, "
+                                f"got {'.'.join(arg.parts)}")
+                        continue
+                    if call.name in ("set", "replace_pattern"):
+                        ctx_cls.check_path(arg, settable=True)
+                        continue
+                _walk_paths(arg, lambda p: ctx_cls.check_path(p, False))
+            if st.where is not None:
+                _walk_paths(
+                    st.where, lambda p: ctx_cls.check_path(p, False))
+        except OttlError as e:
+            raise OttlError(f"{e} (statement: {st.source!r})") from None
+
+
+def apply_statements(stmts: Sequence[Statement], ctx_cls,
+                     batch, error_mode: str = "ignore"):
+    """Run compiled statements over one batch; returns the edited batch."""
+    n = len(batch)
+    if n == 0:
+        return batch
+    ctx = ctx_cls(batch)
+    for st in stmts:
+        try:
+            mask = (_as_mask(_eval(st.where, ctx, n), n)
+                    if st.where is not None else np.ones(n, dtype=bool))
+            if not mask.any():
+                continue
+            _run_edit(st.call, ctx, mask, n)
+        except Exception:
+            # OttlError included: paths were bound at build time
+            # (validate_statements), so anything left is a per-batch
+            # data problem and error_mode governs it (upstream
+            # error_mode semantics)
+            if error_mode == "propagate":
+                raise
+            continue
+    return ctx.result()

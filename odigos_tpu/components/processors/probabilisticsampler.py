@@ -1,0 +1,91 @@
+"""``probabilisticsampler`` processor — consistent head sampling.
+
+Upstream's probabilisticsamplerprocessor (collector/builder-config.yaml:
+77): keep ``sampling_percentage`` of traces, decided by a hash of the
+trace id so every span of a trace (on every collector) gets the same
+verdict.  Our decision is fully vectorized: one splitmix64 finalizer
+over the trace-id columns (the same mixer the load balancer uses —
+loadbalancer hot-spot fix, commit 477e3a3 — because raw trace ids from
+SDKs are NOT uniformly distributed) produces a uniform u64 per span,
+and the batch filters on ``mixed < p * 2^64`` in one numpy op.
+
+Config::
+
+    probabilisticsampler:
+      sampling_percentage: 15.0   # 0..100; >=100 keeps everything
+      hash_seed: 0                # change to re-roll decisions fleet-wide
+
+Logs sample on trace id too when present; records without one (trace_id
+== 0) fall back to a per-record hash of (seed, row index) — the upstream
+attribute-source=record behavior.  Metrics pass through untouched
+(upstream does not register a metrics pipeline for it).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ...pdata.logs import LogBatch
+from ...pdata.spans import SpanBatch
+from ...utils.mix import splitmix64
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+class ProbabilisticSamplerProcessor(Processor):
+    """See module docstring."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        pct = float(config.get("sampling_percentage", 100.0))
+        if pct < 0:
+            raise ValueError("sampling_percentage must be >= 0")
+        self.fraction = min(pct / 100.0, 1.0)
+        self.seed = np.uint64(int(config.get("hash_seed", 0)))
+        # threshold in u64 space; the comparison is then one vector op
+        self.threshold = np.uint64(
+            min(int(self.fraction * float(2**64)), 2**64 - 1))
+        # traceless records hash a RUNNING counter, not the batch row
+        # position — position is constant across batches (one-record
+        # batches would be all-kept or all-dropped forever)
+        self._record_counter = 0
+
+    def _keep_mask(self, hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+        with np.errstate(over="ignore"):
+            mixed = splitmix64(hi ^ splitmix64(lo ^ self.seed))
+        return mixed < self.threshold
+
+    def process(self, batch: Any) -> Any:
+        if self.fraction >= 1.0:
+            return batch
+        if isinstance(batch, SpanBatch) and len(batch):
+            keep = self._keep_mask(batch.col("trace_id_hi"),
+                                   batch.col("trace_id_lo"))
+            return batch if keep.all() else batch.filter(keep)
+        if isinstance(batch, LogBatch) and len(batch):
+            hi = batch.col("trace_id_hi")
+            lo = batch.col("trace_id_lo")
+            keep = self._keep_mask(hi, lo)
+            # traceless records: hash (seed, row) so the keep-rate still
+            # holds (upstream attribute_source=record fallback)
+            traceless = (hi == 0) & (lo == 0)
+            if traceless.any():
+                idx = (np.arange(len(batch), dtype=np.uint64)
+                       + np.uint64(self._record_counter))
+                self._record_counter += len(batch)
+                with np.errstate(over="ignore"):
+                    alt = splitmix64(idx ^ self.seed) < self.threshold
+                keep = np.where(traceless, alt, keep)
+            return batch if keep.all() else batch.filter(keep)
+        return batch
+
+
+register(Factory(
+    type_name="probabilisticsampler",
+    kind=ComponentKind.PROCESSOR,
+    create=ProbabilisticSamplerProcessor,
+    default_config=lambda: {"sampling_percentage": 100.0},
+))

@@ -1,0 +1,112 @@
+"""``redaction`` processor — attribute allow-lists and value masking.
+
+Upstream's redactionprocessor (collector/builder-config.yaml:78): drop
+attributes not on an allow-list, mask attribute VALUES matching blocked
+patterns (credit cards, keys...), and summarize what was redacted.  The
+piimasking Action compiles to conditionalattributes (its own path);
+this is the user-created ``Processor`` CR of type ``redaction``.
+
+Config (upstream names)::
+
+    redaction:
+      allow_all_keys: true        # false => only allowed_keys survive
+      allowed_keys: [http.method]
+      ignored_keys: [safe.attr]   # never masked even if value matches
+      blocked_values:             # regexes masked out of string values
+        - "4[0-9]{12}(?:[0-9]{3})?"
+      summary: info               # info | debug | silent
+
+Applies to span attributes, log record attributes, and metric point
+attributes, plus each batch's resource attributes — dict side-lists,
+off the device path by design.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import replace
+from typing import Any
+
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+MASK = "****"
+
+REDACTED_COUNT_KEY = "redaction.masked.count"
+REDACTED_KEYS_KEY = "redaction.masked.keys"
+
+
+class RedactionProcessor(Processor):
+    """See module docstring."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.allow_all_keys = bool(config.get("allow_all_keys", True))
+        self.allowed = {str(k) for k in (config.get("allowed_keys") or [])}
+        self.ignored = {str(k) for k in (config.get("ignored_keys") or [])}
+        self.blocked = [re.compile(p)
+                        for p in (config.get("blocked_values") or [])]
+        summary = str(config.get("summary", "silent"))
+        if summary not in ("info", "debug", "silent"):
+            raise ValueError(
+                f"redaction summary must be info|debug|silent, "
+                f"got {summary!r}")
+        self.summary = summary
+
+    def _redact(self, d: dict[str, Any]) -> dict[str, Any] | None:
+        """Returns the redacted copy, or None when unchanged."""
+        deleted = [k for k in d
+                   if not self.allow_all_keys and k not in self.allowed
+                   and k not in self.ignored]
+        masked = []
+        for k, v in d.items():
+            if k in deleted or k in self.ignored:
+                continue
+            if isinstance(v, str) and any(rx.search(v)
+                                          for rx in self.blocked):
+                masked.append(k)
+        if not deleted and not masked:
+            return None
+        out = {k: v for k, v in d.items() if k not in deleted}
+        for k in masked:
+            out[k] = MASK
+        if self.summary in ("info", "debug") and masked:
+            out[REDACTED_COUNT_KEY] = len(masked)
+            if self.summary == "debug":
+                out[REDACTED_KEYS_KEY] = ",".join(sorted(masked))
+        return out
+
+    def _redact_list(self, dicts) -> tuple | None:
+        changed = False
+        out = []
+        for d in dicts:
+            r = self._redact(d)
+            if r is None:
+                out.append(d)
+            else:
+                out.append(r)
+                changed = True
+        return tuple(out) if changed else None
+
+    def process(self, batch: Any) -> Any:
+        if not len(batch):
+            return batch
+        fields = {}
+        for attr_field in ("span_attrs", "record_attrs", "point_attrs",
+                           "resources"):
+            dicts = getattr(batch, attr_field, None)
+            if dicts is None:
+                continue
+            redacted = self._redact_list(dicts)
+            if redacted is not None:
+                fields[attr_field] = redacted
+        return replace(batch, **fields) if fields else batch
+
+
+register(Factory(
+    type_name="redaction",
+    kind=ComponentKind.PROCESSOR,
+    create=RedactionProcessor,
+    default_config=lambda: {"allow_all_keys": True},
+))

@@ -1,0 +1,121 @@
+"""``remotetap`` processor — live peek at pipeline data over HTTP.
+
+Upstream's remotetapprocessor (collector/builder-config.yaml:85) is a
+pass-through that serves rate-limited copies of the data flowing by on a
+websocket.  Our analog serves NDJSON over plain HTTP (no websocket
+dependency in this image): the processor keeps a small bounded ring of
+recent sampled rows and ``GET /`` drains a snapshot of it — the
+operator's ``curl`` replaces the websocket client.  Sampling is
+rate-limited to ``limit`` rows/second so a tap on a hot pipeline costs
+amortized O(limit), never O(traffic).
+
+Config::
+
+    remotetap:
+      port: 0          # 0 = ephemeral (resolved port on .port after start)
+      limit: 1.0       # sampled rows per second
+      buffer: 256      # ring capacity
+
+The data plane is never blocked: process() appends to the ring under a
+lock and returns the batch unchanged (mutates_data=False).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from ...pdata.logs import LogBatch
+from ...pdata.metrics import MetricBatch
+from ...pdata.spans import SpanBatch
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+
+
+class RemoteTapProcessor(Processor):
+    """See module docstring."""
+
+    capabilities = Capabilities(mutates_data=False)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.limit = float(config.get("limit", 1.0))
+        self.ring: deque = deque(maxlen=int(config.get("buffer", 256)))
+        self._lock = threading.Lock()
+        self._next_sample = 0.0
+        self._want_port = int(config.get("port", 0))
+        self.port: Optional[int] = None
+        self._http: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        ring, lock = self.ring, self._lock
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802
+                with lock:
+                    rows = list(ring)
+                    ring.clear()  # a poll DRAINS: no duplicate rows
+                body = ("\n".join(json.dumps(r, default=str)
+                                  for r in rows) + "\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a) -> None:  # quiet
+                pass
+
+        self._http = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self._want_port), Handler)
+        self.port = self._http.server_address[1]
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, name=f"remotetap-{self.name}",
+            daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+
+    # --------------------------------------------------------- data plane
+    def process(self, batch: Any) -> Any:
+        now = time.monotonic()
+        with self._lock:
+            if now < self._next_sample:
+                return batch
+            self._next_sample = now + (1.0 / self.limit
+                                       if self.limit > 0 else 3600.0)
+            row = self._sample_row(batch)
+            if row is not None:
+                self.ring.append(row)
+        return batch
+
+    @staticmethod
+    def _sample_row(batch: Any) -> Optional[dict]:
+        if isinstance(batch, SpanBatch) and len(batch):
+            return {"signal": "traces", "n": len(batch),
+                    "first": next(iter(batch.iter_spans()), None)}
+        if isinstance(batch, MetricBatch) and len(batch):
+            return {"signal": "metrics", "n": len(batch),
+                    "first": next(iter(batch.iter_points()), None)}
+        if isinstance(batch, LogBatch) and len(batch):
+            return {"signal": "logs", "n": len(batch),
+                    "first": next(iter(batch.iter_records()), None)}
+        return None
+
+
+register(Factory(
+    type_name="remotetap",
+    kind=ComponentKind.PROCESSOR,
+    create=RemoteTapProcessor,
+    default_config=lambda: {"port": 0, "limit": 1.0},
+))

@@ -1,0 +1,136 @@
+"""``span`` processor — span-name surgery.
+
+Upstream's spanprocessor (collector/builder-config.yaml:82), three jobs:
+
+* ``name.from_attributes`` — rebuild the span name by joining attribute
+  values with ``separator``;
+* ``name.to_attributes.rules`` — regexes with NAMED groups run against
+  the span name; each group becomes a span attribute (and the matched
+  text collapses to the group name in the span name, upstream
+  to_attributes semantics);
+* ``status`` — force status code (ok|error|unset) with a description.
+
+Config::
+
+    span:
+      name:
+        from_attributes: [db.system, db.name]
+        separator: "::"
+        to_attributes:
+          rules: ["^\\/api\\/v1\\/document\\/(?P<documentId>.*)\\/update$"]
+      status:
+        code: error
+
+Name edits re-intern through the ottl SpanContext (one string-table
+rebuild per batch, not per span).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+from ...pdata.spans import SpanBatch
+from ..api import Capabilities, ComponentKind, Factory, Processor, register
+from .ottl import Path, SpanContext
+
+_STATUS = {"unset": 0, "ok": 1, "error": 2}
+_NAME_PATH = Path(("name",))
+_ATTR_PATH = Path(("attributes",))
+_STATUS_PATH = Path(("status_code",))
+
+
+class SpanProcessor(Processor):
+    """See module docstring."""
+
+    capabilities = Capabilities(mutates_data=True)
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        nm = config.get("name") or {}
+        self.from_attributes = [str(k) for k in
+                                (nm.get("from_attributes") or [])]
+        self.separator = str(nm.get("separator", ""))
+        rules = (nm.get("to_attributes") or {}).get("rules") or []
+        self.to_rules = [re.compile(r) for r in rules]
+        for rx in self.to_rules:
+            if not rx.groupindex:
+                raise ValueError(
+                    f"span to_attributes rule {rx.pattern!r} has no "
+                    "named capture groups")
+        status = config.get("status") or {}
+        code = status.get("code")
+        if code is not None and str(code) not in _STATUS:
+            raise ValueError(f"span status.code must be one of "
+                             f"{sorted(_STATUS)}, got {code!r}")
+        self.status_code = _STATUS[str(code)] if code is not None else None
+
+    def process(self, batch: Any) -> Any:
+        if not isinstance(batch, SpanBatch) or not len(batch):
+            return batch
+        n = len(batch)
+        ctx = SpanContext(batch)
+        all_rows = np.ones(n, dtype=bool)
+
+        if self.from_attributes:
+            attrs = batch.span_attrs
+            new_names = []
+            mask = np.zeros(n, dtype=bool)
+            for i in range(n):
+                vals = [attrs[i].get(k) for k in self.from_attributes]
+                if all(v is not None for v in vals):
+                    # upstream only renames when EVERY key is present
+                    mask[i] = True
+                    new_names.append(self.separator.join(
+                        str(v) for v in vals))
+                else:
+                    new_names.append("")
+            if mask.any():
+                ctx.set_values(_NAME_PATH, np.array(new_names,
+                                                    dtype=object), mask)
+
+        if self.to_rules:
+            names = ctx.values(_NAME_PATH)
+            span_attrs = ctx._attr_view(_ATTR_PATH)
+            out_names = np.array(names, dtype=object)
+            mask = np.zeros(n, dtype=bool)
+            for i, nm in enumerate(names):
+                s = str(nm)
+                for rx in self.to_rules:
+                    m = rx.search(s)
+                    if not m:
+                        continue
+                    # splice by group SPANS (in reverse so earlier
+                    # offsets stay valid) — str.replace would corrupt
+                    # names when a captured value is empty or occurs
+                    # elsewhere in the name
+                    spans_by_pos = []
+                    for group in m.groupdict():
+                        value = m.group(group)
+                        if value is None:
+                            continue
+                        span_attrs[i][group] = value
+                        spans_by_pos.append((m.span(group), group))
+                    for (lo, hi), group in sorted(spans_by_pos,
+                                                  reverse=True):
+                        s = s[:lo] + "{%s}" % group + s[hi:]
+                    mask[i] = True
+                out_names[i] = s
+            if mask.any():
+                ctx.set_values(_NAME_PATH, out_names, mask)
+
+        if self.status_code is not None:
+            ctx.set_values(_STATUS_PATH,
+                           np.full(n, self.status_code), all_rows)
+
+        return ctx.result()
+
+
+register(Factory(
+    type_name="span",
+    kind=ComponentKind.PROCESSOR,
+    create=SpanProcessor,
+    default_config=dict,
+))

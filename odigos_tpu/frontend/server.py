@@ -500,6 +500,25 @@ class _Handler(BaseHTTPRequestHandler):
         # SecretRef) — and persist only the non-secret fields.
         import os
 
+        # secret env names are type-scoped: a differing value silently
+        # rebinds every same-type destination's credentials — surface it
+        # in the response like the CLI warns on stderr
+        warnings = []
+        for sname in secret_names:
+            old = os.environ.get(sname)
+            if old is not None and old != fields[sname]:
+                others = [
+                    d.meta.name for d in
+                    fe.store.list("DestinationResource")
+                    if d.meta.name != name and any(
+                        f.secret and f.name == sname
+                        for f in (SPECS[d.dest_type].fields
+                                  if d.dest_type in SPECS else ()))]
+                if others:
+                    warnings.append(
+                        f"{sname} is shared with destination(s) "
+                        f"{', '.join(others)}; the new value replaces "
+                        "theirs")
         for sname in secret_names:
             os.environ[sname] = fields.pop(sname)
             fe.delivered_secret_envs.add(sname)
@@ -510,7 +529,10 @@ class _Handler(BaseHTTPRequestHandler):
             config=fields,
             secret_ref=f"odigos-{name}-secret" if secret_names else "",
             data_stream_names=list(body.get("data_stream_names", []))))
-        return self._json({"applied": name}, 201)
+        body_out = {"applied": name}
+        if warnings:
+            body_out["warnings"] = warnings
+        return self._json(body_out, 201)
 
     def do_DELETE(self) -> None:  # noqa: N802
         from urllib.parse import unquote

@@ -214,3 +214,38 @@ def concat_metric_batches(batches: Sequence[MetricBatch]) -> MetricBatch:
     return MetricBatch(strings=tuple(strings), resources=tuple(resources),
                        point_attrs=tuple(point_attrs),
                        histograms=tuple(histograms), columns=cols)
+
+
+def compact_resources(batch: MetricBatch) -> MetricBatch:
+    """Dedupe identical resource dicts and drop unreferenced ones,
+    remapping ``resource_index``.  Processors that reassemble batches by
+    filter+concat (metricstransform, metricsgeneration) would otherwise
+    double the resources tuple per pass — 2^T growth over T transforms.
+    """
+    if not len(batch):
+        return batch
+    from dataclasses import replace
+
+    resources: list[dict[str, Any]] = []
+    intern: dict[tuple, int] = {}
+    ridx = batch.columns["resource_index"]
+    new_ridx = np.empty(len(ridx), dtype=np.int32)
+    for i, r in enumerate(ridx):
+        r = int(r)
+        if not (0 <= r < len(batch.resources)):
+            new_ridx[i] = -1
+            continue
+        res = batch.resources[r]
+        key = tuple(sorted((k, str(v)) for k, v in res.items()))
+        j = intern.get(key)
+        if j is None:
+            j = len(resources)
+            resources.append(res)
+            intern[key] = j
+        new_ridx[i] = j
+    if len(resources) == len(batch.resources) and \
+            np.array_equal(new_ridx, ridx):
+        return batch
+    cols = dict(batch.columns)
+    cols["resource_index"] = new_ridx
+    return replace(batch, columns=cols, resources=tuple(resources))

@@ -1,0 +1,26 @@
+"""Shared vectorized splitmix64 finalizer.
+
+One implementation for every consumer that needs arbitrary 64-bit keys
+spread uniformly over the u64 ring space: the consistent-hash load
+balancer (wire/client.py — raw trace ids are small/sequential and
+hot-spot a ring; measured 100% pile-up on one replica before mixing)
+and the probabilistic sampler (components/processors/
+probabilisticsampler.py — the keep/drop verdict must be uniform in the
+id, not in whatever id-allocation pattern the SDK has).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """The splitmix64 finalizer, elementwise over a u64 array."""
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x

@@ -177,19 +177,14 @@ class WireExporter(Exporter):
 
 
 def _mix64(x: np.ndarray) -> np.ndarray:
-    """splitmix64 finalizer, vectorized: spreads arbitrary key values
-    uniformly over the u64 ring space. Trace ids are NOT uniform (agents
-    and the synthesizer hand out small/sequential ids) — placing raw ids
-    on an md5-pointed ring sends every trace to the owner of the lowest
-    vnode (measured: 100% hot-spotting on one replica)."""
-    x = x.astype(np.uint64, copy=True)
-    with np.errstate(over="ignore"):
-        x ^= x >> np.uint64(30)
-        x *= np.uint64(0xBF58476D1CE4E5B9)
-        x ^= x >> np.uint64(27)
-        x *= np.uint64(0x94D049BB133111EB)
-        x ^= x >> np.uint64(31)
-    return x
+    """splitmix64 finalizer (shared impl, utils/mix.py): spreads key
+    values uniformly over the u64 ring space. Trace ids are NOT uniform
+    (agents and the synthesizer hand out small/sequential ids) — placing
+    raw ids on an md5-pointed ring sends every trace to the owner of the
+    lowest vnode (measured: 100% hot-spotting on one replica)."""
+    from ..utils.mix import splitmix64
+
+    return splitmix64(x)
 
 
 def _ring_points(endpoints: list[str], vnodes: int = 64) -> tuple[np.ndarray, list[str]]:

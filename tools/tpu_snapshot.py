@@ -76,6 +76,15 @@ def attempt() -> bool:
         return False
     rec["captured_at"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")
+    # code identity at capture: bench.py's CPU-fallback path compares
+    # this against HEAD so a stale snapshot can't silently stand in for
+    # current code (VERDICT r4 item 8)
+    rec["git"] = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+        text=True, cwd=REPO).stdout.strip()
+    rec["git_dirty"] = bool(subprocess.run(
+        ["git", "status", "--porcelain"], capture_output=True,
+        text=True, cwd=REPO).stdout.strip())
     with open(SNAPSHOT, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")
