@@ -20,13 +20,19 @@ One generic implementation serves every vendor:
   delivery semantics as the blob exporter's uploader. ``endpoint_override``
   redirects delivery to any URL (tests point it at a local mock; air-gapped
   installs at their relay).
-* Types whose transport is an SDK or a non-HTTP protocol (AWS services,
-  googlecloud, azuremonitor connection strings, kafka brokers) have no
-  derivable URL in this zero-egress build: the exporter still builds and
-  starts (the collector must boot with an unreachable backend, exactly like
-  the reference's lazily-connecting exporters), but export() counts and
-  drops (``odigos_vendor_dropped_total``) and ``healthy()`` reports False —
-  visible degradation instead of a boot failure or a silent stall.
+* Types with a dedicated ingest protocol (splunkhec, influxdb,
+  opensearch/elasticsearch, the AWS family, azuremonitor, googlecloud)
+  marshal through ``wireformats.MARSHALLERS`` — the backend's REAL wire
+  format (HEC event streams, line protocol, _bulk NDJSON, SigV4-signed
+  JSON-RPC, App Insights envelopes) instead of generic otlp-json.
+  Bodies above ``max_body_bytes`` split the batch recursively into
+  in-limit requests.
+* kafka — the one genuinely non-HTTP transport left — still builds and
+  starts (the collector must boot with an unreachable backend, exactly
+  like the reference's lazily-connecting exporters), but export()
+  counts and drops (``odigos_vendor_dropped_total``) and ``healthy()``
+  reports False — visible degradation instead of a boot failure or a
+  silent stall.
 
 Also here: the ``nop`` exporter (upstream's nop component) and the
 ``datadog`` connector (traces→APM-stats bridge the datadog configer wires
@@ -108,6 +114,54 @@ def _sdk_only(c: dict) -> tuple[Optional[str], dict[str, str]]:
     return None, {}
 
 
+def _splunkhec(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    return c.get("endpoint"), {}
+
+
+def _influxdb(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    return c.get("endpoint"), {}
+
+
+def _opensearch(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    return _elasticsearch(c)
+
+
+def _awss3(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    up = c.get("s3uploader") or {}
+    bucket = up.get("s3_bucket")
+    if not bucket:
+        return None, {}
+    region = up.get("region") or "us-east-1"
+    return f"https://{bucket}.s3.{region}.amazonaws.com", {}
+
+
+def _awsxray(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    if c.get("endpoint"):
+        return str(c["endpoint"]), {}
+    region = c.get("region") or "us-east-1"
+    return f"https://xray.{region}.amazonaws.com", {}
+
+
+def _awslogs(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    region = c.get("region") or "us-east-1"
+    return f"https://logs.{region}.amazonaws.com", {}
+
+
+def _azuremonitor(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    from .wireformats import parse_azure_connection_string
+
+    parts = parse_azure_connection_string(
+        str(c.get("connection_string", "")))
+    ep = parts.get("IngestionEndpoint", "").rstrip("/")
+    return (ep or None), {}
+
+
+def _googlecloud(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    # OTLP-HTTP to the telemetry endpoint (the SDK-free path; the
+    # marshaller appends the per-signal /v1/* path + auth)
+    return "https://telemetry.googleapis.com", {}
+
+
 EXTRACTORS: dict[str, _Extractor] = {
     "otlphttp": _hdr_endpoint,
     "prometheusremotewrite": _hdr_endpoint,
@@ -119,13 +173,18 @@ EXTRACTORS: dict[str, _Extractor] = {
     "logzio": _logzio,
     "coralogix": _coralogix,
     "elasticsearch": _elasticsearch,
-    # SDK / non-HTTP transports: build + run degraded in this build
-    "awsxray": _sdk_only,
-    "awsemf": _sdk_only,
-    "awscloudwatchlogs": _sdk_only,
-    "awss3": _sdk_only,
-    "googlecloud": _sdk_only,
-    "azuremonitor": _sdk_only,
+    # dedicated wire protocols (wireformats.py)
+    "splunkhec": _splunkhec,
+    "influxdb": _influxdb,
+    "opensearch": _opensearch,
+    "awsxray": _awsxray,
+    "awsemf": _awslogs,
+    "awscloudwatchlogs": _awslogs,
+    "awss3": _awss3,
+    "googlecloud": _googlecloud,
+    "azuremonitor": _azuremonitor,
+    # kafka is the one genuinely non-HTTP transport left: build + run
+    # degraded (visible drop) in this zero-egress build
     "kafka": _sdk_only,
 }
 
@@ -195,16 +254,54 @@ class VendorExporter(Exporter):
         # degraded (SDK-only transport, nothing deliverable) -> unhealthy
         return (not self._started) or self._url is not None
 
+    # generous default: well under splunkhec's 800MB-class limits but
+    # above any sane batch; backends with hard request caps get whole
+    # batches split instead of a multi-MB body retried against a 413
+    DEFAULT_MAX_BODY = 4 * 1024 * 1024
+
     def export(self, batch) -> None:
         if self._url is None:
-            # SDK-only transport in a zero-egress build: run degraded,
-            # never wedge the pipeline behind an impossible send
+            # non-HTTP transport in a zero-egress build (kafka): run
+            # degraded, never wedge the pipeline behind an impossible
+            # send
             meter.add(f"{DROPPED_METRIC}{{exporter={self.name}}}",
                       max(len(batch), 1))
             return
+        self._export_bounded(batch)
+
+    def _export_bounded(self, batch) -> None:
+        """Marshal with the vendor's wire format; when a body exceeds
+        max_body_bytes, split the BATCH in half and recurse — in-limit
+        requests, not truncated documents."""
+        from .wireformats import MARSHALLERS, WireRequest
+
+        marshaller = MARSHALLERS.get(self.vendor_type)
+        reqs = (marshaller(batch, self.config) if marshaller
+                else [WireRequest(body=_marshal(batch))])
+        max_body = int(self.config.get("max_body_bytes",
+                                       self.DEFAULT_MAX_BODY))
+        if any(len(r.body) > max_body for r in reqs) and len(batch) > 1:
+            import numpy as np
+
+            mask = np.arange(len(batch)) < len(batch) // 2
+            self._export_bounded(batch.filter(mask))
+            self._export_bounded(batch.filter(~mask))
+            return
+        for r in reqs:
+            self._send(r)
+
+    def _send(self, r) -> None:
+        url = self._url + r.path
+        headers = {**self._headers, **r.headers,
+                   "Content-Type": r.content_type}
+        if r.aws_sign is not None:
+            from ...utils.awssig import sign
+
+            region, service = r.aws_sign
+            headers = sign(r.method, url, region, service, headers,
+                           r.body)
         send_with_retry(
-            self._url, _marshal(batch), method="POST",
-            headers=self._headers,
+            url, r.body, method=r.method, headers=headers,
             max_retries=int(self.config.get("max_retries", 4)),
             backoff_s=float(self.config.get("retry_backoff_s", 0.05)),
             timeout_s=float(self.config.get("timeout_s", 10.0)),

@@ -40,6 +40,9 @@ class BlobStoreServer:
         self.put_count = 0
         self.auth_failures = 0
         self.bodies: list[bytes] = []  # accepted payloads, arrival order
+        # full request records for protocol-shape assertions:
+        # {method, path, headers, body}
+        self.requests: list[dict] = []
         # vendor exporters send vendor-shaped auth (DD-API-KEY: ... etc.);
         # set to (header_name, value) to require that instead of bearer
         self.require_header: tuple[str, str] | None = None
@@ -103,6 +106,10 @@ class BlobStoreServer:
                     return
                 with store._lock:
                     store.bodies.append(body)
+                    store.requests.append({
+                        "method": self.command, "path": self.path,
+                        "headers": {k: v for k, v in self.headers.items()},
+                        "body": body})
                 self.send_response(201)
                 self.send_header("Content-Length", "0")
                 self.end_headers()

@@ -233,7 +233,10 @@ def compact_resources(batch: MetricBatch) -> MetricBatch:
     for i, r in enumerate(ridx):
         r = int(r)
         if not (0 <= r < len(batch.resources)):
-            new_ridx[i] = -1
+            # preserve as-is: -1 is the sanctioned no-resource sentinel,
+            # and a corrupt index must stay loud downstream rather than
+            # be laundered into a valid-looking one
+            new_ridx[i] = r
             continue
         res = batch.resources[r]
         key = tuple(sorted((k, str(v)) for k, v in res.items()))

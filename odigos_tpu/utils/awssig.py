@@ -1,0 +1,83 @@
+"""AWS Signature Version 4 request signing, stdlib-only.
+
+The reference ships the AWS SDK inside awsxrayexporter/awsemfexporter/
+awss3exporter (collector/builder-config.yaml:26-29); this build has no
+SDK and no egress, but SigV4 itself is just HMAC-SHA256 over a canonical
+request (the documented algorithm), so the AWS-family exporters can sign
+real requests — and tests can assert the Authorization shape against
+local mocks — without any dependency.
+
+Credentials come from the environment (AWS_ACCESS_KEY_ID /
+AWS_SECRET_ACCESS_KEY / AWS_SESSION_TOKEN), the same contract the
+reference's IRSA/pod-identity paths ultimately resolve to.  With no
+credentials present ``sign()`` returns the headers unsigned — delivery
+to an ``endpoint_override`` mock still works, and the real endpoint
+rejects with a visible 403 instead of a silent drop.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+from typing import Optional
+from urllib.parse import quote, urlparse
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign(method: str, url: str, region: str, service: str,
+         headers: dict[str, str], body: bytes,
+         now: Optional[datetime.datetime] = None) -> dict[str, str]:
+    """Return ``headers`` plus SigV4 ``Authorization``/``x-amz-date`` (and
+    the payload hash header); unchanged when no credentials are set."""
+    access = os.environ.get("AWS_ACCESS_KEY_ID", "")
+    secret = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+    out = dict(headers)
+    payload_hash = hashlib.sha256(body).hexdigest()
+    out["x-amz-content-sha256"] = payload_hash
+    if not access or not secret:
+        return out
+
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    out["x-amz-date"] = amz_date
+    token = os.environ.get("AWS_SESSION_TOKEN", "")
+    if token:
+        out["x-amz-security-token"] = token
+
+    parsed = urlparse(url)
+    host = parsed.netloc
+    out.setdefault("host", host)
+    canonical_uri = quote(parsed.path or "/", safe="/-_.~")
+    canonical_query = parsed.query  # callers pass pre-encoded queries
+
+    signed_names = sorted(k.lower() for k in out)
+    canonical_headers = "".join(
+        f"{k}:{str(out[orig]).strip()}\n"
+        for k in signed_names
+        for orig in out if orig.lower() == k)
+    signed_headers = ";".join(signed_names)
+    canonical_request = "\n".join([
+        method.upper(), canonical_uri, canonical_query,
+        canonical_headers, signed_headers, payload_hash])
+
+    scope = f"{date}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+    k = _hmac(f"AWS4{secret}".encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}")
+    return out

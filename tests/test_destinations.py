@@ -399,19 +399,22 @@ class TestVendorExporters:
         finally:
             store.stop()
 
-    def test_sdk_only_type_runs_degraded(self):
+    def test_non_http_transport_runs_degraded(self):
+        """kafka is the one remaining non-HTTP transport (round 5 gave
+        the AWS/Azure/GCP family real wire protocols, wireformats.py):
+        it must boot, drop visibly, and report unhealthy."""
         from odigos_tpu.components.api import ComponentKind, registry
         from odigos_tpu.pdata import synthesize_traces
         from odigos_tpu.utils.telemetry import meter
 
-        exp = registry.get(ComponentKind.EXPORTER, "awss3").build(
-            "awss3/x", {"s3uploader": {"s3_bucket": "b"}})
+        exp = registry.get(ComponentKind.EXPORTER, "kafka").build(
+            "kafka/x", {"brokers": ["b:9092"]})
         exp.start()  # must not raise: collector boots with SDK backends
         before = meter.counter(
-            "odigos_vendor_dropped_total{exporter=awss3/x}")
+            "odigos_vendor_dropped_total{exporter=kafka/x}")
         exp.export(synthesize_traces(3, seed=2))  # counted drop, no error
         after = meter.counter(
-            "odigos_vendor_dropped_total{exporter=awss3/x}")
+            "odigos_vendor_dropped_total{exporter=kafka/x}")
         assert after - before > 0
         assert not exp.healthy(), "degraded exporter must report unhealthy"
         exp.shutdown()
