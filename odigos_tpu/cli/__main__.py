@@ -1,5 +1,14 @@
+import os
 import sys
 
 from .commands import main
 
-sys.exit(main())
+try:
+    rc = main()
+except BrokenPipeError:
+    # stdout reader went away (odigos ... | head/grep -q): exit quietly
+    # like any well-behaved CLI instead of tracebacking; devnull stops
+    # the interpreter's flush-at-exit from raising again
+    os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    rc = 0
+sys.exit(rc)

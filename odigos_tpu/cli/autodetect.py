@@ -1,0 +1,93 @@
+"""Install-time platform autodetection (cli/pkg/autodetect analog).
+
+The reference senses its environment before rendering anything: cluster
+kind from name/context heuristics (kindofcluster.go: kind-/k3s/eks/gke/
+aks/openshift/minikube detectors, first match wins) and adapts images/
+securityContexts accordingly.  Ours detects the same cluster-kind
+signals plus the node-level traits that matter on a TPU host:
+
+* ``kind``            — kind|k3s|eks|gke|aks|openshift|minikube|vanilla
+                        from cluster name / kube context (env overrides
+                        ODIGOS_CLUSTER_NAME / ODIGOS_KUBE_CONTEXT let
+                        tests and odd setups pin it)
+* ``cgroup_version``  — 2 when /sys/fs/cgroup/cgroup.controllers exists
+                        (unified hierarchy), else 1; decides which
+                        cgroup paths the odiglet manifest mounts
+* ``systemd``         — /run/systemd/system present; decides the VM
+                        distribution's service-install path
+* ``tpu_present``     — accelerator device nodes (/dev/accel*, /dev/vfio)
+                        or a JAX_PLATFORMS hint; decides whether the
+                        deviceplugin ships and manifests request the
+                        TPU resource
+
+Detection is pure-read (stat/env only — never imports jax; install must
+stay fast and side-effect-free) and returns a plain dict so it persists
+in state.json/Configuration.platform verbatim.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Optional
+
+# ordered like the reference's availableKindDetectors: first match wins
+_KIND_SIGNALS = [
+    ("kind", ("kind-",)),
+    ("k3s", ("k3s", "k3d-")),
+    ("eks", (".eks.amazonaws.com", "arn:aws:eks", "eks-")),
+    ("gke", ("gke_",)),
+    ("aks", ("aks-", "-aks")),
+    ("openshift", ("openshift", "api.crc.testing")),
+    ("minikube", ("minikube",)),
+]
+
+
+def detect_cluster_kind(cluster_name: str = "",
+                        context: str = "") -> str:
+    name = (cluster_name
+            or os.environ.get("ODIGOS_CLUSTER_NAME", "")).lower()
+    ctx = (context or os.environ.get("ODIGOS_KUBE_CONTEXT", "")).lower()
+    for kind, needles in _KIND_SIGNALS:
+        for n in needles:
+            if n in name or n in ctx:
+                return kind
+    return "vanilla"
+
+
+def detect_cgroup_version(root: str = "/sys/fs/cgroup") -> int:
+    return 2 if os.path.exists(os.path.join(root,
+                                            "cgroup.controllers")) else 1
+
+
+def detect_systemd(run_dir: str = "/run/systemd/system") -> bool:
+    return os.path.isdir(run_dir)
+
+
+def detect_tpu(dev_glob: str = "/dev/accel*") -> bool:
+    # /dev/accel* is the TPU driver's device-node pattern; generic vfio
+    # nodes are deliberately NOT a signal (any IOMMU/GPU-passthrough
+    # host has /dev/vfio/vfio, and a false positive renders manifests
+    # requesting a TPU resource the cluster cannot schedule)
+    if glob.glob(dev_glob):
+        return True
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    return "tpu" in plat.lower()
+
+
+def detect_platform(cluster_name: str = "",
+                    context: str = "",
+                    sysroot: Optional[str] = None) -> dict[str, Any]:
+    """One detection pass; ``sysroot`` redirects the filesystem probes
+    (tests point it at a fixture tree)."""
+    root = sysroot or "/"
+
+    def p(*parts: str) -> str:
+        return os.path.join(root, *parts)
+
+    return {
+        "kind": detect_cluster_kind(cluster_name, context),
+        "cgroup_version": detect_cgroup_version(p("sys", "fs", "cgroup")),
+        "systemd": detect_systemd(p("run", "systemd", "system")),
+        "tpu_present": detect_tpu(p("dev", "accel*")),
+    }

@@ -65,6 +65,24 @@ def cmd_install(args) -> int:
     _, unknown = resolve_profiles(config.profiles, tier)
     if unknown:
         return _err(f"unknown or tier-gated profiles: {unknown}")
+    # sense the environment before rendering anything (the reference's
+    # cli/pkg/autodetect step) and adapt the install to it
+    from .autodetect import detect_platform
+
+    platform = detect_platform(cluster_name=config.cluster_name)
+    config.extra["platform"] = platform
+    if platform["kind"] == "openshift":
+        config.extra["openshift_enabled"] = True
+    print("platform: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(platform.items())))
+    # policy-validate the rendered manifests (tests/gatekeeper role):
+    # an install that violates its own constraint set must not proceed
+    from ..controlplane.gatekeeper import policy_violations
+
+    violations = policy_violations(config, platform, tier.value)
+    if violations:
+        return _err("install manifests violate policy:\n  "
+                    + "\n  ".join(str(v) for v in violations))
     state = create_state(path=args.state_dir, nodes=args.nodes,
                          config=config, tier=tier.value)
     state.save()
@@ -72,6 +90,24 @@ def cmd_install(args) -> int:
           f"profiles={config.profiles or 'none'}) "
           f"at {state.path}")
     return 0
+
+
+def cmd_manifests(args) -> int:
+    """Render the component manifests for review (the reference's
+    helm-template/resourcemanager dry-run role)."""
+    import json as _json
+
+    state = _load(args)
+    from ..controlplane.gatekeeper import policy_violations
+    from ..controlplane.manifests import render_manifests
+
+    platform = (state.config.extra or {}).get("platform") or {}
+    print(_json.dumps(render_manifests(state.config, platform,
+                                       state.tier), indent=1))
+    violations = policy_violations(state.config, platform, state.tier)
+    for v in violations:
+        print(f"policy violation: {v}", file=sys.stderr)
+    return 1 if violations else 0
 
 
 def cmd_upgrade(args) -> int:
@@ -156,6 +192,17 @@ def cmd_preflight(args) -> int:
         return "native C++ ring"
 
     check("shared-memory span ring", ring)
+
+    def policy():
+        from ..controlplane.gatekeeper import policy_violations
+
+        platform = (state.config.extra or {}).get("platform") or {}
+        violations = policy_violations(state.config, platform, state.tier)
+        if violations:
+            raise RuntimeError("; ".join(str(v) for v in violations))
+        return "manifests clean"
+
+    check("manifests pass constraint policy", policy)
 
     def tpu():
         import subprocess
@@ -390,11 +437,16 @@ def cmd_destinations(args) -> int:
 def cmd_ui(args) -> int:
     """Serve the operator dashboard over the installed state (the
     reference's `odigos ui` port-forward/serve, cli/cmd/ui.go)."""
+    import os
+
     state = _load(args)
     from ..frontend import FrontendServer
 
+    auth = (getattr(args, "auth_token", None)
+            or os.environ.get("ODIGOS_UI_TOKEN") or None)
     fe = FrontendServer(state.store, cluster=state.cluster,
-                        host=args.address, port=args.port).start()
+                        host=args.address, port=args.port,
+                        auth_token=auth).start()
     print(f"dashboard: {fe.url} (ctrl-c to stop)", flush=True)
     if getattr(args, "once", False):  # tests: bind, report, exit
         fe.shutdown()
@@ -688,6 +740,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("upgrade", help="upgrade an existing installation")
     p.set_defaults(fn=cmd_upgrade)
 
+    p = sub.add_parser("manifests",
+                       help="render component manifests + policy check")
+    p.set_defaults(fn=cmd_manifests)
+
     p = sub.add_parser("preflight", help="installation health checks")
     p.add_argument("--skip-device-probe", action="store_true",
                    help="skip the (advisory, up to 30s) TPU probe")
@@ -760,6 +816,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("ui", help="serve the operator dashboard")
     p.add_argument("--address", default="127.0.0.1")
     p.add_argument("--port", type=int, default=3000)
+    p.add_argument("--auth-token", default=None,
+                   help="require this bearer token (or a valid pro JWT) "
+                        "for mutations and the event stream; default: "
+                        "$ODIGOS_UI_TOKEN, open when unset")
     p.add_argument("--once", action="store_true",
                    help="bind, print the URL, exit (smoke test)")
     p.set_defaults(fn=cmd_ui)

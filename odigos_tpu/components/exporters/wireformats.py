@@ -131,8 +131,11 @@ def _lp_fieldval(v: Any) -> str:
 
 def _lp_line(measurement: str, tags: dict[str, Any],
              fields: dict[str, Any], t_ns: int) -> str:
+    # empty tag values are illegal line protocol (the backend 400s the
+    # whole write): skip them alongside None
     tag_part = "".join(f",{_lp_tag(k)}={_lp_tag(v)}"
-                       for k, v in sorted(tags.items()) if v is not None)
+                       for k, v in sorted(tags.items())
+                       if v is not None and str(v) != "")
     field_part = ",".join(f"{_lp_tag(k)}={_lp_fieldval(v)}"
                           for k, v in fields.items())
     return f"{_lp_meas(measurement)}{tag_part} {field_part} {int(t_ns)}"
@@ -171,8 +174,10 @@ def marshal_influx_line(batch, config: dict[str, Any]) -> list[WireRequest]:
             }
             lines.append(_lp_line("spans", tags, fields,
                                   row["start_unix_nano"]))
-    org = str(config.get("org", ""))
-    bucket = str(config.get("bucket", ""))
+    from urllib.parse import quote
+
+    org = quote(str(config.get("org", "")), safe="")
+    bucket = quote(str(config.get("bucket", "")), safe="")
     headers = {}
     if config.get("token"):
         headers["Authorization"] = f"Token {config['token']}"

@@ -1,0 +1,159 @@
+"""Constraint-policy validation over rendered manifests (the
+tests/gatekeeper analog).
+
+The reference validates its install against the Azure-policy/gatekeeper
+constraint set (/root/reference/tests/gatekeeper/constraints/):
+restrict-privileged, restrict-hostpath, restrict-host-namespace,
+restrict-privilegescalation — each a ConstraintTemplate with rego logic
+plus exclusion lists.  Ours expresses the same four policies as plain
+predicates over the manifest dicts controlplane/manifests.py renders,
+with the same shape of targeted exclusions (the odiglet is the one
+component that legitimately needs privilege + host paths — exactly the
+exemption the reference's e2e encodes for its own install).
+
+``validate(manifests, constraints)`` returns violations; the default
+constraint set encodes the odigos install policy.  The CLI preflight and
+the test suite both run it, so a manifest change that breaks policy
+fails before any cluster sees it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class Violation:
+    constraint: str
+    manifest: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.constraint}] {self.manifest}: {self.detail}"
+
+
+@dataclass(frozen=True)
+class Constraint:
+    name: str
+    check: Callable[[dict], list[str]]  # manifest -> violation details
+    # container/manifest names exempt from this constraint (the
+    # reference templates' excludedImages/excludedContainers role)
+    exclusions: frozenset = frozenset()
+
+
+def _pod_spec(m: dict) -> dict:
+    return ((m.get("spec") or {}).get("template") or {}).get("spec") or {}
+
+
+def _containers(m: dict) -> list[dict]:
+    return list(_pod_spec(m).get("containers") or [])
+
+
+def _name(m: dict) -> str:
+    return (m.get("metadata") or {}).get("name", "?")
+
+
+def restrict_privileged(exclusions: frozenset) -> Constraint:
+    """restrict-privileged.yaml: no privileged containers outside the
+    exemption list."""
+
+    def check(m: dict) -> list[str]:
+        out = []
+        for c in _containers(m):
+            sc = c.get("securityContext") or {}
+            if sc.get("privileged") and c.get("name") not in exclusions:
+                out.append(f"container {c.get('name')} is privileged")
+        return out
+
+    return Constraint("restrict-privileged", check, exclusions)
+
+
+def restrict_privilege_escalation(exclusions: frozenset) -> Constraint:
+    """restrict-privilegescaltion.yaml: allowPrivilegeEscalation must be
+    explicitly false outside the exemption list."""
+
+    def check(m: dict) -> list[str]:
+        out = []
+        for c in _containers(m):
+            if c.get("name") in exclusions:
+                continue
+            sc = c.get("securityContext") or {}
+            if sc.get("allowPrivilegeEscalation", True):
+                out.append(f"container {c.get('name')} allows privilege "
+                           "escalation")
+        return out
+
+    return Constraint("restrict-privilege-escalation", check, exclusions)
+
+
+def restrict_host_namespace(exclusions: frozenset) -> Constraint:
+    """restrict-host-namespace.yaml: hostNetwork/hostPID/hostIPC
+    forbidden outside the exemption list (manifest-level)."""
+
+    def check(m: dict) -> list[str]:
+        if _name(m) in exclusions:
+            return []
+        spec = _pod_spec(m)
+        return [f"{ns} enabled" for ns in
+                ("hostNetwork", "hostPID", "hostIPC") if spec.get(ns)]
+
+    return Constraint("restrict-host-namespace", check, exclusions)
+
+
+def restrict_hostpath(allowed_prefixes: tuple[str, ...],
+                      exclusions: frozenset = frozenset()) -> Constraint:
+    """restrict-hostpath.yaml: hostPath volumes only under the allowed
+    prefixes."""
+
+    def check(m: dict) -> list[str]:
+        if _name(m) in exclusions:
+            return []
+        out = []
+        for v in _pod_spec(m).get("volumes") or []:
+            hp = v.get("hostPath")
+            if hp is None:
+                continue
+            path = hp if isinstance(hp, str) else hp.get("path", "")
+            if not any(path == p or path.startswith(p.rstrip("/") + "/")
+                       or p.rstrip("/") == path.rstrip("/")
+                       for p in allowed_prefixes):
+                out.append(f"hostPath {path} not in allowed set")
+        return out
+
+    return Constraint("restrict-hostpath", check)
+
+
+def default_constraints() -> list[Constraint]:
+    """The odigos install policy: odiglet is the single privileged,
+    host-pid, host-path component; everything else is locked down."""
+    return [
+        restrict_privileged(frozenset({"odiglet"})),
+        restrict_privilege_escalation(frozenset({"odiglet"})),
+        restrict_host_namespace(frozenset({"odiglet"})),
+        restrict_hostpath((
+            "/var/odigos", "/proc", "/sys/fs/cgroup",
+            "/var/lib/kubelet/pod-resources",
+        )),
+    ]
+
+
+def validate(manifests: list[dict],
+             constraints: list[Constraint] | None = None) -> list[Violation]:
+    constraints = (default_constraints() if constraints is None
+                   else constraints)
+    out: list[Violation] = []
+    for m in manifests:
+        for c in constraints:
+            for detail in c.check(m):
+                out.append(Violation(c.name, _name(m), detail))
+    return out
+
+
+def policy_violations(config, platform: dict | None,
+                      tier: str) -> list[Violation]:
+    """Render + validate in one step — the shared path behind install,
+    `odigos manifests`, and preflight."""
+    from .manifests import render_manifests
+
+    return validate(render_manifests(config, dict(platform or {}), tier))

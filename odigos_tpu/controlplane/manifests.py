@@ -1,0 +1,188 @@
+"""Rendered install manifests (the helm-chart/resourcemanager analog).
+
+The reference renders its components as k8s objects (helm charts +
+cli/pkg/resources managers): odiglet DaemonSet (privileged, hostPath
+mounts), gateway/instrumentor/scheduler/autoscaler Deployments with the
+resource defaults BASELINE.md records (500m/128Mi control-plane pods,
+gateway from sizing), frontend Service.  Ours renders the same shapes as
+plain dicts so (a) the gatekeeper policy suite
+(controlplane/gatekeeper.py) has real objects to validate, and (b)
+`odigos manifests` gives operators the reviewable artifact the
+reference's `--dry-run` renders.
+
+Platform adaptation (cli/autodetect.py detect_platform output):
+
+* openshift        — odiglet gets the SCC annotation + SELinux type the
+                     reference's openshift images carry
+* cgroup_version 1 — odiglet mounts the v1 hierarchy paths instead of
+                     the unified mount
+* tpu_present      — the deviceplugin container ships and the gateway
+                     requests the TPU resource for its anomaly replicas
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..config.model import Configuration
+from ..config.sizing import gateway_resources, node_resources
+
+NAMESPACE = "odigos-system"
+
+# BASELINE.md / docs/benchmarks.mdx:30-34: control-plane pod defaults
+CONTROL_PLANE_RESOURCES = {
+    "requests": {"cpu": "10m", "memory": "64Mi"},
+    "limits": {"cpu": "500m", "memory": "128Mi"},
+}
+
+TPU_RESOURCE = "odigos.io/tpu"
+
+
+def _deployment(name: str, containers: list[dict[str, Any]],
+                replicas: int = 1,
+                annotations: dict[str, str] | None = None) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": NAMESPACE,
+                     "annotations": dict(annotations or {})},
+        "spec": {
+            "replicas": replicas,
+            "template": {"spec": {
+                "hostNetwork": False,
+                "hostPID": False,
+                "hostIPC": False,
+                "containers": containers,
+                "volumes": [],
+            }},
+        },
+    }
+
+
+def _mib(v: int) -> str:
+    return f"{v}Mi"
+
+
+def render_manifests(config: Configuration,
+                     platform: dict[str, Any] | None = None,
+                     tier: str = "community") -> list[dict]:
+    """Render every component manifest for the given effective config."""
+    platform = dict(platform or {})
+    openshift = platform.get("kind") == "openshift"
+    cgroup_v = int(platform.get("cgroup_version", 2))
+    tpu = bool(platform.get("tpu_present", False))
+
+    out: list[dict] = []
+
+    # ---- control plane (instrumentor / scheduler / autoscaler)
+    for name in ("instrumentor", "scheduler", "autoscaler"):
+        out.append(_deployment(f"odigos-{name}", [{
+            "name": name,
+            "image": f"{config.image_prefix or 'odigos-tpu'}/{name}",
+            "resources": CONTROL_PLANE_RESOURCES,
+            "securityContext": {"privileged": False,
+                                "allowPrivilegeEscalation": False,
+                                "readOnlyRootFilesystem": True},
+        }]))
+
+    # ---- gateway (cluster collector) from sizing
+    gw = gateway_resources(config.collector_gateway,
+                           config.resource_size_preset or None)
+    gw_container: dict[str, Any] = {
+        "name": "gateway",
+        "image": f"{config.image_prefix or 'odigos-tpu'}/collector",
+        "resources": {
+            "requests": {"cpu": f"{gw.request_cpu_m}m",
+                         "memory": _mib(gw.request_memory_mib)},
+            "limits": {"cpu": f"{gw.limit_cpu_m}m",
+                       "memory": _mib(gw.limit_memory_mib)},
+        },
+        "securityContext": {"privileged": False,
+                            "allowPrivilegeEscalation": False,
+                            "readOnlyRootFilesystem": True},
+        "env": [{"name": "GOMEMLIMIT",
+                 "value": f"{gw.gomemlimit_mib}MiB"}],
+    }
+    if tpu:
+        n = (config.collector_gateway.tpu_replicas or 1)
+        gw_container["resources"]["limits"][TPU_RESOURCE] = str(n)
+    gateway = _deployment("odigos-gateway", [gw_container],
+                          replicas=gw.min_replicas)
+    out.append(gateway)
+
+    # ---- odiglet (node agent): the ONE privileged component — it owns
+    # the shm span rings, /proc inspection, and device plugin sockets
+    nd = node_resources(config.collector_node,
+                        config.resource_size_preset or None)
+    cgroup_mounts = (
+        [{"name": "cgroup", "hostPath": "/sys/fs/cgroup"}]
+        if cgroup_v == 2 else
+        [{"name": "cgroup-cpu", "hostPath": "/sys/fs/cgroup/cpu"},
+         {"name": "cgroup-mem", "hostPath": "/sys/fs/cgroup/memory"}])
+    odiglet_containers = [{
+        "name": "odiglet",
+        "image": f"{config.image_prefix or 'odigos-tpu'}/odiglet",
+        "securityContext": {
+            "privileged": True,
+            "allowPrivilegeEscalation": True,
+            **({"seLinuxOptions": {"type": "spc_t"}} if openshift else {}),
+        },
+        "resources": {
+            "requests": {"cpu": f"{nd.request_cpu_m}m",
+                         "memory": _mib(nd.request_memory_mib)},
+            "limits": {"cpu": f"{nd.limit_cpu_m}m",
+                       "memory": _mib(nd.limit_memory_mib)},
+        },
+    }]
+    if tpu:
+        odiglet_containers.append({
+            "name": "deviceplugin",
+            "image": f"{config.image_prefix or 'odigos-tpu'}/deviceplugin",
+            "securityContext": {"privileged": False,
+                                "allowPrivilegeEscalation": False},
+            "resources": CONTROL_PLANE_RESOURCES,
+        })
+    odiglet = {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": "odiglet", "namespace": NAMESPACE,
+            "annotations": (
+                {"openshift.io/required-scc": "privileged"}
+                if openshift else {}),
+        },
+        "spec": {"template": {"spec": {
+            "hostNetwork": False,
+            "hostPID": True,  # procdiscovery reads /proc of host pids
+            "hostIPC": False,
+            "containers": odiglet_containers,
+            "volumes": [
+                {"name": "odigos", "hostPath": "/var/odigos"},
+                {"name": "proc", "hostPath": "/proc"},
+                {"name": "pod-resources",
+                 "hostPath": "/var/lib/kubelet/pod-resources"},
+                *cgroup_mounts,
+            ],
+        }}},
+    }
+    out.append(odiglet)
+
+    # ---- frontend/UI
+    out.append(_deployment("odigos-ui", [{
+        "name": "ui",
+        "image": f"{config.image_prefix or 'odigos-tpu'}/ui",
+        "resources": CONTROL_PLANE_RESOURCES,
+        "securityContext": {"privileged": False,
+                            "allowPrivilegeEscalation": False,
+                            "readOnlyRootFilesystem": True},
+    }]))
+    if tier != "community":
+        out.append(_deployment("odigos-pro", [{
+            "name": "pro",
+            "image": f"{config.image_prefix or 'odigos-tpu'}/pro",
+            "resources": CONTROL_PLANE_RESOURCES,
+            "securityContext": {"privileged": False,
+                                "allowPrivilegeEscalation": False,
+                                "readOnlyRootFilesystem": True},
+        }]))
+    return out

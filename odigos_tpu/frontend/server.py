@@ -94,9 +94,15 @@ class FrontendServer:
 
     def __init__(self, store: Store, host: str = "127.0.0.1",
                  port: int = 0, metrics_port: Optional[int] = 0,
-                 cluster=None, max_sse_clients: int = 64):
+                 cluster=None, max_sse_clients: int = 64,
+                 auth_token: Optional[str] = None):
         self.store = store
         self.cluster = cluster
+        # reference OIDC middleware analog (frontend/main.go:130): when a
+        # token is configured, mutations (POST/DELETE) and the SSE stream
+        # require exactly that bearer. None = open, the default for
+        # local `ui`. (Pro JWTs are not accepted — see _authorized.)
+        self.auth_token = auth_token
         self.host = host
         self.port = port
         self.max_sse_clients = max_sse_clients
@@ -224,6 +230,31 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, msg: str, status: int = 400) -> None:
         self._json({"error": msg}, status)
 
+    def _authorized(self, token_param: str = "") -> bool:
+        """Bearer/session middleware (reference OIDC analog,
+        frontend/main.go:130). Open server -> always authorized; with
+        auth configured, ONLY the exact configured session token is
+        accepted (constant-time compare). Pro JWTs are deliberately NOT
+        an authentication factor here: utils/auth validates claims, not
+        signatures (it is an entitlement parser), so accepting any
+        well-formed JWT would make the gate forgeable.  ``token_param``
+        carries the SSE query token (EventSource cannot set headers)."""
+        import hmac as _hmac
+
+        expected = self.frontend.auth_token
+        if expected is None:
+            return True
+        presented = token_param
+        hdr = self.headers.get("Authorization", "")
+        if hdr.startswith("Bearer "):
+            presented = hdr[len("Bearer "):].strip()
+        if not presented:
+            return False
+        return _hmac.compare_digest(presented, expected)
+
+    def _unauthorized(self) -> None:
+        self._json({"error": "missing or invalid bearer token"}, 401)
+
     def _html(self, body: bytes) -> None:
         self.send_response(200)
         self.send_header("Content-Type", "text/html; charset=utf-8")
@@ -325,6 +356,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json({"text": describe_workload(
                     state, q["namespace"], q["kind"], q["name"])})
             if path == "/api/events":
+                if not self._authorized(q.get("token", "")):
+                    return self._unauthorized()
                 return self._serve_sse()
             return self._error("not found", 404)
         except ValueError as e:
@@ -366,6 +399,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         fe = self.frontend
+        if not self._authorized():
+            return self._unauthorized()
         path = urlparse(self.path).path.rstrip("/")
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -538,6 +573,8 @@ class _Handler(BaseHTTPRequestHandler):
         from urllib.parse import unquote
 
         fe = self.frontend
+        if not self._authorized():
+            return self._unauthorized()
         parts = urlparse(self.path).path.rstrip("/").split("/")
         # /api/sources/<namespace>/<name> — segments are percent-encoded
         # by clients (the dashboard encodes; names may hold spaces etc.)

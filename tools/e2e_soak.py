@@ -12,6 +12,15 @@ reporting end-to-end spans/s and asserting span conservation (everything
 accepted by the receiver reaches a terminal exporter; REJECTED frames are
 counted, not lost). Writes ``SOAK.json`` and prints one JSON line.
 
+Added-latency percentiles (VERDICT r4 item 7) come from a PROBE stream:
+a separate low-rate sender ships one tiny distinctive batch (service
+``latency-probe``) every ~100 ms through the same loaded wire, and the
+terminal exporters are wrapped to stamp its arrival — send→export wall
+time through admission, batching, scoring, and routing under full load.
+Matching is by probe sequence attr; detection is one cheap membership
+test on the interned string table per exported batch (zero per-span
+work on the hot path).
+
     python tools/e2e_soak.py [--seconds 20] [--senders 2]
 
 Reference discipline: the hot-loop zero-alloc rule of
@@ -121,23 +130,84 @@ def main() -> None:
                 for j in range(q))
         exp.shutdown()
 
+    # ---- latency probe: wrap the terminal exporters to stamp arrival
+    # of the distinctive probe batches (send -> export added latency)
+    from odigos_tpu.pdata.spans import SpanBatchBuilder
+
+    PROBE_SERVICE = "latency-probe"
+    probe_sent: dict[int, float] = {}
+    probe_seen: dict[int, float] = {}
+    probe_lock = threading.Lock()
+
+    def wrap_exporter(exp):
+        orig = exp.consume
+
+        def spy(b):
+            if PROBE_SERVICE in b.strings:  # interned: one tuple scan
+                now = time.perf_counter()
+                with probe_lock:
+                    for attrs in b.span_attrs:
+                        seq = attrs.get("probe_seq")
+                        if seq is not None and seq not in probe_seen:
+                            probe_seen[int(seq)] = now
+            return orig(b)
+
+        exp.consume = spy
+
+    anomaly = collector.graph.exporters["tracedb/anomaly"]
+    normal = collector.graph.exporters["tracedb/normal"]
+    wrap_exporter(anomaly)
+    wrap_exporter(normal)
+
+    probe_spans_sent = [0]
+
+    def prober() -> None:
+        exp = WireExporter("otlpwire/probe", {
+            "endpoint": f"127.0.0.1:{port}", "queue_size": 8,
+            "max_elapsed_s": 30.0})
+        exp.start()
+        seq = 0
+        while not stop.is_set():
+            b = SpanBatchBuilder()
+            b.add_span(trace_id=0x50_0000 + seq, span_id=seq + 1,
+                       name="probe", service=PROBE_SERVICE,
+                       start_unix_nano=time.time_ns(),
+                       end_unix_nano=time.time_ns() + 1000,
+                       attrs={"probe_seq": seq})
+            with probe_lock:
+                probe_sent[seq] = time.perf_counter()
+            exp.export(b.build())
+            probe_spans_sent[0] += 1
+            seq += 1
+            stop.wait(0.1)
+        exp.flush(timeout=30.0)
+        exp.shutdown()
+
     threads = [threading.Thread(target=sender, args=(i,), daemon=True)
                for i in range(args.senders)]
+    probe_thread = threading.Thread(target=prober, daemon=True)
     t0 = time.perf_counter()
     for t in threads:
         t.start()
+    probe_thread.start()
     time.sleep(args.seconds)
     stop.set()
     for t in threads:
         t.join(timeout=90)
+    probe_thread.join(timeout=60)
     collector.drain_receivers(timeout=60.0)
     elapsed = time.perf_counter() - t0
 
-    anomaly = collector.graph.exporters["tracedb/anomaly"]
-    normal = collector.graph.exporters["tracedb/normal"]
-    received = anomaly.span_count + normal.span_count
+    received = (anomaly.span_count + normal.span_count
+                - len(probe_seen))  # probe spans are not workload spans
     sent = sum(sent_spans) - sum(dropped_spans)
     collector.shutdown()
+
+    import numpy as np
+
+    lat_ms = np.array([
+        (probe_seen[k] - probe_sent[k]) * 1e3
+        for k in probe_seen if k in probe_sent])
 
     result = {
         "metric": "e2e_wire_spans_per_sec",
@@ -149,6 +219,20 @@ def main() -> None:
         "spans_received": int(received),
         "conservation": received == sent,
         "anomaly_spans": int(anomaly.span_count),
+        # added latency through the LOADED pipeline (probe stream,
+        # send -> terminal exporter; includes wire, admission, batching
+        # wait, zscore scoring, routing)
+        "probes_sent": int(probe_spans_sent[0]),
+        "probes_delivered": int(len(lat_ms)),
+        "latency_p50_ms": (round(float(np.percentile(lat_ms, 50)), 2)
+                           if len(lat_ms) else None),
+        "latency_p95_ms": (round(float(np.percentile(lat_ms, 95)), 2)
+                           if len(lat_ms) else None),
+        "latency_p99_ms": (round(float(np.percentile(lat_ms, 99)), 2)
+                           if len(lat_ms) else None),
+        "latency_note": ("probe batches ride the same wire/pipeline as "
+                         "the load; p* = send-to-export wall time under "
+                         "full soak load, CPU zscore scoring path"),
     }
     with open(os.path.join(REPO, "SOAK.json"), "w") as f:
         json.dump(result, f, indent=1)
