@@ -8,6 +8,7 @@ from .api import (  # noqa: F401
     Connector,
     Consumer,
     Exporter,
+    Extension,
     Factory,
     FanoutConsumer,
     Processor,
@@ -17,7 +18,8 @@ from .api import (  # noqa: F401
     register,
     registry,
 )
-from . import receivers, processors, exporters, connectors  # noqa: F401
+from . import (  # noqa: F401
+    receivers, processors, exporters, connectors, extensions)
 # network + shared-memory transports register their factories on import too
 # (safe here: both import only ..components.api, which is bound above)
 from .. import transport, wire  # noqa: E402,F401

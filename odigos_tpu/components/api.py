@@ -45,6 +45,7 @@ class ComponentKind(str, enum.Enum):
     PROCESSOR = "processor"
     EXPORTER = "exporter"
     CONNECTOR = "connector"
+    EXTENSION = "extension"
 
 
 @dataclass(frozen=True)
@@ -121,6 +122,17 @@ class Processor(Component, Consumer):
         out = self.process(batch)
         if out is not None and len(out):
             self.next_consumer.consume(out)
+
+
+class Extension(Component):
+    """A service-scoped component outside any pipeline (upstream extension
+    role, builder-config.yaml extensions: healthcheck/zpages/pprof/
+    authenticators): started before receivers, stopped after exporters,
+    never consumes data. Graph injection: extensions that need the live
+    graph (zpages, healthcheck) get ``set_graph`` called before start."""
+
+    def set_graph(self, graph) -> None:  # optional hook
+        pass
 
 
 class Exporter(Component, Consumer):

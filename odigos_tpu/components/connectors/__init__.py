@@ -1,2 +1,3 @@
 from . import (  # noqa: F401
-    forward, router, anomalyrouter, spanmetrics, servicegraph, count)
+    forward, router, anomalyrouter, spanmetrics, servicegraph, count,
+    routing, exceptions)

@@ -1,0 +1,105 @@
+"""``exceptions`` connector — traces in, exception metrics/logs out.
+
+Upstream's exceptionsconnector (collector/builder-config.yaml:108)
+counts exception span events per (service, span name, exception type)
+into ``exceptions_total`` and optionally re-emits them as log records.
+Our span model carries exceptions as span attributes
+(``exception.type``/``exception.message``, the semconv the hooks tracer
+writes) plus ERROR status; the aggregation is one vectorized pass:
+error-mask → np.unique over (service, name) with per-row exception type
+from the attr side-list.
+
+Routing: metric outputs go to pipelines whose name starts with
+``metrics``, log outputs to ``logs`` pipelines — the upstream
+signal-typed connector contract.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from ...pdata.logs import LogBatchBuilder, Severity
+from ...pdata.metrics import MetricBatchBuilder, MetricType
+from ...pdata.spans import SpanBatch, StatusCode
+from ..api import ComponentKind, Connector, Factory, register
+
+
+class ExceptionsConnector(Connector):
+    """Config: exemplars (bool — also emit one log record per exception
+    span, default True when a logs pipeline is attached)."""
+
+    def consume(self, batch: SpanBatch) -> None:
+        if not isinstance(batch, SpanBatch) or not len(batch):
+            return
+        status = batch.col("status_code").astype(np.int64)
+        err = status == int(StatusCode.ERROR)
+        has_exc = np.array([
+            "exception.type" in batch.span_attrs[i]
+            or "exception.message" in batch.span_attrs[i]
+            for i in range(len(batch))])
+        mask = err | has_exc
+        if not mask.any():
+            return
+        idx = np.nonzero(mask)[0]
+        services = batch.service_names()
+        names = batch.span_names()
+        now = time.time_ns()
+
+        # ---- exceptions_total per (service, span name, exception type)
+        counts: dict[tuple[str, str, str], int] = {}
+        for i in idx:
+            etype = str(batch.span_attrs[int(i)].get(
+                "exception.type", "unknown"))
+            key = (services[int(i)], names[int(i)], etype)
+            counts[key] = counts.get(key, 0) + 1
+        mb = MetricBatchBuilder()
+        for (svc, span_name, etype), count in counts.items():
+            res = mb.add_resource({"service.name": svc})
+            mb.add_point(
+                name="exceptions_total", value=float(count),
+                metric_type=MetricType.SUM, time_unix_nano=now,
+                resource_index=res,
+                attrs={"span.name": span_name,
+                       "exception.type": etype})
+        metrics = mb.build()
+
+        # ---- exemplar log records (upstream's logs signal output)
+        logs = None
+        if self.config.get("exemplars", True):
+            lb = LogBatchBuilder()
+            tid_hi = batch.col("trace_id_hi")
+            tid_lo = batch.col("trace_id_lo")
+            sid = batch.col("span_id")
+            for i in idx:
+                attrs = batch.span_attrs[int(i)]
+                res = lb.add_resource(
+                    {"service.name": services[int(i)]})
+                lb.add_record(
+                    body=str(attrs.get("exception.message",
+                                       attrs.get("exception.type",
+                                                 "exception"))),
+                    severity=Severity.ERROR, time_unix_nano=now,
+                    trace_id=(int(tid_hi[i]) << 64) | int(tid_lo[i]),
+                    span_id=int(sid[i]), resource_index=res,
+                    attrs={"span.name": names[int(i)],
+                           "exception.type": str(attrs.get(
+                               "exception.type", "unknown"))})
+            logs = lb.build()
+
+        for pname, out in self.outputs.items():
+            signal = pname.split("/", 1)[0]
+            if signal == "metrics":
+                out.consume(metrics)
+            elif signal == "logs" and logs is not None and len(logs):
+                out.consume(logs)
+
+
+register(Factory(
+    type_name="exceptions",
+    kind=ComponentKind.CONNECTOR,
+    create=ExceptionsConnector,
+    default_config=lambda: {"exemplars": True},
+))

@@ -1,1 +1,2 @@
-from . import blob, debug, filelog, mock, tracedb, vendor  # noqa: F401
+from . import (  # noqa: F401
+    blob, debug, filelog, mock, tracedb, vendor, syslog, wireformats)

@@ -162,17 +162,78 @@ def _googlecloud(c: dict) -> tuple[Optional[str], dict[str, str]]:
     return "https://telemetry.googleapis.com", {}
 
 
+def _sentry(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    from .wireformats import parse_sentry_dsn
+
+    parsed = parse_sentry_dsn(str(c.get("dsn", "")))
+    if not parsed:
+        return None, {}
+    scheme, _key, host, _project = parsed
+    return f"{scheme}://{host}", {}
+
+
+def _honeycombmarker(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    return c.get("api_url") or "https://api.honeycomb.io", {}
+
+
+def _pubsub(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    return (c.get("endpoint")
+            or "https://pubsub.googleapis.com"), {}
+
+
+def _mezmo(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    ep = c.get("ingest_url") or "https://logs.mezmo.com/otel/ingest/rest"
+    return ep, ({"apikey": str(c["ingest_key"])}
+                if c.get("ingest_key") else {})
+
+
+def _logicmonitor(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    ep = c.get("endpoint")
+    headers = {}
+    if (c.get("api_token") or {}).get("access_id"):
+        tok = c["api_token"]
+        headers["Authorization"] = \
+            f"LMv1 {tok['access_id']}:{tok.get('access_key', '')}"
+    elif c.get("headers"):
+        headers.update({str(k): str(v)
+                        for k, v in c["headers"].items()})
+    return ep, headers
+
+
+def _dataset(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    ep = c.get("dataset_url")
+    return ep, ({"Authorization": f"Bearer {c['api_key']}"}
+                if c.get("api_key") else {})
+
+
+def _tencentcls(c: dict) -> tuple[Optional[str], dict[str, str]]:
+    region = c.get("region")
+    if not region:
+        return None, {}
+    return f"https://{region}.cls.tencentcs.com", {}
+
+
 EXTRACTORS: dict[str, _Extractor] = {
     "otlphttp": _hdr_endpoint,
     "prometheusremotewrite": _hdr_endpoint,
+    "googlemanagedprometheus": _hdr_endpoint,
     "loki": _hdr_endpoint,
     "clickhouse": _hdr_endpoint,
     "signalfx": _hdr_endpoint,
     "sapm": _hdr_endpoint,
+    "sumologic": _hdr_endpoint,   # endpoint = the HTTP source URL
     "datadog": _datadog,
     "logzio": _logzio,
     "coralogix": _coralogix,
     "elasticsearch": _elasticsearch,
+    "zipkin": _hdr_endpoint,
+    "sentry": _sentry,
+    "honeycombmarker": _honeycombmarker,
+    "googlecloudpubsub": _pubsub,
+    "mezmo": _mezmo,
+    "logicmonitor": _logicmonitor,
+    "dataset": _dataset,
+    "tencentcloudlogservice": _tencentcls,
     # dedicated wire protocols (wireformats.py)
     "splunkhec": _splunkhec,
     "influxdb": _influxdb,
@@ -183,9 +244,13 @@ EXTRACTORS: dict[str, _Extractor] = {
     "awss3": _awss3,
     "googlecloud": _googlecloud,
     "azuremonitor": _azuremonitor,
-    # kafka is the one genuinely non-HTTP transport left: build + run
-    # degraded (visible drop) in this zero-egress build
+    # genuinely non-HTTP transports: build + run degraded (visible
+    # drop) in this zero-egress build — kafka/pulsar brokers, cassandra
+    # CQL, azure data explorer's OAuth'd Kusto ingest
     "kafka": _sdk_only,
+    "pulsar": _sdk_only,
+    "cassandra": _sdk_only,
+    "azuredataexplorer": _sdk_only,
 }
 
 
@@ -245,6 +310,22 @@ class VendorExporter(Exporter):
             scheme = str(auth.get("scheme", "Bearer"))
             self._headers["Authorization"] = \
                 f"{scheme} {expand_env(str(auth['token']))}"
+        elif auth.get("token_url") is not None:
+            # oauth2clientauthextension: client-credentials grant at
+            # start (upstream fetches/refreshes via oauth2.TokenSource;
+            # one fetch covers this process's lifetime here). A failed
+            # fetch leaves the exporter unauthenticated-but-running:
+            # the backend's 401 is terminal and visible, a crashed boot
+            # would take the whole collector down with it.
+            tok = self._oauth2_fetch(auth)
+            if tok:
+                self._headers["Authorization"] = f"Bearer {tok}"
+        elif auth.get("_type") == "googleclientauth":
+            # googleclientauthextension: ambient Google credentials; the
+            # zero-egress analog reads the operator-provided token env
+            tok = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN", "")
+            if tok:
+                self._headers["Authorization"] = f"Bearer {tok}"
         if self._url is not None:
             self._url = expand_env(self._url)
         self._headers = {k: expand_env(str(v))

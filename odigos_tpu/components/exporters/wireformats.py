@@ -413,8 +413,186 @@ def marshal_otlp_http_pathed(batch,
                         path=path, headers=headers)]
 
 
+
+
+
+# --------------------------------------------------------------- zipkin
+
+
+def marshal_zipkin(batch, config: dict[str, Any]) -> list[WireRequest]:
+    """Zipkin v2 JSON array to /api/v2/spans (zipkinexporter) — the
+    exact inverse of our zipkin receiver's intake mapping."""
+    docs = []
+    for row in _rows(batch):
+        if "start_unix_nano" not in row:
+            continue  # traces-only signal upstream
+        doc = {
+            "traceId": row["trace_id"],
+            "id": row["span_id"],
+            "parentId": (row["parent_span_id"]
+                         if row["parent_span_id"].strip("0") else None),
+            "name": row["name"],
+            "timestamp": row["start_unix_nano"] // 1000,
+            "duration": max((row["end_unix_nano"]
+                             - row["start_unix_nano"]) // 1000, 1),
+            "localEndpoint": {"serviceName": row["service"]},
+            "tags": {str(k): str(v)
+                     for k, v in row["attributes"].items()},
+        }
+        # zipkin v2 accepts ONLY CLIENT|SERVER|PRODUCER|CONSUMER; a real
+        # server 400s the whole array on anything else (INTERNAL spans
+        # omit the field, as upstream's zipkin translator does)
+        if row["kind"] in ("CLIENT", "SERVER", "PRODUCER", "CONSUMER"):
+            doc["kind"] = row["kind"]
+        docs.append(doc)
+    return [WireRequest(body=json.dumps(docs).encode(),
+                        path="/api/v2/spans")]
+
+
+# ------------------------------------------------------------ sumologic
+
+
+def marshal_sumologic(batch, config: dict[str, Any]) -> list[WireRequest]:
+    """Sumo HTTP source (sumologicexporter): logs as newline-joined
+    bodies with X-Sumo-* metadata headers; metrics as prometheus
+    exposition lines; traces as otlp-json."""
+    headers = {}
+    for cfg_key, header in (("source_category", "X-Sumo-Category"),
+                            ("source_name", "X-Sumo-Name"),
+                            ("source_host", "X-Sumo-Host")):
+        if config.get(cfg_key):
+            headers[header] = str(config[cfg_key])
+    if isinstance(batch, LogBatch):
+        body = "\n".join(r["body"] for r in _rows(batch)).encode()
+        return [WireRequest(body=body, headers=headers,
+                            content_type="text/plain")]
+    if isinstance(batch, MetricBatch):
+        lines = []
+        for r in _rows(batch):
+            labels = ",".join(
+                f'{k}="{v}"' for k, v in sorted(r["attributes"].items()))
+            lines.append(f"{r['name']}{{{labels}}} {r['value']} "
+                         f"{r['time_unix_nano'] // 10**6}")
+        return [WireRequest(body="\n".join(lines).encode(),
+                            headers=headers,
+                            content_type=("application/vnd.sumologic."
+                                          "prometheus"))]
+    doc = {"resourceSpans": _rows(batch)}
+    return [WireRequest(body=json.dumps(doc, default=str).encode(),
+                        headers=headers)]
+
+
+# --------------------------------------------------------------- sentry
+
+
+_DSN_RE = re.compile(
+    r"(https?)://([^@:/]+)(?::([^@/]+))?@([^/]+)/(\d+)")
+
+
+def parse_sentry_dsn(dsn: str):
+    """(scheme, public_key, host, project) or None — ONE parser for the
+    extractor and the marshaller (legacy key:secret DSNs included)."""
+    m = _DSN_RE.match(dsn or "")
+    if not m:
+        return None
+    return m.group(1), m.group(2), m.group(4), m.group(5)
+
+
+def marshal_sentry(batch, config: dict[str, Any]) -> list[WireRequest]:
+    """Sentry envelope endpoint (sentryexporter): one envelope of
+    transaction items; DSN parsed for the project id + public key."""
+    dsn = str(config.get("dsn", ""))
+    parsed = parse_sentry_dsn(dsn)
+    key, project = (parsed[1], parsed[3]) if parsed else ("", "0")
+    lines = [json.dumps({"dsn": dsn})]
+    for row in _rows(batch):
+        if "start_unix_nano" not in row:
+            continue
+        item = {
+            "type": "transaction",
+            "transaction": row["name"],
+            "event_id": row["span_id"].rjust(32, "0"),
+            "start_timestamp": row["start_unix_nano"] / 1e9,
+            "timestamp": row["end_unix_nano"] / 1e9,
+            "contexts": {"trace": {"trace_id": row["trace_id"],
+                                    "span_id": row["span_id"],
+                                    "op": row["kind"]}},
+            "tags": {str(k): str(v)
+                     for k, v in row["attributes"].items()},
+        }
+        payload = json.dumps(item)
+        lines.append(json.dumps({"type": "transaction",
+                                 "length": len(payload)}))
+        lines.append(payload)
+    headers = {"X-Sentry-Auth": (f"Sentry sentry_key={key}, "
+                                 "sentry_version=7")} if key else {}
+    return [WireRequest(body="\n".join(lines).encode(),
+                        path=f"/api/{project}/envelope/",
+                        headers=headers,
+                        content_type="application/x-sentry-envelope")]
+
+
+# ------------------------------------------------------ honeycombmarker
+
+
+def marshal_honeycomb_marker(batch,
+                             config: dict[str, Any]) -> list[WireRequest]:
+    """honeycombmarkerexporter: one marker per matching log record to
+    /1/markers/{dataset} with the team key header."""
+    dataset = str(config.get("dataset", "__all__"))
+    headers = {}
+    if config.get("api_key"):
+        headers["X-Honeycomb-Team"] = str(config["api_key"])
+    reqs = []
+    for row in _rows(batch):
+        marker = {
+            "message": row.get("body") or row.get("name", ""),
+            "type": str(config.get("marker_type", "otel")),
+            "start_time": int((row.get("time_unix_nano")
+                               or row.get("start_unix_nano") or 0)
+                              / 1e9),
+        }
+        reqs.append(WireRequest(body=json.dumps(marker).encode(),
+                                path=f"/1/markers/{dataset}",
+                                headers=headers))
+    return reqs or [WireRequest(body=b"[]",
+                                path=f"/1/markers/{dataset}",
+                                headers=headers)]
+
+
+# --------------------------------------------------- googlecloudpubsub
+
+
+def marshal_pubsub(batch, config: dict[str, Any]) -> list[WireRequest]:
+    """googlecloudpubsubexporter: REST publish — otlp-json document
+    base64-wrapped in a Pub/Sub message."""
+    import base64
+    import os
+
+    if isinstance(batch, MetricBatch):
+        doc = {"resourceMetrics": _rows(batch)}
+    elif isinstance(batch, LogBatch):
+        doc = {"resourceLogs": _rows(batch)}
+    else:
+        doc = {"resourceSpans": _rows(batch)}
+    topic = str(config.get("topic", ""))  # projects/<p>/topics/<t>
+    payload = {"messages": [{"data": base64.b64encode(
+        json.dumps(doc, default=str).encode()).decode()}]}
+    headers = {}
+    token = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN", "")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    return [WireRequest(body=json.dumps(payload).encode(),
+                        path=f"/v1/{topic}:publish", headers=headers)]
+
+
 MARSHALLERS: dict[str, Marshaller] = {
     "googlecloud": marshal_otlp_http_pathed,
+    "zipkin": marshal_zipkin,
+    "sumologic": marshal_sumologic,
+    "sentry": marshal_sentry,
+    "honeycombmarker": marshal_honeycomb_marker,
+    "googlecloudpubsub": marshal_pubsub,
     "splunkhec": marshal_splunk_hec,
     "influxdb": marshal_influx_line,
     "opensearch": marshal_bulk_ndjson,

@@ -1,0 +1,1 @@
+from . import healthcheck, pprofz, zpages  # noqa: F401

@@ -1,0 +1,52 @@
+"""``healthcheck`` extension — liveness/readiness over HTTP.
+
+Upstream's healthcheckextension (collector/builder-config.yaml:11): an
+HTTP endpoint k8s probes hit. ``GET /`` (and ``/health``) answers 200
+while every component in the graph reports healthy, 503 with the
+failing component names otherwise — wired to the same ``healthy()``
+hook the OpAMP status aggregation reads.
+
+Binds 0.0.0.0 by default: kubelet probes the POD ip, never loopback
+(upstream default 0.0.0.0:13133). Config: ``endpoint``/``host``/``port``
+(0 = ephemeral; resolved on ``.port`` after start).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..api import ComponentKind, Factory, register
+from .httpbase import HttpExtension, Page
+
+
+class HealthCheckExtension(HttpExtension):
+    DEFAULT_HOST = "0.0.0.0"
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._graph = None
+
+    def set_graph(self, graph) -> None:
+        self._graph = graph
+
+    def _status(self, q: dict[str, str]) -> tuple[int, dict]:
+        graph = self._graph
+        if graph is None:
+            return 503, {"status": "unavailable", "reason": "no graph"}
+        unhealthy = [c.name for c in graph.all_components()
+                     if c is not self and not c.healthy()]
+        if unhealthy:
+            return 503, {"status": "unavailable",
+                         "unhealthy": sorted(unhealthy)}
+        return 200, {"status": "ok"}
+
+    def pages(self) -> dict[str, Page]:
+        return {"": self._status, "/health": self._status}
+
+
+register(Factory(
+    type_name="healthcheck",
+    kind=ComponentKind.EXTENSION,
+    create=HealthCheckExtension,
+    default_config=lambda: {"port": 0},
+))

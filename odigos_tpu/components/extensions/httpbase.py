@@ -1,0 +1,88 @@
+"""Shared HTTP lifecycle for the debug/health extensions.
+
+healthcheck/zpages/pprof are each "a tiny HTTP server serving a few
+JSON pages"; this base owns the server lifecycle (bind, daemon thread,
+clean shutdown) so the extensions declare only their page functions.
+
+Config shared by all subclasses::
+
+    endpoint: "0.0.0.0:13133"    # or host: / port: separately
+    port: 0                      # 0 = ephemeral (resolved on .port)
+
+healthcheck defaults to 0.0.0.0 (kubelet probes the POD ip, never
+loopback — upstream healthcheckextension default 0.0.0.0:13133); the
+debug-only pages (zpages/pprof) default to loopback.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from typing import Any, Callable, Optional
+
+from ..api import Extension
+
+Page = Callable[[dict[str, str]], tuple[int, Any]]  # query -> (code, body)
+
+
+class HttpExtension(Extension):
+    DEFAULT_HOST = "127.0.0.1"
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        endpoint = str(config.get("endpoint", ""))
+        if ":" in endpoint:
+            host, _, port_s = endpoint.rpartition(":")
+            self.host = host or self.DEFAULT_HOST
+            self._want_port = int(port_s)
+        else:
+            self.host = str(config.get("host", self.DEFAULT_HOST))
+            self._want_port = int(config.get("port", 0))
+        self.port: Optional[int] = None
+        self._http: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def pages(self) -> dict[str, Page]:
+        """path (trailing slash stripped) -> page fn; subclass hook."""
+        raise NotImplementedError
+
+    def start(self) -> None:
+        super().start()
+        from urllib.parse import parse_qs, urlparse
+
+        pages = self.pages()
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802
+                url = urlparse(self.path)
+                fn = pages.get(url.path.rstrip("/"))
+                if fn is None:
+                    self.send_error(404)
+                    return
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                code, body = fn(q)
+                payload = json.dumps(body, indent=1).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a) -> None:
+                pass
+
+        self._http = http.server.ThreadingHTTPServer(
+            (self.host, self._want_port), Handler)
+        self.port = self._http.server_address[1]
+        self._thread = threading.Thread(
+            target=self._http.serve_forever,
+            name=f"{type(self).__name__}-{self.name}", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+        super().shutdown()

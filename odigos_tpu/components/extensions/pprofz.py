@@ -1,0 +1,85 @@
+"""``pprof`` extension — in-process profiling endpoint.
+
+Upstream's pprofextension (collector/builder-config.yaml:12) exposes Go
+pprof. The Python-runtime analog serves:
+
+* ``/debug/threadz``  — instantaneous stacks of every thread (the
+                        goroutine-dump role; first stop for a wedged
+                        pipeline)
+* ``/debug/profile?seconds=S&hz=H`` — statistical sampling profile:
+  samples ``sys._current_frames`` at H hz for S seconds and returns
+  collapsed stacks with counts (flamegraph-ready "folded" format, one
+  ``frame;frame;frame count`` line per stack), JSON-wrapped.
+
+Sampling happens in the handler thread: the data plane pays only the
+GIL checkpoints it already pays, nothing runs when nobody asks.
+
+Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``,
+``max_seconds`` (profile cap, default 30).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Any
+
+from ..api import ComponentKind, Factory, register
+from .httpbase import HttpExtension, Page
+
+
+def thread_stacks() -> dict[str, list[str]]:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        stack = [f"{f.filename}:{f.lineno}:{f.name}"
+                 for f in traceback.extract_stack(frame)]
+        out[names.get(ident, str(ident))] = stack
+    return out
+
+
+def sample_profile(seconds: float, hz: float) -> list[str]:
+    """Collapsed-stack statistical profile of every thread."""
+    interval = 1.0 / max(hz, 1.0)
+    me = threading.get_ident()
+    counts: Counter = Counter()
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = ";".join(
+                f.name for f in traceback.extract_stack(frame))
+            counts[stack] += 1
+        time.sleep(interval)
+    return [f"{stack} {n}" for stack, n in counts.most_common()]
+
+
+class PprofExtension(HttpExtension):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.max_seconds = float(config.get("max_seconds", 30.0))
+
+    def _threadz(self, q: dict[str, str]) -> tuple[int, dict]:
+        return 200, {"threads": thread_stacks()}
+
+    def _profile(self, q: dict[str, str]) -> tuple[int, dict]:
+        seconds = min(float(q.get("seconds", 1.0)), self.max_seconds)
+        hz = min(float(q.get("hz", 97.0)), 997.0)
+        return 200, {"seconds": seconds, "hz": hz,
+                     "folded": sample_profile(seconds, hz)}
+
+    def pages(self) -> dict[str, Page]:
+        return {"/debug/threadz": self._threadz,
+                "/debug/profile": self._profile}
+
+
+register(Factory(
+    type_name="pprof",
+    kind=ComponentKind.EXTENSION,
+    create=PprofExtension,
+    default_config=lambda: {"port": 0},
+))

@@ -1,0 +1,71 @@
+"""``zpages`` extension — live in-process diagnostics pages.
+
+Upstream's zpagesextension (collector/builder-config.yaml:9) serves
+``/debug/pipelinez`` etc. from inside the running collector.  Ours
+serves JSON (terminal-first operators curl it):
+
+* ``/debug/pipelinez``   — pipeline topology: receivers, per-pipeline
+                           processor chains, exporters/connectors
+* ``/debug/servicez``    — component inventory with health
+* ``/debug/extensionz``  — running extensions
+
+Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..api import ComponentKind, Factory, register
+from .httpbase import HttpExtension, Page
+
+
+class ZPagesExtension(HttpExtension):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._graph = None
+
+    def set_graph(self, graph) -> None:
+        self._graph = graph
+
+    def _pipelinez(self, q: dict[str, str]) -> tuple[int, dict]:
+        g = self._graph
+        if g is None:
+            return 503, {}
+        return 200, {
+            "receivers": sorted(g.receivers),
+            "pipelines": {
+                pname: [p.name for p in procs]
+                for pname, procs in g.pipeline_processors.items()},
+            "exporters": sorted(g.exporters),
+            "connectors": sorted(g.connectors),
+            "pipeline_order": list(g.pipeline_order),
+        }
+
+    def _servicez(self, q: dict[str, str]) -> tuple[int, dict]:
+        g = self._graph
+        if g is None:
+            return 503, {}
+        return 200, {"components": [
+            {"name": c.name, "healthy": bool(c.healthy()),
+             "type": type(c).__name__}
+            for c in g.all_components()]}
+
+    def _extensionz(self, q: dict[str, str]) -> tuple[int, dict]:
+        g = self._graph
+        if g is None:
+            return 503, {}
+        return 200, {"extensions": sorted(g.extensions)}
+
+    def pages(self) -> dict[str, Page]:
+        return {"/debug/pipelinez": self._pipelinez,
+                "/debug/servicez": self._servicez,
+                "/debug/extensionz": self._extensionz}
+
+
+register(Factory(
+    type_name="zpages",
+    kind=ComponentKind.EXTENSION,
+    create=ZPagesExtension,
+    default_config=lambda: {"port": 0},
+))

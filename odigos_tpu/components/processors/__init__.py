@@ -4,4 +4,5 @@ from . import (  # noqa: F401
     conditionalattributes, logsresourceattrs, filter, resourcename,
     cumulativetodelta, deltatorate, transform, resourcedetection,
     probabilisticsampler, groupbyattrs, metricstransform,
-    metricsgeneration, span, redaction, remotetap)
+    metricsgeneration, span, redaction, remotetap, tailsampling,
+    sumologic)

@@ -59,7 +59,7 @@ class GroupByTraceProcessor(Processor):
             if len(self._first_seen) > self.num_traces:
                 evict = self._release_locked(self._evict_cutoff_locked())
         if evict:
-            self.next_consumer.consume(evict)
+            self._emit(evict)
 
     def _evict_cutoff_locked(self) -> float:
         """First-seen cutoff that keeps the newest ``num_traces`` traces:
@@ -96,14 +96,19 @@ class GroupByTraceProcessor(Processor):
         with self._lock:
             out = self._release_locked(self._clock() - self.wait_duration_s)
         if out:
-            self.next_consumer.consume(out)
+            self._emit(out)
 
     def flush(self) -> None:
         """Release everything (shutdown path)."""
         with self._lock:
             out = self._release_locked(np.inf)
         if out:
-            self.next_consumer.consume(out)
+            self._emit(out)
+
+    def _emit(self, out: SpanBatch) -> None:
+        """Release hook: subclasses (tailsampling) decide per released
+        trace before forwarding; the base forwards everything."""
+        self.next_consumer.consume(out)
 
     # ---------------------------------------------------------- lifecycle
     def _schedule(self) -> None:

@@ -115,10 +115,12 @@ def render_manifests(config: Configuration,
     nd = node_resources(config.collector_node,
                         config.resource_size_preset or None)
     cgroup_mounts = (
-        [{"name": "cgroup", "hostPath": "/sys/fs/cgroup"}]
+        [{"name": "cgroup", "hostPath": {"path": "/sys/fs/cgroup"}}]
         if cgroup_v == 2 else
-        [{"name": "cgroup-cpu", "hostPath": "/sys/fs/cgroup/cpu"},
-         {"name": "cgroup-mem", "hostPath": "/sys/fs/cgroup/memory"}])
+        [{"name": "cgroup-cpu",
+          "hostPath": {"path": "/sys/fs/cgroup/cpu"}},
+         {"name": "cgroup-mem",
+          "hostPath": {"path": "/sys/fs/cgroup/memory"}}])
     odiglet_containers = [{
         "name": "odiglet",
         "image": f"{config.image_prefix or 'odigos-tpu'}/odiglet",
@@ -157,10 +159,11 @@ def render_manifests(config: Configuration,
             "hostIPC": False,
             "containers": odiglet_containers,
             "volumes": [
-                {"name": "odigos", "hostPath": "/var/odigos"},
-                {"name": "proc", "hostPath": "/proc"},
+                {"name": "odigos", "hostPath": {"path": "/var/odigos"}},
+                {"name": "proc", "hostPath": {"path": "/proc"}},
                 {"name": "pod-resources",
-                 "hostPath": "/var/lib/kubelet/pod-resources"},
+                 "hostPath": {"path":
+                              "/var/lib/kubelet/pod-resources"}},
                 *cgroup_mounts,
             ],
         }}},

@@ -43,6 +43,10 @@ class Graph:
     receivers: dict[str, Receiver] = field(default_factory=dict)
     exporters: dict[str, Exporter] = field(default_factory=dict)
     connectors: dict[str, Connector] = field(default_factory=dict)
+    # service-scoped components outside any pipeline (healthcheck, zpages,
+    # pprof — upstream extension role); authenticator extensions stay
+    # config-only (resolved into exporter configs, never instantiated)
+    extensions: dict[str, "Component"] = field(default_factory=dict)
     # (pipeline, id) -> processor instance
     processors: dict[tuple[str, str], Processor] = field(default_factory=dict)
     pipeline_entries: dict[str, Consumer] = field(default_factory=dict)
@@ -52,8 +56,13 @@ class Graph:
     pipeline_processors: dict[str, list[Processor]] = field(default_factory=dict)
 
     def all_components(self) -> list[Component]:
-        return (list(self.exporters.values()) + list(self.connectors.values())
-                + list(self.processors.values()) + list(self.receivers.values()))
+        # extensions first: healthcheck must be able to answer before any
+        # data flows (upstream starts extensions ahead of pipelines)
+        return (list(self.extensions.values())
+                + list(self.exporters.values())
+                + list(self.connectors.values())
+                + list(self.processors.values())
+                + list(self.receivers.values()))
 
     def processors_topological(self) -> list[Processor]:
         """Processors ordered so flushing each in turn pushes pending data
@@ -109,6 +118,15 @@ def validate_config(config: dict[str, Any]) -> list[str]:
     # exporter silently sending unauthenticated would be worse)
     extensions = config.get("extensions", {})
     enabled_ext = set(config.get("service", {}).get("extensions", []))
+    from ..components.api import registry as _registry
+
+    for xid in enabled_ext:
+        xtype = xid.split("/", 1)[0]
+        if not _registry.has(ComponentKind.EXTENSION, xtype) \
+                and xid not in extensions:
+            problems.append(
+                f"service.extensions lists {xid!r}: no extension "
+                f"factory for type {xtype!r} and no extensions entry")
     for eid, ecfg in config.get("exporters", {}).items():
         ref = (ecfg or {}).get("auth", {}).get("authenticator")
         if ref and ref not in extensions:
@@ -190,10 +208,33 @@ def build_graph(config: dict[str, Any],
     # extension's settings are inlined into the exporter config as
     # auth_resolved so components never need the global document.
     extensions = config.get("extensions", {})
+    # runnable extensions (healthcheck/zpages/pprof) instantiate from the
+    # registry; authenticator extensions (basicauth/bearertokenauth/...)
+    # have no factory and stay config-only, resolved into exporter
+    # configs below — both listed under the same service.extensions key,
+    # exactly the upstream split between running and auth extensions
+    for xid in config.get("service", {}).get("extensions", []):
+        xtype = xid.split("/", 1)[0]
+        if reg.has(ComponentKind.EXTENSION, xtype):
+            g.extensions[xid] = reg.get(
+                ComponentKind.EXTENSION, xtype).build(
+                    xid, extensions.get(xid) or {})
+        elif xid not in extensions:
+            # a typo'd id would otherwise build a collector that looks
+            # healthy but silently lacks its health endpoint (upstream
+            # otelcol errors on an unknown extension reference too)
+            raise ValueError(
+                f"service.extensions lists {xid!r}: no extension "
+                f"factory for type {xtype!r} and no extensions "
+                f"config entry (authenticator)")
     for eid, ecfg in config.get("exporters", {}).items():
         ref = (ecfg or {}).get("auth", {}).get("authenticator")
         if ref:
-            ecfg = {**ecfg, "auth_resolved": extensions[ref]}
+            # the extension TYPE rides along so the exporter knows which
+            # authenticator semantics apply (basicauth vs bearertoken vs
+            # oauth2client vs googleclientauth)
+            ecfg = {**ecfg, "auth_resolved": {
+                "_type": ref.split("/", 1)[0], **extensions[ref]}}
         g.exporters[eid] = reg.get(ComponentKind.EXPORTER, eid).build(eid, ecfg)
     for cid, ccfg in conn_cfgs.items():
         g.connectors[cid] = reg.get(ComponentKind.CONNECTOR, cid).build(cid, ccfg)
@@ -236,5 +277,11 @@ def build_graph(config: dict[str, Any],
         recv = reg.get(ComponentKind.RECEIVER, rid).build(rid, rcfg)
         recv.set_consumer(feeds[0] if len(feeds) == 1 else FanoutConsumer(feeds))
         g.receivers[rid] = recv
+
+    # graph-aware extensions (zpages topology, healthcheck component
+    # polling) see the finished graph before anything starts
+    for ext in g.extensions.values():
+        if hasattr(ext, "set_graph"):
+            ext.set_graph(g)
 
     return g

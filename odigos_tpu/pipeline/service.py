@@ -80,6 +80,8 @@ class Collector:
             conn.shutdown()
         for exp in graph.exporters.values():
             exp.shutdown()
+        for ext in graph.extensions.values():
+            ext.shutdown()  # last: health answers until the end
 
     # ------------------------------------------------------------ hot swap
     def reload(self, new_config: dict[str, Any]) -> None:

@@ -101,11 +101,11 @@ class TestManifests:
         sc = odiglet(osft)["spec"]["template"]["spec"]["containers"][0][
             "securityContext"]
         assert sc["seLinuxOptions"]["type"] == "spc_t"
-        # cgroup v1: split hierarchy mounts
-        v1_paths = [v["hostPath"] for v in
+        # cgroup v1: split hierarchy mounts (valid k8s hostPath shape)
+        v1_paths = [v["hostPath"]["path"] for v in
                     odiglet(osft)["spec"]["template"]["spec"]["volumes"]]
         assert "/sys/fs/cgroup/cpu" in v1_paths
-        v2_paths = [v["hostPath"] for v in
+        v2_paths = [v["hostPath"]["path"] for v in
                     odiglet(base)["spec"]["template"]["spec"]["volumes"]]
         assert "/sys/fs/cgroup" in v2_paths
         # tpu: deviceplugin container + gateway TPU resource

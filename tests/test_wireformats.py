@@ -235,9 +235,11 @@ class TestBodyCap:
         assert len(store.requests) == 1
 
 
-def test_only_kafka_remains_on_the_drop_path():
-    """VERDICT r4 item 5 'done' bar: odigos_vendor_dropped_total moves
-    only for kafka."""
+def test_only_non_http_transports_remain_on_the_drop_path():
+    """VERDICT r4 item 5 'done' bar, extended by the round-5 vendor
+    additions: odigos_vendor_dropped_total moves only for the genuinely
+    non-HTTP transports (kafka/pulsar brokers, cassandra CQL, ADX's
+    OAuth'd Kusto ingest)."""
     from odigos_tpu.components.exporters.vendor import EXTRACTORS
     from odigos_tpu.utils.telemetry import meter
 
@@ -259,6 +261,15 @@ def test_only_kafka_remains_on_the_drop_path():
             "splunkhec": {"endpoint": "https://x.example"},
             "influxdb": {"endpoint": "https://x.example"},
             "opensearch": {"endpoints": ["https://x.example"]},
+            "googlemanagedprometheus": {"endpoint": "https://x.example"},
+            "sumologic": {"endpoint": "https://x.example"},
+            "zipkin": {"endpoint": "https://x.example"},
+            "sentry": {"dsn": "https://k@sentry.example/42"},
+            "mezmo": {"ingest_key": "k"},
+            "logicmonitor": {"endpoint": "https://x.example"},
+            "dataset": {"dataset_url": "https://x.example",
+                        "api_key": "k"},
+            "tencentcloudlogservice": {"region": "ap-guangzhou"},
         }.get(vt, {})
         exp = registry.get(ComponentKind.EXPORTER, vt).build(
             f"{vt}/dropcheck", {**cfg, "max_retries": 0,
@@ -276,7 +287,8 @@ def test_only_kafka_remains_on_the_drop_path():
         if after > before:
             droppers.append(vt)
         exp.shutdown()
-    assert droppers == ["kafka"], droppers
+    assert droppers == ["azuredataexplorer", "cassandra", "kafka",
+                        "pulsar"], droppers
 
 
 def test_s3_keys_unique_across_split_halves(tmp_path, monkeypatch):
@@ -311,3 +323,123 @@ def test_azure_debug_maps_to_verbose(tmp_path):
         "connection_string": "InstrumentationKey=i"})
     env = json.loads(reqs[0].body)[0]
     assert env["data"]["baseData"]["severityLevel"] == 0  # Verbose
+
+
+class TestRound5VendorAdditions:
+    def test_zipkin_v2_roundtrips_through_our_receiver(self, store):
+        """The zipkin exporter's output must be valid input for our own
+        zipkin receiver — the inverse-mapping contract."""
+        _export("zipkin", {"endpoint": "ignored"}, store,
+                synthesize_traces(3, seed=6))
+        req = store.requests[0]
+        assert req["path"] == "/api/v2/spans"
+        docs = json.loads(req["body"])
+        assert docs and all(d["localEndpoint"]["serviceName"]
+                            for d in docs)
+        from odigos_tpu.components.receivers.zipkin import translate_spans
+
+        batch = translate_spans(docs)
+        assert len(batch) == len(docs)
+
+    def test_sumologic_logs_with_source_headers(self, store):
+        _export("sumologic", {"endpoint": "ignored",
+                              "source_category": "prod/x"},
+                store, _logs())
+        req = store.requests[0]
+        assert hget(req, "X-Sumo-Category") == "prod/x"
+        assert req["body"] == b"hello"
+
+    def test_sentry_envelope_shape(self, store):
+        _export("sentry", {"dsn": "https://pubkey@o0.ingest.sentry.io/42",
+                           "endpoint_override": store.url},
+                store, synthesize_traces(1, seed=7))
+        req = store.requests[0]
+        assert req["path"] == "/api/42/envelope/"
+        assert "sentry_key=pubkey" in hget(req, "X-Sentry-Auth")
+        lines = req["body"].decode().splitlines()
+        assert json.loads(lines[0])["dsn"].startswith("https://pubkey@")
+        item_header = json.loads(lines[1])
+        assert item_header["type"] == "transaction"
+        assert json.loads(lines[2])["transaction"]
+
+    def test_honeycomb_marker(self, store):
+        _export("honeycombmarker",
+                {"api_key": "hck", "dataset": "prod"}, store, _logs())
+        req = store.requests[0]
+        assert req["path"] == "/1/markers/prod"
+        assert hget(req, "X-Honeycomb-Team") == "hck"
+        assert json.loads(req["body"])["message"] == "hello"
+
+    def test_pubsub_publish_base64(self, store):
+        import base64
+
+        _export("googlecloudpubsub",
+                {"topic": "projects/p/topics/t"}, store, _logs())
+        req = store.requests[0]
+        assert req["path"] == "/v1/projects/p/topics/t:publish"
+        msg = json.loads(req["body"])["messages"][0]
+        inner = json.loads(base64.b64decode(msg["data"]))
+        assert inner["resourceLogs"]
+
+
+class TestSyslogExporter:
+    def test_rfc5424_frames_over_real_tcp(self):
+        import socket
+        import threading
+
+        received = []
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def accept():
+            conn, _ = srv.accept()
+            data = b""
+            while b"\n" not in data:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+            received.append(data)
+            conn.close()
+
+        t = threading.Thread(target=accept, daemon=True)
+        t.start()
+        exp = registry.get(ComponentKind.EXPORTER, "syslog").build(
+            "syslog/t", {"endpoint": "127.0.0.1", "port": port,
+                         "protocol": "tcp"})
+        exp.start()
+        try:
+            from odigos_tpu.pdata.logs import LogBatchBuilder, Severity
+
+            b = LogBatchBuilder()
+            res = b.add_resource({"service.name": "cart",
+                                  "host.name": "n1"})
+            b.add_record(body="disk full", severity=Severity.ERROR,
+                         resource_index=res,
+                         time_unix_nano=1_700_000_000_000_000_000)
+            exp.export(b.build())
+            t.join(timeout=10)
+        finally:
+            exp.shutdown()
+            srv.close()
+        assert received, "no syslog frame arrived"
+        frame = received[0].decode()
+        # <PRI>1 TIMESTAMP HOSTNAME APP ... MSG
+        assert frame.startswith("<131>1 2023-11-14T"), frame  # 16*8+3
+        assert " n1 cart - - - disk full\n" in frame
+
+    def test_non_log_batches_drop_visibly(self):
+        from odigos_tpu.utils.telemetry import meter
+
+        exp = registry.get(ComponentKind.EXPORTER, "syslog").build(
+            "syslog/d", {"endpoint": "127.0.0.1", "port": 1})
+        exp.start()
+        before = meter.counter(
+            "odigos_vendor_dropped_total{exporter=syslog/d}")
+        exp.export(synthesize_traces(2, seed=1))
+        after = meter.counter(
+            "odigos_vendor_dropped_total{exporter=syslog/d}")
+        assert after > before
+        exp.shutdown()
