@@ -93,6 +93,33 @@ def _device_reachable(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def _git_identity() -> dict:
+    """Short HEAD + dirty flag of the tree this run measured. Stamped into
+    EVERY emitted record (round-5 stale-evidence complaint: a snapshot
+    with ``snapshot_git: "(not recorded)"`` cannot be matched to code, so
+    drift checks degrade to "assume stale"). Re-recorded snapshots
+    inherit the field automatically because it rides the result dict."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=here)
+        status = subprocess.run(
+            ["git", "status", "--porcelain"], capture_output=True,
+            text=True, cwd=here)
+    except OSError:
+        return {"git": "", "git_dirty": True}
+    head = rev.stdout.strip()
+    if rev.returncode != 0 or not head or status.returncode != 0:
+        # a failed git probe (exported tree, dubious-ownership refusal)
+        # must read as "unmatched", never as a clean identity
+        return {"git": "", "git_dirty": True}
+    return {"git": head, "git_dirty": bool(status.stdout.strip())}
+
+
 def _snapshot_drift() -> dict:
     """Compare the committed TPU snapshot's code identity against HEAD
     (VERDICT r4 item 8): a CPU-fallback run must say explicitly whether
@@ -139,6 +166,7 @@ def main() -> None:
 
     result = with_retry(lambda: throughput_bench(on_tpu), "throughput")
     result["platform"] = dev.platform
+    result.update(_git_identity())
     if infra_note:
         result["infra_note"] = infra_note
         result.update(_snapshot_drift())

@@ -25,6 +25,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional, Protocol
 
+from ..selftelemetry.tracer import tracer
 from .resources import ObjectMeta, Resource
 
 
@@ -204,10 +205,18 @@ class ControllerManager:
         with self._lock:
             self._pending.discard(item)
             reg = self._registrations[reg_idx]
-        try:
-            reg.reconciler.reconcile(self.store, key)
-        except Exception as e:  # reconcile errors are recorded, not fatal
-            self.errors.append((reg.name, key, e))
+        # one self-tracing span per reconcile pass (controller + key +
+        # outcome): the reconcile-loop view the diagnose bundle ships
+        with tracer.span(f"reconcile/{reg.name}") as sp:
+            sp.set_attr("namespace", key[0])
+            sp.set_attr("name", key[1])
+            try:
+                reg.reconciler.reconcile(self.store, key)
+            except Exception as e:  # reconcile errors are recorded, not fatal
+                sp.set_attr("outcome", f"error:{type(e).__name__}")
+                self.errors.append((reg.name, key, e))
+            else:
+                sp.set_attr("outcome", "ok")
 
     def run_once(self, max_iterations: int = 10_000) -> int:
         """Drain until quiescent (reconciles may enqueue further work).

@@ -546,7 +546,7 @@ def cmd_describe(args) -> int:
 def cmd_diagnose(args) -> int:
     from .diagnose import collect_bundle
 
-    path = collect_bundle(_load(args), args.output)
+    path = collect_bundle(_load(args), args.output, redact=args.redact)
     print(f"bundle written: {path}")
     return 0
 
@@ -845,6 +845,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("diagnose", help="collect a support bundle")
     p.add_argument("-o", "--output", default=None)
+    p.add_argument("--redact", action="store_true",
+                   help="strip destination-secret values from every "
+                        "archived file (span attributes, metric labels, "
+                        "resource dumps)")
     p.set_defaults(fn=cmd_diagnose)
 
     return ap

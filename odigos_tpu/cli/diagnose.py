@@ -1,7 +1,14 @@
 """Diagnose — support-bundle collection (odigos diagnose;
 cli/cmd/diagnose.go + k8sutils/pkg/diagnose/ in the reference): dump the
 full installation state, effective config, self-telemetry metrics snapshot,
-and environment info into one tar.gz an operator can attach to a bug report.
+the internal-tracing span ring, and environment info into one tar.gz an
+operator can attach to a bug report.
+
+``--redact`` strips destination-secret values (delivered env credentials
+and the CLI secrets file) from every archived file before it is written:
+span attributes, metric label values, and resource dumps all pass through
+the same scrub, so a bundle built from a cluster with live credentials is
+safe to attach to a public issue.
 """
 
 from __future__ import annotations
@@ -12,14 +19,17 @@ import os
 import platform
 import tarfile
 import time
-from typing import Optional
+from typing import Iterable, Optional
 
 from ..controlplane.scheduler import (
     EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE)
+from ..selftelemetry.tracer import tracer
 from ..utils.serde import to_jsonable
 from ..utils.telemetry import meter
 from .describe import describe_install
 from .state import CliState
+
+REDACTED = "[REDACTED]"
 
 
 def _add_file(tar: tarfile.TarFile, name: str, content: str) -> None:
@@ -30,33 +40,79 @@ def _add_file(tar: tarfile.TarFile, name: str, content: str) -> None:
     tar.addfile(info, io.BytesIO(data))
 
 
-def collect_bundle(state: CliState, out_path: Optional[str] = None) -> str:
+def _secret_values(state: CliState) -> list[str]:
+    """Every destination-secret VALUE reachable from this install: the
+    CLI secrets file plus the env vars destination configers reference as
+    ``${NAME}`` (the frontend delivers submitted credentials there).
+    Values shorter than 4 chars are skipped — scrubbing them would
+    mangle unrelated text more than it would protect anything."""
+    from ..destinations.registry import referenced_secret_env_names
+
+    values = set(state.secrets.values())
+    for env_name in referenced_secret_env_names(
+            state.store.list("DestinationResource")):
+        v = os.environ.get(env_name)
+        if v:
+            values.add(v)
+    # longest first: when one secret is a prefix of another (sk-abcd /
+    # sk-abcd-prod-…), replacing the short one first would leave the
+    # long one's distinguishing suffix in cleartext
+    return sorted((v for v in values if len(v) >= 4),
+                  key=lambda v: (-len(v), v))
+
+
+def _redact_text(content: str, secrets: Iterable[str]) -> str:
+    """Replace each secret value (and its JSON-escaped form — archived
+    files are JSON, where e.g. a quote in a token appears as ``\\"``)
+    with the redaction marker."""
+    for secret in secrets:
+        content = content.replace(secret, REDACTED)
+        escaped = json.dumps(secret)[1:-1]
+        if escaped != secret:
+            content = content.replace(escaped, REDACTED)
+    return content
+
+
+def collect_bundle(state: CliState, out_path: Optional[str] = None,
+                   redact: bool = False) -> str:
     """Write the support bundle; returns its path."""
     out_path = out_path or os.path.join(
         state.path, f"odigos-diagnose-{int(time.time())}.tar.gz")
+    secrets = _secret_values(state) if redact else []
+
     with tarfile.open(out_path, "w:gz") as tar:
+        def add(name: str, content: str) -> None:
+            if secrets:
+                content = _redact_text(content, secrets)
+            _add_file(tar, name, content)
+
         # resources, kind by kind (the kubectl-get-everything analog)
         for kind, objs in sorted(state.store._objects.items()):
             dump = json.dumps([to_jsonable(r) for r in objs.values()],
                               indent=1, sort_keys=True)
-            _add_file(tar, f"resources/{kind}.json", dump)
-        _add_file(tar, "cluster.json",
-                  json.dumps(state.cluster.to_dict(), indent=1))
-        _add_file(tar, "config/authored.json",
-                  json.dumps(state.config.to_dict(), indent=1))
+            add(f"resources/{kind}.json", dump)
+        add("cluster.json", json.dumps(state.cluster.to_dict(), indent=1))
+        add("config/authored.json",
+            json.dumps(state.config.to_dict(), indent=1))
         eff = state.store.get("ConfigMap", ODIGOS_NAMESPACE,
                               EFFECTIVE_CONFIG_NAME)
         if eff is not None:
-            _add_file(tar, "config/effective.json",
-                      json.dumps(to_jsonable(eff.data), indent=1))
+            add("config/effective.json",
+                json.dumps(to_jsonable(eff.data), indent=1))
         # self-telemetry snapshot (the pprof/metrics piece of the bundle)
-        _add_file(tar, "metrics.json",
-                  json.dumps(meter.snapshot(), indent=1, sort_keys=True))
-        _add_file(tar, "describe.txt", describe_install(state))
-        _add_file(tar, "environment.json", json.dumps({
+        add("metrics.json",
+            json.dumps(meter.snapshot(), indent=1, sort_keys=True))
+        # internal-tracing span ring: where time went inside the pipeline,
+        # the reconcile loops, and the TPU scoring engine right before the
+        # bundle was cut — the evidence layer for latency bug reports
+        add("selftrace.json",
+            json.dumps(tracer.snapshot(), indent=1, sort_keys=True))
+        add("describe.txt", describe_install(state))
+        add("environment.json", json.dumps({
             "python": platform.python_version(),
             "platform": platform.platform(),
             "state_dir": state.path,
+            "redacted": bool(secrets),
             "collected_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         }, indent=1))
     return out_path

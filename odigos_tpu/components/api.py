@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Protocol, runtime_checkable
 
 from ..pdata.spans import SpanBatch
+from ..selftelemetry.tracer import is_selftelemetry_batch, tracer
 
 
 class Signal(str, enum.Enum):
@@ -119,7 +120,24 @@ class Processor(Component, Consumer):
         return batch
 
     def consume(self, batch: SpanBatch) -> None:
-        out = self.process(batch)
+        # self-tracing weave: the stage span covers process() only;
+        # downstream consume happens after it closes, so sibling stage
+        # spans under one pipeline span sum to the pipeline's duration.
+        # Stateful processors that override consume() record their own
+        # telemetry (enforced by test_package_hygiene). Self-span
+        # batches (resource marker) never generate spans about
+        # themselves, on any thread — see is_selftelemetry_batch.
+        if not tracer.enabled or is_selftelemetry_batch(batch):
+            out = self.process(batch)
+            if out is not None and len(out):
+                self.next_consumer.consume(out)
+            return
+        with tracer.span(f"processor/{self.name}") as sp:
+            sp.set_attr("batch.spans", len(batch))
+            out = self.process(batch)
+            n_out = 0 if out is None else len(out)
+            if n_out != len(batch):
+                sp.set_attr("batch.spans_out", n_out)
         if out is not None and len(out):
             self.next_consumer.consume(out)
 
@@ -137,7 +155,15 @@ class Extension(Component):
 
 class Exporter(Component, Consumer):
     def consume(self, batch: SpanBatch) -> None:
-        self.export(batch)
+        if not tracer.enabled or is_selftelemetry_batch(batch):
+            self.export(batch)
+            return
+        with tracer.span(f"exporter/{self.name}") as sp:
+            sp.set_attr("batch.spans", len(batch))
+            queued = getattr(self, "queued", None)
+            if queued is not None:
+                sp.set_attr("queue.depth", int(queued))
+            self.export(batch)
 
     def export(self, batch: SpanBatch) -> None:
         raise NotImplementedError

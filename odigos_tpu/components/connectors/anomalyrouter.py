@@ -22,6 +22,7 @@ from typing import Any
 import numpy as np
 
 from ...pdata.spans import SpanBatch
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 from ..processors.tpuanomaly import FLAG_ATTR
 
@@ -36,11 +37,15 @@ class AnomalyRouterConnector(Connector):
             raise ValueError(f"{name}: mode must be 'span' or 'trace'")
         self.mirror = bool(config.get("mirror", False))
         self.flag_attr = config.get("flag_attr", FLAG_ATTR)
+        self._flagged_metric = labeled_key(
+            "odigos_anomalyrouter_flagged_spans_total", connector=name)
 
     def consume(self, batch: SpanBatch) -> None:
         flag = self.flag_attr
         flagged = np.fromiter((flag in a for a in batch.span_attrs),
                               bool, len(batch))
+        if flagged.any():
+            meter.add(self._flagged_metric, int(flagged.sum()))
         if self.mode == "trace" and flagged.any():
             # expand to whole traces: flag every span sharing a trace id with
             # a flagged span (vectorized via structured trace-key match)

@@ -19,6 +19,7 @@ import numpy as np
 from ...pdata.logs import LogBatch
 from ...pdata.metrics import MetricBatch, MetricBatchBuilder, MetricType
 from ...pdata.spans import SpanBatch
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 
 
@@ -26,10 +27,16 @@ class CountConnector(Connector):
     """Config: span_metric / log_metric / metric_metric override the
     emitted metric names."""
 
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._points_metric = labeled_key(
+            "odigos_connector_points_total", connector=name)
+
     def consume(self, batch: Any) -> None:
         if not batch:
             return
         out = self.aggregate(batch)
+        meter.add(self._points_metric, len(out))
         for consumer in self.outputs.values():
             consumer.consume(out)
 

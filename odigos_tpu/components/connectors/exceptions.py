@@ -24,12 +24,18 @@ import numpy as np
 from ...pdata.logs import LogBatchBuilder, Severity
 from ...pdata.metrics import MetricBatchBuilder, MetricType
 from ...pdata.spans import SpanBatch, StatusCode
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 
 
 class ExceptionsConnector(Connector):
     """Config: exemplars (bool — also emit one log record per exception
     span, default True when a logs pipeline is attached)."""
+
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self._exc_metric = labeled_key(
+            "odigos_connector_exception_spans_total", connector=name)
 
     def consume(self, batch: SpanBatch) -> None:
         if not isinstance(batch, SpanBatch) or not len(batch):
@@ -43,6 +49,7 @@ class ExceptionsConnector(Connector):
         mask = err | has_exc
         if not mask.any():
             return
+        meter.add(self._exc_metric, int(mask.sum()))
         idx = np.nonzero(mask)[0]
         services = batch.service_names()
         names = batch.span_names()

@@ -8,11 +8,18 @@ every configured output pipeline unchanged.
 from __future__ import annotations
 
 from ...pdata.spans import SpanBatch
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 
 
 class ForwardConnector(Connector):
+    def __init__(self, name, config):
+        super().__init__(name, config)
+        self._spans_metric = labeled_key(
+            "odigos_connector_spans_total", connector=name)
+
     def consume(self, batch: SpanBatch) -> None:
+        meter.add(self._spans_metric, len(batch))
         for consumer in self.outputs.values():
             consumer.consume(batch)
 

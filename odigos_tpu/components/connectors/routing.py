@@ -32,6 +32,7 @@ import numpy as np
 from ...pdata.logs import LogBatch
 from ...pdata.metrics import MetricBatch
 from ...pdata.spans import SpanBatch
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 from ..processors import ottl
 
@@ -42,6 +43,8 @@ class RoutingConnector(Connector):
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         self.default_pipelines = list(config.get("default_pipelines", []))
+        self._spans_metric = labeled_key(
+            "odigos_connector_spans_total", connector=name)
         self.table = []
         for entry in config.get("table", []):
             cond_src = entry.get("condition") or ""
@@ -65,6 +68,7 @@ class RoutingConnector(Connector):
         n = len(batch)
         if n == 0:
             return
+        meter.add(self._spans_metric, n)
         if not self.table:
             self._emit(batch, self.default_pipelines)
             return

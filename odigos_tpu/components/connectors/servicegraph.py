@@ -26,6 +26,7 @@ import numpy as np
 
 from ...pdata.metrics import MetricBatchBuilder, MetricType, group_histograms
 from ...pdata.spans import SpanBatch, StatusCode
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 
 _DEFAULT_BOUNDS_MS = (2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
@@ -38,12 +39,15 @@ class ServiceGraphConnector(Connector):
         self.bounds = np.asarray(
             config.get("histogram_bounds_ms", _DEFAULT_BOUNDS_MS),
             dtype=np.float64)
+        self._points_metric = labeled_key(
+            "odigos_connector_points_total", connector=name)
 
     def consume(self, batch: SpanBatch) -> None:
         if not batch:
             return
         out = self.aggregate(batch)
         if len(out):
+            meter.add(self._points_metric, len(out))
             for consumer in self.outputs.values():
                 consumer.consume(out)
 

@@ -21,6 +21,7 @@ import numpy as np
 
 from ...pdata.metrics import MetricBatchBuilder, MetricType, group_histograms
 from ...pdata.spans import SpanBatch, SpanKind, StatusCode
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 
 _DEFAULT_BOUNDS_MS = (2.0, 4.0, 6.0, 8.0, 10.0, 50.0, 100.0, 200.0, 400.0,
@@ -44,10 +45,13 @@ class SpanMetricsConnector(Connector):
             config.get("histogram_bounds_ms", _DEFAULT_BOUNDS_MS),
             dtype=np.float64)
         self.extra_dimensions: list[str] = list(config.get("dimensions", []))
+        self._spans_metric = labeled_key(
+            "odigos_connector_spans_total", connector=name)
 
     def consume(self, batch: SpanBatch) -> None:
         if not batch:
             return
+        meter.add(self._spans_metric, len(batch))
         out = self.aggregate(batch)
         for consumer in self.outputs.values():
             consumer.consume(out)

@@ -27,6 +27,7 @@ import numpy as np
 
 from ...pdata.spans import SpanBatch, concat_batches
 from ...pdata.traces import TraceView, trace_keys
+from ...utils.telemetry import labeled_key, meter
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
 
@@ -41,6 +42,10 @@ class GroupByTraceProcessor(Processor):
         tick = config.get("tick_interval_s")
         self.tick_interval_s = float(
             tick if tick is not None else max(self.wait_duration_s / 4, 0.05))
+        self._buffered_gauge = labeled_key(
+            "odigos_groupbytrace_buffered_traces", processor=name)
+        self._evicted_metric = labeled_key(
+            "odigos_groupbytrace_evicted_spans_total", processor=name)
         self._lock = threading.Lock()
         self._pending: list[SpanBatch] = []
         self._first_seen: dict[bytes, float] = {}  # trace key bytes → time
@@ -58,7 +63,10 @@ class GroupByTraceProcessor(Processor):
                 self._first_seen.setdefault(key.tobytes(), now)
             if len(self._first_seen) > self.num_traces:
                 evict = self._release_locked(self._evict_cutoff_locked())
+            meter.set_gauge(self._buffered_gauge,
+                            float(len(self._first_seen)))
         if evict:
+            meter.add(self._evicted_metric, len(evict))
             self._emit(evict)
 
     def _evict_cutoff_locked(self) -> float:

@@ -1,2 +1,3 @@
 from . import (  # noqa: F401  (registers factories on import)
-    filelog, hostmetrics, kubeletstats, prometheus, synthetic, zipkin)
+    filelog, hostmetrics, kubeletstats, prometheus, selftelemetry,
+    synthetic, zipkin)

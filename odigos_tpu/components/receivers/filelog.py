@@ -154,6 +154,10 @@ class FilelogReceiver(Receiver):
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._offsets_dirty = False
+        # serializes polls: the background loop, the drain hook, and test
+        # callers may overlap, and two concurrent scans of the same tail
+        # both read from the same offset — duplicated records
+        self._poll_lock = threading.Lock()
 
     # --------------------------------------------------- offset checkpoint
 
@@ -239,6 +243,10 @@ class FilelogReceiver(Receiver):
         At-least-once: per-file offsets are committed only after the
         consumer accepts the batch; a failed consume re-reads the same
         bytes next poll (duplicates possible, loss not)."""
+        with self._poll_lock:
+            return self._poll_once_locked()
+
+    def _poll_once_locked(self) -> int:
         max_records = int(self.config.get("max_batch_records", 4096))
         builder = LogBatchBuilder()
         # (tail, new_offset, pending_before) proposals, committed on success

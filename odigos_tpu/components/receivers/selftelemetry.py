@@ -1,0 +1,113 @@
+"""``selftelemetry`` receiver — the dogfood loop.
+
+The reference injects a self-telemetry pipeline into every managed
+collector (autoscaler clustercollector/configmap.go:42 +
+odigostrafficmetrics); our analog feeds the process-global internal
+tracer's span ring into whatever pipeline configures this receiver, as
+ordinary SpanBatch pdata. The ring is read through a ``total``-watermark
+cursor, NOT drained: /api/selftrace and the diagnose bundle keep their
+recent-span evidence even with the dogfood pipeline exporting every
+second. Spans evicted before a read could see them are counted on
+``odigos_selftrace_missed_spans_total``. Guarded by configuration: no
+pipeline lists ``selftelemetry`` → nothing runs and minimal installs are
+unchanged.
+
+Emission happens under ``tracer.suppressed()``, and the emitted batches
+carry the ``odigos.selftelemetry`` resource marker that every weave site
+checks (``is_selftelemetry_batch``) — so the dogfood pipeline's own
+stages never trace themselves recursively, even when a batch processor
+re-flushes the batch on a timer thread or a wire hop carries it to
+another collector (the OTel Collector excludes its internal-telemetry
+pipeline the same way).
+
+Config:
+    interval_s: drain cadence (default 1.0)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ...selftelemetry.tracer import tracer
+from ...utils.telemetry import labeled_key, meter
+from ..api import ComponentKind, Factory, Receiver, Signal, register
+
+EMITTED_METRIC = "odigos_selftrace_exported_spans_total"
+MISSED_METRIC = "odigos_selftrace_missed_spans_total"
+
+
+class SelfTelemetryReceiver(Receiver):
+    def __init__(self, name: str, config: dict[str, Any]):
+        super().__init__(name, config)
+        self.interval_s = float(config.get("interval_s", 1.0))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # export watermark against ring.total: first emit ships whatever
+        # is buffered at that point, later emits only the delta
+        self._cursor = 0
+        # serializes emits: the interval thread, the drain hook, and
+        # shutdown's final pass may overlap — two concurrent reads of
+        # the same cursor would export the same window twice
+        self._emit_lock = threading.Lock()
+        self._emitted_metric = labeled_key(EMITTED_METRIC, receiver=name)
+        self._missed_metric = labeled_key(MISSED_METRIC, receiver=name)
+
+    def start(self) -> None:
+        super().start()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"selftelemetry-{self.name}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            self.emit()  # final drain: spans buffered since the last tick
+        except Exception:
+            meter.add("odigos_selftrace_export_failures_total")
+        super().shutdown()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Collector.drain_receivers hook: push pending spans now."""
+        self.emit()
+
+    def emit(self) -> int:
+        """One export pass; returns the number of spans emitted."""
+        with self._emit_lock:
+            return self._emit_locked()
+
+    def _emit_locked(self) -> int:
+        spans, cursor, missed = tracer.ring.since(self._cursor)
+        batch = tracer.to_batch(spans)
+        if batch is not None:
+            with tracer.suppressed():
+                self.next_consumer.consume(batch)
+            meter.add(self._emitted_metric, len(batch))
+        # the watermark (and the missed count riding on it) advances
+        # only after a successful hand-off: a rejecting downstream
+        # retries this window next tick instead of losing it, and the
+        # retry does not re-count the same missed spans
+        self._cursor = cursor
+        if missed:
+            meter.add(self._missed_metric, missed)
+        return 0 if batch is None else len(batch)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.emit()
+            except Exception:
+                # downstream pressure: spans are droppable telemetry —
+                # count, never wedge the drain thread
+                meter.add("odigos_selftrace_export_failures_total")
+
+
+register(Factory(
+    type_name="selftelemetry", kind=ComponentKind.RECEIVER,
+    create=SelfTelemetryReceiver, signals=(Signal.TRACES,),
+    default_config=lambda: {"interval_s": 1.0}))

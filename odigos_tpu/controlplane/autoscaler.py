@@ -42,6 +42,7 @@ from ..pipelinegen import (
     build_gateway_config,
     build_node_collector_config,
 )
+from ..selftelemetry.tracer import tracer
 from .scheduler import EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE
 
 GATEWAY_CONFIG_NAME = "odigos-gateway-config"
@@ -241,8 +242,19 @@ class Autoscaler:
             anomaly=self.config.anomaly,
             ui_endpoint=self.config.ui_endpoint,
         )
-        config, status, enabled_signals = build_gateway_config(
-            destinations, processors, data_streams, options)
+        with tracer.span("autoscaler/render-gateway-config") as sp:
+            sp.set_attr("cr.kind", "ConfigMap")
+            sp.set_attr("cr.name", GATEWAY_CONFIG_NAME)
+            sp.set_attr("destinations", len(destinations))
+            sp.set_attr("processors", len(processors))
+            config, status, enabled_signals = build_gateway_config(
+                destinations, processors, data_streams, options)
+            # status.destination maps every dest id; None means success
+            sp.set_attr("outcome",
+                        "errors" if any(
+                            v is not None
+                            for v in status.destination.values())
+                        else "rendered")
 
         store.apply(ConfigMap(
             meta=ObjectMeta(name=GATEWAY_CONFIG_NAME,
@@ -299,12 +311,20 @@ class Autoscaler:
         co-scheduled with TPU devices (north star: the virtual-device
         affinity pattern of distros/yamls/golang-community.yaml:15-18
         applied to gateway replicas)."""
-        desired = self.hpa.desired_replicas(
-            self.gateway_replicas, cpu_pct, memory_pct, rejections_per_pod,
-            now)
-        group = self._gateway_group(self.store)
-        if group is not None:
-            desired = self._co_schedule_tpu(desired, group)
+        with tracer.span("autoscaler/hpa-observe") as sp:
+            sp.set_attr("cpu_pct", round(cpu_pct, 2))
+            sp.set_attr("memory_pct", round(memory_pct, 2))
+            sp.set_attr("rejections_per_pod", round(rejections_per_pod, 2))
+            desired = self.hpa.desired_replicas(
+                self.gateway_replicas, cpu_pct, memory_pct,
+                rejections_per_pod, now)
+            group = self._gateway_group(self.store)
+            if group is not None:
+                desired = self._co_schedule_tpu(desired, group)
+            sp.set_attr("outcome",
+                        "scale" if desired != self.gateway_replicas
+                        else "steady")
+            sp.set_attr("replicas", desired)
         self.gateway_replicas = desired
         return self.gateway_replicas
 

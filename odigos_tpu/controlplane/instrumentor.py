@@ -52,6 +52,7 @@ from ..api.resources import (
 from ..api.store import ControllerManager, Event, Store
 from ..config.model import Configuration
 from ..distros.registry import DISTROS_BY_NAME, DistroProvider
+from ..selftelemetry.tracer import tracer
 from .cluster import Cluster, Pod, PodPhase
 
 OTEL_SERVICE_NAME_ATTR = "service.name"
@@ -137,6 +138,16 @@ class Instrumentor:
                    if c.agent_enabled}
         if not enabled:
             return
+        with tracer.span("instrumentor/pod-webhook") as sp:
+            sp.set_attr("cr.kind", pod.workload_kind.value)
+            sp.set_attr("cr.name", f"{pod.namespace}/{pod.workload_name}")
+            sp.set_attr("containers", len(enabled))
+            self._mutate_pod(pod, ref, ic, enabled)
+            sp.set_attr("outcome", "mutated")
+
+    def _mutate_pod(self, pod: Pod, ref: WorkloadRef,
+                    ic: InstrumentationConfig,
+                    enabled: dict[str, ContainerAgentConfig]) -> None:
         service_name = ic.service_name or ref.name
         pod.resource_attrs.update({
             OTEL_SERVICE_NAME_ATTR: service_name,
@@ -375,9 +386,17 @@ class _AgentEnabledReconciler:
         ic = store.get("InstrumentationConfig", namespace, name)
         if not isinstance(ic, InstrumentationConfig):
             return
+        with tracer.span("instrumentor/agent-enabled") as sp:
+            sp.set_attr("cr.kind", "InstrumentationConfig")
+            sp.set_attr("cr.name", f"{namespace}/{name}")
+            self._reconcile_ic(store, ic, sp)
+
+    def _reconcile_ic(self, store: Store, ic: InstrumentationConfig,
+                      sp) -> None:
         cfg = self.i.config
 
         if not ic.runtime_details:
+            sp.set_attr("outcome", "waiting-for-detection")
             if ic.set_condition(Condition(
                     RUNTIME_DETECTION, ConditionStatus.FALSE,
                     RuntimeDetectionReason.WAITING_FOR_DETECTION.value,
@@ -391,6 +410,7 @@ class _AgentEnabledReconciler:
 
         # rollback check before (re-)enabling (rollout.go:325 podHasBackOff)
         if self._check_rollback(store, ic):
+            sp.set_attr("outcome", "rolled-back")
             return
         agent_cond = ic.condition(AGENT_ENABLED)
         if agent_cond is not None and agent_cond.reason in (
@@ -398,6 +418,7 @@ class _AgentEnabledReconciler:
                 AgentEnabledReason.IMAGE_PULL_BACK_OFF.value):
             # rolled back: stay un-instrumented until the operator heals the
             # workload and re-applies the Source (rollback stability)
+            sp.set_attr("outcome", "rollback-hold")
             if dirty:
                 store.update_status(ic)
             return
@@ -430,6 +451,9 @@ class _AgentEnabledReconciler:
                 AGENT_ENABLED, ConditionStatus.FALSE, worst.value,
                 "; ".join(c.message for c in containers if c.message)))
 
+        sp.set_attr("outcome", "agents-enabled" if any_enabled
+                    else "agents-disabled")
+        sp.set_attr("rollout", bool(changed))
         if changed:
             self._rollout(ic)
         if changed or dirty:

@@ -20,6 +20,7 @@ from ..api.resources import (
 from ..api.store import ControllerManager, Store
 from ..config.effective import calculate_effective_config
 from ..config.model import Configuration, Tier
+from ..selftelemetry.tracer import tracer
 
 ODIGOS_NAMESPACE = "odigos-system"
 AUTHORED_CONFIG_NAME = "odigos-configuration"
@@ -73,9 +74,16 @@ class Scheduler:
             except ValueError:
                 tier_problem = (f"unknown tier {cm.data['tier']!r} in "
                                 f"authored config; using {self.tier.value}")
-        eff = calculate_effective_config(authored, tier)
-        if tier_problem:
-            eff.problems.append(tier_problem)
+        with tracer.span("scheduler/effective-config") as sp:
+            sp.set_attr("cr.kind", "ConfigMap")
+            sp.set_attr("cr.name", AUTHORED_CONFIG_NAME)
+            eff = calculate_effective_config(authored, tier)
+            if tier_problem:
+                eff.problems.append(tier_problem)
+            sp.set_attr("outcome",
+                        "problems" if eff.problems else "applied")
+            sp.set_attr("profiles", len(eff.applied_profiles))
+            sp.set_attr("problems", len(eff.problems))
 
         store.apply(ConfigMap(
             meta=ObjectMeta(name=EFFECTIVE_CONFIG_NAME,

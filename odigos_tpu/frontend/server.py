@@ -45,6 +45,7 @@ from ..api.resources import (
 from ..api.store import Event, Store
 from ..controlplane.scheduler import (
     EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE)
+from ..selftelemetry.tracer import tracer
 from ..utils.serde import to_jsonable
 from ..utils.telemetry import meter
 from .collector_metrics import CollectorMetricsConsumer
@@ -288,6 +289,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if path == "/api/selftrace":
+                # recent internal traces (the framework tracing itself):
+                # ring-buffer spans grouped per trace, most recent
+                # first; ?spans=1 opts into the per-span detail (the
+                # polled panel only needs the per-trace headline)
+                try:
+                    limit = max(1, min(int(q.get("limit", 50)), 500))
+                except ValueError:
+                    return self._error("limit must be an integer")
+                include = q.get("spans", "0") not in ("0", "false", "")
+                return self._json(tracer.summary(limit, include))
             if path == "/api/sources":
                 return self._json(_resource_list(
                     store, "Source", q.get("namespace")))

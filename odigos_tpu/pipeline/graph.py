@@ -36,6 +36,7 @@ from ..components.api import (
     Registry,
     registry as default_registry,
 )
+from ..selftelemetry import trace_pipeline_entry
 
 
 @dataclass
@@ -255,7 +256,11 @@ def build_graph(config: dict[str, Any],
             chain.append(proc)
             tail = proc
         g.pipeline_processors[pname] = list(reversed(chain))
-        g.pipeline_entries[pname] = tail
+        # self-tracing weave: one pipeline/<name> span per batch at the
+        # entry; receivers and connector outputs both route through the
+        # entry map, so every ingress edge is covered. Free when the
+        # tracer is disabled (TracedEntry's fast path).
+        g.pipeline_entries[pname] = trace_pipeline_entry(pname, tail)
     g.pipeline_order = _topological_pipelines(pipelines)
 
     # 3. connector outputs: downstream pipeline name -> entry consumer

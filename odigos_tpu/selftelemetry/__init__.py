@@ -1,0 +1,20 @@
+"""Self-telemetry: the framework tracing itself through its own pipeline.
+
+``tracer`` is the process-global internal tracer (spans over the data
+plane, control plane, and TPU scoring engine); ``TracedEntry`` is the
+pipeline-graph weave; the ``selftelemetry`` receiver factory
+(components/receivers/selftelemetry.py) re-enters completed spans into a
+configured pipeline as ordinary pdata.
+"""
+
+from .instrument import TracedEntry, trace_pipeline_entry  # noqa: F401
+from .tracer import (  # noqa: F401
+    DROPPED_METRIC,
+    SCOPE,
+    SPANS_METRIC,
+    SelfTracer,
+    Span,
+    SpanRing,
+    is_selftelemetry_batch,
+    tracer,
+)

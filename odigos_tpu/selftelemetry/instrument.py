@@ -1,0 +1,41 @@
+"""Pipeline-graph instrumentation: the per-stage span weave.
+
+The graph builder wraps every pipeline entry with ``TracedEntry`` so each
+batch entering a pipeline opens one ``pipeline/<name>`` span. Component
+base classes (``components.api``) open the per-stage spans *flat* under
+it — a stage span covers the stage's own work only, downstream consume
+happens after the span closes — so sibling stage latencies sum to the
+pipeline span's duration (the "where does the time go" view the soak
+p99 investigation was missing), instead of telescoping cumulatively.
+"""
+
+from __future__ import annotations
+
+from ..pdata.spans import SpanBatch
+from .tracer import is_selftelemetry_batch, tracer
+
+
+class TracedEntry:
+    """Wraps a pipeline's entry consumer with a per-batch pipeline span.
+
+    Transparent when tracing is disabled (one attribute load + branch);
+    exceptions propagate unchanged either way (memory-limiter rejections
+    must still reach the receiver's backpressure path)."""
+
+    __slots__ = ("pipeline", "inner")
+
+    def __init__(self, pipeline: str, inner):
+        self.pipeline = pipeline
+        self.inner = inner
+
+    def consume(self, batch: SpanBatch) -> None:
+        if not tracer.enabled or is_selftelemetry_batch(batch):
+            self.inner.consume(batch)
+            return
+        with tracer.span(f"pipeline/{self.pipeline}") as sp:
+            sp.set_attr("batch.spans", len(batch))
+            self.inner.consume(batch)
+
+
+def trace_pipeline_entry(pipeline: str, entry) -> TracedEntry:
+    return TracedEntry(pipeline, entry)

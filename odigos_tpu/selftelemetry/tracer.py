@@ -1,0 +1,351 @@
+"""Internal self-tracing: spans for the framework's own hot paths.
+
+A distributed-tracing framework that cannot trace itself is the canonical
+dogfooding gap (OTel Collector's ``service::telemetry`` internal traces;
+Dapper-style propagation in PAPERS.md). The round-5 verdict could not
+explain the saturated-soak p99 because the process-local meter only held
+aggregates — no span-level view of where time goes inside the pipeline,
+the reconcile loops, or the TPU scoring engine.
+
+This module is that view:
+
+* ``SelfTracer.span()`` opens a lightweight internal span (128-bit trace
+  id, 64-bit span id, parent link via the shared W3C contextvar in
+  ``hooks.tracecontext``, wall-clock start + **monotonic** duration,
+  attributes). Completed spans land in a bounded in-memory ring buffer
+  and increment ``odigos_selftrace_spans_total{span=<name>}`` so the
+  Prometheus ``/metrics`` surface sees span counts without scraping the
+  ring.
+* Spans convert to the framework's own pdata (``drain_batch()`` →
+  ``SpanBatch``) and are re-enterable into a configured pipeline via the
+  ``selftelemetry`` receiver — the dogfood loop. ``suppressed()`` marks
+  the dogfood pipeline's own consumption so exporting self-spans never
+  traces itself recursively.
+* Sharing the ``hooks.tracecontext`` contextvar means internal spans,
+  manual app spans, and W3C ``traceparent`` headers all join one trace:
+  the wire exporter stamps the active context into the frame header and
+  the wire receiver re-parents under it, so a batch's path through
+  node-collector → gateway is a single coherent trace.
+
+The tracer is process-global (``tracer``), enabled by default, and can
+be switched off with ``ODIGOS_SELFTRACE=0`` or ``tracer.enabled =
+False`` — the disabled fast path is one attribute load and a branch per
+call site, so minimal installs pay nothing measurable.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import random
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from ..hooks.tracecontext import _active, parse_traceparent
+from ..pdata.spans import SpanBatch, SpanBatchBuilder, SpanKind, StatusCode
+from ..utils.telemetry import labeled_key, meter
+
+SPANS_METRIC = "odigos_selftrace_spans_total"
+DROPPED_METRIC = "odigos_selftrace_dropped_spans_total"
+SCOPE = "odigos.selftelemetry"
+
+# set while the dogfood pipeline consumes the tracer's own output: spans
+# opened under suppression are not recorded (no recursive self-tracing)
+_suppress: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "odigos_selftrace_suppress", default=False)
+
+
+def is_selftelemetry_batch(batch) -> bool:
+    """True when the batch carries the tracer's own resource marker.
+
+    The contextvar-scoped ``suppressed()`` only covers the emit thread;
+    a batch processor buffering the dogfood batch flushes it later on a
+    Timer thread where the contextvar is unset, and the wire hop moves
+    self-spans to another process entirely. The marker rides the batch
+    itself, so every weave site can refuse to record spans ABOUT
+    self-span batches on whatever thread (or node) they travel —
+    otherwise each flush of exported self-spans would mint new spans,
+    a perpetual self-feeding trickle with zero real traffic."""
+    return any(r.get("odigos.selftelemetry")
+               for r in getattr(batch, "resources", ()))
+
+
+class Span:
+    """A mutable in-flight internal span; immutable once ringed.
+
+    The span is its own context manager (enter stamps the clocks and
+    installs the trace context, exit finishes into the ring) — a plain
+    ``__enter__``/``__exit__`` pair, not ``@contextmanager``, because the
+    generator protocol costs more than the rest of the span bookkeeping
+    combined on the pipeline hot path."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_span_id", "kind",
+                 "status", "attrs", "start_unix_nano", "duration_ns",
+                 "_tracer", "_flags", "_token", "_t0")
+
+    def __init__(self, name: str, trace_id: int, span_id: int,
+                 parent_span_id: int, kind: int,
+                 attrs: Optional[dict[str, Any]], tracer: "SelfTracer",
+                 flags: int):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+        self.kind = kind
+        self.status = StatusCode.UNSET
+        self.attrs = dict(attrs) if attrs else {}
+        self.start_unix_nano = 0
+        self.duration_ns = 0
+        self._tracer = tracer
+        self._flags = flags
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        self._token = _active.set(
+            (self.trace_id, self.span_id, self._flags))
+        self.start_unix_nano = time.time_ns()
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _active.reset(self._token)
+        self.duration_ns = time.monotonic_ns() - self._t0
+        if exc_type is not None:
+            self.status = StatusCode.ERROR
+        self._tracer._finish(self)
+        return False  # errors escaping the block re-raise
+
+    @property
+    def end_unix_nano(self) -> int:
+        return self.start_unix_nano + self.duration_ns
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "trace_id": f"{self.trace_id:032x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent_span_id": f"{self.parent_span_id:016x}",
+            "name": self.name,
+            "kind": SpanKind(self.kind).name,
+            "status": StatusCode(self.status).name,
+            "start_unix_nano": self.start_unix_nano,
+            "duration_ms": round(self.duration_ns / 1e6, 4),
+            "attributes": dict(self.attrs),
+        }
+
+
+class _NullSpan:
+    """Shared no-op span handed out when tracing is off/suppressed."""
+
+    __slots__ = ()
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL = _NullSpan()
+# public no-op span for call sites that must suppress conditionally on
+# data (e.g. scoring a self-telemetry batch) rather than on tracer state
+NULL_SPAN = _NULL
+
+
+class SpanRing:
+    """Bounded ring of completed spans; overflow drops the oldest and
+    counts it (the tracer must never become the memory leak it exists
+    to find)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._buf: deque[Span] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self.total = 0
+
+    def append(self, span: Span) -> bool:
+        """Ring the span; True when an older span was evicted to make room."""
+        with self._lock:
+            dropped = len(self._buf) == self.capacity
+            if dropped:
+                self.dropped += 1
+            self._buf.append(span)
+            self.total += 1
+            return dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self._buf)
+
+    def drain(self) -> list[Span]:
+        with self._lock:
+            out = list(self._buf)
+            self._buf.clear()
+            return out
+
+    def since(self, cursor: int) -> tuple[list[Span], int, int]:
+        """Spans recorded after the ``total``-watermark ``cursor``,
+        WITHOUT clearing the ring — the dogfood exporter reads through
+        here so /api/selftrace and the diagnose bundle keep their
+        evidence. Returns ``(spans, new_cursor, missed)``; ``missed``
+        counts spans evicted before this read could see them."""
+        with self._lock:
+            new = self.total - cursor
+            if new <= 0:
+                return [], self.total, 0
+            missed = max(new - len(self._buf), 0)
+            take = new - missed
+            spans = list(self._buf)[-take:] if take else []
+            return spans, self.total, missed
+
+
+class SelfTracer:
+    """Process-global internal tracer; see module docstring."""
+
+    def __init__(self, service: str = "odigos-tpu",
+                 capacity: int = 4096) -> None:
+        self.service = service
+        self.ring = SpanRing(capacity)
+        self.enabled = os.environ.get("ODIGOS_SELFTRACE", "1") != "0"
+        self._rng = random.Random()
+        # span-name -> rendered counter key; span names are bounded
+        # (component/pipeline names), so this converges to a few dozen
+        # entries and turns _finish's label render into a dict hit
+        self._metric_keys: dict[str, str] = {}
+
+    # ------------------------------------------------------------- spans
+
+    def span(self, name: str, attrs: Optional[dict[str, Any]] = None,
+             kind: int = SpanKind.INTERNAL,
+             traceparent: Optional[str] = None):
+        """Open an internal span (``with tracer.span(...) as sp``). Joins
+        the active trace (or the remote ``traceparent`` for the
+        wire-receiver hop); errors escaping the block set ERROR status
+        and re-raise. The span is yielded so callers can attach
+        attributes mid-flight."""
+        if not self.enabled or _suppress.get():
+            return _NULL
+        parent = parse_traceparent(traceparent) if traceparent else \
+            _active.get()
+        if parent is not None:
+            trace_id, parent_span_id, flags = parent
+        else:
+            trace_id = self._rng.getrandbits(128) or 1
+            parent_span_id, flags = 0, 1
+        span_id = self._rng.getrandbits(64) or 1
+        return Span(name, trace_id, span_id, parent_span_id, kind, attrs,
+                    self, flags)
+
+    def _finish(self, span: Span) -> None:
+        if self.ring.append(span):
+            meter.add(DROPPED_METRIC)
+        key = self._metric_keys.get(span.name)
+        if key is None:
+            key = labeled_key(SPANS_METRIC, span=span.name)
+            if len(self._metric_keys) < 4096:  # cardinality backstop
+                self._metric_keys[span.name] = key
+        meter.add(key)
+
+    @contextmanager
+    def suppressed(self):
+        """No spans are recorded inside this block (dogfood-pipeline
+        guard: exporting the ring must not trace itself)."""
+        token = _suppress.set(True)
+        try:
+            yield
+        finally:
+            _suppress.reset(token)
+
+    # ---------------------------------------------------------- export
+
+    def to_batch(self, spans: list[Span]) -> Optional[SpanBatch]:
+        """Convert completed spans to the framework's own pdata — the
+        re-entry point into a configured pipeline."""
+        if not spans:
+            return None
+        b = SpanBatchBuilder()
+        res = b.add_resource({"service.name": self.service,
+                              "odigos.selftelemetry": True})
+        for s in spans:
+            b.add_span(
+                trace_id=s.trace_id, span_id=s.span_id,
+                parent_span_id=s.parent_span_id, name=s.name,
+                service=self.service, kind=s.kind, status_code=s.status,
+                start_unix_nano=s.start_unix_nano,
+                end_unix_nano=s.end_unix_nano,
+                resource_index=res, attrs=s.attrs or None, scope=SCOPE)
+        return b.build()
+
+    def drain_batch(self) -> Optional[SpanBatch]:
+        """Drain the ring into a SpanBatch (None when empty)."""
+        return self.to_batch(self.ring.drain())
+
+    # --------------------------------------------------------- surfaces
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-able dump of the ring (diagnose bundle / API surface)."""
+        return {
+            "enabled": self.enabled,
+            "service": self.service,
+            "spans_buffered": len(self.ring),
+            "spans_total": self.ring.total,
+            "dropped": self.ring.dropped,
+            "spans": [s.to_dict() for s in self.ring.snapshot()],
+        }
+
+    def traces(self, limit: int = 50,
+               include_spans: bool = False) -> list[dict[str, Any]]:
+        """Ring spans grouped into traces, most recent first (the
+        recent-traces panel feed). ``root`` is the span with no parent
+        in the group (falls back to the earliest). Per-span dicts are
+        opt-in: the dashboard polls this every tick and renders only the
+        per-trace headline, so serializing the whole ring per poll would
+        be megabytes of discarded JSON."""
+        groups: dict[int, list[Span]] = {}
+        for s in self.ring.snapshot():
+            groups.setdefault(s.trace_id, []).append(s)
+        out = []
+        for tid, spans in groups.items():
+            spans.sort(key=lambda s: s.start_unix_nano)
+            root = next((s for s in spans if s.parent_span_id == 0),
+                        spans[0])
+            start = min(s.start_unix_nano for s in spans)
+            end = max(s.end_unix_nano for s in spans)
+            t = {
+                "trace_id": f"{tid:032x}",
+                "root": root.name,
+                "span_count": len(spans),
+                "duration_ms": round((end - start) / 1e6, 4),
+                "start_unix_nano": start,
+            }
+            if include_spans:
+                t["spans"] = [s.to_dict() for s in spans]
+            out.append(t)
+        out.sort(key=lambda t: t["start_unix_nano"], reverse=True)
+        return out[:limit]
+
+    def summary(self, limit: int = 50,
+                include_spans: bool = False) -> dict[str, Any]:
+        """The ``/api/selftrace`` payload: counters + grouped traces."""
+        return {
+            "enabled": self.enabled,
+            "spans_buffered": len(self.ring),
+            "spans_total": self.ring.total,
+            "dropped": self.ring.dropped,
+            "traces": self.traces(limit, include_spans),
+        }
+
+
+tracer = SelfTracer()

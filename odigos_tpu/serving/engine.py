@@ -38,6 +38,8 @@ import numpy as np
 from ..features.featurizer import (
     FeaturizerConfig, SpanFeatures, assemble_sequences, featurize)
 from ..pdata.spans import SpanBatch
+from ..selftelemetry.tracer import (
+    NULL_SPAN, is_selftelemetry_batch, tracer)
 from ..utils.telemetry import meter
 
 PASSTHROUGH_METRIC = "odigos_anomaly_passthrough_total"
@@ -158,6 +160,9 @@ class SequenceBackend:
         # the model's positional table bounds the sequence geometry: never
         # pack longer rows than the (possibly restored) model can embed
         self.max_len = min(cfg.max_len, self.model.cfg.max_len)
+        self.device_label = str(jax.devices()[0])
+        self.last_shape: Optional[list[int]] = None
+        self.last_padding_waste: Optional[float] = None
         self.variables = variables if variables is not None else \
             self.model.init(jax.random.PRNGKey(cfg.seed))
         self._packed_score = None
@@ -194,6 +199,10 @@ class SequenceBackend:
 
             packed = pack_sequences(batch, features, max_len=self.max_len,
                                     pad_rows_to=self.cfg.trace_bucket)
+            # scoring-span attributes: device shape + padding waste (the
+            # MXU-density evidence the bench trajectory reads offline)
+            self.last_shape = list(packed.categorical.shape[:2])
+            self.last_padding_waste = round(1.0 - float(packed.density()), 4)
             if self._packed_score is not None:  # dp across chips
                 span_scores = np.asarray(self._packed_score(
                     self.variables, packed.categorical, packed.continuous,
@@ -218,6 +227,9 @@ class SequenceBackend:
         seqs = assemble_sequences(
             batch, features, max_len=self.max_len,
             pad_traces_to=self.cfg.trace_bucket)
+        self.last_shape = list(seqs.categorical.shape[:2])
+        self.last_padding_waste = round(1.0 - float(seqs.mask.mean()), 4) \
+            if seqs.mask.size else 0.0
         span_scores, _ = self.model.score_spans(
             self.variables, jnp.asarray(seqs.categorical),
             jnp.asarray(seqs.continuous), jnp.asarray(seqs.mask))
@@ -279,6 +291,11 @@ class ScoringEngine:
         self._queue: queue.Queue[ScoreRequest] = queue.Queue(self.cfg.max_queue)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # first-call latency split: call 0 pays jit compilation on top of
+        # execution; the estimated compile share is (first - second) call
+        # duration, surfaced as a gauge + span attribute
+        self._device_calls = 0
+        self._first_call_ms = 0.0
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "ScoringEngine":
@@ -361,28 +378,68 @@ class ScoringEngine:
 
     def _score_group(self, reqs: list[ScoreRequest]) -> None:
         t0 = time.monotonic_ns()
-        if len(reqs) == 1:
-            r = reqs[0]
-            r.scores = self.backend.score(r.batch, r.features)
-            r.done.set()
-            n = len(r.batch)
-        else:
-            from ..pdata.spans import concat_batches
-
-            merged = concat_batches([r.batch for r in reqs])
-            feats = None
-            if all(r.features is not None for r in reqs):
-                feats = SpanFeatures(
-                    np.concatenate([r.features.categorical for r in reqs]),
-                    np.concatenate([r.features.continuous for r in reqs]))
-            scores = self.backend.score(merged, feats)
-            off = 0
-            for r in reqs:
-                n_r = len(r.batch)
-                r.scores = scores[off:off + n_r]
-                off += n_r
+        # scoring exported self-spans (a pipeline dogfooding anomaly
+        # detection on internal traces) must not mint new spans about
+        # them — the worker thread is outside the suppressed() scope,
+        # so the batch marker is the only signal that survives the hop
+        span = (NULL_SPAN
+                if any(is_selftelemetry_batch(r.batch) for r in reqs)
+                else tracer.span("tpu/score"))
+        with span as sp:
+            if len(reqs) == 1:
+                r = reqs[0]
+                r.scores = self.backend.score(r.batch, r.features)
                 r.done.set()
-            n = off
-        dt_ms = (time.monotonic_ns() - t0) / 1e6
+                n = len(r.batch)
+            else:
+                from ..pdata.spans import concat_batches
+
+                merged = concat_batches([r.batch for r in reqs])
+                feats = None
+                if all(r.features is not None for r in reqs):
+                    feats = SpanFeatures(
+                        np.concatenate([r.features.categorical
+                                        for r in reqs]),
+                        np.concatenate([r.features.continuous
+                                        for r in reqs]))
+                scores = self.backend.score(merged, feats)
+                off = 0
+                for r in reqs:
+                    n_r = len(r.batch)
+                    r.scores = scores[off:off + n_r]
+                    off += n_r
+                    r.done.set()
+                n = off
+            dt_ms = (time.monotonic_ns() - t0) / 1e6
+            self._annotate_score_span(sp, reqs, n, t0, dt_ms)
         meter.add(SCORED_METRIC, n)
         meter.record("odigos_anomaly_score_latency_ms", dt_ms)
+
+    def _annotate_score_span(self, sp, reqs: list[ScoreRequest], n: int,
+                             t0: int, dt_ms: float) -> None:
+        """TPU-stage span attributes: device, coalesced batch shape,
+        padding waste, queue wait, and the compile-vs-execute first-call
+        split (jit compilation dominates call 0; the difference to call 1
+        is the estimated compile share)."""
+        sp.set_attr("model", self.cfg.model)
+        sp.set_attr("device",
+                    getattr(self.backend, "device_label", "host"))
+        sp.set_attr("batch.spans", n)
+        sp.set_attr("requests", len(reqs))
+        sp.set_attr("queue_wait_ms", round(
+            (t0 - min(r.submitted_ns for r in reqs)) / 1e6, 3))
+        shape = getattr(self.backend, "last_shape", None)
+        if shape is not None:
+            sp.set_attr("device.shape", "x".join(map(str, shape)))
+        waste = getattr(self.backend, "last_padding_waste", None)
+        if waste is not None:
+            sp.set_attr("padding.waste", waste)
+        if self._device_calls == 0:
+            self._first_call_ms = dt_ms
+            sp.set_attr("jit.first_call", True)
+        elif self._device_calls == 1:
+            est = max(self._first_call_ms - dt_ms, 0.0)
+            sp.set_attr("jit.compile_est_ms", round(est, 3))
+            meter.set_gauge("odigos_anomaly_jit_compile_est_ms",
+                            round(est, 3))
+        self._device_calls += 1

@@ -12,32 +12,51 @@ latency accounting read from it, and `snapshot()` is the scrape endpoint.
 
 from __future__ import annotations
 
+import random
 import threading
-from bisect import insort
 from collections import defaultdict
 from typing import Optional
 
 
 class _Histogram:
-    __slots__ = ("values", "count", "total", "max_samples")
+    """Bounded uniform reservoir (Vitter's algorithm R) with exact
+    ``count``/``total``. The old decimation scheme (``values[::2]`` on
+    overflow) permanently halved resolution after one overflow and
+    biased quantiles toward whatever survived the cut; random
+    replacement keeps every sample equally likely to be resident, so
+    quantile error stays bounded at any stream length."""
+
+    __slots__ = ("values", "count", "total", "max_samples", "_dirty",
+                 "_rng")
 
     def __init__(self, max_samples: int = 8192):
-        self.values: list[float] = []  # sorted reservoir
+        self.values: list[float] = []  # reservoir; sorted lazily
         self.count = 0
         self.total = 0.0
         self.max_samples = max_samples
+        self._dirty = False
+        # deterministic per-instance stream: quantiles are reproducible
+        # for a given record sequence (tests) without a global seed
+        self._rng = random.Random(0x9E3779B97F4A7C15)
 
     def record(self, v: float) -> None:
         self.count += 1
         self.total += v
-        if len(self.values) >= self.max_samples:
-            # simple reservoir decimation: drop every other sample
-            self.values = self.values[::2]
-        insort(self.values, v)
+        if len(self.values) < self.max_samples:
+            self.values.append(v)
+            self._dirty = True
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.max_samples:
+                self.values[j] = v
+                self._dirty = True
 
     def quantile(self, q: float) -> float:
         if not self.values:
             return 0.0
+        if self._dirty:
+            self.values.sort()
+            self._dirty = False
         idx = min(int(q * len(self.values)), len(self.values) - 1)
         return self.values[idx]
 
@@ -114,6 +133,16 @@ def label_value(v: str) -> str:
     must round-trip through naive split."""
     return (v.replace(",", "_").replace("=", "_")
              .replace("{", "_").replace("}", "_"))
+
+
+def labeled_key(metric: str, **labels: str) -> str:
+    """Render a flat ``name{key=value}`` registry key, routing every
+    label VALUE through ``label_value`` (see its contract). The flat
+    encoding's one rule lives here; hot-path callers precompute the key
+    once at construction."""
+    inner = ",".join(f"{k}={label_value(str(v))}"
+                     for k, v in labels.items())
+    return f"{metric}{{{inner}}}"
 
 
 def prometheus_text(snapshot: dict[str, float]) -> str:

@@ -24,8 +24,10 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..components.api import ComponentKind, Exporter, Factory, Signal, register
+from ..hooks.tracecontext import current_trace_context, is_zero_trace_context
 from ..pdata.spans import SpanBatch
-from ..utils.telemetry import meter
+from ..selftelemetry.tracer import tracer
+from ..utils.telemetry import labeled_key, meter
 from .codec import frame
 from .server import ACCEPTED, MALFORMED
 
@@ -51,14 +53,24 @@ class WireExporter(Exporter):
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._inflight: Optional[bytes] = None
+        self._dropped_metric = labeled_key(
+            "odigos_exporter_dropped_frames_total", exporter=name)
 
     # ------------------------------------------------------------ pipeline
 
     def export(self, batch: SpanBatch) -> None:
-        buf = frame(batch)  # encode on caller thread; send is async
+        # self-tracing context is captured HERE (caller thread, while the
+        # exporter stage span is active), not on the sender thread — the
+        # async send must still stamp the span the batch left under
+        tp = None
+        if tracer.enabled:
+            ctx = current_trace_context()
+            if not is_zero_trace_context(ctx):
+                tp = ctx
+        buf = frame(batch, tp)  # encode on caller thread; send is async
         with self._qlock:
             if len(self._queue) == self._queue.maxlen:
-                meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+                meter.add(self._dropped_metric)
             self._queue.append(buf)
         self._wake.set()
 
@@ -133,7 +145,7 @@ class WireExporter(Exporter):
             return False
         if status == MALFORMED:
             # permanently bad frame: drop it, don't head-of-line block
-            meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+            meter.add(self._dropped_metric)
             return True
         # REJECTED: server sheds load — back off, keep the frame
         meter.add(f"odigos_exporter_backpressure_total{{exporter={self.name}}}")
@@ -166,7 +178,7 @@ class WireExporter(Exporter):
                 backoff = initial
             elif time.monotonic() - frame_started > max_elapsed:
                 self._inflight = None
-                meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+                meter.add(self._dropped_metric)
                 backoff = initial
             else:
                 self._stop.wait(backoff)
@@ -212,6 +224,8 @@ class LoadBalancingExporter(Exporter):
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         self._children: dict[str, WireExporter] = {}
+        self._dropped_metric = labeled_key(
+            "odigos_exporter_dropped_frames_total", exporter=name)
         # (ring points, endpoints, vnode -> endpoint index)
         self._ring: tuple[np.ndarray, list[str], np.ndarray] = (
             np.zeros(0, np.uint64), [], np.zeros(0, np.int64))
@@ -298,7 +312,7 @@ class LoadBalancingExporter(Exporter):
             points, endpoints, ep_of_point = self._ring
             children = dict(self._children)
         if not endpoints:
-            meter.add(f"odigos_exporter_dropped_frames_total{{exporter={self.name}}}")
+            meter.add(self._dropped_metric)
             return
         # vectorized ring lookup on the HASHED trace id: same trace ->
         # same replica, uniform spread regardless of id distribution

@@ -37,7 +37,7 @@ MAGIC = b"OTW1"
 _HDR = struct.Struct("<I")
 
 
-def encode_batch(batch) -> bytes:
+def encode_batch(batch, traceparent: str | None = None) -> bytes:
     cols = [(name, arr) for name, arr in batch.columns.items()]
     header = {
         "n": len(batch),
@@ -45,6 +45,12 @@ def encode_batch(batch) -> bytes:
         "resources": [dict(r) for r in batch.resources],
         "cols": [[name, arr.dtype.str] for name, arr in cols],
     }
+    if traceparent:
+        # self-tracing context of the sending stage (W3C traceparent):
+        # the receiving collector parents its receive span under it so a
+        # batch's node-collector → gateway path is one internal trace.
+        # Decoders that predate the key ignore it.
+        header["tp"] = traceparent
     if isinstance(batch, MetricBatch):
         header["kind"] = "metrics"
         header["attrs"] = {str(i): a
@@ -68,6 +74,12 @@ def encode_batch(batch) -> bytes:
 
 
 def decode_batch(payload: bytes):
+    return decode_frame(payload)[0]
+
+
+def decode_frame(payload: bytes):
+    """Decode a payload into ``(batch, traceparent)`` — the traceparent
+    is the sender's self-tracing context (None when absent)."""
     (hdr_len,) = _HDR.unpack_from(payload, 0)
     header = json.loads(payload[4:4 + hdr_len])
     n = header["n"]
@@ -81,6 +93,7 @@ def decode_batch(payload: bytes):
         columns[name] = np.frombuffer(
             payload, dtype=dt, count=n, offset=off).copy()
         off += nbytes
+    tp = header.get("tp")
     if header.get("kind") == "metrics":
         hists_sparse = {int(k): v for k, v in header.get("hists", {}).items()}
         return MetricBatch(
@@ -88,22 +101,22 @@ def decode_batch(payload: bytes):
             resources=tuple(header["resources"]),
             point_attrs=attrs,
             histograms=tuple(hists_sparse.get(i) for i in range(n)),
-            columns=columns)
+            columns=columns), tp
     if header.get("kind") == "logs":
         return LogBatch(
             resources=tuple(header["resources"]),
             bodies=tuple(header["bodies"]),
             record_attrs=attrs,
-            columns=columns)
+            columns=columns), tp
     return SpanBatch(
         strings=tuple(header["strings"]),
         resources=tuple(header["resources"]),
         span_attrs=attrs,
-        columns=columns)
+        columns=columns), tp
 
 
-def frame(batch: SpanBatch) -> bytes:
-    payload = encode_batch(batch)
+def frame(batch: SpanBatch, traceparent: str | None = None) -> bytes:
+    payload = encode_batch(batch, traceparent)
     return MAGIC + _HDR.pack(len(payload)) + payload
 
 

@@ -20,9 +20,11 @@ import threading
 from typing import Any, Callable, Optional
 
 from ..components.api import ComponentKind, Factory, Receiver, Signal, register
+from ..pdata.spans import SpanKind
+from ..selftelemetry.tracer import is_selftelemetry_batch, tracer
 from ..utils.framing import recv_exact as _recv_exact
 from ..utils.telemetry import meter
-from .codec import MAGIC, decode_batch, read_frame_header
+from .codec import MAGIC, decode_frame, read_frame_header
 
 ACCEPTED = b"\x00"
 REJECTED = b"\x01"
@@ -127,7 +129,7 @@ class WireReceiver(Receiver):
                             if payload is None:
                                 return
                             try:
-                                batch = decode_batch(payload)
+                                batch, tp = decode_frame(payload)
                             except Exception:
                                 # corrupt payload is permanent: MALFORMED
                                 # tells the client to drop, not retry
@@ -137,7 +139,24 @@ class WireReceiver(Receiver):
                                 sock.sendall(MALFORMED)
                                 continue
                             try:
-                                receiver.next_consumer.consume(batch)
+                                if is_selftelemetry_batch(batch):
+                                    # forwarded self-spans must not mint
+                                    # spans about themselves downstream
+                                    receiver.next_consumer.consume(batch)
+                                else:
+                                    # re-parent under the sender's span
+                                    # (the frame's traceparent): node-
+                                    # collector → gateway is one trace
+                                    with tracer.span(
+                                            f"receiver/{receiver.name}",
+                                            kind=SpanKind.SERVER,
+                                            traceparent=tp) as sp:
+                                        sp.set_attr("batch.spans",
+                                                    len(batch))
+                                        sp.set_attr("frame.bytes",
+                                                    payload_len)
+                                        receiver.next_consumer.consume(
+                                            batch)
                             except Exception:
                                 # downstream pressure is transient: REJECTED
                                 meter.add(

@@ -94,6 +94,11 @@ CONTRACT: dict[str, dict] = {
            "fields": ["meta", "action_kind", "signals", "disabled"]},
     "ru": {"endpoint": "/api/rules", "each": True,
            "fields": ["meta", "rule_kind", "languages", "disabled"]},
+    # self-tracing panel (the framework tracing itself, /api/selftrace)
+    "st": {"endpoint": "/api/selftrace",
+           "fields": ["traces", "spans_total", "dropped"]},
+    "tr": {"endpoint": "/api/selftrace", "at": ["traces", "*"],
+           "fields": ["root", "span_count", "duration_ms"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

@@ -2,6 +2,8 @@
 trace-collection, data-streams, instrumentation-rollback, chaos/backpressure
 against the in-process KinD-analog environment."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -147,6 +149,11 @@ class TestChaos:
             assert env.send_traces_wire(synthesize_traces(10, seed=1))
             assert _db(env, "good").wait_for_spans(before + 1, timeout=5)
             mock = env.gateway_component("mockdestination/bad")
+            # bad's batch processor flushes on its own clock — the good
+            # destination landing first says nothing about bad's tick yet
+            deadline = time.monotonic() + 5
+            while mock.rejected_batches == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
             assert mock.rejected_batches > 0
 
     def test_backpressure_rejection_drives_scale_up(self):

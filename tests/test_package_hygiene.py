@@ -150,3 +150,51 @@ class TestFeatureGates:
         cm = store.get("ConfigMap", ODIGOS_NAMESPACE, EFFECTIVE_CONFIG_NAME)
         assert cm is not None and "features" in cm.data
         assert "shard-map-scoring" in cm.data["features"]
+
+
+class TestComponentObservability:
+    """Every registered data-path component factory must record at least
+    one own-telemetry metric or span (ISSUE 1 satellite): a component
+    whose class hierarchy never touches ``meter`` or ``tracer`` ships
+    invisible to the self-telemetry pipeline, /metrics, and the diagnose
+    bundle. Static import-and-inspect — no runtime pipeline needed.
+
+    Components inheriting the instrumented ``Processor.consume`` /
+    ``Exporter.consume`` weave pass through their base class; components
+    that OVERRIDE consume (stateful batching, memory limiting, routing)
+    must record their own metric or span. Extensions are exempt: they sit
+    outside the data path (health/zpages/pprof serve diagnostics, they do
+    not carry batches)."""
+
+    DATA_PATH_KINDS = ("receiver", "processor", "exporter", "connector")
+    MARKERS = ("meter.", "tracer.")
+
+    def test_every_component_factory_records_own_telemetry(self):
+        import inspect
+
+        import odigos_tpu.components  # noqa: F401  (registers factories)
+        from odigos_tpu.components.api import registry
+
+        unobservable = []
+        for (kind, type_name), factory in sorted(
+                registry._factories.items(),
+                key=lambda kv: (kv[0][0].value, kv[0][1])):
+            if kind.value not in self.DATA_PATH_KINDS:
+                continue
+            create = factory.create
+            classes = getattr(create, "__mro__", None) or [create]
+            blob = []
+            for cls in classes:
+                if getattr(cls, "__module__", "").startswith("odigos_tpu"):
+                    try:
+                        blob.append(inspect.getsource(cls))
+                    except (OSError, TypeError):
+                        pass
+            source = "\n".join(blob)
+            if not any(m in source for m in self.MARKERS):
+                unobservable.append(f"{kind.value}/{type_name} "
+                                    f"({create!r})")
+        assert not unobservable, (
+            "components with no own-telemetry metric or span — add a "
+            "meter counter or tracer span before registering:\n  "
+            + "\n  ".join(unobservable))

