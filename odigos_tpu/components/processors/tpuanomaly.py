@@ -76,8 +76,10 @@ class TpuAnomalyProcessor(Processor):
         (model "remote"; serving/sidecar.py)
     threshold: score in [0,1] above which a span is tagged (default 0.8)
     timeout_ms: scoring latency budget before pass-through (default 5.0)
-    attr_slots / max_len / trace_bucket / online_update / checkpoint_path:
-        forwarded to EngineConfig
+    attr_slots / max_len / trace_bucket / online_update / checkpoint_path /
+    pipeline_depth / bucket_ladder / warm_ladder:
+        forwarded to EngineConfig (pipeline_depth 2 = double-buffered
+        scoring: host packing overlaps device execution)
     shared_engine: reuse one engine across processor instances (default True)
     """
 
@@ -109,6 +111,9 @@ class TpuAnomalyProcessor(Processor):
             socket_path=config.get("socket_path"),
             data_parallel=int(config.get("data_parallel", 0)),
             seed=int(config.get("seed", 0)),
+            pipeline_depth=int(config.get("pipeline_depth", 2)),
+            bucket_ladder=int(config.get("bucket_ladder", 4)),
+            warm_ladder=bool(config.get("warm_ladder", False)),
         )
         self.engine = _engine_for(self.engine_cfg,
                                   bool(config.get("shared_engine", True)))

@@ -25,6 +25,14 @@ from flax import linen as nn
 
 from .layers import Encoder
 
+# see models/transformer.py: every jitted scoring entry point declares its
+# recompile-bounding strategy (asserted by the package hygiene test)
+SHAPE_BUCKETING = {
+    "score_spans": "leading trace axis padded by the engine's BucketLadder "
+                   "(serving.engine) or a fixed trace_bucket multiple; "
+                   "L/C fixed by AutoencoderConfig",
+}
+
 
 @dataclass(frozen=True)
 class AutoencoderConfig:

@@ -32,13 +32,21 @@ is asserted at the same >=0.95 bar as the float path
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
 from ..features.featurizer import CAT_FIELDS
+from .transformer import serving_donation
+
+# see models/transformer.py: every jitted scoring entry point declares its
+# recompile-bounding strategy (asserted by the package hygiene test)
+SHAPE_BUCKETING = {
+    "score_packed": "packed row axis padded by BucketLadder.round_rows "
+                    "(serving.engine); L/C fixed by the wrapped model's "
+                    "TransformerConfig",
+}
 
 
 def quantize_weight(w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -89,6 +97,14 @@ class QuantizedTraceScorer:
     def __init__(self, model, variables):
         self.cfg = model.cfg
         self.params = self._prepare(variables["params"])
+        self._score_packed_jit = None  # lazy: donation is opt-in
+        self._donate_inputs = False
+
+    def enable_input_donation(self) -> None:
+        """Serving-engine opt-in (see transformer.serving_donation): every
+        engine call passes freshly materialized packed buffers."""
+        self._donate_inputs = True
+        self._score_packed_jit = None
 
     # ------------------------------------------------------------- prepare
 
@@ -182,10 +198,20 @@ class QuantizedTraceScorer:
         return x + _qdense(h, blk["ffn2"]["w"], blk["ffn2"]["s"],
                            blk["ffn2"]["b"], dt)
 
-    @partial(jax.jit, static_argnums=0)
     def score_packed(self, cat, cont, segments, positions):
         """(R, L) span anomaly probabilities — drop-in for
-        TraceTransformer.score_packed."""
+        TraceTransformer.score_packed. Jitted lazily with the packed input
+        buffers donated on TPU (the int8 path is the HBM-bound one — the
+        whole point is halving weight traffic, so input churn matters
+        doubly)."""
+        if self._score_packed_jit is None:
+            self._score_packed_jit = jax.jit(
+                self._score_packed_impl,
+                donate_argnums=serving_donation((0, 1, 2, 3),
+                                                self._donate_inputs))
+        return self._score_packed_jit(cat, cont, segments, positions)
+
+    def _score_packed_impl(self, cat, cont, segments, positions):
         c, p = self.cfg, self.params
         dt = c.dtype
         mask = segments > 0

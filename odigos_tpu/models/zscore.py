@@ -26,6 +26,16 @@ import numpy as np
 
 from ..features.featurizer import SpanFeatures
 
+# see models/transformer.py: every jitted scoring entry point declares its
+# recompile-bounding strategy (asserted by the package hygiene test)
+SHAPE_BUCKETING = {
+    "update_kernel": "state tables fixed at (n_groups,); the span axis is "
+                     "unbucketed — elementwise VPU kernels compile in "
+                     "milliseconds and batch sizes are bounded upstream by "
+                     "the batch processor's fixed send_batch_size",
+    "score_kernel": "same as update_kernel (shared (G,) state geometry)",
+}
+
 
 class ZScoreState(NamedTuple):
     count: jax.Array  # (G,) float32

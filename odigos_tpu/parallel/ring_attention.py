@@ -55,9 +55,12 @@ def _ring_body(q, k, v, kv_mask, axis_name, scale):
     if hasattr(jax.lax, "pcast"):
         def _vary(x):
             return jax.lax.pcast(x, axis_name, to="varying")
-    else:  # pragma: no cover - older jax
+    elif hasattr(jax.lax, "pvary"):  # pragma: no cover - jax 0.5-0.8
         def _vary(x):
             return jax.lax.pvary(x, axis_name)
+    else:  # jax <= 0.4: shard_map has no vma typing; no marking needed
+        def _vary(x):
+            return x
     o = _vary(jnp.zeros((B, Lq, H, D), jnp.float32))
     m = _vary(jnp.full((B, H, Lq), NEG_INF, jnp.float32))
     s = _vary(jnp.zeros((B, H, Lq), jnp.float32))

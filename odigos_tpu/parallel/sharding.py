@@ -22,6 +22,20 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# see models/transformer.py: every jitted scoring/training entry point
+# declares its recompile-bounding strategy (package hygiene test)
+SHAPE_BUCKETING = {
+    "make_sharded_score_fn": "delegates to model.score_spans — leading axis "
+                             "padded to a data-axis multiple by "
+                             "_shard_inputs on top of the engine bucketing",
+    "make_sharded_packed_score_fn": "delegates to model.score_packed — row "
+                                    "axis bucketed by the engine's ladder "
+                                    "(multiples of data_parallel enforced)",
+    "make_sharded_train_step": "training loop feeds fixed (batch, L) "
+                               "shapes from data.py batching; one compile "
+                               "per run",
+}
+
 
 def transformer_param_spec(path: tuple, leaf: Any) -> P:
     """Map a flax param path (tuple of str keys) to a PartitionSpec."""
@@ -129,12 +143,17 @@ def make_sharded_train_step(model, tx, mesh: Mesh):
     return run
 
 
-def make_sharded_packed_score_fn(model, mesh: Mesh):
+def make_sharded_packed_score_fn(model, mesh: Mesh, block: bool = True):
     """Data-parallel **packed** scoring (BASELINE config #5: DP across
     v5e-8) — the serving path's flagship shape. Packed rows shard on
     "data"; variables placed per the transformer rules (pure-DP meshes
     replicate them; a "model" axis shards heads/ffn too). XLA inserts the
     collectives from the placements.
+
+    ``block=False`` returns the (R, L) device array without the host
+    fetch: the pipelined engine harvests it against the *next* in-flight
+    call so the transfer overlaps device execution. R is unpadded (the
+    divisibility check guarantees it), so no trailing-slice is needed.
     """
     dp = mesh.shape["data"]
     # cache the sharded placement of the last-seen pytree. Keyed by id()
@@ -156,6 +175,8 @@ def make_sharded_packed_score_fn(model, mesh: Mesh):
         cat, cont, segments, positions = _shard_inputs(
             mesh, (cat, cont, segments, positions))
         span_p = model.score_packed(v, cat, cont, segments, positions)
+        if not block:
+            return span_p
         return np.asarray(span_p)[:R]
 
     return score

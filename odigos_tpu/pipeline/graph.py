@@ -21,6 +21,7 @@ Semantics follow the OTel collector the reference is built on (SURVEY.md §2.3):
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -181,10 +182,12 @@ def _topological_pipelines(pipelines: dict[str, Any]) -> list[str]:
             for downstream in conn_receivers.get(eid, []):
                 edges[pname].append(downstream)
                 indeg[downstream] += 1
-    queue = [p for p, d in indeg.items() if d == 0]
+    # deque: list.pop(0) is O(n) per pop — quadratic over large rendered
+    # pipeline graphs (pipelinegen emits one pipeline per data stream)
+    queue = deque(p for p, d in indeg.items() if d == 0)
     order: list[str] = []
     while queue:
-        node = queue.pop(0)
+        node = queue.popleft()
         order.append(node)
         for nxt in edges[node]:
             indeg[nxt] -= 1

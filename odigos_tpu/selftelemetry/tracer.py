@@ -105,6 +105,23 @@ class Span:
     def set_attr(self, key: str, value: Any) -> None:
         self.attrs[key] = value
 
+    # Non-context lifecycle: the pipelined scoring engine keeps up to
+    # ``pipeline_depth`` tpu/score spans open at once on one worker thread,
+    # so the LIFO contextvar tokens of __enter__/__exit__ cannot bracket
+    # them. begin()/finish() stamp the same clocks and ring the span
+    # without installing trace context (these are root spans on a worker
+    # thread anyway — there is no active parent to join).
+    def begin(self) -> "Span":
+        self.start_unix_nano = time.time_ns()
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def finish(self, error: bool = False) -> None:
+        self.duration_ns = time.monotonic_ns() - self._t0
+        if error:
+            self.status = StatusCode.ERROR
+        self._tracer._finish(self)
+
     def __enter__(self) -> "Span":
         self._token = _active.set(
             (self.trace_id, self.span_id, self._flags))
@@ -144,6 +161,12 @@ class _NullSpan:
     __slots__ = ()
 
     def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def begin(self) -> "_NullSpan":
+        return self
+
+    def finish(self, error: bool = False) -> None:
         pass
 
     def __enter__(self) -> "_NullSpan":

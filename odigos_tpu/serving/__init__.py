@@ -1,5 +1,5 @@
-from .engine import ScoringEngine, EngineConfig, ScoreRequest
+from .engine import BucketLadder, ScoringEngine, EngineConfig, ScoreRequest
 from .sidecar import RemoteBackend, SidecarClient, SidecarServer
 
-__all__ = ["ScoringEngine", "EngineConfig", "ScoreRequest",
+__all__ = ["BucketLadder", "ScoringEngine", "EngineConfig", "ScoreRequest",
            "RemoteBackend", "SidecarClient", "SidecarServer"]

@@ -5,18 +5,32 @@ must never block on TPU round-trips; <5 ms p99 added latency at ≥1M spans/s.
 The reference's analog discipline is the eBPF receiver's hot loop + pre-decode
 rejection (odigosebpfreceiver/traces.go:17, configgrpc fork).
 
-Design:
+Design — a two-stage software pipeline over one worker thread:
 
 * callers ``submit()`` featurized batches into a **bounded** queue and wait on
   a per-request event with a deadline;
-* one worker thread drains the queue, **coalesces** pending requests into a
-  single device call (big batches feed the MXU), splits scores back per
-  request, and sets events;
+* the worker's **pack stage** drains the queue, **coalesces** pending requests
+  into a single device call (big batches feed the MXU), featurizes/packs on
+  the host, and *dispatches* the device call without blocking on its result
+  (JAX async dispatch);
+* up to ``pipeline_depth`` device calls ride **in flight** at once: while
+  call N executes on the device, the worker packs and dispatches call N+1 —
+  the host/device overlap that closes the serial featurize→execute→fetch
+  gap. The **harvest stage** then blocks on the *oldest* in-flight call,
+  splits scores back per request, and sets events — FIFO, so per-request
+  results are byte-identical to the serial path;
+* backends without an async ``dispatch`` (zscore's ordered online updates,
+  mock, the remote sidecar with its own deadline) degrade to depth 1 — the
+  exact serial behavior;
+* shape churn is absorbed by a **bucket ladder**: packed row counts round up
+  to a small geometric set of precompiled XLA shapes (optionally warmed at
+  ``start()``), so steady-state traffic never recompiles;
 * if the deadline passes, the caller forwards spans unscored (pass-through)
   and the late scores still update online state; a passthrough counter feeds
   own-telemetry (the memory-limiter-rejections pattern);
 * if the queue is full, ``submit`` fails fast (admission control) instead of
-  stalling the pipeline.
+  stalling the pipeline; ``shutdown()`` drains queued and in-flight work
+  losslessly before the worker exits.
 
 Backends plug in via ``ModelBackend``: zscore (streaming, online update),
 transformer / autoencoder (sequence models with shape-bucketed jit), and mock
@@ -30,13 +44,15 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Optional, Protocol
 
 import numpy as np
 
 from ..features.featurizer import (
-    FeaturizerConfig, SpanFeatures, assemble_sequences, featurize)
+    FeaturizerConfig, SpanFeatures, assemble_sequences, featurize,
+    pack_sequences)
 from ..pdata.spans import SpanBatch
 from ..selftelemetry.tracer import (
     NULL_SPAN, is_selftelemetry_batch, tracer)
@@ -46,6 +62,10 @@ PASSTHROUGH_METRIC = "odigos_anomaly_passthrough_total"
 QUEUE_FULL_METRIC = "odigos_anomaly_queue_full_total"
 SCORED_METRIC = "odigos_anomaly_scored_spans_total"
 COLD_METRIC = "odigos_anomaly_cold_spans_total"
+DEVICE_BUSY_GAUGE = "odigos_anomaly_device_busy_frac"
+STAGE_PACK_METRIC = "odigos_anomaly_stage_pack_ms"
+STAGE_DEVICE_METRIC = "odigos_anomaly_stage_device_ms"
+STAGE_HARVEST_METRIC = "odigos_anomaly_stage_harvest_ms"
 
 
 @dataclass(frozen=True)
@@ -54,7 +74,7 @@ class EngineConfig:
     max_queue: int = 64          # pending requests bound
     max_batch_spans: int = 65536  # coalescing cap per device call
     max_len: int = 64            # sequence models: spans per trace
-    trace_bucket: int = 256      # sequence models: trace-count shape bucket
+    trace_bucket: int = 256      # sequence models: base row/trace shape bucket
     online_update: bool = True   # zscore: fit on observed traffic
     # transformer: serve with int8 (W8A8) matmuls — ~2x MXU rate on v5e;
     # weights quantize once at load (models/quantized.py)
@@ -69,11 +89,82 @@ class EngineConfig:
     # shards packed rows over it. trace_bucket must divide by N.
     data_parallel: int = 0
     seed: int = 0
+    # ---- pipelining (sequence backends only; others clamp to depth 1).
+    # Depth 2 = classic double buffering: one call packing on the host while
+    # one executes on the device. Deeper windows add in-flight latency (a
+    # request's result waits behind depth-1 device calls) without adding
+    # overlap — two stages can only hide one call — so 2 is the sweet spot
+    # inside the 5 ms budget (docs/architecture.md "Scoring engine
+    # pipelining").
+    pipeline_depth: int = 2
+    bucket_ladder: int = 4      # geometric row buckets above trace_bucket
+    warm_ladder: bool = False   # compile the whole ladder at start()
 
 
 class ModelBackend(Protocol):
     def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
         """Return per-span anomaly scores, shape (len(batch),)."""
+
+    # Pipelining (optional): backends that can enqueue device work without
+    # blocking split score() into dispatch() -> opaque handle and
+    # harvest(handle) -> scores. The engine only overlaps backends that
+    # define dispatch; score() must equal harvest(dispatch(...)) so the
+    # serial and pipelined paths return identical bytes.
+
+
+class BucketLadder:
+    """Geometric row-count buckets bounding XLA recompiles.
+
+    ``round_rows`` maps a real packed row/trace count to the smallest ladder
+    bucket that holds it (base, 2·base, 4·base, ...); counts beyond the top
+    bucket round up to a multiple of it (rare — max_batch_spans bounds the
+    coalesced call). ``observe`` tracks which shapes have already been
+    compiled this process (LRU-bounded so an adversarial shape storm cannot
+    grow the table), feeding the bench's hit-rate and the zero-recompile
+    assertion; ``mark_warm`` pre-seeds it from ``warm()`` compilations.
+    """
+
+    def __init__(self, base: int, n_buckets: int = 4):
+        self.base = max(1, int(base))
+        self.buckets = [self.base << k for k in range(max(1, int(n_buckets)))]
+        self.hits = 0
+        self.misses = 0
+        self._compiled: OrderedDict[int, None] = OrderedDict()
+        self._max_tracked = max(16, len(self.buckets) * 2)
+
+    def round_rows(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        top = self.buckets[-1]
+        return ((rows + top - 1) // top) * top
+
+    def mark_warm(self, rows: int) -> None:
+        self._compiled[rows] = None
+        self._compiled.move_to_end(rows)
+
+    def observe(self, rows: int) -> bool:
+        """Record a device call at this padded row count; True = the shape
+        was already compiled (warm hit, no XLA recompile)."""
+        hit = rows in self._compiled
+        if hit:
+            self.hits += 1
+            self._compiled.move_to_end(rows)
+        else:
+            self.misses += 1
+            self._compiled[rows] = None
+            if len(self._compiled) > self._max_tracked:
+                self._compiled.popitem(last=False)
+        return hit
+
+    def stats(self) -> dict[str, Any]:
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "buckets": list(self.buckets),
+        }
 
 
 class MockBackend:
@@ -92,6 +183,9 @@ class MockBackend:
 
 
 class ZScoreBackend:
+    # no async dispatch: score-then-update must stay ordered per device
+    # call, so the engine clamps this backend to pipeline depth 1
+
     def __init__(self, cfg: EngineConfig):
         from ..models.zscore import ZScoreDetector
 
@@ -116,8 +210,11 @@ class SequenceBackend:
     """Transformer / autoencoder scoring over assembled trace sequences.
 
     Scores are computed per (trace, position) and scattered back to span rows
-    via TraceSequences.span_index. Shape bucketing (trace_bucket, max_len)
-    bounds XLA recompilation.
+    via span_index. The bucket ladder (BucketLadder over trace_bucket) bounds
+    XLA recompilation; ``dispatch``/``harvest`` split the device call so the
+    engine can overlap host packing with device execution (the scatter and
+    the blocking ``np.asarray`` fetch happen at harvest, against the
+    *previous* in-flight call's result).
     """
 
     def __init__(self, cfg: EngineConfig):
@@ -161,8 +258,15 @@ class SequenceBackend:
         # pack longer rows than the (possibly restored) model can embed
         self.max_len = min(cfg.max_len, self.model.cfg.max_len)
         self.device_label = str(jax.devices()[0])
+        # the engine owns this model instance and materializes fresh input
+        # arrays every call — safe to donate their device buffers on TPU
+        donate = getattr(self.model, "enable_input_donation", None)
+        if donate is not None:
+            donate()
+        self.ladder = BucketLadder(cfg.trace_bucket, cfg.bucket_ladder)
         self.last_shape: Optional[list[int]] = None
         self.last_padding_waste: Optional[float] = None
+        self.last_bucket_hit: Optional[bool] = None
         self.variables = variables if variables is not None else \
             self.model.init(jax.random.PRNGKey(cfg.seed))
         self._packed_score = None
@@ -178,6 +282,7 @@ class SequenceBackend:
 
             self._quantized = QuantizedTraceScorer(self.model,
                                                    self.variables)
+            self._quantized.enable_input_donation()
         if cfg.data_parallel and cfg.data_parallel > 1:
             if cfg.trace_bucket % cfg.data_parallel:
                 raise ValueError(
@@ -186,61 +291,116 @@ class SequenceBackend:
             from ..parallel import make_mesh, make_sharded_packed_score_fn
 
             mesh = make_mesh({"data": cfg.data_parallel})
+            # block=False: the engine harvests the device array itself so
+            # the fetch overlaps the next in-flight call
             self._packed_score = make_sharded_packed_score_fn(
-                self.model, mesh)
+                self.model, mesh, block=False)
 
-    def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
+    # ------------------------------------------------------- device stage
+
+    def _device_call(self, packed) -> Any:
+        """Enqueue the packed scoring call; returns the device array
+        WITHOUT blocking on it (JAX async dispatch)."""
+        import jax.numpy as jnp
+
+        if self._packed_score is not None:  # dp across chips
+            return self._packed_score(
+                self.variables, packed.categorical, packed.continuous,
+                packed.segments, packed.positions)
+        if self._quantized is not None:  # int8 serving path
+            return self._quantized.score_packed(
+                jnp.asarray(packed.categorical),
+                jnp.asarray(packed.continuous),
+                jnp.asarray(packed.segments),
+                jnp.asarray(packed.positions))
+        return self.model.score_packed(
+            self.variables, jnp.asarray(packed.categorical),
+            jnp.asarray(packed.continuous),
+            jnp.asarray(packed.segments),
+            jnp.asarray(packed.positions))
+
+    def dispatch(self, batch: SpanBatch, features: SpanFeatures) -> Any:
+        """Pack stage: host featurize/pack/pad + non-blocking device
+        enqueue. Returns an opaque handle for ``harvest``."""
         import jax.numpy as jnp
 
         if self.cfg.model == "transformer":
             # packed rows: block-diagonal attention, ~6x the MXU density of
             # naive per-trace padding (bench.py measures this path)
-            from ..features.featurizer import pack_sequences
-
             packed = pack_sequences(batch, features, max_len=self.max_len,
-                                    pad_rows_to=self.cfg.trace_bucket)
+                                    pad_rows_to=self.ladder.round_rows)
             # scoring-span attributes: device shape + padding waste (the
             # MXU-density evidence the bench trajectory reads offline)
             self.last_shape = list(packed.categorical.shape[:2])
             self.last_padding_waste = round(1.0 - float(packed.density()), 4)
-            if self._packed_score is not None:  # dp across chips
-                span_scores = np.asarray(self._packed_score(
-                    self.variables, packed.categorical, packed.continuous,
-                    packed.segments, packed.positions), dtype=np.float32)
-            elif self._quantized is not None:  # int8 serving path
-                span_scores = np.asarray(self._quantized.score_packed(
-                    jnp.asarray(packed.categorical),
-                    jnp.asarray(packed.continuous),
-                    jnp.asarray(packed.segments),
-                    jnp.asarray(packed.positions)), dtype=np.float32)
-            else:
-                span_scores = np.asarray(self.model.score_packed(
-                    self.variables, jnp.asarray(packed.categorical),
-                    jnp.asarray(packed.continuous),
-                    jnp.asarray(packed.segments),
-                    jnp.asarray(packed.positions)), dtype=np.float32)
-            out = np.zeros(len(batch), np.float32)
-            m = packed.mask
-            out[packed.span_index[m]] = span_scores[m]
-            return out
+            self.last_bucket_hit = self.ladder.observe(packed.n_rows)
+            dev = self._device_call(packed)
+            return ("packed", dev, packed.span_index, packed.mask,
+                    len(batch))
 
         seqs = assemble_sequences(
             batch, features, max_len=self.max_len,
-            pad_traces_to=self.cfg.trace_bucket)
+            pad_traces_to=self.ladder.round_rows)
         self.last_shape = list(seqs.categorical.shape[:2])
         self.last_padding_waste = round(1.0 - float(seqs.mask.mean()), 4) \
             if seqs.mask.size else 0.0
-        span_scores, _ = self.model.score_spans(
+        self.last_bucket_hit = self.ladder.observe(seqs.n_traces)
+        dev, _ = self.model.score_spans(
             self.variables, jnp.asarray(seqs.categorical),
             jnp.asarray(seqs.continuous), jnp.asarray(seqs.mask))
-        # raw reconstruction error is unbounded; squash to (0, 1) so the
-        # processor's threshold contract (score in [0,1]) holds for both
-        # sequence models (the transformer path is already a sigmoid)
-        span_scores = 1.0 - np.exp(-np.asarray(span_scores, dtype=np.float32))
-        out = np.zeros(len(batch), np.float32)
-        m = seqs.mask
-        out[seqs.span_index[m]] = span_scores[m]
+        return ("seq", dev, seqs.span_index, seqs.mask, len(batch))
+
+    def harvest(self, handle: Any) -> np.ndarray:
+        """Harvest stage: block on the device result (the only blocking
+        host<->device interaction), scatter scores back to span rows."""
+        kind, dev, span_index, mask, n = handle
+        span_scores = np.asarray(dev, dtype=np.float32)
+        if kind == "seq":
+            # raw reconstruction error is unbounded; squash to (0, 1) so the
+            # processor's threshold contract (score in [0,1]) holds for both
+            # sequence models (the transformer path is already a sigmoid)
+            span_scores = 1.0 - np.exp(-span_scores)
+        out = np.zeros(n, np.float32)
+        out[span_index[mask]] = span_scores[mask]
         return out
+
+    def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
+        return self.harvest(self.dispatch(batch, features))
+
+    def warm(self) -> None:
+        """Compile every ladder bucket with zero-filled inputs so
+        steady-state traffic never pays an XLA recompile (all-padding
+        inputs trace the same program as real ones — shapes are all that
+        matter to jit)."""
+        import jax.numpy as jnp
+
+        C = self.cfg.featurizer.cat_width
+        D = self.cfg.featurizer.cont_width
+        L = self.max_len
+        for R in self.ladder.buckets:
+            if self.cfg.model == "transformer":
+                dev = self._device_call(_ZeroPacked(
+                    np.zeros((R, L, C), np.int32),
+                    np.zeros((R, L, D), np.float32),
+                    np.zeros((R, L), np.int32),
+                    np.zeros((R, L), np.int32)))
+            else:
+                dev, _ = self.model.score_spans(
+                    self.variables, jnp.zeros((R, L, C), jnp.int32),
+                    jnp.zeros((R, L, D), jnp.float32),
+                    jnp.zeros((R, L), bool))
+            np.asarray(dev)  # block: compile finished before serving
+            self.ladder.mark_warm(R)
+
+
+@dataclass(frozen=True)
+class _ZeroPacked:
+    """Shape-only stand-in for PackedSequences during ladder warming."""
+
+    categorical: np.ndarray
+    continuous: np.ndarray
+    segments: np.ndarray
+    positions: np.ndarray
 
 
 def _remote_backend(cfg: "EngineConfig"):
@@ -267,6 +427,28 @@ class ScoreRequest:
     submitted_ns: int = 0
 
 
+@dataclass
+class _InflightGroup:
+    """One dispatched-but-not-harvested device call."""
+
+    reqs: list[ScoreRequest]
+    handle: Any
+    span: Any             # selftelemetry Span (begin()ed) or NULL_SPAN
+    n_spans: int
+    t_pack0: int          # monotonic ns: pack stage start
+    t_dispatch: int       # monotonic ns: device call enqueued
+    # host pack time spent while another call was in flight — an UPPER
+    # bound on true host/device overlap (the in-flight call may finish
+    # mid-pack; without device-side timestamps the split is unknowable
+    # host-side, same caveat as the device_busy_frac union accounting)
+    overlap_ms: float
+    bucket_hit: Optional[bool]
+    # snapshotted at dispatch: the backend's last_* fields already describe
+    # the NEXT call by the time this group retires under depth > 1
+    shape: Optional[list[int]]
+    padding_waste: Optional[float]
+
+
 class ScoringEngine:
     """One engine per collector process (shared across pipelines).
 
@@ -288,18 +470,42 @@ class ScoringEngine:
             raise ValueError(
                 f"unknown scoring model {self.cfg.model!r} "
                 f"(known: {sorted(_BACKENDS)})") from None
+        # only backends with an async dispatch can overlap; everything else
+        # (zscore's ordered online update, mock, the remote sidecar with its
+        # own deadline discipline) keeps the exact serial depth-1 behavior
+        self._depth = max(1, self.cfg.pipeline_depth) \
+            if callable(getattr(self.backend, "dispatch", None)) else 1
         self._queue: queue.Queue[ScoreRequest] = queue.Queue(self.cfg.max_queue)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # serializes backend access between the worker and warmup(): a
+        # stateful backend (zscore online updates) hit from both threads at
+        # once loses updates — warmup's 400-trace fit silently overwritten
+        # by a concurrent tiny scoring update leaves the detector cold (the
+        # long-standing e2e spike-test flake). Worker-internal only; it
+        # never serializes dispatch against harvest across calls, so the
+        # host/device overlap is untouched.
+        self._backend_lock = threading.Lock()
         # first-call latency split: call 0 pays jit compilation on top of
         # execution; the estimated compile share is (first - second) call
         # duration, surfaced as a gauge + span attribute
         self._device_calls = 0
         self._first_call_ms = 0.0
+        # pipeline observability: per-call stage timings (bounded ring) and
+        # a union accumulator of device in-flight intervals for the
+        # device_busy_frac the bench reports
+        self._stage_log: deque[dict[str, Any]] = deque(maxlen=512)
+        self._busy_ns = 0
+        self._busy_until = 0
+        self._t_run0: Optional[int] = None
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "ScoringEngine":
         if self._thread is None or not self._thread.is_alive():
+            if self.cfg.warm_ladder:
+                w = getattr(self.backend, "warm", None)
+                if w is not None:
+                    w()  # blocking by design: caller opted into warm start
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._worker, name="scoring-engine", daemon=True)
@@ -309,13 +515,31 @@ class ScoringEngine:
     def shutdown(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            # the worker drains queued + in-flight work losslessly first
+            self._thread.join(timeout=30.0)
             self._thread = None
+        # fail-fast any request that raced past submit()'s stop check after
+        # the worker's final queue-empty observation (TOCTOU): its done
+        # event must still fire or a score_sync caller eats the full
+        # deadline for a request nothing will ever score
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.scores = None
+            req.done.set()
 
     # ------------------------------------------------------------- scoring
     def submit(self, batch: SpanBatch,
                features: Optional[SpanFeatures] = None) -> Optional[ScoreRequest]:
-        """Enqueue for scoring; returns None (and counts) if queue is full."""
+        """Enqueue for scoring; returns None (and counts) if queue is full
+        or the engine is draining for shutdown."""
+        if self._stop.is_set():
+            # shutting down: the worker is draining; new work would race
+            # the lossless-drain guarantee
+            meter.add(QUEUE_FULL_METRIC)
+            return None
         if features is None and getattr(self.backend, "needs_features", True):
             # a remote backend ships the raw batch and the sidecar
             # featurizes server-side; featurizing here too would pay the
@@ -344,53 +568,116 @@ class ScoringEngine:
 
     def warmup(self, batch: SpanBatch) -> None:
         """Feed presumed-normal traffic to streaming backends; also triggers
-        jit compilation of the scoring path so first real batch is fast."""
-        w = getattr(self.backend, "warmup", None)
-        if w is not None:
-            w(batch)
-        feats = featurize(batch, self.cfg.featurizer)
-        self.backend.score(batch, feats)
+        jit compilation of the scoring path so first real batch is fast.
+        Runs under the backend lock: a worker scoring concurrent traffic
+        must not interleave with the warm-fit (lost-update race on
+        streaming state)."""
+        with self._backend_lock:
+            w = getattr(self.backend, "warmup", None)
+            if w is not None:
+                w(batch)
+            feats = featurize(batch, self.cfg.featurizer)
+            self.backend.score(batch, feats)
+
+    def pipeline_stats(self) -> dict[str, Any]:
+        """Pipeline observability snapshot (bench.py reports this next to
+        spans_per_sec_per_chip_scored so the overlap win is visible)."""
+        log = list(self._stage_log)
+
+        def pcts(key: str) -> dict[str, float]:
+            vals = [c[key] for c in log]
+            if not vals:
+                return {"p50": 0.0, "p99": 0.0}
+            return {"p50": round(float(np.percentile(vals, 50)), 3),
+                    "p99": round(float(np.percentile(vals, 99)), 3)}
+
+        wall = (time.monotonic_ns() - self._t_run0) if self._t_run0 else 0
+        out: dict[str, Any] = {
+            "pipeline_depth": self._depth,
+            "device_calls": self._device_calls,
+            "device_busy_frac": round(self._busy_ns / wall, 4) if wall
+            else 0.0,
+            "overlap_ms_total": round(
+                sum(c["overlap_ms"] for c in log), 3),
+            "stage_pack_ms": pcts("pack_ms"),
+            "stage_device_ms": pcts("device_ms"),
+            "stage_harvest_ms": pcts("harvest_ms"),
+        }
+        ladder = getattr(self.backend, "ladder", None)
+        if ladder is not None:
+            out["bucket_ladder"] = ladder.stats()
+        return out
 
     # -------------------------------------------------------------- worker
     def _worker(self) -> None:
-        while not self._stop.is_set():
+        """Two-stage pipelined loop: fill the in-flight window (pack +
+        dispatch) ahead of harvesting, retire FIFO. With an empty queue the
+        window drains immediately (no latency added when there is nothing
+        to overlap with); on stop the queue and window drain losslessly."""
+        inflight: deque[_InflightGroup] = deque()
+        while True:
+            stopping = self._stop.is_set()
+            if stopping and not inflight and self._queue.empty():
+                return
+            # keep-serving backstop: _dispatch_group/_retire fail their own
+            # requests on error, but nothing outside those narrow trys may
+            # kill this thread — a dead worker turns every future submit
+            # into a silent full-deadline pass-through
             try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            reqs = [first]
-            total = len(first.batch)
-            # coalesce whatever else is already waiting (bounded)
-            while total < self.cfg.max_batch_spans:
-                try:
-                    nxt = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                reqs.append(nxt)
-                total += len(nxt.batch)
-            try:
-                self._score_group(reqs)
+                if len(inflight) < self._depth:
+                    reqs = self._collect(block=not inflight and not stopping)
+                    if reqs is not None:
+                        grp = self._dispatch_group(reqs,
+                                                   overlapped=bool(inflight))
+                        if grp is not None:
+                            inflight.append(grp)
+                        continue
+                if inflight:
+                    self._retire(inflight.popleft())
             except Exception:
                 meter.add("odigos_anomaly_engine_errors_total")
-                for r in reqs:
-                    r.scores = None
-                    r.done.set()
 
-    def _score_group(self, reqs: list[ScoreRequest]) -> None:
+    def _collect(self, block: bool) -> Optional[list[ScoreRequest]]:
+        """Pack-stage intake: one request (blocking briefly only when the
+        pipeline is idle) plus whatever else is already waiting (bounded
+        coalescing)."""
+        try:
+            if block:
+                first = self._queue.get(timeout=0.05)
+            else:
+                first = self._queue.get_nowait()
+        except queue.Empty:
+            return None
+        reqs = [first]
+        total = len(first.batch)
+        while total < self.cfg.max_batch_spans:
+            try:
+                nxt = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            reqs.append(nxt)
+            total += len(nxt.batch)
+        return reqs
+
+    def _dispatch_group(self, reqs: list[ScoreRequest],
+                        overlapped: bool) -> Optional[_InflightGroup]:
+        """Pack stage: coalesce, featurize-if-needed, pack, and enqueue the
+        device call without blocking on its result. When ``overlapped``,
+        every host millisecond spent here ran concurrently with the
+        previous in-flight device call — that is the pipelining win."""
         t0 = time.monotonic_ns()
+        if self._t_run0 is None:
+            self._t_run0 = t0
         # scoring exported self-spans (a pipeline dogfooding anomaly
         # detection on internal traces) must not mint new spans about
         # them — the worker thread is outside the suppressed() scope,
         # so the batch marker is the only signal that survives the hop
         span = (NULL_SPAN
                 if any(is_selftelemetry_batch(r.batch) for r in reqs)
-                else tracer.span("tpu/score"))
-        with span as sp:
+                else tracer.span("tpu/score")).begin()
+        try:
             if len(reqs) == 1:
-                r = reqs[0]
-                r.scores = self.backend.score(r.batch, r.features)
-                r.done.set()
-                n = len(r.batch)
+                merged, feats = reqs[0].batch, reqs[0].features
             else:
                 from ..pdata.spans import concat_batches
 
@@ -402,38 +689,126 @@ class ScoringEngine:
                                         for r in reqs]),
                         np.concatenate([r.features.continuous
                                         for r in reqs]))
-                scores = self.backend.score(merged, feats)
+            dispatch = getattr(self.backend, "dispatch", None)
+            with self._backend_lock:
+                if dispatch is not None:
+                    handle = dispatch(merged, feats)
+                else:
+                    # depth-1 backend: the whole call happens here, eagerly
+                    # — identical to the serial engine (ordering guarantees
+                    # for zscore online updates and the remote sidecar
+                    # deadline)
+                    handle = self.backend.score(merged, feats)
+                # snapshot while still holding the lock: a concurrent
+                # warmup() score would overwrite the last_* fields with
+                # the warmup call's shape before we read them
+                bucket_hit = getattr(self.backend, "last_bucket_hit", None)
+                shape = getattr(self.backend, "last_shape", None)
+                waste = getattr(self.backend, "last_padding_waste", None)
+        except Exception:
+            meter.add("odigos_anomaly_engine_errors_total")
+            for r in reqs:
+                r.scores = None
+                r.done.set()
+            span.set_attr("error", True)
+            span.finish(error=True)
+            return None
+        t1 = time.monotonic_ns()
+        return _InflightGroup(
+            reqs=reqs, handle=handle, span=span,
+            n_spans=sum(len(r.batch) for r in reqs),
+            t_pack0=t0, t_dispatch=t1,
+            overlap_ms=(t1 - t0) / 1e6 if overlapped else 0.0,
+            bucket_hit=bucket_hit, shape=shape, padding_waste=waste)
+
+    def _retire(self, grp: _InflightGroup) -> None:
+        """Harvest stage: block on the oldest in-flight device call, split
+        scores per request (FIFO — byte-identical to the serial path), set
+        events, and account stage timings."""
+        t_h0 = time.monotonic_ns()
+        try:
+            harvest = getattr(self.backend, "harvest", None)
+            with self._backend_lock:
+                scores = harvest(grp.handle) if harvest is not None \
+                    else grp.handle
+        except Exception:
+            meter.add("odigos_anomaly_engine_errors_total")
+            for r in grp.reqs:
+                r.scores = None
+                r.done.set()
+            grp.span.set_attr("error", True)
+            grp.span.finish(error=True)
+            return
+        try:
+            if len(grp.reqs) == 1:
+                grp.reqs[0].scores = scores
+                grp.reqs[0].done.set()
+            else:
                 off = 0
-                for r in reqs:
+                for r in grp.reqs:
                     n_r = len(r.batch)
                     r.scores = scores[off:off + n_r]
                     off += n_r
                     r.done.set()
-                n = off
-            dt_ms = (time.monotonic_ns() - t0) / 1e6
-            self._annotate_score_span(sp, reqs, n, t0, dt_ms)
-        meter.add(SCORED_METRIC, n)
+        finally:
+            # no request may hang on a half-failed split: unset events fire
+            # with scores=None (caller passes through, counter fires)
+            for r in grp.reqs:
+                if not r.done.is_set():
+                    r.done.set()
+        t_end = time.monotonic_ns()
+        # device-occupancy accounting: the union of [dispatch, harvest-end]
+        # intervals is an upper bound on device busy time (it includes
+        # transfers); intervals overlap under depth>1, so clip to the
+        # high-water mark instead of double counting
+        self._busy_ns += t_end - max(grp.t_dispatch, self._busy_until)
+        self._busy_until = t_end
+        wall = max(t_end - self._t_run0, 1)
+        busy_frac = min(self._busy_ns / wall, 1.0)
+        dt_ms = (t_end - grp.t_pack0) / 1e6
+        pack_ms = (grp.t_dispatch - grp.t_pack0) / 1e6
+        device_ms = (t_end - grp.t_dispatch) / 1e6
+        harvest_ms = (t_end - t_h0) / 1e6
+        self._stage_log.append({
+            "pack_ms": pack_ms, "device_ms": device_ms,
+            "harvest_ms": harvest_ms, "overlap_ms": grp.overlap_ms,
+            "spans": grp.n_spans, "bucket_hit": grp.bucket_hit})
+        self._annotate_score_span(grp, busy_frac, dt_ms, pack_ms,
+                                  harvest_ms)
+        grp.span.finish()
+        meter.add(SCORED_METRIC, grp.n_spans)
         meter.record("odigos_anomaly_score_latency_ms", dt_ms)
+        meter.record(STAGE_PACK_METRIC, pack_ms)
+        meter.record(STAGE_DEVICE_METRIC, device_ms)
+        meter.record(STAGE_HARVEST_METRIC, harvest_ms)
+        meter.set_gauge(DEVICE_BUSY_GAUGE, round(busy_frac, 4))
 
-    def _annotate_score_span(self, sp, reqs: list[ScoreRequest], n: int,
-                             t0: int, dt_ms: float) -> None:
+    def _annotate_score_span(self, grp: _InflightGroup, busy_frac: float,
+                             dt_ms: float, pack_ms: float,
+                             harvest_ms: float) -> None:
         """TPU-stage span attributes: device, coalesced batch shape,
-        padding waste, queue wait, and the compile-vs-execute first-call
-        split (jit compilation dominates call 0; the difference to call 1
-        is the estimated compile share)."""
+        padding waste, queue wait, per-stage split, pipeline overlap, and
+        the compile-vs-execute first-call split (jit compilation dominates
+        call 0; the difference to call 1 is the estimated compile share)."""
+        sp = grp.span
         sp.set_attr("model", self.cfg.model)
         sp.set_attr("device",
                     getattr(self.backend, "device_label", "host"))
-        sp.set_attr("batch.spans", n)
-        sp.set_attr("requests", len(reqs))
+        sp.set_attr("batch.spans", grp.n_spans)
+        sp.set_attr("requests", len(grp.reqs))
         sp.set_attr("queue_wait_ms", round(
-            (t0 - min(r.submitted_ns for r in reqs)) / 1e6, 3))
-        shape = getattr(self.backend, "last_shape", None)
-        if shape is not None:
-            sp.set_attr("device.shape", "x".join(map(str, shape)))
-        waste = getattr(self.backend, "last_padding_waste", None)
-        if waste is not None:
-            sp.set_attr("padding.waste", waste)
+            (grp.t_pack0 - min(r.submitted_ns for r in grp.reqs)) / 1e6, 3))
+        sp.set_attr("pipeline.depth", self._depth)
+        sp.set_attr("overlap_ms", round(grp.overlap_ms, 3))
+        sp.set_attr("device_busy_frac", round(busy_frac, 4))
+        sp.set_attr("pack_ms", round(pack_ms, 3))
+        sp.set_attr("harvest_ms", round(harvest_ms, 3))
+        if grp.shape is not None:
+            sp.set_attr("device.shape", "x".join(map(str, grp.shape)))
+        if grp.padding_waste is not None:
+            sp.set_attr("padding.waste", grp.padding_waste)
+        if grp.bucket_hit is not None:
+            sp.set_attr("bucket.hit", grp.bucket_hit)
         if self._device_calls == 0:
             self._first_call_ms = dt_ms
             sp.set_attr("jit.first_call", True)

@@ -343,6 +343,11 @@ class RemoteBackend:
     # the sidecar featurizes server-side; the client engine must not
     # featurize too (double host cost on the latency budget)
     needs_features = False
+    # no async dispatch: the socket round trip carries its own deadline
+    # (remote_timeout_s) and overlapping calls here would reorder the
+    # sidecar's cross-connection coalescing — the client engine runs this
+    # backend at pipeline depth 1 and the SERVER engine (which owns the
+    # device) does the double buffering where it pays off
 
     def __init__(self, cfg):
         if not cfg.socket_path:
@@ -387,11 +392,21 @@ def main(argv: Optional[list[str]] = None) -> None:
     ap.add_argument("--trace-bucket", type=int, default=256)
     ap.add_argument("--timeout-ms", type=float, default=5000.0,
                     help="server-side scoring deadline")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="in-flight device calls (sequence models; "
+                         "1 = serial)")
+    ap.add_argument("--bucket-ladder", type=int, default=4,
+                    help="geometric row-shape buckets above --trace-bucket")
+    ap.add_argument("--warm-ladder", action="store_true",
+                    help="compile every ladder bucket before serving "
+                         "(slower start, zero steady-state recompiles)")
     args = ap.parse_args(argv)
 
     engine = ScoringEngine(EngineConfig(
         model=args.model, checkpoint_path=args.checkpoint,
-        max_len=args.max_len, trace_bucket=args.trace_bucket))
+        max_len=args.max_len, trace_bucket=args.trace_bucket,
+        pipeline_depth=args.pipeline_depth,
+        bucket_ladder=args.bucket_ladder, warm_ladder=args.warm_ladder))
     server = SidecarServer(engine, args.socket,
                            score_timeout_s=args.timeout_ms / 1000.0)
     print(f"sidecar: model={args.model} socket={args.socket}", flush=True)

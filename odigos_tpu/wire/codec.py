@@ -20,6 +20,14 @@ same discipline as the eBPF receiver's protobuf-to-columnar decode
 never per-span. Metrics share the layout so the self-telemetry pipeline's
 ``otlp/ui`` exporter rides the same transport to the frontend consumer
 (frontend/services/collector_metrics in the reference).
+
+Decode is **zero-copy**: columns are read-only ``np.frombuffer`` views into
+the received payload (the encoder pads the JSON header so the first column
+lands 8-byte aligned), copied only when a column's offset is misaligned for
+its dtype. Two consequences the rest of the stack is built around: a decoded
+batch pins its whole frame in memory for as long as any column view lives,
+and in-place writes raise — every mutating path copies first (the pdata
+``replace``/builder discipline), which the wire tests assert.
 """
 
 from __future__ import annotations
@@ -68,6 +76,10 @@ def encode_batch(batch, traceparent: str | None = None) -> bytes:
         header["attrs"] = {str(i): a
                            for i, a in enumerate(batch.span_attrs) if a}
     hdr = json.dumps(header, separators=(",", ":")).encode()
+    # pad the header (JSON ignores trailing whitespace) so the first column
+    # starts 8-byte aligned — the precondition for the decoder's zero-copy
+    # views; u64/f64 columns dominate the span layout
+    hdr += b" " * (-(_HDR.size + len(hdr)) % 8)
     parts = [_HDR.pack(len(hdr)), hdr]
     parts.extend(np.ascontiguousarray(arr).tobytes() for _, arr in cols)
     return b"".join(parts)
@@ -90,8 +102,18 @@ def decode_frame(payload: bytes):
     for name, dtype_str in header["cols"]:
         dt = np.dtype(dtype_str)
         nbytes = dt.itemsize * n
-        columns[name] = np.frombuffer(
-            payload, dtype=dt, count=n, offset=off).copy()
+        if off % dt.alignment:
+            # misaligned (odd-length narrow column upstream, or a frame
+            # from a pre-padding encoder): copy into an aligned buffer —
+            # the only per-column memcpy left on the decode path
+            columns[name] = np.frombuffer(
+                payload, dtype=np.uint8, count=nbytes,
+                offset=off).copy().view(dt)
+        else:
+            # zero-copy read-only view into the payload; writers must copy
+            # first (numpy raises on in-place writes, by design)
+            columns[name] = np.frombuffer(
+                payload, dtype=dt, count=n, offset=off)
         off += nbytes
     tp = header.get("tp")
     if header.get("kind") == "metrics":

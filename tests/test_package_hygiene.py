@@ -95,6 +95,118 @@ def test_every_module_is_imported_somewhere():
     assert not orphans, f"modules nothing imports (dead weight): {orphans}"
 
 
+class TestJitShapeBucketing:
+    """Every jitted scoring/training entry point in ``models/`` and
+    ``parallel/`` must declare its shape-bucketing strategy (ISSUE 2
+    satellite): an undeclared ``jax.jit`` path is an unbounded-recompile
+    hazard — each novel input shape silently pays an XLA compile on the
+    serving hot path. The contract: a module that jits exports a
+    module-level ``SHAPE_BUCKETING`` dict, and every jit site resolves to
+    one of its keys (the decorated/wrapped function name, the enclosing
+    factory, or the lazy ``self._<name>_jit`` attribute, underscores and
+    the ``_jit``/``_impl``/``_kernel`` suffixes stripped)."""
+
+    JIT_DIRS = ("models", "parallel")
+
+    @staticmethod
+    def _is_jit_call(node: ast.AST) -> bool:
+        """jax.jit(...) or partial(jax.jit, ...) in decorator/call form."""
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "jit":
+            return True
+        if isinstance(f, ast.Name) and f.id == "partial" and node.args:
+            a = node.args[0]
+            return isinstance(a, ast.Attribute) and a.attr == "jit"
+        return False
+
+    @classmethod
+    def _jit_sites(cls, tree: ast.Module) -> list[tuple[int, set]]:
+        """(lineno, candidate names) per jit site: enclosing defs plus any
+        assignment target of the jit(...) call."""
+        parents: dict = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        sites = []
+        for node in ast.walk(tree):
+            is_site = False
+            names: set = set()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(cls._is_jit_call(d) or
+                       (isinstance(d, ast.Attribute) and d.attr == "jit")
+                       for d in node.decorator_list):
+                    is_site = True
+            elif cls._is_jit_call(node):
+                # every jit(...) call is a site — assigned, returned, or
+                # passed straight through (the `return jax.jit(fn)` factory
+                # idiom must not escape the declaration contract)
+                is_site = True
+                p = parents.get(node)
+                if isinstance(p, ast.Assign):
+                    for t in p.targets:
+                        if isinstance(t, ast.Attribute):
+                            names.add(t.attr)
+                        elif isinstance(t, ast.Name):
+                            names.add(t.id)
+            if not is_site:
+                continue
+            cur = node
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    names.add(cur.name)
+                cur = parents.get(cur)
+            sites.append((node.lineno, names))
+        return sites
+
+    @staticmethod
+    def _normalize(name: str) -> str:
+        name = name.strip("_")
+        for suffix in ("_jit", "_impl", "_kernel"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+        return name.strip("_")
+
+    def test_every_jit_path_declares_bucketing_strategy(self):
+        problems = []
+        for sub in self.JIT_DIRS:
+            root = os.path.join(PKG_ROOT, sub)
+            for fn in sorted(os.listdir(root)):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                with open(path) as f:
+                    src = f.read()
+                if "jax.jit" not in src:
+                    continue
+                tree = ast.parse(src, path)
+                declared = None
+                for node in tree.body:
+                    if isinstance(node, ast.Assign) and any(
+                            isinstance(t, ast.Name) and
+                            t.id == "SHAPE_BUCKETING"
+                            for t in node.targets):
+                        declared = ast.literal_eval(node.value)
+                if declared is None:
+                    problems.append(
+                        f"{sub}/{fn}: jits but exports no SHAPE_BUCKETING")
+                    continue
+                assert all(isinstance(v, str) and v
+                           for v in declared.values()), \
+                    f"{sub}/{fn}: SHAPE_BUCKETING values must be non-empty"
+                keys = {self._normalize(k) for k in declared}
+                for lineno, names in self._jit_sites(tree):
+                    cands = {self._normalize(n) for n in names}
+                    if not (cands & keys):
+                        problems.append(
+                            f"{sub}/{fn}:{lineno}: jit site "
+                            f"{sorted(names)} has no SHAPE_BUCKETING entry")
+        assert not problems, (
+            "jit paths without a declared shape-bucketing strategy "
+            "(unbounded-recompile hazard):\n  " + "\n  ".join(problems))
+
+
 class TestFeatureGates:
     def test_gate_stages_by_version(self):
         from odigos_tpu.utils.feature import Features
