@@ -532,7 +532,83 @@ def latency_bench(on_tpu: bool) -> dict:
         "scored_fraction": round(float(frac), 4),
         "axon_budget_ms": round(budget_ms, 1),
     })
+
+    # ---- 5. continuous-profiler overhead (ISSUE 3 acceptance: < 2%
+    # added p50 at the default ~19 Hz rate). Measured on the engine
+    # queue-hop path — host-side and GIL-bound, i.e. exactly where a
+    # sampling profiler's cost would land; device time is unaffected by
+    # a host sampler and would only dilute the fraction.
+    try:
+        out.update(_profiler_overhead(iters=400 if on_tpu else 200))
+        log(f"profiler_overhead: {out['profiler_overhead']:.4f} "
+            f"(p50 {out['profiler_p50_off_ms']:.3f} ms off -> "
+            f"{out['profiler_p50_on_ms']:.3f} ms on at default rate)")
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"profiler overhead bench failed: {type(e).__name__}: {e}")
+        out["profiler_overhead_error"] = f"{type(e).__name__}: {e}"[:300]
     return out
+
+
+def _profiler_overhead(iters: int, rounds: int = 4) -> dict:
+    """p50 of the tier-1 latency pass (mock-backend score_sync round
+    trip) with the continuous profiler off vs. on at the default rate,
+    as a fraction of the off baseline. Conditions INTERLEAVE
+    (off/on per round, samples pooled per condition) so machine drift
+    between passes cannot masquerade as profiler cost — a single
+    off-then-on A/B measured 20%+ phantom overhead from warm-up drift
+    while repeated interleaved passes show the true cost in the noise
+    (~19 Hz x ~5 µs/sweep ≈ 0.01% duty)."""
+    from odigos_tpu.features import featurize
+    from odigos_tpu.pdata import synthesize_traces
+    from odigos_tpu.selftelemetry.profiler import (
+        ContinuousProfiler, ProfilerConfig)
+    from odigos_tpu.serving import EngineConfig, ScoringEngine
+
+    eng = ScoringEngine(EngineConfig(model="mock")).start()
+    batch = synthesize_traces(50, seed=42)
+    feats = featurize(batch)
+    per_pass = max(iters // rounds, 20)
+
+    def one_pass() -> np.ndarray:
+        t = np.empty(per_pass)
+        for i in range(per_pass):
+            t0 = time.perf_counter()
+            eng.score_sync(batch, feats, timeout_s=5.0)
+            t[i] = (time.perf_counter() - t0) * 1e3
+        return t
+
+    off_t: list[np.ndarray] = []
+    on_t: list[np.ndarray] = []
+    prof = ContinuousProfiler(ProfilerConfig(enabled=True))  # ~19 Hz
+    try:
+        for _ in range(per_pass):  # warm-up: settle caches + threads
+            eng.score_sync(batch, feats, timeout_s=5.0)
+        for r in range(rounds):
+            # alternate which condition leads per round: monotone
+            # machine drift (thermal throttle) otherwise lands on the
+            # same condition every time and reads as profiler cost
+            order = ("off", "on") if r % 2 == 0 else ("on", "off")
+            for cond in order:
+                if cond == "on":
+                    prof.start()
+                    on_t.append(one_pass())
+                    prof.stop()
+                else:
+                    off_t.append(one_pass())
+    finally:
+        prof.stop()
+        eng.shutdown()
+    off = float(np.percentile(np.concatenate(off_t), 50))
+    on = float(np.percentile(np.concatenate(on_t), 50))
+    return {
+        "profiler_overhead": round(max(on / max(off, 1e-9) - 1.0, 0.0), 4),
+        "profiler_p50_off_ms": round(off, 4),
+        "profiler_p50_on_ms": round(on, 4),
+        "profiler_overhead_note": (
+            "fraction of p50 added to the mock-engine score_sync round "
+            "trip by the continuous profiler at its default rate; "
+            "off/on passes interleaved, samples pooled per condition"),
+    }
 
 
 def _device_direct_per_call(backend, packs, n_calls: int,

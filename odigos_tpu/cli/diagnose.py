@@ -18,11 +18,14 @@ import json
 import os
 import platform
 import tarfile
+import threading
 import time
 from typing import Iterable, Optional
 
+from ..components.extensions.pprofz import sample_profile
 from ..controlplane.scheduler import (
     EFFECTIVE_CONFIG_NAME, ODIGOS_NAMESPACE)
+from ..selftelemetry.profiler import DeviceRuntimeCollector, profiler
 from ..selftelemetry.tracer import tracer
 from ..utils.serde import to_jsonable
 from ..utils.telemetry import meter
@@ -107,6 +110,38 @@ def collect_bundle(state: CliState, out_path: Optional[str] = None,
         # bundle was cut — the evidence layer for latency bug reports
         add("selftrace.json",
             json.dumps(tracer.snapshot(), indent=1, sort_keys=True))
+        # histogram exemplars: the metric→trace links (tail witnesses)
+        # pairing the metrics snapshot with the span ring above
+        add("exemplars.json",
+            json.dumps(meter.exemplars(), indent=1, sort_keys=True))
+        # device-runtime snapshot, taken fresh at bundle time: engine
+        # gauges + (when jax is loaded) live arrays, device memory, and
+        # per-jit-site cache/compile accounting. Read-only: a one-shot
+        # diagnostic must not publish gauges nothing will ever refresh.
+        add("device_runtime.json",
+            json.dumps(DeviceRuntimeCollector().collect_once(
+                publish=False), indent=1, sort_keys=True))
+        # continuous profiler (ISSUE 3): ring metadata + the merged
+        # folded profile — where CPU time went over the retained windows.
+        # With the profiler off (the default) a brief on-demand sample
+        # stands in, so a bundle always carries a stack profile.
+        add("profiler.json",
+            json.dumps(profiler.snapshot(), indent=1, sort_keys=True))
+        folded = profiler.folded()
+        if not folded:
+            # on-demand fallback runs on a helper thread: the sampler
+            # excludes its own thread, so sampling from the (possibly
+            # only) CLI main thread directly would see nothing — from a
+            # helper, the main thread's join() stack is always visible
+            box: dict[str, list[str]] = {}
+            t = threading.Thread(
+                target=lambda: box.setdefault(
+                    "folded", sample_profile(seconds=0.25, hz=97.0)),
+                daemon=True)
+            t.start()
+            t.join(timeout=5.0)
+            folded = box.get("folded", [])
+        add("profile.folded", "\n".join(folded) + "\n")
         add("describe.txt", describe_install(state))
         add("environment.json", json.dumps({
             "python": platform.python_version(),

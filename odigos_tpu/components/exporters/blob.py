@@ -37,7 +37,7 @@ from typing import Any, Union
 from ...pdata.logs import LogBatch
 from ...pdata.spans import SpanBatch
 from ...utils.httpsend import send_with_retry
-from ...utils.telemetry import meter
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Exporter, Factory, Signal, register
 
 WRITTEN_METRIC = "odigos_blob_objects_written_total"
@@ -82,6 +82,8 @@ class HttpUploader:
         self.backoff_s = float(backoff_s)
         self.timeout_s = float(timeout_s)
         self.exporter_name = exporter_name
+        self._retry_metric = labeled_key(RETRY_METRIC,
+                                         exporter=exporter_name)
 
     def upload(self, key: str, payload: bytes) -> None:
         headers = ({"Authorization": f"Bearer {self.token}"}
@@ -90,8 +92,7 @@ class HttpUploader:
             f"{self.base}/{key}", payload, method="PUT", headers=headers,
             max_retries=self.max_retries, backoff_s=self.backoff_s,
             timeout_s=self.timeout_s, who="blob",
-            on_retry=lambda: meter.add(
-                f"{RETRY_METRIC}{{exporter={self.exporter_name}}}"))
+            on_retry=lambda: meter.add(self._retry_metric))
 
 
 Batch = Union[SpanBatch, LogBatch]

@@ -10,9 +10,22 @@ pprof. The Python-runtime analog serves:
   samples ``sys._current_frames`` at H hz for S seconds and returns
   collapsed stacks with counts (flamegraph-ready "folded" format, one
   ``frame;frame;frame count`` line per stack), JSON-wrapped.
+* ``/debug/profilez?window=N`` — the continuous profiler's window ring
+  (selftelemetry.profiler), merged over the last N windows (default:
+  all) — the always-on, after-the-fact view; ``/debug/profile`` remains
+  the on-demand one.
 
-Sampling happens in the handler thread: the data plane pays only the
-GIL checkpoints it already pays, nothing runs when nobody asks.
+On-demand sampling happens in the handler thread: the data plane pays
+only the GIL checkpoints it already pays, nothing runs when nobody
+asks. Concurrent ``/debug/profile`` requests serialize on a lock — two
+interleaved samplers would double-count each other's sweep work and
+halve each other's effective rate.
+
+Frames are folded as ``module:name`` (bare ``name`` merged every
+``process``/``export`` across modules into one flamegraph frame), and
+the sampler sleeps to the next **absolute tick** rather than a fixed
+``sleep(interval)`` whose effective hz drifts low by the per-sweep
+sampling cost.
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``,
 ``max_seconds`` (profile cap, default 30).
@@ -20,6 +33,7 @@ Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``,
 
 from __future__ import annotations
 
+import math
 import sys
 import threading
 import time
@@ -27,6 +41,7 @@ import traceback
 from collections import Counter
 from typing import Any
 
+from ...selftelemetry.profiler import advance_tick, fold_stack, profiler
 from ..api import ComponentKind, Factory, register
 from .httpbase import HttpExtension, Page
 
@@ -42,39 +57,78 @@ def thread_stacks() -> dict[str, list[str]]:
 
 
 def sample_profile(seconds: float, hz: float) -> list[str]:
-    """Collapsed-stack statistical profile of every thread."""
+    """Collapsed-stack statistical profile of every thread.
+
+    Folds frames as ``module:name`` (shared ``fold_stack`` with the
+    continuous profiler) and schedules sweeps on an absolute tick grid:
+    ``sleep(interval)`` after each sweep ignores the sweep's own cost,
+    so the effective rate drifts low exactly when the process is busy —
+    the moment a profile matters most. Overrun ticks are skipped, never
+    bursted."""
     interval = 1.0 / max(hz, 1.0)
     me = threading.get_ident()
     counts: Counter = Counter()
-    deadline = time.monotonic() + seconds
+    start = time.monotonic()
+    deadline = start + seconds
+    next_tick = start
     while time.monotonic() < deadline:
         for ident, frame in sys._current_frames().items():
             if ident == me:
                 continue
-            stack = ";".join(
-                f.name for f in traceback.extract_stack(frame))
-            counts[stack] += 1
-        time.sleep(interval)
+            counts[fold_stack(frame)] += 1
+        now = time.monotonic()
+        next_tick, _missed = advance_tick(next_tick, now, interval)
+        time.sleep(max(min(next_tick - now, deadline - now), 0.0))
     return [f"{stack} {n}" for stack, n in counts.most_common()]
+
+
+def _clamp(raw: str, lo: float, hi: float, default: float) -> float:
+    """Parse a query number and clamp to [lo, hi]; unparsable, NaN and
+    non-finite values fall back to the default (a profile request must
+    never 500 — it is the tool you reach for when things are wrong)."""
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        v = default
+    if math.isnan(v):
+        v = default
+    return min(max(v, lo), hi)  # the default clamps too (tiny caps)
 
 
 class PprofExtension(HttpExtension):
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         self.max_seconds = float(config.get("max_seconds", 30.0))
+        # serializes on-demand sampling: concurrent /debug/profile
+        # handlers would sample each other's sweep loops
+        self._sample_lock = threading.Lock()
 
     def _threadz(self, q: dict[str, str]) -> tuple[int, dict]:
         return 200, {"threads": thread_stacks()}
 
     def _profile(self, q: dict[str, str]) -> tuple[int, dict]:
-        seconds = min(float(q.get("seconds", 1.0)), self.max_seconds)
-        hz = min(float(q.get("hz", 97.0)), 997.0)
-        return 200, {"seconds": seconds, "hz": hz,
-                     "folded": sample_profile(seconds, hz)}
+        seconds = _clamp(q.get("seconds", ""), 0.01, self.max_seconds, 1.0)
+        hz = _clamp(q.get("hz", ""), 1.0, 997.0, 97.0)
+        with self._sample_lock:
+            folded = sample_profile(seconds, hz)
+        return 200, {"seconds": seconds, "hz": hz, "folded": folded}
+
+    def _profilez(self, q: dict[str, str]) -> tuple[int, dict]:
+        """Continuous-profiler ring: merged folded profile over the last
+        ``window=N`` windows (default all), plus ring metadata. Serves
+        the disabled state as data, not an error — `odigos diagnose`
+        and operators probe this blind."""
+        window = int(_clamp(q.get("window", ""), 0, 1_000_000, 0)) or None
+        snap = profiler.snapshot()
+        snap["merged_windows"] = (min(window, len(snap["windows"]))
+                                  if window else len(snap["windows"]))
+        snap["folded"] = profiler.folded(window)
+        return 200, snap
 
     def pages(self) -> dict[str, Page]:
         return {"/debug/threadz": self._threadz,
-                "/debug/profile": self._profile}
+                "/debug/profile": self._profile,
+                "/debug/profilez": self._profilez}
 
 
 register(Factory(

@@ -8,6 +8,12 @@ serves JSON (terminal-first operators curl it):
                            processor chains, exporters/connectors
 * ``/debug/servicez``    — component inventory with health
 * ``/debug/extensionz``  — running extensions
+* ``/debug/tracez``      — self-trace ring summarized per span name
+                           (count, errors, p50/p99/max ms, a recent
+                           exemplar trace id each); ``?trace_id=<hex>``
+                           pivots to that trace's full span list — the
+                           landing page for ``/metrics`` ``# EXEMPLAR``
+                           annotations (upstream zpages' tracez role)
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
 """
@@ -16,6 +22,8 @@ from __future__ import annotations
 
 from typing import Any
 
+from ...pdata.spans import StatusCode
+from ...selftelemetry.tracer import tracer
 from ..api import ComponentKind, Factory, register
 from .httpbase import HttpExtension, Page
 
@@ -57,10 +65,44 @@ class ZPagesExtension(HttpExtension):
             return 503, {}
         return 200, {"extensions": sorted(g.extensions)}
 
+    def _tracez(self, q: dict[str, str]) -> tuple[int, dict]:
+        if "trace_id" in q:  # exemplar pivot: one trace, all its spans
+            return 200, tracer.trace(q["trace_id"])
+        by_name: dict[str, dict[str, Any]] = {}
+        for s in tracer.ring.snapshot():
+            agg = by_name.get(s.name)
+            if agg is None:
+                agg = by_name[s.name] = {
+                    "count": 0, "errors": 0, "durations": [],
+                    "latest_trace_id": "", "latest_start": -1}
+            agg["count"] += 1
+            agg["errors"] += 1 if s.status == StatusCode.ERROR else 0
+            agg["durations"].append(s.duration_ns)
+            if s.start_unix_nano > agg["latest_start"]:
+                agg["latest_start"] = s.start_unix_nano
+                agg["latest_trace_id"] = f"{s.trace_id:032x}"
+        rows = []
+        for name, agg in sorted(by_name.items()):
+            ds = sorted(agg["durations"])
+            rows.append({
+                "span": name,
+                "count": agg["count"],
+                "errors": agg["errors"],
+                "p50_ms": round(ds[len(ds) // 2] / 1e6, 4),
+                "p99_ms": round(ds[min(int(0.99 * len(ds)),
+                                       len(ds) - 1)] / 1e6, 4),
+                "max_ms": round(ds[-1] / 1e6, 4),
+                "exemplar_trace_id": agg["latest_trace_id"],
+            })
+        return 200, {"enabled": tracer.enabled,
+                     "spans_buffered": len(tracer.ring),
+                     "by_span": rows}
+
     def pages(self) -> dict[str, Page]:
         return {"/debug/pipelinez": self._pipelinez,
                 "/debug/servicez": self._servicez,
-                "/debug/extensionz": self._extensionz}
+                "/debug/extensionz": self._extensionz,
+                "/debug/tracez": self._tracez}
 
 
 register(Factory(

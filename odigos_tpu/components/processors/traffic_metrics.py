@@ -19,7 +19,10 @@ from .memory_limiter import batch_nbytes
 
 class TrafficMetricsProcessor(Processor):
     def process(self, batch: SpanBatch) -> SpanBatch:
-        pipeline = self.config.get("pipeline", self.name)
+        # pipeline names come from config — sanitize like any
+        # other data-derived label value (metric-name lint)
+        pipeline = label_value(
+            str(self.config.get("pipeline", self.name)))
         nbytes = batch_nbytes(batch)
         meter.add(f"odigos_traffic_spans_total{{pipeline={pipeline}}}", len(batch))
         meter.add(f"odigos_traffic_bytes_total{{pipeline={pipeline}}}", nbytes)

@@ -22,7 +22,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ...pdata.metrics import MetricBatch, MetricBatchBuilder, MetricType
-from ...utils.telemetry import meter
+from ...utils.telemetry import label_value, meter
 from ..api import ComponentKind, Factory, Receiver, Signal, register
 
 ERRORS_METRIC = "odigos_hostmetrics_scrape_errors_total"
@@ -224,7 +224,7 @@ class HostMetricsReceiver(Receiver):
             try:
                 fn(b, res, now)
             except Exception:
-                meter.add(f"{ERRORS_METRIC}{{scraper={sname}}}")
+                meter.add(f"{ERRORS_METRIC}{{scraper={label_value(sname)}}}")
         batch = b.build()
         if len(batch):
             self.next_consumer.consume(batch)

@@ -107,6 +107,22 @@ class AnomalyStageConfiguration:
 
 
 @dataclass
+class SelfTelemetryConfiguration:
+    """Continuous profiler + device-runtime telemetry knobs (ISSUE 3;
+    rendered into the gateway config's ``service.telemetry`` stanza and
+    applied by the collector via ``selftelemetry.start_from_config``).
+    Disabled by default: the subsystem is a strict no-op unless opted
+    in — no sampler thread, no collector thread, nothing allocated."""
+
+    profiler_enabled: bool = False
+    profiler_hz: float = 19.0       # prime default: no aliasing
+    profiler_window_s: float = 60.0
+    profiler_windows: int = 12      # bounded ring: 12 x 60 s
+    device_runtime_enabled: bool = False
+    device_runtime_interval_s: float = 10.0
+
+
+@dataclass
 class MetricsSourcesConfiguration:
     """Which metrics feeds are enabled (common/odigos_config.go
     MetricsSourceConfiguration: spanMetrics/hostMetrics/kubeletStats/
@@ -171,6 +187,8 @@ class Configuration:
         default_factory=MetricsSourcesConfiguration)
     anomaly: AnomalyStageConfiguration = field(
         default_factory=AnomalyStageConfiguration)
+    selftelemetry: SelfTelemetryConfiguration = field(
+        default_factory=SelfTelemetryConfiguration)
     # Free-form bag for profile-applied settings without a dedicated field
     # (reference profiles patch arbitrary config, e.g. disable-gin).
     extra: dict[str, Any] = field(default_factory=dict)

@@ -241,6 +241,7 @@ class Autoscaler:
             small_batches=self.config.extra.get("small_batches"),
             anomaly=self.config.anomaly,
             ui_endpoint=self.config.ui_endpoint,
+            telemetry_config=self.config.selftelemetry,
         )
         with tracer.span("autoscaler/render-gateway-config") as sp:
             sp.set_attr("cr.kind", "ConfigMap")

@@ -279,9 +279,12 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/metrics":
                 # Prometheus text exposition of this process's self
                 # metrics (own-observability ServiceMonitor scrape role)
+                # with # EXEMPLAR annotations linking histogram tails to
+                # self-traces (resolve via /api/selftrace?trace_id=)
                 from ..utils.telemetry import prometheus_text
 
-                body = prometheus_text(meter.snapshot()).encode()
+                body = prometheus_text(meter.snapshot(),
+                                       meter.exemplars()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -294,12 +297,26 @@ class _Handler(BaseHTTPRequestHandler):
                 # ring-buffer spans grouped per trace, most recent
                 # first; ?spans=1 opts into the per-span detail (the
                 # polled panel only needs the per-trace headline)
+                if "trace_id" in q:
+                    # exemplar pivot: /metrics # EXEMPLAR annotations and
+                    # the dashboard resolve a trace id to its spans here
+                    return self._json(tracer.trace(q["trace_id"]))
                 try:
                     limit = max(1, min(int(q.get("limit", 50)), 500))
                 except ValueError:
                     return self._error("limit must be an integer")
                 include = q.get("spans", "0") not in ("0", "false", "")
-                return self._json(tracer.summary(limit, include))
+                out = tracer.summary(limit, include)
+                # latency exemplars (metric→trace witnesses) ride the
+                # same payload: the dashboard's recent-traces panel
+                # renders them as pivot links without a second endpoint
+                exs = []
+                for metric, items in meter.exemplars().items():
+                    for ex in items:
+                        exs.append(dict(ex, metric=metric))
+                exs.sort(key=lambda e: e["value"], reverse=True)
+                out["exemplars"] = exs[:20]
+                return self._json(out)
             if path == "/api/sources":
                 return self._json(_resource_list(
                     store, "Source", q.get("namespace")))

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from . import jitstats
 from .layers import Encoder
 
 # see models/transformer.py: every jitted scoring entry point declares its
@@ -131,3 +132,8 @@ class SpanAutoencoder:
         err = self._errors(variables, categorical, continuous, mask)
         m = mask.astype(jnp.float32)
         return err.sum() / jnp.maximum(m.sum(), 1.0)
+
+
+# compile accounting for the class-level jitted scoring entry
+jitstats.track_jit("autoencoder.score_spans",
+                   SpanAutoencoder.__dict__["score_spans"])

@@ -1,0 +1,85 @@
+"""Per-jit-site compile accounting (ISSUE 3 device-runtime telemetry).
+
+Every jitted scoring/training entry point already declares its shape-
+bucketing strategy (``SHAPE_BUCKETING``, package-hygiene test); this
+module adds the runtime half: which jit sites exist as live compiled
+functions, how many cached executables each holds (one per traced input
+shape — the cache growing past the declared bucket ladder is the
+unbounded-recompile hazard showing up live), and how many cumulative
+seconds each site has spent compiling (observed where code can see a
+compile happen: the engine's first-call split, ladder warming).
+
+Deliberately jax-free at import time: the DeviceRuntimeCollector reads
+these tables from a telemetry thread that must never be the reason jax
+(or a device runtime) gets initialized. Tracked functions are held by
+weakref — accounting must not extend executable lifetimes.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable
+
+_lock = threading.Lock()
+# site -> weakref to the jitted callable (PjitFunction exposes
+# _cache_size(); absent/changed API degrades to "size unknown")
+_tracked: dict[str, Any] = {}
+# site -> cumulative observed compile seconds
+_compile_seconds: dict[str, float] = {}
+
+
+def track_jit(site: str, fn: Callable) -> Callable:
+    """Register a jitted callable under a stable site name and return it
+    unchanged (wrap-at-assignment idiom: the jit site passes its freshly
+    built compiled function through here)."""
+    try:
+        ref = weakref.ref(fn)
+    except TypeError:  # some wrappers refuse weakrefs: drop tracking
+        return fn
+    with _lock:
+        _tracked[site] = ref
+    return fn
+
+
+def record_compile_seconds(site: str, seconds: float) -> None:
+    """Accumulate observed compile time for a site (engine first-call
+    split, ladder warm passes)."""
+    if seconds <= 0:
+        return
+    with _lock:
+        _compile_seconds[site] = _compile_seconds.get(site, 0.0) + seconds
+
+
+def cache_sizes() -> dict[str, int]:
+    """Live jit-cache executable count per tracked site. Dead refs are
+    pruned; callables without a readable cache size report -1 (tracked,
+    size unknown) rather than vanishing."""
+    out: dict[str, int] = {}
+    with _lock:
+        dead = []
+        for site, ref in _tracked.items():
+            fn = ref()
+            if fn is None:
+                dead.append(site)
+                continue
+            size = getattr(fn, "_cache_size", None)
+            try:
+                out[site] = int(size()) if callable(size) else -1
+            except Exception:  # noqa: BLE001 — private API drifted
+                out[site] = -1
+        for site in dead:
+            del _tracked[site]
+    return out
+
+
+def compile_seconds() -> dict[str, float]:
+    with _lock:
+        return dict(_compile_seconds)
+
+
+def reset() -> None:
+    """Test hook: drop all tracked sites and accumulated seconds."""
+    with _lock:
+        _tracked.clear()
+        _compile_seconds.clear()

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..features.featurizer import CAT_FIELDS
+from . import jitstats
 from .transformer import serving_donation
 
 # see models/transformer.py: every jitted scoring entry point declares its
@@ -205,10 +206,11 @@ class QuantizedTraceScorer:
         whole point is halving weight traffic, so input churn matters
         doubly)."""
         if self._score_packed_jit is None:
-            self._score_packed_jit = jax.jit(
-                self._score_packed_impl,
-                donate_argnums=serving_donation((0, 1, 2, 3),
-                                                self._donate_inputs))
+            self._score_packed_jit = jitstats.track_jit(
+                "quantized.score_packed", jax.jit(
+                    self._score_packed_impl,
+                    donate_argnums=serving_donation((0, 1, 2, 3),
+                                                    self._donate_inputs)))
         return self._score_packed_jit(cat, cont, segments, positions)
 
     def _score_packed_impl(self, cat, cont, segments, positions):

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from . import jitstats
 from .layers import Encoder
 
 # Shape-bucketing strategy per jitted scoring entry point (the package
@@ -154,10 +155,11 @@ class TraceTransformer:
         output instead of churning allocations at north-star call rates.
         """
         if self._score_packed_jit is None:
-            self._score_packed_jit = jax.jit(
-                self._score_packed_impl,
-                donate_argnums=serving_donation((1, 2, 3, 4),
-                                                self._donate_inputs))
+            self._score_packed_jit = jitstats.track_jit(
+                "transformer.score_packed", jax.jit(
+                    self._score_packed_impl,
+                    donate_argnums=serving_donation((1, 2, 3, 4),
+                                                    self._donate_inputs)))
         return self._score_packed_jit(variables, categorical, continuous,
                                       segments, positions)
 
@@ -176,6 +178,12 @@ class TraceTransformer:
         trace_bce = optax_sigmoid_bce(trace_logit, trace_labels)
         trace_loss = (trace_bce * valid).sum() / jnp.maximum(valid.sum(), 1.0)
         return span_loss + trace_loss
+
+
+# compile accounting for the class-level jitted scoring entry (shared by
+# every instance; __dict__ access skips any descriptor binding)
+jitstats.track_jit("transformer.score_spans",
+                   TraceTransformer.__dict__["score_spans"])
 
 
 def optax_sigmoid_bce(logits: jax.Array, labels: jax.Array) -> jax.Array:

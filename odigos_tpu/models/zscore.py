@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..features.featurizer import SpanFeatures
+from . import jitstats
 
 # see models/transformer.py: every jitted scoring entry point declares its
 # recompile-bounding strategy (asserted by the package hygiene test)
@@ -85,6 +86,12 @@ def _score_kernel(state: ZScoreState, categorical: jax.Array,
     z = jnp.abs(log_dur - mean) / std
     # cold groups (not enough history) score 0 — never page on unknowns
     return jnp.where(count >= min_count, z, 0.0)
+
+
+# compile accounting for the module-level jitted kernels (ISSUE 3
+# device-runtime telemetry: jit cache size per site)
+jitstats.track_jit("zscore.update", _update_kernel)
+jitstats.track_jit("zscore.score", _score_kernel)
 
 
 @dataclass

@@ -118,7 +118,10 @@ def _serve_metrics(port: int, collector=None):
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = prometheus_text(meter.snapshot()).encode()
+            # exemplar annotations ride the collector scrape too —
+            # this process hosts the engine/pipeline histograms
+            body = prometheus_text(meter.snapshot(),
+                                   meter.exemplars()).encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")

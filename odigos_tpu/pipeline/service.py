@@ -15,6 +15,7 @@ from typing import Any, Optional
 
 import odigos_tpu.components  # noqa: F401  (registers builtin factories)
 
+from ..selftelemetry.profiler import start_from_config, stop_started
 from ..utils.telemetry import meter
 from .graph import Graph, build_graph
 
@@ -26,6 +27,10 @@ class Collector:
         self.config = config
         self.graph: Graph = build_graph(config, registry)
         self._running = False
+        # which process-global telemetry subsystems (continuous profiler,
+        # device-runtime collector) THIS collector's config started — only
+        # those are stopped on shutdown (another owner's stay running)
+        self._telemetry_started: list[str] = []
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Collector":
@@ -35,6 +40,8 @@ class Collector:
             for comp in self.graph.all_components():
                 comp.start()
             self._running = True
+            self._telemetry_started = start_from_config(
+                self.config.get("service", {}).get("telemetry"))
         meter.add("odigos_collector_starts_total")
         return self
 
@@ -43,6 +50,8 @@ class Collector:
             if not self._running:
                 return
             self._stop_graph(self.graph)
+            stop_started(self._telemetry_started)
+            self._telemetry_started = []
             self._running = False
 
     def __enter__(self) -> "Collector":
@@ -115,4 +124,9 @@ class Collector:
                     meter.add("odigos_collector_reload_failures_total")
                     raise
             self.graph, self.config = new_graph, new_config
+            if old_running:
+                # re-anchor the telemetry subsystems on the new stanza
+                stop_started(self._telemetry_started)
+                self._telemetry_started = start_from_config(
+                    new_config.get("service", {}).get("telemetry"))
         meter.add("odigos_collector_reloads_total")

@@ -30,7 +30,8 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ..components.api import Signal
-from ..config.model import AnomalyStageConfiguration
+from ..config.model import (
+    AnomalyStageConfiguration, SelfTelemetryConfiguration)
 from ..destinations.configers import ConfigerError, modify_config
 from ..destinations.registry import Destination
 
@@ -81,6 +82,12 @@ class GatewayOptions:
     small_batches: Optional[GenericMap] = None  # small-batches profile config
     anomaly: Optional[AnomalyStageConfiguration] = None
     self_telemetry: bool = True
+    # continuous profiler + device-runtime telemetry knobs (ISSUE 3);
+    # None or all-disabled renders nothing. Named telemetry_config, NOT
+    # selftelemetry: a one-underscore slip against the pre-existing
+    # self_telemetry bool (the dogfood-receiver toggle above) would
+    # silently toggle the wrong subsystem.
+    telemetry_config: Optional[SelfTelemetryConfiguration] = None
     ui_endpoint: str = "ui.odigos-system:4317"  # otlp/ui stream target
     # extra processor ids (already configured in `processors`) to run in the
     # root pipeline per signal, e.g. compiled Actions.
@@ -344,5 +351,24 @@ def build_gateway_config(
             "processors": [VERSION_RESOURCE_PROCESSOR],
             "exporters": ["otlp/ui"],
         }
+
+    # --- continuous profiler + device-runtime telemetry (ISSUE 3): an
+    # opted-in Configuration renders a service.telemetry stanza; the
+    # collector applies it via selftelemetry.start_from_config. Absent
+    # when disabled — the generated config stays byte-stable for
+    # existing installs.
+    st = options.telemetry_config
+    if st is not None and (st.profiler_enabled or st.device_runtime_enabled):
+        telemetry: GenericMap = {}
+        if st.profiler_enabled:
+            telemetry["profiler"] = {
+                "enabled": True, "hz": st.profiler_hz,
+                "window_s": st.profiler_window_s,
+                "windows": st.profiler_windows}
+        if st.device_runtime_enabled:
+            telemetry["device_runtime"] = {
+                "enabled": True,
+                "interval_s": st.device_runtime_interval_s}
+        config["service"]["telemetry"] = telemetry
 
     return config, status, enabled_signals

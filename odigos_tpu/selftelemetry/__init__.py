@@ -8,6 +8,16 @@ configured pipeline as ordinary pdata.
 """
 
 from .instrument import TracedEntry, trace_pipeline_entry  # noqa: F401
+from .profiler import (  # noqa: F401
+    ContinuousProfiler,
+    DeviceRuntimeCollector,
+    DeviceRuntimeConfig,
+    ProfilerConfig,
+    device_runtime,
+    profiler,
+    start_from_config,
+    stop_started,
+)
 from .tracer import (  # noqa: F401
     DROPPED_METRIC,
     SCOPE,

@@ -7,34 +7,58 @@ it — a stage span covers the stage's own work only, downstream consume
 happens after the span closes — so sibling stage latencies sum to the
 pipeline span's duration (the "where does the time go" view the soak
 p99 investigation was missing), instead of telescoping cumulatively.
+
+Every entry also records the batch's wall time into the
+``odigos_pipeline_batch_latency_ms{pipeline=...}`` histogram, with the
+pipeline span attached as an **exemplar** — the /metrics tail links
+straight back to the self-trace that populated it (ISSUE 3).
 """
 
 from __future__ import annotations
 
+import time
+
 from ..pdata.spans import SpanBatch
+from ..utils.telemetry import labeled_key, meter
 from .tracer import is_selftelemetry_batch, tracer
+
+BATCH_LATENCY_METRIC = "odigos_pipeline_batch_latency_ms"
 
 
 class TracedEntry:
     """Wraps a pipeline's entry consumer with a per-batch pipeline span.
 
-    Transparent when tracing is disabled (one attribute load + branch);
-    exceptions propagate unchanged either way (memory-limiter rejections
-    must still reach the receiver's backpressure path)."""
+    Transparent when tracing is disabled (one attribute load + branch —
+    the latency histogram rides the traced path only, so minimal
+    installs with ``ODIGOS_SELFTRACE=0`` pay neither the clock reads nor
+    the meter lock); exceptions propagate unchanged either way
+    (memory-limiter rejections must still reach the receiver's
+    backpressure path)."""
 
-    __slots__ = ("pipeline", "inner")
+    __slots__ = ("pipeline", "inner", "_latency_key")
 
     def __init__(self, pipeline: str, inner):
         self.pipeline = pipeline
         self.inner = inner
+        # pipeline names come from config — sanitize once at construction
+        self._latency_key = labeled_key(BATCH_LATENCY_METRIC,
+                                        pipeline=pipeline)
 
     def consume(self, batch: SpanBatch) -> None:
         if not tracer.enabled or is_selftelemetry_batch(batch):
             self.inner.consume(batch)
             return
+        t0 = time.monotonic_ns()
         with tracer.span(f"pipeline/{self.pipeline}") as sp:
             sp.set_attr("batch.spans", len(batch))
             self.inner.consume(batch)
+        # record AFTER the span closes so the exemplar points at a
+        # completed, ring-resident trace (a suppressed context hands out
+        # the id-less NULL span: no exemplar, latency still recorded)
+        tid = getattr(sp, "trace_id", None)
+        meter.record(self._latency_key, (time.monotonic_ns() - t0) / 1e6,
+                     exemplar=(tid, sp.span_id) if tid is not None
+                     else None)
 
 
 def trace_pipeline_entry(pipeline: str, entry) -> TracedEntry:

@@ -359,6 +359,21 @@ class SelfTracer:
         out.sort(key=lambda t: t["start_unix_nano"], reverse=True)
         return out[:limit]
 
+    def trace(self, trace_id: str) -> dict[str, Any]:
+        """All ring spans of one trace by 32-hex id — the exemplar pivot
+        (``/metrics`` ``# EXEMPLAR`` → ``/api/selftrace?trace_id=`` →
+        the self-trace that populated the histogram tail). ``found`` is
+        False when the trace has been evicted from the ring (or the id
+        is malformed) — exemplars outlive ring residency."""
+        try:
+            tid = int(trace_id, 16)
+        except (TypeError, ValueError):
+            return {"trace_id": str(trace_id), "found": False, "spans": []}
+        spans = [s for s in self.ring.snapshot() if s.trace_id == tid]
+        spans.sort(key=lambda s: s.start_unix_nano)
+        return {"trace_id": f"{tid:032x}", "found": bool(spans),
+                "spans": [s.to_dict() for s in spans]}
+
     def summary(self, limit: int = 50,
                 include_spans: bool = False) -> dict[str, Any]:
         """The ``/api/selftrace`` payload: counters + grouped traces."""

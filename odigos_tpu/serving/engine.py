@@ -54,9 +54,23 @@ from ..features.featurizer import (
     FeaturizerConfig, SpanFeatures, assemble_sequences, featurize,
     pack_sequences)
 from ..pdata.spans import SpanBatch
+from ..selftelemetry.profiler import engines as _engine_registry
 from ..selftelemetry.tracer import (
     NULL_SPAN, is_selftelemetry_batch, tracer)
 from ..utils.telemetry import meter
+
+
+def _record_compile_seconds(site: str, seconds: float) -> None:
+    """Feed observed compile time into models.jitstats WITHOUT importing
+    the models package (and so jax) from a process that never loaded it
+    (mock-backend engines must stay jax-free)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return
+    from ..models import jitstats
+
+    jitstats.record_compile_seconds(site, seconds)
 
 PASSTHROUGH_METRIC = "odigos_anomaly_passthrough_total"
 QUEUE_FULL_METRIC = "odigos_anomaly_queue_full_total"
@@ -264,6 +278,12 @@ class SequenceBackend:
         if donate is not None:
             donate()
         self.ladder = BucketLadder(cfg.trace_bucket, cfg.bucket_ladder)
+        # jitstats site this backend's device calls compile under — must
+        # match the track_jit registration in models/ so compile seconds
+        # and cache size land on the same label value
+        self.jit_site = ("transformer.score_packed"
+                         if cfg.model == "transformer"
+                         else "autoencoder.score_spans")
         self.last_shape: Optional[list[int]] = None
         self.last_padding_waste: Optional[float] = None
         self.last_bucket_hit: Optional[bool] = None
@@ -283,6 +303,7 @@ class SequenceBackend:
             self._quantized = QuantizedTraceScorer(self.model,
                                                    self.variables)
             self._quantized.enable_input_donation()
+            self.jit_site = "quantized.score_packed"  # the jit that runs
         if cfg.data_parallel and cfg.data_parallel > 1:
             if cfg.trace_bucket % cfg.data_parallel:
                 raise ValueError(
@@ -377,7 +398,9 @@ class SequenceBackend:
         C = self.cfg.featurizer.cat_width
         D = self.cfg.featurizer.cont_width
         L = self.max_len
+        site = self.jit_site
         for R in self.ladder.buckets:
+            t0 = time.monotonic()
             if self.cfg.model == "transformer":
                 dev = self._device_call(_ZeroPacked(
                     np.zeros((R, L, C), np.int32),
@@ -391,6 +414,9 @@ class SequenceBackend:
                     jnp.zeros((R, L), bool))
             np.asarray(dev)  # block: compile finished before serving
             self.ladder.mark_warm(R)
+            # ladder warming is the one place every bucket compile is
+            # observable end-to-end — feed the per-site compile ledger
+            _record_compile_seconds(site, time.monotonic() - t0)
 
 
 @dataclass(frozen=True)
@@ -498,6 +524,10 @@ class ScoringEngine:
         self._busy_ns = 0
         self._busy_until = 0
         self._t_run0: Optional[int] = None
+        # in-flight window occupancy, mirrored from the worker's local
+        # deque (int store is atomic) so the device-runtime collector can
+        # sample it without touching worker state
+        self._inflight_count = 0
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "ScoringEngine":
@@ -506,13 +536,24 @@ class ScoringEngine:
                 w = getattr(self.backend, "warm", None)
                 if w is not None:
                     w()  # blocking by design: caller opted into warm start
-            self._stop.clear()
+            # per-run stop event: a worker that outlived a timed-out
+            # shutdown() join (hung device call) keeps ITS event set and
+            # exits when the call unwedges — clearing a shared event
+            # would resurrect it alongside the new worker (two workers
+            # popping one queue, interleaved online updates)
+            stop = threading.Event()
+            self._stop = stop
             self._thread = threading.Thread(
-                target=self._worker, name="scoring-engine", daemon=True)
+                target=self._worker, args=(stop,),
+                name="scoring-engine", daemon=True)
             self._thread.start()
+            # visible to the device-runtime collector from now on (weak
+            # registration: a dropped engine unregisters itself)
+            _engine_registry.register(self)
         return self
 
     def shutdown(self) -> None:
+        _engine_registry.unregister(self)
         self._stop.set()
         if self._thread is not None:
             # the worker drains queued + in-flight work losslessly first
@@ -579,6 +620,32 @@ class ScoringEngine:
             feats = featurize(batch, self.cfg.featurizer)
             self.backend.score(batch, feats)
 
+    def runtime_gauges(self) -> dict[str, Any]:
+        """Instantaneous engine state for the device-runtime collector
+        (ISSUE 3): the gauges the pipeline always computed but never
+        published — sampled, not accumulated, so the collector can poll
+        at its own cadence without touching worker internals."""
+        inflight = self._inflight_count
+        now = time.monotonic_ns()
+        wall = (now - self._t_run0) if self._t_run0 else 0
+        out: dict[str, Any] = {
+            "model": self.cfg.model,
+            "queue_depth": self._queue.qsize(),
+            "inflight": inflight,
+            "window_occupancy": round(inflight / self._depth, 4),
+            "pipeline_depth": self._depth,
+            "device_calls": self._device_calls,
+            "device_busy_frac": round(min(self._busy_ns / wall, 1.0), 4)
+            if wall else 0.0,
+        }
+        waste = getattr(self.backend, "last_padding_waste", None)
+        if waste is not None:
+            out["padding_waste_frac"] = waste
+        ladder = getattr(self.backend, "ladder", None)
+        if ladder is not None:
+            out["bucket_ladder_hit_rate"] = ladder.stats()["hit_rate"]
+        return out
+
     def pipeline_stats(self) -> dict[str, Any]:
         """Pipeline observability snapshot (bench.py reports this next to
         spans_per_sec_per_chip_scored so the overlap win is visible)."""
@@ -609,14 +676,16 @@ class ScoringEngine:
         return out
 
     # -------------------------------------------------------------- worker
-    def _worker(self) -> None:
+    def _worker(self, stop: threading.Event) -> None:
         """Two-stage pipelined loop: fill the in-flight window (pack +
         dispatch) ahead of harvesting, retire FIFO. With an empty queue the
         window drains immediately (no latency added when there is nothing
-        to overlap with); on stop the queue and window drain losslessly."""
+        to overlap with); on stop the queue and window drain losslessly.
+        ``stop`` is THIS run's event (see start()): a zombie run never
+        consults the replacement's."""
         inflight: deque[_InflightGroup] = deque()
         while True:
-            stopping = self._stop.is_set()
+            stopping = stop.is_set()
             if stopping and not inflight and self._queue.empty():
                 return
             # keep-serving backstop: _dispatch_group/_retire fail their own
@@ -631,9 +700,12 @@ class ScoringEngine:
                                                    overlapped=bool(inflight))
                         if grp is not None:
                             inflight.append(grp)
+                            self._inflight_count = len(inflight)
                         continue
                 if inflight:
-                    self._retire(inflight.popleft())
+                    grp = inflight.popleft()
+                    self._inflight_count = len(inflight)
+                    self._retire(grp)
             except Exception:
                 meter.add("odigos_anomaly_engine_errors_total")
 
@@ -777,7 +849,13 @@ class ScoringEngine:
                                   harvest_ms)
         grp.span.finish()
         meter.add(SCORED_METRIC, grp.n_spans)
-        meter.record("odigos_anomaly_score_latency_ms", dt_ms)
+        # exemplar: link this latency sample to the tpu/score self-trace
+        # that produced it (Dapper-style metric→trace pivot; NULL_SPAN —
+        # tracing off or a self-telemetry batch — carries no ids)
+        tid = getattr(grp.span, "trace_id", None)
+        meter.record("odigos_anomaly_score_latency_ms", dt_ms,
+                     exemplar=(tid, grp.span.span_id)
+                     if tid is not None else None)
         meter.record(STAGE_PACK_METRIC, pack_ms)
         meter.record(STAGE_DEVICE_METRIC, device_ms)
         meter.record(STAGE_HARVEST_METRIC, harvest_ms)
@@ -817,4 +895,16 @@ class ScoringEngine:
             sp.set_attr("jit.compile_est_ms", round(est, 3))
             meter.set_gauge("odigos_anomaly_jit_compile_est_ms",
                             round(est, 3))
+            # attribute to the backend's real jit site (matches the
+            # track_jit registration); zscore's kernels register as
+            # zscore.score/zscore.update — score is what first-call pays.
+            # Skip on warm-started engines (the ladder already recorded
+            # the real compiles — call 0 is warm, est is pure jitter)
+            # and below 1 ms (scheduler noise must not read as a
+            # post-warmup recompile in the per-site ledger).
+            site = getattr(self.backend, "jit_site", None) or (
+                "zscore.score" if self.cfg.model == "zscore" else None)
+            if site is not None and not self.cfg.warm_ladder \
+                    and est >= 1.0:
+                _record_compile_seconds(site, est / 1e3)
         self._device_calls += 1

@@ -96,9 +96,12 @@ CONTRACT: dict[str, dict] = {
            "fields": ["meta", "rule_kind", "languages", "disabled"]},
     # self-tracing panel (the framework tracing itself, /api/selftrace)
     "st": {"endpoint": "/api/selftrace",
-           "fields": ["traces", "spans_total", "dropped"]},
+           "fields": ["traces", "spans_total", "dropped", "exemplars"]},
     "tr": {"endpoint": "/api/selftrace", "at": ["traces", "*"],
            "fields": ["root", "span_count", "duration_ms"]},
+    # latency exemplars (ISSUE 3): histogram tail -> self-trace pivot
+    "ex": {"endpoint": "/api/selftrace", "at": ["exemplars", "*"],
+           "fields": ["metric", "value", "trace_id"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

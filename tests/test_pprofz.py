@@ -1,0 +1,196 @@
+"""pprof extension coverage (ISSUE 3 satellite): concurrent
+``/debug/profile`` + ``/debug/threadz`` requests don't interleave
+sampler state, ``seconds``/``hz`` clamp against hostile query values
+(negative, NaN, garbage), folded output parses as ``frame;frame count``
+lines with ``module:name`` frames, and the absolute-tick scheduler holds
+its effective rate instead of drifting low by the per-sweep cost."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from odigos_tpu.components.extensions.pprofz import (
+    PprofExtension, sample_profile, thread_stacks)
+
+
+@pytest.fixture
+def ext():
+    e = PprofExtension("pprof", {"port": 0, "max_seconds": 2.0})
+    e.start()
+    yield e
+    e.shutdown()
+
+
+def get_json(ext, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{ext.port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestSampleProfile:
+    def test_folded_lines_parse(self):
+        # a busy helper thread guarantees at least one sampled stack
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(100))
+
+        t = threading.Thread(target=spin, daemon=True)
+        t.start()
+        try:
+            folded = sample_profile(seconds=0.2, hz=200.0)
+        finally:
+            stop.set()
+            t.join()
+        assert folded
+        for line in folded:
+            stack, count = line.rsplit(" ", 1)
+            assert count.isdigit() and int(count) >= 1
+            assert stack  # "frame;frame" part non-empty
+
+    def test_frames_carry_module_names(self):
+        """``module:name`` frames: same-named functions in different
+        modules must not merge into one flamegraph frame."""
+        stop = threading.Event()
+
+        def spin():  # this frame must fold as "test_pprofz:spin"
+            while not stop.is_set():
+                sum(range(100))
+
+        t = threading.Thread(target=spin, daemon=True)
+        t.start()
+        try:
+            folded = sample_profile(seconds=0.2, hz=200.0)
+        finally:
+            stop.set()
+            t.join()
+        joined = "\n".join(folded)
+        assert "test_pprofz:spin" in joined
+        # every frame in every stack is module-qualified
+        for line in folded:
+            for frame in line.rsplit(" ", 1)[0].split(";"):
+                assert ":" in frame, f"unqualified frame {frame!r}"
+
+    def test_effective_rate_holds_near_target(self):
+        """Absolute-tick scheduling: sweeps/elapsed stays near hz even
+        though each sweep costs time (the old sleep(interval) drifted
+        low by exactly the sweep cost)."""
+        hz, seconds = 100.0, 0.5
+        stop = threading.Event()
+
+        def spin():  # a thread to sample, so sweep count is observable
+            while not stop.is_set():
+                sum(range(100))
+
+        t = threading.Thread(target=spin, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        try:
+            folded = sample_profile(seconds=seconds, hz=hz)
+        finally:
+            stop.set()
+            t.join()
+        elapsed = time.monotonic() - t0
+        # total samples across stacks / threads-per-sweep ≈ sweep count;
+        # count sweeps via the busiest single stack as a lower bound
+        sweeps = max(int(line.rsplit(" ", 1)[1]) for line in folded) \
+            if folded else 0
+        assert elapsed < seconds + 0.3
+        # allow generous scheduler noise; the drifting implementation
+        # loses far more than 40% under a sweep cost of ~1ms at 100hz
+        assert sweeps >= hz * seconds * 0.6, \
+            f"only {sweeps} sweeps in {elapsed:.2f}s at {hz}hz"
+
+
+class TestProfileEndpoint:
+    @pytest.mark.parametrize("query,exp_seconds,exp_hz", [
+        ("seconds=0.05&hz=200", 0.05, 200.0),
+        ("seconds=9999&hz=99999", 0.2, 997.0),      # clamped to caps
+        ("seconds=-3&hz=-7", 0.01, 1.0),            # clamped to floors
+        ("seconds=nan&hz=nan", 0.2, 97.0),          # NaN -> capped default
+        ("seconds=bogus&hz=bogus", 0.2, 97.0),      # garbage -> default
+    ])
+    def test_clamping(self, query, exp_seconds, exp_hz):
+        # handler exercised directly (no HTTP hop): the clamp contract is
+        # pure; max_seconds kept tiny so default-fallback cases stay fast
+        ext = PprofExtension("pprof", {"port": 0, "max_seconds": 0.2})
+        q = dict(kv.split("=") for kv in query.split("&"))
+        code, body = ext._profile(q)
+        assert code == 200
+        assert body["seconds"] == pytest.approx(exp_seconds)
+        assert body["hz"] == pytest.approx(exp_hz)
+        for line in body["folded"]:
+            stack, count = line.rsplit(" ", 1)
+            assert count.isdigit()
+
+    def test_concurrent_profile_and_threadz(self, ext):
+        """Concurrent requests: profiles serialize on the sampler lock
+        (no interleaved sampler state), threadz stays lock-free, and
+        every response is complete and well-formed."""
+        results: dict[str, object] = {}
+        errors: list[BaseException] = []
+
+        def hit(name, path):
+            try:
+                results[name] = get_json(ext, path)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=hit, args=(
+                "p1", "/debug/profile?seconds=0.3&hz=97")),
+            threading.Thread(target=hit, args=(
+                "p2", "/debug/profile?seconds=0.3&hz=97")),
+            threading.Thread(target=hit, args=("t1", "/debug/threadz")),
+            threading.Thread(target=hit, args=("t2", "/debug/threadz")),
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert set(results) == {"p1", "p2", "t1", "t2"}
+        # the two profiles serialized: total wall >= 2 x 0.3s
+        assert time.monotonic() - t0 >= 0.55
+        for name in ("p1", "p2"):
+            body = results[name]
+            assert body["seconds"] == pytest.approx(0.3)
+            for line in body["folded"]:
+                stack, count = line.rsplit(" ", 1)
+                assert count.isdigit() and stack
+        for name in ("t1", "t2"):
+            threads_out = results[name]["threads"]
+            assert threads_out  # at least the main + handler threads
+            for stack in threads_out.values():
+                assert isinstance(stack, list)
+
+    def test_threadz_sees_named_threads(self, ext):
+        hold = threading.Event()
+        release = threading.Event()
+
+        def parked():
+            hold.set()
+            release.wait(5)
+
+        t = threading.Thread(target=parked, name="parked-probe",
+                             daemon=True)
+        t.start()
+        hold.wait(5)
+        try:
+            out = get_json(ext, "/debug/threadz")
+            assert "parked-probe" in out["threads"]
+        finally:
+            release.set()
+            t.join()
+
+
+def test_thread_stacks_maps_names():
+    out = thread_stacks()
+    assert any("MainThread" in name or name for name in out)

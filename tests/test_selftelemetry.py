@@ -501,6 +501,13 @@ _PROM_LINE = re.compile(
     r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
     r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
     r' (-?\d+(\.\d+)?([eE][+-]?\d+)?|-?inf|nan)$')
+# exemplar annotations (ISSUE 3): comment lines, ignored by plain
+# Prometheus scrapers, linking a histogram to the self-trace that
+# populated it — # EXEMPLAR <name>{...} {trace_id=..,span_id=..} v ts
+_PROM_EXEMPLAR = re.compile(
+    r'^# EXEMPLAR [a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? '
+    r'\{trace_id="[0-9a-f]{32}",span_id="[0-9a-f]{16}"\} '
+    r'-?\d+(\.\d+)?([eE][+-]?\d+)? \d+(\.\d+)?$')
 
 
 class TestFrontendSurfaces:
@@ -524,7 +531,9 @@ class TestFrontendSurfaces:
         body = req.read().decode()
         lines = [ln for ln in body.splitlines() if ln]
         assert lines, "empty exposition"
-        bad = [ln for ln in lines if not _PROM_LINE.match(ln)]
+        bad = [ln for ln in lines
+               if not (_PROM_EXEMPLAR.match(ln) if ln.startswith("#")
+                       else _PROM_LINE.match(ln))]
         assert not bad, f"non-Prometheus lines: {bad[:5]}"
         names = {ln.split("{")[0].split(" ")[0] for ln in lines}
         assert "odigos_selftrace_spans_total" in names
