@@ -174,6 +174,13 @@ def main() -> None:
     print(json.dumps(result), flush=True)
 
     try:
+        result.update(attrs_pipeline_bench())
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"attrs pipeline bench failed: {type(e).__name__}: {e}")
+        result["attrs_pipeline_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result), flush=True)
+
+    try:
         pipe = with_retry(lambda: pipeline_bench(on_tpu), "pipeline")
         result.update(pipe)
     except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
@@ -265,6 +272,134 @@ def throughput_bench(on_tpu: bool) -> dict:
         "unit": "spans/s",
         "vs_baseline": round(tf_sps / 1_000_000.0, 4),
         "zscore_spans_per_sec": round(len(batch) / zdt, 1),
+    }
+
+
+def attrs_pipeline_bench() -> dict:
+    """Columnar attribute store A/B (ISSUE 4): the SAME attrs-heavy
+    processor chain (filter → attributes → transform → batch-style
+    concat+split) run against the dictionary-encoded CSR store vs the
+    historical tuple-of-dicts representation, spans/sec each way; plus
+    the featurizer's attr_slots=4 vs attr_slots=0 wall-time ratio on the
+    columnar path (the evidence that hashed attrs are now viable on the
+    throughput path). Host-only — no device, no tunnel."""
+    from odigos_tpu.components.processors.attributes import (
+        AttributesProcessor)
+    from odigos_tpu.components.processors.filter import FilterProcessor
+    from odigos_tpu.components.processors.transform import (
+        TransformProcessor)
+    from odigos_tpu.features import FeaturizerConfig, featurize
+    from odigos_tpu.pdata import (columnar_attrs, concat_batches,
+                                  synthesize_traces)
+
+    def make_batch(seed=99):
+        # attrs-heavy: tenant/status/retry labels on 70% of spans on top
+        # of the synthesized peer.service/http.method
+        batch = synthesize_traces(2000, seed=seed)
+        rng = np.random.default_rng(seed)
+        n = len(batch)
+        mask = rng.random(n) < 0.7
+        k = int(mask.sum())
+        return batch.with_span_attrs({
+            "http.status": rng.choice([200, 404, 500], k).tolist(),
+            "tenant": [f"t{i % 17}" for i in range(k)],
+            "retry": rng.integers(0, 4, k).tolist(),
+        }, mask)
+
+    def make_chain():
+        filt = FilterProcessor("filter/bench", {"exclude": [
+            {"attr": {"key": "http.status", "value": 500}}]})
+        filt.start()
+        attrp = AttributesProcessor("attributes/bench", {"actions": [
+            {"action": "insert", "key": "env", "value": "prod"},
+            {"action": "upsert", "key": "zone", "value": "z1"},
+            {"action": "rename", "key": "retry", "new_key": "retry.count"},
+            {"action": "delete", "key": "peer.service"}]})
+        tf = TransformProcessor("transform/bench", {"trace_statements": [
+            'set(attributes["slow"], true) where duration_ms > 1',
+            'set(attributes["tier"], "gold") '
+            'where attributes["tenant"] == "t3"']})
+        return (filt, attrp, tf)
+
+    N_VARIANTS = 8  # fresh-store inputs rotate: a mode must not replay
+    # one memoized batch — per-store memo hits only occur at the rate a
+    # production stream would see (a repeated batch every N_VARIANTS)
+
+    def setup_mode(columnar: bool):
+        with columnar_attrs(columnar):
+            batches = [make_batch(seed=99 + v) for v in range(N_VARIANTS)]
+            chain = make_chain()
+        state = {"i": 0}
+
+        def once():
+            with columnar_attrs(columnar):
+                b = batches[state["i"] % N_VARIANTS]
+                state["i"] += 1
+                for p in chain:
+                    b = p.process(b)
+                merged = concat_batches([b, b])
+                for lo in range(0, len(merged), 4096):  # max-size split
+                    merged.slice(lo, min(lo + 4096, len(merged)))
+
+        once()  # settle caches/compiles outside the timed region
+        return sum(len(b) for b in batches) / N_VARIANTS, once
+
+    # interleave the two representations (profiler-overhead discipline:
+    # monotone machine drift must not land on one condition) and take
+    # per-mode p50s
+    n_dict, once_dict = setup_mode(False)
+    n_col, once_col = setup_mode(True)
+    samples: dict[bool, list] = {True: [], False: []}
+    for r in range(32):
+        order = (False, True) if r % 2 == 0 else (True, False)
+        for columnar in order:
+            fn = once_col if columnar else once_dict
+            t0 = time.perf_counter()
+            fn()
+            samples[columnar].append(time.perf_counter() - t0)
+    sps_dict = n_dict / float(np.percentile(samples[False], 50))
+    sps_col = n_col / float(np.percentile(samples[True], 50))
+    speedup = sps_col / max(sps_dict, 1e-9)
+    log(f"attrs_pipeline: {sps_col:,.0f} spans/s columnar vs "
+        f"{sps_dict:,.0f} dict ({speedup:.2f}x) on the "
+        f"filter->attributes->transform->batch chain")
+
+    # featurizer: hashed attr slots on vs off, columnar path, same batch;
+    # the two configs INTERLEAVE (sub-ms samples — a scheduler hiccup
+    # landing on one condition would fabricate a ratio)
+    with columnar_attrs(True):
+        batch = make_batch()
+        batch.attrs()  # store prebuilt, as a wire decode would hand over
+        cfgs = {s: FeaturizerConfig(attr_slots=s) for s in (0, 4)}
+        raw: dict[int, list] = {0: [], 4: []}
+        for s, cfg in cfgs.items():
+            featurize(batch, cfg)  # warm hash caches + slot-matrix memo
+        for r in range(20):
+            for s in ((0, 4) if r % 2 == 0 else (4, 0)):
+                t0 = time.perf_counter()
+                featurize(batch, cfgs[s])
+                raw[s].append((time.perf_counter() - t0) * 1e3)
+        times = {s: float(np.percentile(v, 50)) for s, v in raw.items()}
+    ratio = times[4] / max(times[0], 1e-9)
+    log(f"attrs_pipeline: featurize p50 {times[0]:.3f} ms (slots=0) -> "
+        f"{times[4]:.3f} ms (slots=4), ratio {ratio:.3f}")
+
+    return {
+        "attrs_pipeline_spans_per_sec_columnar": round(sps_col, 1),
+        "attrs_pipeline_spans_per_sec_dict": round(sps_dict, 1),
+        "attrs_pipeline_speedup": round(speedup, 3),
+        "attrs_featurizer_p50_ms_slots0": round(times[0], 4),
+        "attrs_featurizer_p50_ms_slots4": round(times[4], 4),
+        "attrs_featurizer_slots_ratio": round(ratio, 4),
+        "attrs_pipeline_note": (
+            "spans/sec through an attrs-heavy filter->attributes->"
+            "transform->batch chain, columnar AttrStore vs per-span dict "
+            "side lists on identical rotating inputs (8 variants, "
+            "interleaved rounds); featurizer ratio = attr_slots=4 over "
+            "attr_slots=0 p50 wall time on the columnar path, store-"
+            "memoized steady state (re-featurizing a batch is a lookup; "
+            "cold cost is O(distinct key/value pairs) hashing + "
+            "O(entries) scatter)"),
     }
 
 

@@ -42,8 +42,8 @@ class AnomalyRouterConnector(Connector):
 
     def consume(self, batch: SpanBatch) -> None:
         flag = self.flag_attr
-        flagged = np.fromiter((flag in a for a in batch.span_attrs),
-                              bool, len(batch))
+        # columnar presence probe — one key-table lookup + entry gather
+        flagged = batch.attrs().mask_has(flag)
         if flagged.any():
             meter.add(self._flagged_metric, int(flagged.sum()))
         if self.mode == "trace" and flagged.any():

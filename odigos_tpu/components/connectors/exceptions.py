@@ -42,10 +42,10 @@ class ExceptionsConnector(Connector):
             return
         status = batch.col("status_code").astype(np.int64)
         err = status == int(StatusCode.ERROR)
-        has_exc = np.array([
-            "exception.type" in batch.span_attrs[i]
-            or "exception.message" in batch.span_attrs[i]
-            for i in range(len(batch))])
+        # columnar presence probes — no per-span dict materialization
+        store = batch.attrs()
+        has_exc = store.mask_has("exception.type") \
+            | store.mask_has("exception.message")
         mask = err | has_exc
         if not mask.any():
             return
@@ -56,10 +56,11 @@ class ExceptionsConnector(Connector):
         now = time.time_ns()
 
         # ---- exceptions_total per (service, span name, exception type)
+        etype_vals, etype_present = store.column("exception.type")
+        emsg_vals, emsg_present = store.column("exception.message")
         counts: dict[tuple[str, str, str], int] = {}
         for i in idx:
-            etype = str(batch.span_attrs[int(i)].get(
-                "exception.type", "unknown"))
+            etype = str(etype_vals[i]) if etype_present[i] else "unknown"
             key = (services[int(i)], names[int(i)], etype)
             counts[key] = counts.get(key, 0) + 1
         mb = MetricBatchBuilder()
@@ -81,19 +82,22 @@ class ExceptionsConnector(Connector):
             tid_lo = batch.col("trace_id_lo")
             sid = batch.col("span_id")
             for i in idx:
-                attrs = batch.span_attrs[int(i)]
+                if emsg_present[i]:
+                    body = str(emsg_vals[i])
+                elif etype_present[i]:
+                    body = str(etype_vals[i])
+                else:
+                    body = "exception"
                 res = lb.add_resource(
                     {"service.name": services[int(i)]})
                 lb.add_record(
-                    body=str(attrs.get("exception.message",
-                                       attrs.get("exception.type",
-                                                 "exception"))),
+                    body=body,
                     severity=Severity.ERROR, time_unix_nano=now,
                     trace_id=(int(tid_hi[i]) << 64) | int(tid_lo[i]),
                     span_id=int(sid[i]), resource_index=res,
                     attrs={"span.name": names[int(i)],
-                           "exception.type": str(attrs.get(
-                               "exception.type", "unknown"))})
+                           "exception.type": str(etype_vals[i])
+                           if etype_present[i] else "unknown"})
             logs = lb.build()
 
         for pname, out in self.outputs.items():

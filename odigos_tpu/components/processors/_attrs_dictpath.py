@@ -1,0 +1,55 @@
+"""Reference dict-path implementations of attrs-touching hot stages.
+
+These are the pre-columnar per-span-dict code paths, kept (a) as the
+fallback when ``columnar_enabled()`` is off and (b) as the ground truth
+the parity suite and the bench A/B compare the columnar ports against.
+They are NOT on the default hot path — the package-hygiene lint forbids
+per-span ``span_attrs`` iteration in the scoring-route modules, and this
+module is its one sanctioned home.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+_MISSING = object()
+
+
+def filter_attr_eq_mask(batch, key: str, want: Any) -> np.ndarray:
+    """Dict path of the filter processor's ``attr: {key, value}`` clause."""
+    return np.fromiter(
+        (a.get(key, _MISSING) == want for a in batch.span_attrs),
+        bool, len(batch))
+
+
+def filter_attr_has_mask(batch, key: str) -> np.ndarray:
+    """Dict path of the filter processor's attr PRESENCE clause."""
+    return np.fromiter((key in a for a in batch.span_attrs),
+                       bool, len(batch))
+
+
+def flagged_mask(batch, flag: str) -> np.ndarray:
+    """Dict path of the anomaly-router / mock-backend flag probe."""
+    return np.fromiter((flag in a for a in batch.span_attrs),
+                       bool, len(batch))
+
+
+def copy_span_attr_dicts(batch) -> list[dict[str, Any]]:
+    """Dict path of the attributes processor's working copy."""
+    return [dict(d) for d in batch.span_attrs]
+
+
+def featurize_attr_slots(batch, slot_fn, slots: int,
+                         vocab: int) -> np.ndarray:
+    """Dict path of the featurizer's attr-slot hashing (per-span loop,
+    cached per distinct dict content via ``slot_fn``'s lru_cache)."""
+    out = np.empty((len(batch), slots), dtype=np.int32)
+    for i, attrs in enumerate(batch.span_attrs):
+        if attrs:
+            key = tuple(sorted((k, str(v)) for k, v in attrs.items()))
+            out[i] = slot_fn(key, slots, vocab)
+        else:
+            out[i] = 0
+    return out

@@ -4,6 +4,12 @@ Covers the reference's attribute-manipulation action processors
 (addclusterinfo / renameattribute / deleteattribute compiled by
 autoscaler/controllers/actions/*.go into collector processors): insert,
 rename, delete keys on span or resource attributes.
+
+Span-scoped actions run on the columnar attribute store
+(``pdata/attrstore.py``): insert/update/upsert are one masked
+``set_const`` (key-presence mask read off the CSR arrays), delete drops
+the key's entries with one bincount, rename re-points them — no
+per-span dict copy. Resource attrs stay dicts (bounded, deduped).
 """
 
 from __future__ import annotations
@@ -11,8 +17,13 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import Any
 
+import numpy as np
+
+from ...pdata.attrstore import (AttrDictView, AttrStore, _val_key,
+                                columnar_enabled)
 from ...pdata.spans import SpanBatch
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
+from . import _attrs_dictpath as _dictpath
 
 
 class AttributesProcessor(Processor):
@@ -25,24 +36,114 @@ class AttributesProcessor(Processor):
         actions = self.config.get("actions", [])
         if not actions:
             return batch
+        store: AttrStore | None = None
         span_attrs = None
         resources = None
+        span_actions: list[dict[str, Any]] = []
         for a in actions:
             scope = a.get("scope", "span")
             if scope == "resource":
                 if resources is None:
                     resources = [dict(r) for r in batch.resources]
                 _apply(resources, a)
+            elif columnar_enabled():
+                span_actions.append(a)
             else:
                 if span_attrs is None:
-                    span_attrs = [dict(d) for d in batch.span_attrs]
+                    span_attrs = _dictpath.copy_span_attr_dicts(batch)
                 _apply(span_attrs, a)
+        if span_actions:
+            store = batch.attrs()
+            composed = _compose_actions(store, span_actions)
+            if composed is not None:
+                store = composed
+            else:
+                for a in span_actions:
+                    store = _apply_store(store, a)
         out = batch
+        if store is not None:
+            out = replace(out, span_attrs=AttrDictView(store))
         if span_attrs is not None:
             out = replace(out, span_attrs=tuple(span_attrs))
         if resources is not None:
             out = replace(out, resources=tuple(resources))
         return out
+
+
+def _compose_actions(store: AttrStore,
+                     actions: list[dict[str, Any]]) -> AttrStore | None:
+    """Fold a whole action list into ONE ``rebuild_entries`` pass when
+    the actions are independent: keys pairwise distinct, and every
+    written key (insert/upsert/rename target) absent from the key table
+    so position semantics reduce to append-at-row-end. Returns None when
+    the sequence needs the exact sequential semantics (overlapping keys,
+    updates of existing keys) — the caller falls back to per-action ops.
+    """
+    touched: set[str] = set()
+    for a in actions:
+        kind = a.get("action", "upsert")
+        if kind == "update":
+            return None  # in-place value rewrite: cheap sequentially
+        ks = [a["key"]] + ([a["new_key"]] if kind == "rename" else [])
+        for k in ks:
+            if k in touched:
+                return None
+            touched.add(k)
+        if kind in ("insert", "upsert", "rename") and \
+                store.has_key(ks[-1]):
+            return None  # target exists: keep-position semantics
+    n = store.n_rows
+    drop: np.ndarray | None = None
+    appends: list[tuple[str, np.ndarray, np.ndarray]] = []
+    vals = store.vals
+    lookup = {_val_key(v): i for i, v in enumerate(vals)}
+    for a in actions:
+        kind = a.get("action", "upsert")
+        key = a["key"]
+        if kind == "delete":
+            kid = store._key_id(key)
+            if kid >= 0:
+                hit = store.key_idx == kid
+                drop = hit if drop is None else (drop | hit)
+        elif kind == "rename":
+            codes, present = store.column_codes(key)
+            if present.any():
+                kid = store._key_id(key)
+                hit = store.key_idx == kid
+                drop = hit if drop is None else (drop | hit)
+                appends.append((a["new_key"], present, codes))
+        else:  # insert/upsert of a table-absent key: append everywhere
+            value = a.get("value")
+            vk = _val_key(value)
+            code = lookup.get(vk)
+            if code is None:
+                code = len(vals)
+                vals = vals + (value,)
+                lookup[vk] = code
+            appends.append((key, np.ones(n, dtype=bool),
+                            np.full(n, code, dtype=np.int32)))
+    if drop is None and not appends:
+        return store
+    return store.rebuild_entries(drop, appends, new_vals=vals)
+
+
+def _apply_store(store: AttrStore, action: dict[str, Any]) -> AttrStore:
+    """One action as copy-on-write store ops — whole-batch array work."""
+    kind = action.get("action", "upsert")
+    key = action["key"]
+    if kind == "insert":  # setdefault: only rows missing the key
+        return store.set_const(key, action.get("value"),
+                               ~store.mask_has(key))
+    if kind == "update":  # only rows that already have it
+        return store.set_const(key, action.get("value"),
+                               store.mask_has(key))
+    if kind == "upsert":
+        return store.set_const(key, action.get("value"))
+    if kind == "delete":
+        return store.delete_key(key)
+    if kind == "rename":
+        return store.rename_key(key, action["new_key"])
+    raise ValueError(f"unknown attributes action {kind!r}")
 
 
 def _apply(dicts: list[dict[str, Any]], action: dict[str, Any]) -> None:

@@ -75,10 +75,12 @@ class BatchProcessor(Processor):
             return
         max_size = self.send_batch_max_size
         if max_size and len(merged) > max_size:
-            import numpy as np
+            # contiguous chunks: slice() hands out column VIEWS (numpy
+            # basic slicing + attr-store entry slices) — the old
+            # take(arange(lo, hi)) copied every column per chunk
             for lo in range(0, len(merged), max_size):
-                idx = np.arange(lo, min(lo + max_size, len(merged)))
-                self.next_consumer.consume(merged.take(idx))
+                self.next_consumer.consume(
+                    merged.slice(lo, min(lo + max_size, len(merged))))
         else:
             self.next_consumer.consume(merged)
 

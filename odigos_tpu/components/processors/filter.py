@@ -30,9 +30,11 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ...pdata.attrstore import columnar_enabled
 from ...pdata.spans import SpanBatch
 from ...utils.telemetry import meter
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
+from . import _attrs_dictpath as _dictpath
 
 DROPPED_METRIC = "odigos_filter_dropped_spans_total"
 _KNOWN_CLAUSES = frozenset(
@@ -71,17 +73,19 @@ def _condition_mask(batch: SpanBatch, cond: dict[str, Any]) -> np.ndarray:
         key = cond["attr"]["key"]
         if "value" in cond["attr"]:
             want_v = cond["attr"]["value"]
-            # sentinel default: a missing key must never equal any value
-            mask &= np.fromiter(
-                (a.get(key, _MISSING) == want_v for a in batch.span_attrs),
-                bool, len(batch))
+            if columnar_enabled():
+                # columnar: scan the deduped value pool once, reach rows
+                # through a val_idx gather — a missing key never matches
+                # (mask_eq is presence-anded)
+                mask &= batch.attrs().mask_eq(key, want_v)
+            else:
+                mask &= _dictpath.filter_attr_eq_mask(batch, key, want_v)
         else:  # value omitted = presence check
-            mask &= np.fromiter((key in a for a in batch.span_attrs),
-                                bool, len(batch))
+            if columnar_enabled():
+                mask &= batch.attrs().mask_has(key)
+            else:
+                mask &= _dictpath.filter_attr_has_mask(batch, key)
     return mask
-
-
-_MISSING = object()
 
 
 def _any_match(batch: SpanBatch, conds: list[dict]) -> np.ndarray:

@@ -14,8 +14,16 @@ Config::
 For each row: the listed keys are read from the record's own attributes
 (falling back to the current resource's), removed from the record
 attrs, and the row is re-pointed at a resource extending the current
-one with those values.  Columnar cost: one pass over the attr
-side-lists plus a resource_index column rewrite.
+one with those values.
+
+Columnar path: per-row group identity is a small integer CODE MATRIX —
+one column for the base resource, one per configured key holding the
+attr's ``val_idx`` (dictionary code) or a resource-fallback code — so
+grouping is ``np.unique(axis=0)`` over ints and promoted-key removal is
+one entry-mask ``filter_entries`` on the attr store. Python runs once
+per DISTINCT (resource, values...) combination to build the merged
+resource dicts (content-interned in first-encounter order, so the
+output is bit-identical to the per-row dict path), never per row.
 """
 
 from __future__ import annotations
@@ -25,10 +33,15 @@ from typing import Any
 
 import numpy as np
 
+from ...pdata.attrstore import AttrDictView, columnar_enabled
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
 _ATTR_FIELD = {"span_attrs": "span_attrs", "record_attrs": "record_attrs",
                "point_attrs": "point_attrs"}
+
+
+def _content_key(d: dict[str, Any]) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in d.items()))
 
 
 class GroupByAttrsProcessor(Processor):
@@ -47,6 +60,108 @@ class GroupByAttrsProcessor(Processor):
                            if hasattr(batch, f)), None)
         if attr_field is None:
             return batch
+        if columnar_enabled():
+            return self._process_columnar(batch, attr_field)
+        return self._process_dicts(batch, attr_field)
+
+    # ------------------------------------------------------- columnar path
+    def _process_columnar(self, batch: Any, attr_field: str) -> Any:
+        store = batch.attrs()
+        resources = batch.resources
+        n = len(batch)
+        ridx = np.asarray(batch.col("resource_index"), dtype=np.int64)
+        valid = (ridx >= 0) & (ridx < len(resources))
+        safe_ridx = np.where(valid, ridx, 0)
+
+        # cheap pre-pass mirror: no key appears in the store's table and
+        # the resources are already distinct → nothing to do
+        if not any(store.has_key(k) for k in self.keys):
+            idents = [_content_key(r) for r in resources]
+            if len(set(idents)) == len(idents):
+                return batch
+
+        # ---- group-identity code matrix: one int per (row, key)
+        V = len(store.vals)
+        val_is_none = np.fromiter((v is None for v in store.vals),
+                                  dtype=bool, count=V) if V else \
+            np.empty(0, dtype=bool)
+        codes = np.empty((n, len(self.keys) + 1), dtype=np.int64)
+        codes[:, 0] = np.where(valid, ridx, -1)  # base resource identity
+        drop_entries: np.ndarray | None = None
+        col_vals: list[np.ndarray] = []
+        for j, key in enumerate(self.keys):
+            ccodes, present = store.column_codes(key)
+            # attr value wins unless it's None-valued; fall back to the
+            # base resource's value (identity = base index: the value is
+            # a function of the base), else "not promoted" (-1)
+            attr_ok = present & ~val_is_none[np.maximum(ccodes, 0)] \
+                if V else np.zeros(n, dtype=bool)
+            if resources:
+                res_has = np.fromiter(
+                    (r.get(key) is not None for r in resources),
+                    dtype=bool, count=len(resources))
+                # dict semantics: d.get(k, base.get(k)) — the resource
+                # fallback only fires when the key is ABSENT from the
+                # record attrs (a present None value is "not promoted")
+                fallback = np.where(~present & valid & res_has[safe_ridx],
+                                    V + safe_ridx, -1)
+            else:
+                fallback = np.full(n, -1, dtype=np.int64)
+            code_j = np.where(attr_ok, ccodes.astype(np.int64), fallback)
+            codes[:, j + 1] = code_j
+            col_vals.append(store.column(key)[0])
+            # promoted keys leave the record attrs (only where present)
+            promoted = code_j >= 0
+            if promoted.any():
+                kid = store._key_id(key)
+                hit = (store.key_idx == kid) & promoted[store.entry_rows]
+                drop_entries = hit if drop_entries is None \
+                    else (drop_entries | hit)
+
+        # ---- one Python pass per DISTINCT combo (first-encounter order)
+        _, inv = np.unique(codes, axis=0, return_inverse=True)
+        inv = inv.ravel()
+        n_combo = int(inv.max()) + 1
+        first_row = np.full(n_combo, n, dtype=np.int64)
+        np.minimum.at(first_row, inv, np.arange(n, dtype=np.int64))
+        combo_order = np.argsort(first_row, kind="stable")
+
+        new_resources: list[dict[str, Any]] = []
+        intern: dict[tuple, int] = {}
+        combo_final = np.empty(n_combo, dtype=np.int32)
+        for c in combo_order:
+            i = int(first_row[c])
+            base = resources[int(ridx[i])] if valid[i] else {}
+            merged = dict(base)
+            for j, key in enumerate(self.keys):
+                if codes[i, j + 1] >= 0:
+                    v = col_vals[j][i]
+                    merged[key] = base.get(key) if v is None else v
+            ck = _content_key(merged)
+            idx = intern.get(ck)
+            if idx is None:
+                idx = len(new_resources)
+                new_resources.append(merged)
+                intern[ck] = idx
+            combo_final[c] = idx
+        new_ridx = combo_final[inv].astype(np.int32)
+
+        attrs_changed = drop_entries is not None and bool(
+            drop_entries.any())
+        if not attrs_changed and not (new_ridx != ridx).any() \
+                and len(new_resources) == len(resources):
+            return batch
+        fields: dict[str, Any] = {}
+        if attrs_changed:
+            fields[attr_field] = AttrDictView(
+                store.filter_entries(~drop_entries))
+        cols = dict(batch.columns)
+        cols["resource_index"] = new_ridx
+        return replace(batch, columns=cols,
+                       resources=tuple(new_resources), **fields)
+
+    # ----------------------------------------------- dict reference path
+    def _process_dicts(self, batch: Any, attr_field: str) -> Any:
         attrs = getattr(batch, attr_field)
         resources = batch.resources
         ridx = batch.col("resource_index")
@@ -56,8 +171,7 @@ class GroupByAttrsProcessor(Processor):
         # conclude "unchanged" after O(n) dict/tuple work per batch —
         # skip it (hot trace pipelines hit this case constantly)
         if not any(k in d for d in attrs for k in self.keys):
-            idents = [tuple(sorted((k, str(v)) for k, v in r.items()))
-                      for r in resources]
+            idents = [_content_key(r) for r in resources]
             if len(set(idents)) == len(idents):
                 return batch
 
@@ -81,7 +195,7 @@ class GroupByAttrsProcessor(Processor):
                 changed = True
             merged = dict(base)
             merged.update(promoted)
-            key = tuple(sorted((k, str(v)) for k, v in merged.items()))
+            key = _content_key(merged)
             j = intern.get(key)
             if j is None:
                 j = len(new_resources)

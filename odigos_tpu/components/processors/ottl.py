@@ -53,6 +53,8 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from ...pdata.attrstore import AttrDictView, AttrStore, columnar_enabled
+
 
 class OttlError(ValueError):
     """Parse or bind failure — raised at processor BUILD time so a bad
@@ -263,6 +265,15 @@ def parse_statement(src: str) -> Statement:
 # and finishes with .result() -> rebuilt batch.  All keyed-attribute
 # machinery, the resource fan-out, and the string-table re-intern are
 # shared in _BaseContext; subclasses declare their scalar fields.
+#
+# Record-scoped attribute get/set ride the columnar AttrStore: values()
+# is a memoized column gather and set() a copy-on-write set_column — no
+# dict materialization. Only the dict-shaped edit functions (delete_key,
+# keep_keys, truncate_all, replace_*_patterns over whole dicts) downgrade
+# the context to mutable dicts, materialized ONCE from the store's
+# current state; every later statement in the group then stays on dicts
+# so upstream OTTL sequencing (later where-clauses see earlier edits)
+# holds on both paths.
 
 _ATTR_PATHS = (("attributes",), ("resource", "attributes"))
 
@@ -295,6 +306,7 @@ class _BaseContext:
         self._attrs: Optional[list[dict]] = None
         self._resources: Optional[list[dict]] = None
         self._cols: Optional[dict[str, np.ndarray]] = None
+        self._store: Optional[AttrStore] = None  # CoW-edited attr store
 
     # ---- build-time validation (no batch needed)
     @classmethod
@@ -322,6 +334,12 @@ class _BaseContext:
         return self.batch.col(name)
 
     # ---- shared keyed-attribute machinery
+    def _cur_store(self) -> AttrStore:
+        """The attr store including edits staged earlier in this
+        statement group."""
+        return self._store if self._store is not None \
+            else self.batch.attrs()
+
     def _attr_view(self, path: Path) -> list[dict]:
         if path.parts[:1] == ("resource",):
             if self._resources is None:
@@ -329,8 +347,15 @@ class _BaseContext:
             return self._resources
         if path.parts == ("attributes",):
             if self._attrs is None:
-                self._attrs = [dict(d) for d in
-                               getattr(self.batch, self.ATTR_FIELD)]
+                # downgrade: dict-shaped edits need mutable dicts — fold
+                # any staged store edits in, then stay on dicts for the
+                # rest of the group
+                if self._store is not None:
+                    base: Sequence = self._store.to_dicts()
+                    self._store = None
+                else:
+                    base = getattr(self.batch, self.ATTR_FIELD)
+                self._attrs = [dict(d) for d in base]
             return self._attrs
         raise OttlError(
             f"unknown attributes path {'.'.join(path.parts)}")
@@ -345,12 +370,18 @@ class _BaseContext:
 
     def values(self, path: Path) -> np.ndarray:
         if path.key is not None:
-            dicts = self._attr_view(path)
             if path.parts[:1] == ("resource",):
+                dicts = self._attr_view(path)
                 ridx = self.batch.col("resource_index")
                 return np.array(
                     [dicts[int(i)].get(path.key) for i in ridx],
                     dtype=object)
+            if self._attrs is None and columnar_enabled():
+                # columnar read: memoized column gather, None where
+                # absent — exactly d.get(key). Copy so condition code
+                # can never corrupt the store's memo.
+                return self._cur_store().column(path.key)[0].copy()
+            dicts = self._attr_view(path)
             return np.array([d.get(path.key) for d in dicts],
                             dtype=object)
         self.check_path(path, settable=False)
@@ -359,17 +390,45 @@ class _BaseContext:
     def set_values(self, path: Path, vals: Sequence[Any],
                    mask: np.ndarray) -> None:
         if path.key is not None:
-            dicts = self._attr_view(path)
             if path.parts[:1] == ("resource",):
+                dicts = self._attr_view(path)
                 ridx = self.batch.col("resource_index")
                 for i in np.nonzero(mask)[0]:
                     dicts[int(ridx[i])][path.key] = vals[i]
-            else:
-                for i in np.nonzero(mask)[0]:
-                    dicts[int(i)][path.key] = vals[i]
+                return
+            if self._attrs is None and columnar_enabled():
+                masked = vals[mask] if isinstance(vals, np.ndarray) \
+                    else [v for v, m in zip(vals, mask) if m]
+                self._store = self._cur_store().set_column(
+                    path.key, masked, mask)
+                return
+            dicts = self._attr_view(path)
+            for i in np.nonzero(mask)[0]:
+                dicts[int(i)][path.key] = vals[i]
             return
         self.check_path(path, settable=True)
         self._field_set(path.parts, vals, mask)
+
+    # ---- columnar fast paths (None when not applicable — caller falls
+    # back to the generic per-row evaluation)
+    def attr_mask_eq(self, path: Path, value: Any
+                     ) -> Optional[np.ndarray]:
+        """Pool-level ``attributes["k"] == literal`` row mask."""
+        if (self._attrs is None and columnar_enabled()
+                and path.parts == ("attributes",)):
+            return self._cur_store().mask_eq(path.key, value)
+        return None
+
+    def set_attr_literal(self, path: Path, value: Any,
+                         mask: np.ndarray) -> bool:
+        """``set(attributes["k"], literal)`` as one ``set_const`` — the
+        literal interns ONCE instead of once per masked row."""
+        if (self._attrs is None and columnar_enabled()
+                and path.parts == ("attributes",)):
+            self._store = self._cur_store().set_const(path.key, value,
+                                                      mask)
+            return True
+        return False
 
     def _set_numeric_col(self, col: str, vals: Sequence[Any],
                          mask: np.ndarray, cast) -> None:
@@ -386,7 +445,9 @@ class _BaseContext:
         fields = {}
         if self._cols is not None:
             fields["columns"] = self._cols
-        if self._attrs is not None:
+        if self._store is not None:
+            fields[self.ATTR_FIELD] = AttrDictView(self._store)
+        elif self._attrs is not None:
             fields[self.ATTR_FIELD] = tuple(self._attrs)
         if self._resources is not None:
             fields["resources"] = tuple(self._resources)
@@ -560,12 +621,29 @@ def _eval(node: Any, ctx, n: int) -> Any:
         if node.op == "or":
             return (_as_mask(_eval(node.left, ctx, n), n)
                     | _as_mask(_eval(node.right, ctx, n), n))
+        if node.op in ("==", "!="):
+            fast = _attr_eq_fast(node, ctx)
+            if fast is not None:
+                return fast if node.op == "==" else ~fast
         left = _eval(node.left, ctx, n)
         right = _eval(node.right, ctx, n)
         return _compare(node.op, left, right, n)
     if isinstance(node, Call):
         return _eval_condition_call(node, ctx, n)
     raise OttlError(f"cannot evaluate {node!r}")
+
+
+def _attr_eq_fast(node: BinOp, ctx) -> Optional[np.ndarray]:
+    """``attributes["k"] == literal`` (either side) via the store's
+    pool-scan mask. A nil literal falls through to the generic path: its
+    dict semantics (absent == nil is True) differ from presence-anded
+    equality."""
+    for a, b in ((node.left, node.right), (node.right, node.left)):
+        if (isinstance(a, Path) and a.key is not None
+                and isinstance(b, Literal) and b.value is not None
+                and hasattr(ctx, "attr_mask_eq")):
+            return ctx.attr_mask_eq(a, b.value)
+    return None
 
 
 def _as_mask(v: Any, n: int) -> np.ndarray:
@@ -649,10 +727,14 @@ def _run_edit(call: Call, ctx, mask: np.ndarray, n: int) -> None:
     if name == "set":
         if len(call.args) != 2 or not isinstance(call.args[0], Path):
             raise OttlError("set(path, value)")
+        path = call.args[0]
+        if (path.key is not None and isinstance(call.args[1], Literal)
+                and ctx.set_attr_literal(path, call.args[1].value, mask)):
+            return  # literal interned once, not once per row
         vals = _eval(call.args[1], ctx, n)
         if not isinstance(vals, np.ndarray):
             vals = np.full(n, vals, dtype=object)
-        ctx.set_values(call.args[0], vals, mask)
+        ctx.set_values(path, vals, mask)
         return
     if name == "delete_key":
         path, key = _attr_and_literal(call, "delete_key")

@@ -17,16 +17,26 @@ Config (upstream names)::
       summary: info               # info | debug | silent
 
 Applies to span attributes, log record attributes, and metric point
-attributes, plus each batch's resource attributes — dict side-lists,
-off the device path by design.
+attributes, plus each batch's resource attributes.
+
+Record-level attrs run columnar: the key table is classified once
+(allow/ignore — O(distinct keys)), the deduped value pool is regex-
+scanned once (O(distinct values), not O(rows)), and the verdicts reach
+rows through ``key_idx``/``val_idx`` gathers — deletion is one entry
+filter, masking re-points entries at the interned ``****``. Only the
+summary strings for rows that actually got masked touch Python.
+Resource dicts (bounded, deduped) keep the dict path.
 """
 
 from __future__ import annotations
 
 import re
 from dataclasses import replace
-from typing import Any
+from typing import Any, Optional
 
+import numpy as np
+
+from ...pdata.attrstore import AttrDictView, AttrStore, columnar_enabled
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
 MASK = "****"
@@ -89,18 +99,76 @@ class RedactionProcessor(Processor):
                 changed = True
         return tuple(out) if changed else None
 
+    def _redact_store(self, store: AttrStore) -> Optional[AttrStore]:
+        """Columnar redaction; returns the new store, or None when
+        unchanged. Key/value verdicts are computed on the deduped
+        tables, never per row."""
+        K, V = len(store.keys), len(store.vals)
+        if not store.nnz:
+            return None
+        key_ignored = np.fromiter((k in self.ignored for k in store.keys),
+                                  dtype=bool, count=K)
+        key_deleted = np.fromiter(
+            (not self.allow_all_keys and k not in self.allowed
+             and k not in self.ignored for k in store.keys),
+            dtype=bool, count=K)
+        if self.blocked:
+            val_blocked = np.fromiter(
+                (isinstance(v, str) and any(rx.search(v)
+                                            for rx in self.blocked)
+                 for v in store.vals), dtype=bool, count=V)
+        else:
+            val_blocked = np.zeros(V, dtype=bool)
+        del_e = key_deleted[store.key_idx]
+        masked_e = (~del_e & ~key_ignored[store.key_idx]
+                    & val_blocked[store.val_idx])
+        if not del_e.any() and not masked_e.any():
+            return None
+        n = store.n_rows
+        masked_counts = np.bincount(store.entry_rows[masked_e],
+                                    minlength=n)
+        debug_keys: Optional[list[str]] = None
+        if self.summary == "debug" and masked_e.any():
+            # per-row joined key names — Python only over MASKED entries
+            per_row: dict[int, list[str]] = {}
+            for r, k in zip(store.entry_rows[masked_e],
+                            store.key_idx[masked_e]):
+                per_row.setdefault(int(r), []).append(store.keys[k])
+            debug_keys = [",".join(sorted(per_row[r]))
+                          for r in sorted(per_row)]
+        out = store.replace_vals(masked_e, MASK)
+        if del_e.any():
+            out = out.filter_entries(~del_e)
+        if self.summary in ("info", "debug") and masked_e.any():
+            rows_m = masked_counts > 0
+            out = out.set_column(REDACTED_COUNT_KEY,
+                                 [int(c) for c in masked_counts[rows_m]],
+                                 rows_m)
+            if debug_keys is not None:
+                out = out.set_column(REDACTED_KEYS_KEY, debug_keys,
+                                     rows_m)
+        return out
+
     def process(self, batch: Any) -> Any:
         if not len(batch):
             return batch
         fields = {}
-        for attr_field in ("span_attrs", "record_attrs", "point_attrs",
-                           "resources"):
+        for attr_field in ("span_attrs", "record_attrs", "point_attrs"):
             dicts = getattr(batch, attr_field, None)
             if dicts is None:
                 continue
-            redacted = self._redact_list(dicts)
-            if redacted is not None:
-                fields[attr_field] = redacted
+            if columnar_enabled():
+                redacted_store = self._redact_store(batch.attrs())
+                if redacted_store is not None:
+                    fields[attr_field] = AttrDictView(redacted_store)
+            else:
+                redacted = self._redact_list(dicts)
+                if redacted is not None:
+                    fields[attr_field] = redacted
+        res = self._redact_list(batch.resources) \
+            if getattr(batch, "resources", None) is not None else None
+        if res is not None:
+            fields["resources"] = res
         return replace(batch, **fields) if fields else batch
 
 

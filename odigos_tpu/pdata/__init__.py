@@ -12,6 +12,14 @@ full-fidelity attributes. Pipeline components operate on whole batches; the
 featurizer hands columns straight to JAX with no per-span work.
 """
 
+from .attrstore import (
+    AttrDictView,
+    AttrStore,
+    attr_store_of,
+    columnar_attrs,
+    columnar_enabled,
+    set_columnar_attrs,
+)
 from .spans import (
     SpanKind,
     StatusCode,
@@ -48,6 +56,12 @@ def concat_any(batches):
 
 
 __all__ = [
+    "AttrDictView",
+    "AttrStore",
+    "attr_store_of",
+    "columnar_attrs",
+    "columnar_enabled",
+    "set_columnar_attrs",
     "MetricBatch",
     "MetricBatchBuilder",
     "MetricType",

@@ -1,0 +1,692 @@
+"""Dictionary-encoded CSR attribute store — the canonical attrs layout.
+
+The pdata design promise is "never touch Python per span" (spans.py), and
+the numeric columns have kept it since the seed — but span/record/point
+*attributes* lived as a tuple of per-span dicts, so every attrs-touching
+stage (filter key match, attribute rewrites, redaction, groupbyattrs, the
+featurizer's attr slots) paid O(n) interpreter work per batch. This module
+replaces the side lists with the representation the reference collector's
+pdata gets its throughput from: dictionary-encoded columnar storage.
+
+Layout (CSR over rows)::
+
+    keys:    tuple[str, ...]       interned key table (deduped)
+    vals:    tuple[Any, ...]       typed value pool (deduped; 80 != "80")
+    row_ptr: int32 (n_rows + 1)    row i's entries are [row_ptr[i], row_ptr[i+1])
+    key_idx: int32 (nnz)           entry -> keys
+    val_idx: int32 (nnz)           entry -> vals
+
+Within a row, entries keep dict insertion order; ``set_column`` on an
+existing key updates in place (keeps position), a new key appends at the
+row's end — the same observable ordering as ``d[k] = v`` on a Python dict,
+so the lazy dict view stays bit-identical to the old tuples.
+
+Everything is copy-on-write: a store is immutable, mutation ops return a
+new store sharing the key table / value pool (and entry arrays where
+possible). ``filter``/``take``/``slice``/``concat`` are pure array ops —
+no per-row tuple rebuilds. Read paths go through the memoized
+``column(key)`` (per-row values + presence mask) or the pool-level
+``mask_eq``/``mask_has`` (scan the deduped pool once, gather through
+``val_idx`` — O(distinct values), not O(rows)).
+
+``AttrDictView`` wraps a store as a read-only sequence of dicts so
+exporters and unported components keep working unchanged; dicts
+materialize lazily, only when some consumer actually indexes or iterates.
+
+The ``columnar_enabled()`` toggle exists for the bench A/B and the parity
+suite: with it off, pdata falls back to the historical tuple-of-dicts
+paths so the two representations can be compared on identical inputs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+_I32 = np.dtype(np.int32)
+
+# ------------------------------------------------------------------ toggle
+
+_ENABLED = os.environ.get("ODIGOS_COLUMNAR_ATTRS", "1") != "0"
+_toggle_lock = threading.Lock()
+
+
+def columnar_enabled() -> bool:
+    """True when pdata uses the columnar store as the canonical attrs
+    representation (the default). Off = historical tuple-of-dicts paths,
+    kept alive only for the bench A/B and the parity suite."""
+    return _ENABLED
+
+
+def set_columnar_attrs(flag: bool) -> bool:
+    """Flip the representation; returns the previous setting."""
+    global _ENABLED
+    with _toggle_lock:
+        prev = _ENABLED
+        _ENABLED = bool(flag)
+        return prev
+
+
+@contextmanager
+def columnar_attrs(flag: bool):
+    """Scoped toggle (parity tests / bench A/B)."""
+    prev = set_columnar_attrs(flag)
+    try:
+        yield
+    finally:
+        set_columnar_attrs(prev)
+
+
+# ------------------------------------------------------------------- store
+
+
+def _val_key(v: Any) -> tuple:
+    """Pool-dedup identity: type-qualified so 80, 80.0, "80" and True stay
+    distinct (the _resource_key discipline); falls back to repr for
+    unhashable values (lists from JSON-decoded frames)."""
+    try:
+        hash(v)
+    except TypeError:
+        return (v.__class__, repr(v))
+    return (v.__class__, v)
+
+
+class _Interner:
+    """Append-only intern table used by builders/concat/set ops."""
+
+    __slots__ = ("items", "lookup", "keyfn")
+
+    def __init__(self, items: Sequence[Any] = (), keyfn=None):
+        self.keyfn = keyfn or (lambda x: x)
+        self.items: list = list(items)
+        self.lookup: dict = {self.keyfn(v): i
+                             for i, v in enumerate(self.items)}
+
+    def add(self, v: Any) -> int:
+        k = self.keyfn(v)
+        i = self.lookup.get(k)
+        if i is None:
+            i = len(self.items)
+            self.items.append(v)
+            self.lookup[k] = i
+        return i
+
+
+@dataclass(frozen=True, eq=False)
+class AttrStore:
+    """Immutable dictionary-encoded CSR attribute store (module docstring)."""
+
+    keys: tuple[str, ...]
+    vals: tuple[Any, ...]
+    row_ptr: np.ndarray
+    key_idx: np.ndarray
+    val_idx: np.ndarray
+
+    # ------------------------------------------------------------ basics
+    @property
+    def n_rows(self) -> int:
+        return int(self.row_ptr.shape[0]) - 1
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    @property
+    def nnz(self) -> int:
+        return int(self.key_idx.shape[0])
+
+    def _cache(self) -> dict:
+        c = self.__dict__.get("_memo")
+        if c is None:
+            c = {}
+            object.__setattr__(self, "_memo", c)
+        return c
+
+    @property
+    def entry_rows(self) -> np.ndarray:
+        """Row id of every entry (cached): np.repeat over row lengths."""
+        c = self._cache()
+        er = c.get("entry_rows")
+        if er is None:
+            er = np.repeat(np.arange(self.n_rows, dtype=np.int32),
+                           np.diff(self.row_ptr))
+            er.flags.writeable = False  # memoized + shared: frozen
+            c["entry_rows"] = er
+        return er
+
+    def _key_id(self, key: str) -> int:
+        """Index of ``key`` in the key table, -1 when absent (cached map)."""
+        c = self._cache()
+        lk = c.get("key_lookup")
+        if lk is None:
+            lk = {k: i for i, k in enumerate(self.keys)}
+            c["key_lookup"] = lk
+        return lk.get(key, -1)
+
+    def has_key(self, key: str) -> bool:
+        return self._key_id(key) >= 0
+
+    # -------------------------------------------------------- read paths
+    def column(self, key: str) -> tuple[np.ndarray, np.ndarray]:
+        """Per-row ``(values, present)`` for one key, memoized per store.
+
+        ``values`` is an object array (None where absent — matching
+        ``d.get(key)``), ``present`` the row-level presence mask. Cost is
+        one entry scan + gather, amortized across every later read."""
+        c = self._cache()
+        hit = c.setdefault("columns", {}).get(key)
+        if hit is not None:
+            return hit
+        codes, present = self.column_codes(key)
+        values = np.full(self.n_rows, None, dtype=object)
+        rows = np.nonzero(present)[0]
+        if rows.size:
+            pool = c.get("vals_obj")
+            if pool is None:
+                pool = np.empty(max(len(self.vals), 1), dtype=object)
+                pool[:len(self.vals)] = self.vals
+                c["vals_obj"] = pool
+            values[rows] = pool[codes[rows]]
+        values.flags.writeable = False  # memoized + shared: frozen
+        out = (values, present)
+        c["columns"][key] = out
+        return out
+
+    def column_codes(self, key: str) -> tuple[np.ndarray, np.ndarray]:
+        """Per-row ``(val_idx codes, present)`` for one key — the raw
+        dictionary-encoded read (groupbyattrs' grouping primitive).
+        Codes are -1 where absent."""
+        c = self._cache()
+        hit = c.setdefault("codes", {}).get(key)
+        if hit is not None:
+            return hit
+        n = self.n_rows
+        codes = np.full(n, -1, dtype=np.int32)
+        kid = self._key_id(key)
+        if kid >= 0:
+            e = np.nonzero(self.key_idx == kid)[0]
+            codes[self.entry_rows[e]] = self.val_idx[e]
+        present = codes >= 0
+        # memoized + shared between every later read of this store: a
+        # consumer's in-place edit must raise, not poison the cache
+        codes.flags.writeable = False
+        present.flags.writeable = False
+        out = (codes, present)
+        c["codes"][key] = out
+        return out
+
+    def mask_has(self, key: str) -> np.ndarray:
+        """Rows where ``key`` is present — no value materialization."""
+        return self.column_codes(key)[1]
+
+    def mask_eq(self, key: str, value: Any) -> np.ndarray:
+        """Rows where ``attrs[key] == value``; a missing key never
+        matches. The pool is scanned once (O(distinct values)), rows are
+        reached through a val_idx gather — never a per-row dict probe.
+        Memoized per (key, value): the store is immutable, so repeated
+        conditions (include+exclude clauses, re-applied statements) are
+        lookups — an amortization the dict path structurally lacks."""
+        try:
+            memo_key = ("mask_eq", key, _val_key(value))
+        except TypeError:
+            memo_key = None
+        if memo_key is not None:
+            hit = self._cache().get(memo_key)
+            if hit is not None:
+                return hit
+        codes, present = self.column_codes(key)
+        if not present.any():
+            out = present
+        else:
+            pool_eq = np.fromiter((v == value for v in self.vals),
+                                  dtype=bool, count=len(self.vals))
+            match_code = np.nonzero(pool_eq)[0]
+            if not match_code.size:
+                out = np.zeros(self.n_rows, dtype=bool)
+            else:
+                out = present & np.isin(codes,
+                                        match_code.astype(np.int32))
+        if memo_key is not None:
+            if out.flags.writeable:
+                out.flags.writeable = False  # frozen like all memos
+            self._cache()[memo_key] = out
+        return out
+
+    # -------------------------------------------------- row-set reshapes
+    def filter(self, mask: np.ndarray) -> "AttrStore":
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (self.n_rows,):
+            raise ValueError(
+                f"mask shape {mask.shape} != ({self.n_rows},)")
+        return self.take(np.nonzero(mask)[0])
+
+    def take(self, indices: np.ndarray) -> "AttrStore":
+        indices = np.asarray(indices, dtype=np.int64)
+        starts = self.row_ptr[indices]
+        lens = self.row_ptr[indices + 1] - starts
+        new_ptr = np.zeros(len(indices) + 1, dtype=_I32)
+        np.cumsum(lens, out=new_ptr[1:])
+        # gather positions: for each kept row, the run [start, start+len)
+        pos = (np.repeat(starts.astype(np.int64) - new_ptr[:-1], lens)
+               + np.arange(int(new_ptr[-1]), dtype=np.int64))
+        return AttrStore(keys=self.keys, vals=self.vals, row_ptr=new_ptr,
+                         key_idx=self.key_idx[pos],
+                         val_idx=self.val_idx[pos])
+
+    def slice(self, lo: int, hi: int) -> "AttrStore":
+        """Contiguous row range as *views* (no entry copy): key_idx/val_idx
+        are basic numpy slices of the parent arrays; only the small
+        rebased row_ptr is new."""
+        lo = max(int(lo), 0)
+        hi = min(int(hi), self.n_rows)
+        s, e = int(self.row_ptr[lo]), int(self.row_ptr[hi])
+        return AttrStore(keys=self.keys, vals=self.vals,
+                         row_ptr=self.row_ptr[lo:hi + 1] - s,
+                         key_idx=self.key_idx[s:e],
+                         val_idx=self.val_idx[s:e])
+
+    @staticmethod
+    def concat(stores: Sequence["AttrStore"]) -> "AttrStore":
+        """Merge stores, re-interning key tables and value pools. Python
+        work is O(sum of distinct keys/values) — table merges, like the
+        string-table remap in concat_batches — entries are gathered."""
+        stores = list(stores)
+        if not stores:
+            return AttrStore.empty(0)
+        if len(stores) == 1:
+            return stores[0]
+        first = stores[0]
+        if all(s.keys is first.keys and s.vals is first.vals
+               for s in stores[1:]):
+            # shared pools (descendants of one batch — the batch
+            # processor's common diet): entries concatenate untouched,
+            # no re-interning
+            ptr_parts = [np.zeros(1, dtype=_I32)]
+            base = 0
+            for s in stores:
+                ptr_parts.append(s.row_ptr[1:].astype(_I32) + base)
+                base += int(s.row_ptr[-1])
+            return AttrStore(
+                keys=first.keys, vals=first.vals,
+                row_ptr=np.concatenate(ptr_parts),
+                key_idx=np.concatenate([s.key_idx for s in stores]),
+                val_idx=np.concatenate([s.val_idx for s in stores]))
+        keys = _Interner()
+        vals = _Interner(keyfn=_val_key)
+        ptr_parts: list[np.ndarray] = [np.zeros(1, dtype=_I32)]
+        key_parts: list[np.ndarray] = []
+        val_parts: list[np.ndarray] = []
+        base = 0
+        for s in stores:
+            kmap = np.fromiter((keys.add(k) for k in s.keys),
+                               dtype=_I32, count=len(s.keys)) \
+                if s.keys else np.empty(0, dtype=_I32)
+            vmap = np.fromiter((vals.add(v) for v in s.vals),
+                               dtype=_I32, count=len(s.vals)) \
+                if s.vals else np.empty(0, dtype=_I32)
+            key_parts.append(kmap[s.key_idx] if s.nnz else
+                             np.empty(0, dtype=_I32))
+            val_parts.append(vmap[s.val_idx] if s.nnz else
+                             np.empty(0, dtype=_I32))
+            ptr_parts.append(s.row_ptr[1:].astype(_I32) + base)
+            base += int(s.row_ptr[-1])
+        return AttrStore(keys=tuple(keys.items), vals=tuple(vals.items),
+                         row_ptr=np.concatenate(ptr_parts),
+                         key_idx=np.concatenate(key_parts),
+                         val_idx=np.concatenate(val_parts))
+
+    # ------------------------------------------------- copy-on-write ops
+    def _val_lookup(self) -> dict:
+        """``_val_key(v) -> pool code`` map, built once per store."""
+        c = self._cache()
+        lk = c.get("val_lookup")
+        if lk is None:
+            lk = {_val_key(v): i for i, v in enumerate(self.vals)}
+            c["val_lookup"] = lk
+        return lk
+
+    def _intern_vals(self, values: Sequence[Any]
+                     ) -> tuple[tuple, np.ndarray]:
+        """Extend the pool with ``values``; returns (pool, codes). The
+        pool tuple is returned BY IDENTITY when every value was already
+        interned (keeps shared-pool fast paths alive), and the lookup
+        map is memoized so repeated mutations don't rebuild it."""
+        lk = self._val_lookup()
+        added: dict = {}
+        items: Optional[list] = None
+        codes = np.empty(len(values), dtype=_I32)
+        for j, v in enumerate(values):
+            k = _val_key(v)
+            i = lk.get(k)
+            if i is None:
+                i = added.get(k)
+                if i is None:
+                    if items is None:
+                        items = list(self.vals)
+                    i = len(items)
+                    items.append(v)
+                    added[k] = i
+            codes[j] = i
+        if items is None:
+            return self.vals, codes
+        return tuple(items), codes
+
+    def _intern_key(self, key: str) -> tuple[tuple, int]:
+        kid = self._key_id(key)
+        if kid >= 0:
+            return self.keys, kid
+        return self.keys + (key,), len(self.keys)
+
+    def set_column(self, key: str, values: Sequence[Any],
+                   mask: np.ndarray) -> "AttrStore":
+        """CoW ``attrs[key] = values[j]`` for masked rows (one value per
+        masked row). Existing entries update in place (keep their dict
+        position); rows without the key get the entry appended at the
+        row's end — Python-dict assignment semantics, vectorized."""
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (self.n_rows,):
+            raise ValueError(
+                f"mask shape {mask.shape} != ({self.n_rows},)")
+        rows = np.nonzero(mask)[0]
+        if len(values) != len(rows):
+            raise ValueError(
+                f"values length {len(values)} != masked count {len(rows)}")
+        if not rows.size:
+            return self
+        vals, codes = self._intern_vals(values)
+        row_code = np.full(self.n_rows, -1, dtype=_I32)
+        row_code[rows] = codes
+        return self._set_codes(key, vals, row_code, mask, rows)
+
+    def _set_codes(self, key: str, vals: tuple, row_code: np.ndarray,
+                   mask: np.ndarray, rows: np.ndarray) -> "AttrStore":
+        keys, kid = self._intern_key(key)
+        present = self.mask_has(key) if self.nnz else \
+            np.zeros(self.n_rows, dtype=bool)
+        upd = mask & present
+        ins_rows = np.nonzero(mask & ~present)[0]
+
+        val_idx = self.val_idx
+        if upd.any():
+            e = np.nonzero((self.key_idx == kid)
+                           & upd[self.entry_rows])[0]
+            val_idx = val_idx.copy()
+            val_idx[e] = row_code[self.entry_rows[e]]
+        if not ins_rows.size:
+            return AttrStore(keys=keys, vals=vals, row_ptr=self.row_ptr,
+                             key_idx=self.key_idx, val_idx=val_idx)
+
+        # append one entry at the end of each inserting row: old entries
+        # shift by their row's cumulative insert count (a per-row delta
+        # gathered through the cached entry_rows — no repeat)
+        lens = np.diff(self.row_ptr)
+        extra = np.zeros(self.n_rows, dtype=_I32)
+        extra[ins_rows] = 1
+        new_ptr = np.zeros(self.n_rows + 1, dtype=_I32)
+        np.cumsum(lens + extra, out=new_ptr[1:])
+        nnz_new = int(new_ptr[-1])
+        new_key = np.empty(nnz_new, dtype=_I32)
+        new_val = np.empty(nnz_new, dtype=_I32)
+        delta = new_ptr[:-1] - self.row_ptr[:-1]
+        old_pos = delta[self.entry_rows] + np.arange(self.nnz,
+                                                     dtype=_I32)
+        new_key[old_pos] = self.key_idx
+        new_val[old_pos] = val_idx
+        ins_pos = new_ptr[:-1][ins_rows] + lens[ins_rows]
+        new_key[ins_pos] = kid
+        new_val[ins_pos] = row_code[ins_rows]
+        return AttrStore(keys=keys, vals=vals, row_ptr=new_ptr,
+                         key_idx=new_key, val_idx=new_val)
+
+    def set_columns(self, updates: dict[str, Sequence[Any]],
+                    mask: np.ndarray) -> "AttrStore":
+        """Several keys on the same masked rows (the anomaly tagger's
+        primitive); key order = dict order, like repeated ``d[k] = v``."""
+        out = self
+        for key, values in updates.items():
+            out = out.set_column(key, values, mask)
+        return out
+
+    def set_const(self, key: str, value: Any,
+                  mask: Optional[np.ndarray] = None) -> "AttrStore":
+        """Broadcast one value over masked rows (all rows if None) — the
+        value interns ONCE, rows get its code by array fill."""
+        if mask is None:
+            mask = np.ones(self.n_rows, dtype=bool)
+        mask = np.asarray(mask, dtype=bool)
+        rows = np.nonzero(mask)[0]
+        if not rows.size:
+            return self
+        vals, codes = self._intern_vals([value])
+        row_code = np.full(self.n_rows, -1, dtype=_I32)
+        row_code[rows] = codes[0]
+        return self._set_codes(key, vals, row_code, mask, rows)
+
+    def filter_entries(self, keep: np.ndarray) -> "AttrStore":
+        """Drop entries where ``keep`` is False (row count unchanged) —
+        the delete primitive: one bincount rebuilds row_ptr."""
+        keep = np.asarray(keep, dtype=bool)
+        if keep.all():
+            return self
+        counts = np.bincount(self.entry_rows[keep],
+                             minlength=self.n_rows).astype(_I32)
+        new_ptr = np.zeros(self.n_rows + 1, dtype=_I32)
+        np.cumsum(counts, out=new_ptr[1:])
+        return AttrStore(keys=self.keys, vals=self.vals, row_ptr=new_ptr,
+                         key_idx=self.key_idx[keep],
+                         val_idx=self.val_idx[keep])
+
+    def delete_key(self, key: str,
+                   mask: Optional[np.ndarray] = None) -> "AttrStore":
+        """Remove ``key`` from masked rows (all if None). No-op when the
+        key isn't in the table."""
+        kid = self._key_id(key)
+        if kid < 0 or not self.nnz:
+            return self
+        drop = self.key_idx == kid
+        if mask is not None:
+            drop &= np.asarray(mask, dtype=bool)[self.entry_rows]
+        if not drop.any():
+            return self
+        return self.filter_entries(~drop)
+
+    def rename_key(self, key: str, new_key: str) -> "AttrStore":
+        """``d[new_key] = d.pop(key)`` on every row that has ``key`` —
+        delete-then-set keeps exact dict ordering semantics (existing
+        new_key keeps its position; otherwise appended at row end). The
+        values never re-intern: their pool codes carry over directly."""
+        codes, present = self.column_codes(key)
+        if not present.any():
+            return self
+        out = self.delete_key(key)
+        rows = np.nonzero(present)[0]
+        return out._set_codes(new_key, out.vals, codes, present, rows)
+
+    def rebuild_entries(self, drop: Optional[np.ndarray],
+                        appends: Sequence[tuple[str, np.ndarray,
+                                                np.ndarray]],
+                        new_vals: Optional[tuple] = None) -> "AttrStore":
+        """One-pass rebuild: drop masked entries, then append per-row
+        entries at each row's end in ``appends`` order — the composed
+        form of a delete/insert/rename action sequence, one O(nnz)
+        reshuffle instead of one per action.
+
+        ``appends``: ``(key, row_mask, row_codes)`` triples — append
+        ``key`` with value-pool code ``row_codes[row]`` to every masked
+        row. ``new_vals`` replaces the value pool (pre-extended by the
+        caller; pass None to keep it)."""
+        n = self.n_rows
+        vals = self.vals if new_vals is None else new_vals
+        if drop is None or not drop.any():
+            kept_key, kept_val = self.key_idx, self.val_idx
+            kept_lens = np.diff(self.row_ptr)
+            kept_rows = self.entry_rows
+        else:
+            keep = ~drop
+            kept_key = self.key_idx[keep]
+            kept_val = self.val_idx[keep]
+            kept_rows = self.entry_rows[keep]
+            kept_lens = np.bincount(kept_rows, minlength=n).astype(_I32)
+        keys_l = list(self.keys)
+        lookup = {k: i for i, k in enumerate(keys_l)}
+        kids = []
+        for key, _mask, _codes in appends:
+            kid = lookup.get(key)
+            if kid is None:
+                kid = len(keys_l)
+                keys_l.append(key)
+                lookup[key] = kid
+            kids.append(kid)
+        keys = tuple(keys_l)
+        app_total = np.zeros(n, dtype=_I32)
+        for _key, mask, _codes in appends:
+            app_total += mask
+        new_lens = kept_lens + app_total
+        new_ptr = np.zeros(n + 1, dtype=_I32)
+        np.cumsum(new_lens, out=new_ptr[1:])
+        nnz_new = int(new_ptr[-1])
+        out_key = np.empty(nnz_new, dtype=_I32)
+        out_val = np.empty(nnz_new, dtype=_I32)
+        # kept entries keep their within-row order
+        kept_cum = np.zeros(n, dtype=_I32)
+        np.cumsum(kept_lens[:-1], out=kept_cum[1:])
+        in_row = np.arange(len(kept_rows), dtype=_I32) \
+            - kept_cum[kept_rows]
+        pos = new_ptr[:-1][kept_rows] + in_row
+        out_key[pos] = kept_key
+        out_val[pos] = kept_val
+        # appends land after the kept run, in appends order
+        base = new_ptr[:-1] + kept_lens
+        prior = np.zeros(n, dtype=_I32)
+        for (key, mask, codes), kid in zip(appends, kids):
+            rows = np.nonzero(mask)[0]
+            p = base[rows] + prior[rows]
+            out_key[p] = kid
+            out_val[p] = codes[rows]
+            prior[rows] += 1
+        return AttrStore(keys=keys, vals=vals, row_ptr=new_ptr,
+                         key_idx=out_key, val_idx=out_val)
+
+    def replace_vals(self, entry_mask: np.ndarray,
+                     value: Any) -> "AttrStore":
+        """Point all masked entries at one (interned) value — redaction's
+        masking primitive: the pool was scanned once, entries re-point."""
+        entry_mask = np.asarray(entry_mask, dtype=bool)
+        if not entry_mask.any():
+            return self
+        vals, codes = self._intern_vals([value])
+        val_idx = self.val_idx.copy()
+        val_idx[entry_mask] = codes[0]
+        return AttrStore(keys=self.keys, vals=vals, row_ptr=self.row_ptr,
+                         key_idx=self.key_idx, val_idx=val_idx)
+
+    # --------------------------------------------------- materialization
+    def dict_at(self, i: int) -> dict[str, Any]:
+        s, e = int(self.row_ptr[i]), int(self.row_ptr[i + 1])
+        return {self.keys[k]: self.vals[v]
+                for k, v in zip(self.key_idx[s:e], self.val_idx[s:e])}
+
+    def to_dicts(self) -> tuple[dict[str, Any], ...]:
+        """Materialize every row (exporter/debug path — NOT hot)."""
+        empty: dict[str, Any] = {}
+        keys, vals = self.keys, self.vals
+        ptr, ki, vi = self.row_ptr, self.key_idx, self.val_idx
+        return tuple(
+            {keys[ki[j]]: vals[vi[j]] for j in range(ptr[i], ptr[i + 1])}
+            if ptr[i + 1] > ptr[i] else empty
+            for i in range(self.n_rows))
+
+    # ----------------------------------------------------------- builders
+    @staticmethod
+    def empty(n_rows: int) -> "AttrStore":
+        return AttrStore(keys=(), vals=(),
+                         row_ptr=np.zeros(n_rows + 1, dtype=_I32),
+                         key_idx=np.empty(0, dtype=_I32),
+                         val_idx=np.empty(0, dtype=_I32))
+
+    @staticmethod
+    def from_dicts(dicts: Sequence[dict[str, Any]]) -> "AttrStore":
+        """Build once at decode/ingest; the only place that walks dicts."""
+        keys = _Interner()
+        vals = _Interner(keyfn=_val_key)
+        row_ptr = np.zeros(len(dicts) + 1, dtype=_I32)
+        key_l: list[int] = []
+        val_l: list[int] = []
+        for i, d in enumerate(dicts):
+            for k, v in d.items():
+                key_l.append(keys.add(k))
+                val_l.append(vals.add(v))
+            row_ptr[i + 1] = len(key_l)
+        return AttrStore(keys=tuple(keys.items), vals=tuple(vals.items),
+                         row_ptr=row_ptr,
+                         key_idx=np.asarray(key_l, dtype=_I32),
+                         val_idx=np.asarray(val_l, dtype=_I32))
+
+
+# ---------------------------------------------------------------- view
+
+
+class AttrDictView(Sequence):
+    """Read-only tuple-of-dicts facade over an :class:`AttrStore`.
+
+    Exporters and unported components index/iterate it exactly like the
+    old ``span_attrs`` tuple; dicts materialize lazily on first full
+    iteration (cached) or per row on indexing. Treat the dicts as
+    read-only — mutate through the store's CoW ops."""
+
+    __slots__ = ("store", "_dicts")
+
+    def __init__(self, store: AttrStore):
+        self.store = store
+        self._dicts: Optional[tuple] = None
+
+    def _all(self) -> tuple:
+        if self._dicts is None:
+            self._dicts = self.store.to_dicts()
+        return self._dicts
+
+    def __len__(self) -> int:
+        return self.store.n_rows
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return self._all()[i]
+        if self._dicts is not None:
+            return self._dicts[i]
+        n = self.store.n_rows
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return self.store.dict_at(i)
+
+    def __iter__(self) -> Iterator[dict[str, Any]]:
+        return iter(self._all())
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, AttrDictView) and other.store is self.store:
+            return True
+        try:
+            return len(self) == len(other) and \
+                all(a == b for a, b in zip(self, other))
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):  # dataclass field equality support
+        return hash((id(self.store),))
+
+    def __repr__(self) -> str:
+        return (f"AttrDictView({self.store.n_rows} rows, "
+                f"{self.store.nnz} entries)")
+
+
+def attr_store_of(attrs: Sequence[dict[str, Any]]) -> AttrStore:
+    """The store behind an attrs field: pass-through for a view, one-time
+    build for a plain tuple (callers cache the result on the batch)."""
+    if isinstance(attrs, AttrDictView):
+        return attrs.store
+    return AttrStore.from_dicts(attrs)

@@ -4,7 +4,9 @@ One row per log record, mirroring the plog shapes the reference's filelog
 pipeline carries (node collector `filelog` receiver →
 odigoslogsresourceattrsprocessor → exporters; SURVEY.md §2.3). Bodies are
 kept in a side list (full fidelity, exporter-only); severity/timestamps/trace
-correlation are numpy columns so filters stay vectorized.
+correlation are numpy columns so filters stay vectorized. Record
+attributes mirror the span layout: canonically a dictionary-encoded CSR
+``AttrStore`` (attrstore.py) with ``record_attrs`` as its lazy dict view.
 """
 
 from __future__ import annotations
@@ -14,6 +16,9 @@ from dataclasses import dataclass, field, replace
 from typing import Any, Iterator, Optional, Sequence
 
 import numpy as np
+
+from .attrstore import (AttrDictView, AttrStore, attr_store_of,
+                        columnar_enabled)
 
 
 class Severity(enum.IntEnum):
@@ -44,7 +49,7 @@ _EMPTY_DICT: dict[str, Any] = {}
 class LogBatch:
     resources: tuple[dict[str, Any], ...]
     bodies: tuple[str, ...]
-    record_attrs: tuple[dict[str, Any], ...]
+    record_attrs: Sequence[dict[str, Any]]
     columns: dict[str, np.ndarray] = field(default_factory=dict)
 
     def __len__(self) -> int:
@@ -56,21 +61,47 @@ class LogBatch:
     def col(self, name: str) -> np.ndarray:
         return self.columns[name]
 
+    def attrs(self) -> AttrStore:
+        """Columnar store behind ``record_attrs`` (cached)."""
+        store = self.__dict__.get("_attr_store")
+        if store is None:
+            store = attr_store_of(self.record_attrs)
+            object.__setattr__(self, "_attr_store", store)
+        return store
+
     def filter(self, mask: np.ndarray) -> "LogBatch":
         mask = np.asarray(mask, dtype=bool)
         if mask.shape != (len(self),):
             raise ValueError(f"mask shape {mask.shape} != ({len(self)},)")
         cols = {k: v[mask] for k, v in self.columns.items()}
         bodies = tuple(b for b, keep in zip(self.bodies, mask) if keep)
-        attrs = tuple(a for a, keep in zip(self.record_attrs, mask) if keep)
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().filter(mask))
+        else:
+            attrs = tuple(a for a, keep in zip(self.record_attrs, mask)
+                          if keep)
         return replace(self, columns=cols, bodies=bodies, record_attrs=attrs)
 
     def take(self, indices: np.ndarray) -> "LogBatch":
         indices = np.asarray(indices)
         cols = {k: v[indices] for k, v in self.columns.items()}
         bodies = tuple(self.bodies[int(i)] for i in indices)
-        attrs = tuple(self.record_attrs[int(i)] for i in indices)
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().take(indices))
+        else:
+            attrs = tuple(self.record_attrs[int(i)] for i in indices)
         return replace(self, columns=cols, bodies=bodies, record_attrs=attrs)
+
+    def slice(self, lo: int, hi: int) -> "LogBatch":
+        """Contiguous row range; numeric columns and attr entries are
+        views (bodies stay a tuple slice — pointer copies)."""
+        cols = {k: v[lo:hi] for k, v in self.columns.items()}
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().slice(lo, hi))
+        else:
+            attrs = tuple(self.record_attrs[lo:hi])
+        return replace(self, columns=cols, bodies=self.bodies[lo:hi],
+                       record_attrs=attrs)
 
     def with_resources(self, resources: Sequence[dict[str, Any]]) -> "LogBatch":
         """Replace the resource table (the enrichment primitive —
@@ -135,9 +166,11 @@ class LogBatchBuilder:
     def build(self) -> LogBatch:
         cols = {k: np.asarray(v, dtype=_COLUMNS[k])
                 for k, v in self._cols.items()}
+        attrs: Sequence = (AttrDictView(AttrStore.from_dicts(self._attrs))
+                           if columnar_enabled() else tuple(self._attrs))
         return LogBatch(resources=tuple(self._resources),
                         bodies=tuple(self._bodies),
-                        record_attrs=tuple(self._attrs), columns=cols)
+                        record_attrs=attrs, columns=cols)
 
 
 def concat_log_batches(batches: Sequence[LogBatch]) -> LogBatch:
@@ -150,6 +183,7 @@ def concat_log_batches(batches: Sequence[LogBatch]) -> LogBatch:
     bodies: list[str] = []
     attrs: list[dict[str, Any]] = []
     out_cols: dict[str, list[np.ndarray]] = {k: [] for k in _COLUMNS}
+    columnar = columnar_enabled()
     for b in batches:
         res_base = len(resources)
         resources.extend(b.resources)
@@ -159,7 +193,10 @@ def concat_log_batches(batches: Sequence[LogBatch]) -> LogBatch:
                 colv = np.where(colv >= 0, colv + res_base, -1)
             out_cols[k].append(colv.astype(_COLUMNS[k], copy=False))
         bodies.extend(b.bodies)
-        attrs.extend(b.record_attrs)
+        if not columnar:
+            attrs.extend(b.record_attrs)
+    merged: Sequence = (AttrDictView(AttrStore.concat(
+        [b.attrs() for b in batches])) if columnar else tuple(attrs))
     cols = {k: np.concatenate(v) for k, v in out_cols.items()}
     return LogBatch(resources=tuple(resources), bodies=tuple(bodies),
-                    record_attrs=tuple(attrs), columns=cols)
+                    record_attrs=merged, columns=cols)

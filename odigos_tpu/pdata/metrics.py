@@ -21,6 +21,9 @@ from typing import Any, Iterator, Optional, Sequence
 
 import numpy as np
 
+from .attrstore import (AttrDictView, AttrStore, attr_store_of,
+                        columnar_enabled)
+
 
 class MetricType(enum.IntEnum):
     GAUGE = 0
@@ -43,7 +46,7 @@ _EMPTY_DICT: dict[str, Any] = {}
 class MetricBatch:
     strings: tuple[str, ...]
     resources: tuple[dict[str, Any], ...]
-    point_attrs: tuple[dict[str, Any], ...]
+    point_attrs: Sequence[dict[str, Any]]
     histograms: tuple[Optional[dict[str, Any]], ...]
     columns: dict[str, np.ndarray] = field(default_factory=dict)
 
@@ -58,6 +61,14 @@ class MetricBatch:
     def col(self, name: str) -> np.ndarray:
         return self.columns[name]
 
+    def attrs(self) -> AttrStore:
+        """Columnar store behind ``point_attrs`` (cached)."""
+        store = self.__dict__.get("_attr_store")
+        if store is None:
+            store = attr_store_of(self.point_attrs)
+            object.__setattr__(self, "_attr_store", store)
+        return store
+
     def string_at(self, index: int) -> str:
         return self.strings[index] if 0 <= index < len(self.strings) else ""
 
@@ -69,16 +80,34 @@ class MetricBatch:
         if mask.shape != (len(self),):
             raise ValueError(f"mask shape {mask.shape} != ({len(self)},)")
         cols = {k: v[mask] for k, v in self.columns.items()}
-        attrs = tuple(a for a, keep in zip(self.point_attrs, mask) if keep)
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().filter(mask))
+        else:
+            attrs = tuple(a for a, keep in zip(self.point_attrs, mask)
+                          if keep)
         hists = tuple(h for h, keep in zip(self.histograms, mask) if keep)
         return replace(self, columns=cols, point_attrs=attrs, histograms=hists)
 
     def take(self, indices: np.ndarray) -> "MetricBatch":
         indices = np.asarray(indices)
         cols = {k: v[indices] for k, v in self.columns.items()}
-        attrs = tuple(self.point_attrs[int(i)] for i in indices)
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().take(indices))
+        else:
+            attrs = tuple(self.point_attrs[int(i)] for i in indices)
         hists = tuple(self.histograms[int(i)] for i in indices)
         return replace(self, columns=cols, point_attrs=attrs, histograms=hists)
+
+    def slice(self, lo: int, hi: int) -> "MetricBatch":
+        """Contiguous row range; numeric columns and attr entries are
+        views (histograms stay a tuple slice)."""
+        cols = {k: v[lo:hi] for k, v in self.columns.items()}
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().slice(lo, hi))
+        else:
+            attrs = tuple(self.point_attrs[lo:hi])
+        return replace(self, columns=cols, point_attrs=attrs,
+                       histograms=self.histograms[lo:hi])
 
     def iter_points(self) -> Iterator[dict[str, Any]]:
         """Debug/exporter-only per-point dict view. NOT for the hot path."""
@@ -151,9 +180,12 @@ class MetricBatchBuilder:
     def build(self) -> MetricBatch:
         cols = {k: np.asarray(v, dtype=_COLUMNS[k])
                 for k, v in self._cols.items()}
+        attrs: Sequence = (
+            AttrDictView(AttrStore.from_dicts(self._point_attrs))
+            if columnar_enabled() else tuple(self._point_attrs))
         return MetricBatch(strings=tuple(self._strings),
                            resources=tuple(self._resources),
-                           point_attrs=tuple(self._point_attrs),
+                           point_attrs=attrs,
                            histograms=tuple(self._histograms),
                            columns=cols)
 
@@ -190,6 +222,7 @@ def concat_metric_batches(batches: Sequence[MetricBatch]) -> MetricBatch:
     point_attrs: list[dict[str, Any]] = []
     histograms: list[Optional[dict[str, Any]]] = []
     out_cols: dict[str, list[np.ndarray]] = {k: [] for k in _COLUMNS}
+    columnar = columnar_enabled()
     for b in batches:
         remap = np.empty(max(len(b.strings), 1), dtype=np.int32)
         for i, s in enumerate(b.strings):
@@ -208,11 +241,14 @@ def concat_metric_batches(batches: Sequence[MetricBatch]) -> MetricBatch:
             elif k == "resource_index":
                 colv = np.where(colv >= 0, colv + res_base, -1)
             out_cols[k].append(colv.astype(_COLUMNS[k], copy=False))
-        point_attrs.extend(b.point_attrs)
+        if not columnar:
+            point_attrs.extend(b.point_attrs)
         histograms.extend(b.histograms)
+    merged: Sequence = (AttrDictView(AttrStore.concat(
+        [b.attrs() for b in batches])) if columnar else tuple(point_attrs))
     cols = {k: np.concatenate(v) for k, v in out_cols.items()}
     return MetricBatch(strings=tuple(strings), resources=tuple(resources),
-                       point_attrs=tuple(point_attrs),
+                       point_attrs=merged,
                        histograms=tuple(histograms), columns=cols)
 
 

@@ -12,8 +12,13 @@ route *per record*; our equivalent must never touch Python per span. So:
 * strings (service name, span name) are interned into a per-batch string table
   and stored as int32 indices — the featurizer hashes table entries once per
   batch, not once per span;
-* variable attributes keep full fidelity in side lists (`span_attrs`,
-  `resources`) for exporters, but nothing on the scoring path reads them.
+* variable attributes are canonically a dictionary-encoded CSR store
+  (`pdata/attrstore.py`): interned key table, deduped value pool, and
+  `row_ptr`/`key_idx`/`val_idx` int32 arrays, built once at decode/ingest.
+  `span_attrs` is a lazy dict *view* over that store, so exporters and
+  unported components keep their tuple-of-dicts contract while every hot
+  consumer (filter, attributes, redaction, groupbyattrs, the featurizer's
+  attr slots) works on the arrays — per-batch cost, never per-span.
 
 A batch is immutable once built (columns may be shared between batches after
 `filter`/`concat`); mutation happens by building a new batch.
@@ -26,6 +31,9 @@ from dataclasses import dataclass, field, replace
 from typing import Any, Iterator, Optional, Sequence
 
 import numpy as np
+
+from .attrstore import (AttrDictView, AttrStore, attr_store_of,
+                        columnar_enabled)
 
 
 class SpanKind(enum.IntEnum):
@@ -83,8 +91,20 @@ class SpanBatch:
 
     strings: tuple[str, ...]
     resources: tuple[dict[str, Any], ...]
-    span_attrs: tuple[dict[str, Any], ...]
+    # a tuple of dicts OR an AttrDictView over the columnar AttrStore;
+    # both honor the same sequence-of-dicts read contract
+    span_attrs: Sequence[dict[str, Any]]
     columns: dict[str, np.ndarray] = field(default_factory=dict)
+
+    def attrs(self) -> AttrStore:
+        """The columnar attribute store behind ``span_attrs`` (built once
+        and cached when the field is a plain tuple — e.g. after a legacy
+        processor rebuilt it)."""
+        store = self.__dict__.get("_attr_store")
+        if store is None:
+            store = attr_store_of(self.span_attrs)
+            object.__setattr__(self, "_attr_store", store)
+        return store
 
     # ------------------------------------------------------------- basics
     def __len__(self) -> int:
@@ -121,12 +141,18 @@ class SpanBatch:
     # --------------------------------------------------------- transforms
     def filter(self, mask: np.ndarray) -> "SpanBatch":
         """Select spans where ``mask`` is true. Column arrays are new; the
-        string table and resource dicts are shared with the parent batch."""
+        string table, resource dicts, and the attr store's key table /
+        value pool are shared with the parent batch — attrs move as pure
+        array ops, no per-span tuple rebuild."""
         mask = np.asarray(mask, dtype=bool)
         if mask.shape != (len(self),):
             raise ValueError(f"mask shape {mask.shape} != ({len(self)},)")
         cols = {k: v[mask] for k, v in self.columns.items()}
-        attrs = tuple(a for a, keep in zip(self.span_attrs, mask) if keep)
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().filter(mask))
+        else:
+            attrs = tuple(a for a, keep in zip(self.span_attrs, mask)
+                          if keep)
         return replace(self, columns=cols, span_attrs=attrs)
 
     def take(self, indices: np.ndarray) -> "SpanBatch":
@@ -134,7 +160,22 @@ class SpanBatch:
         if indices.dtype == bool:
             raise TypeError("take() requires integer indices; use filter() for masks")
         cols = {k: v[indices] for k, v in self.columns.items()}
-        attrs = tuple(self.span_attrs[int(i)] for i in indices)
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().take(indices))
+        else:
+            attrs = tuple(self.span_attrs[int(i)] for i in indices)
+        return replace(self, columns=cols, span_attrs=attrs)
+
+    def slice(self, lo: int, hi: int) -> "SpanBatch":
+        """Contiguous row range ``[lo, hi)`` as column *views* — numpy
+        basic slicing for the fixed columns, entry-array slices for the
+        attr store. No copy; the batch processor's max-size splitter is
+        the intended caller."""
+        cols = {k: v[lo:hi] for k, v in self.columns.items()}
+        if columnar_enabled():
+            attrs: Sequence = AttrDictView(self.attrs().slice(lo, hi))
+        else:
+            attrs = tuple(self.span_attrs[lo:hi])
         return replace(self, columns=cols, span_attrs=attrs)
 
     def with_span_attr(self, key: str, values: Sequence[Any],
@@ -174,6 +215,11 @@ class SpanBatch:
                 raise ValueError(
                     f"values for {key!r} have length {len(values)}, "
                     f"expected masked count {len(idxs)}")
+        if columnar_enabled():
+            # copy-on-write store ops: the key table / value pool extend,
+            # untouched entry runs are gathered — no per-span dict copy
+            store = self.attrs().set_columns(updates, mask)
+            return replace(self, span_attrs=AttrDictView(store))
         new_attrs = list(self.span_attrs)
         for j, i in enumerate(idxs):
             d = dict(new_attrs[i])
@@ -189,16 +235,26 @@ class SpanBatch:
         the original column data."""
         if not new_names:
             return self
+        rows = np.fromiter(new_names.keys(), dtype=np.int64,
+                           count=len(new_names))
+        names = np.asarray(list(new_names.values()), dtype=object)
+        # intern each DISTINCT new name once (np.unique), then map every
+        # row through a vectorized searchsorted gather — the old per-row
+        # dict-probe loop cost O(rows), this costs O(distinct names)
+        uniq = np.unique(names)
+        intern = {s: i for i, s in enumerate(self.strings)}
         strings = list(self.strings)
-        intern = {s: i for i, s in enumerate(strings)}
-        name_col = self.columns["name"].copy()
-        for row, s in new_names.items():
+        uniq_idx = np.empty(len(uniq), dtype=np.int32)
+        for j, s in enumerate(uniq):
+            s = str(s)
             idx = intern.get(s)
             if idx is None:
                 idx = len(strings)
                 strings.append(s)
                 intern[s] = idx
-            name_col[row] = idx
+            uniq_idx[j] = idx
+        name_col = self.columns["name"].copy()
+        name_col[rows] = uniq_idx[np.searchsorted(uniq, names)]
         cols = dict(self.columns)
         cols["name"] = name_col
         return replace(self, strings=tuple(strings), columns=cols)
@@ -312,10 +368,17 @@ class SpanBatchBuilder:
         cols = {
             k: np.asarray(v, dtype=_COLUMNS[k]) for k, v in self._cols.items()
         }
+        if columnar_enabled():
+            # the one place the dicts are walked: decode/ingest builds the
+            # CSR store once, everything downstream is array ops
+            attrs: Sequence = AttrDictView(
+                AttrStore.from_dicts(self._span_attrs))
+        else:
+            attrs = tuple(self._span_attrs)
         return SpanBatch(
             strings=tuple(self._strings),
             resources=tuple(self._resources),
-            span_attrs=tuple(self._span_attrs),
+            span_attrs=attrs,
             columns=cols,
         )
 
@@ -339,6 +402,7 @@ def concat_batches(batches: Sequence[SpanBatch]) -> SpanBatch:
     res_intern: dict[tuple, int] = {}  # content key -> new index
     span_attrs: list[dict[str, Any]] = []
     out_cols: dict[str, list[np.ndarray]] = {k: [] for k in _COLUMNS}
+    columnar = columnar_enabled()
 
     for b in batches:
         # string remap table for this batch (vectorized gather afterwards)
@@ -369,12 +433,20 @@ def concat_batches(batches: Sequence[SpanBatch]) -> SpanBatch:
             elif k == "resource_index":
                 colv = res_remap[colv]
             out_cols[k].append(colv.astype(_COLUMNS[k], copy=False))
-        span_attrs.extend(b.span_attrs)
+        if not columnar:
+            span_attrs.extend(b.span_attrs)
 
+    if columnar:
+        # attr stores merge the same way the string table does: key/value
+        # pools re-intern (O(distinct)), entry arrays concatenate
+        attrs: Sequence = AttrDictView(
+            AttrStore.concat([b.attrs() for b in batches]))
+    else:
+        attrs = tuple(span_attrs)
     cols = {k: np.concatenate(v) for k, v in out_cols.items()}
     return SpanBatch(
         strings=tuple(strings),
         resources=tuple(resources),
-        span_attrs=tuple(span_attrs),
+        span_attrs=attrs,
         columns=cols,
     )

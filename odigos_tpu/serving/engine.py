@@ -191,8 +191,7 @@ class MockBackend:
     def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
         log_dur = features.continuous[:, 0]
         scores = np.clip((log_dur - 5.0) / 10.0, 0.0, 1.0)
-        forced = np.fromiter(("mock.anomaly" in a for a in batch.span_attrs),
-                             bool, len(batch))
+        forced = batch.attrs().mask_has("mock.anomaly")
         return np.where(forced, 1.0, scores).astype(np.float32)
 
 

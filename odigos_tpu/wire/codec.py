@@ -7,27 +7,34 @@ payload:
     u32 header length, header JSON:
         {"n": points, "kind": "spans"|"metrics"|"logs" (absent = spans),
          "strings": [...], "resources": [...],
-         "attrs": {row_idx: {...}},        # sparse — empties omitted
+         "astore": {"keys": [...], "vals": [...], "nnz": K},  # attr pools
          "hists": {row_idx: {...}},        # metrics only, sparse
          "bodies": [...],                  # logs only
          "cols": [[name, dtype], ...]}     # order = byte layout
     raw column bytes, concatenated in header order
+    attr-store arrays (when "astore" present), 8-byte aligned:
+        row_ptr int32 (n+1) | key_idx int32 (K) | val_idx int32 (K)
 
-The hot path ships the numeric columns as raw buffers (one memcpy each
-side); only the string table and sparse attrs go through JSON. This is the
-same discipline as the eBPF receiver's protobuf-to-columnar decode
-(collector/receivers/odigosebpfreceiver/traces.go:105) — per-batch cost,
-never per-span. Metrics share the layout so the self-telemetry pipeline's
-``otlp/ui`` exporter rides the same transport to the frontend consumer
-(frontend/services/collector_metrics in the reference).
+The hot path ships the numeric columns AND the attribute entry arrays as
+raw buffers (one memcpy each side); only the string table and the attr
+store's deduped key/value pools go through JSON — per-DISTINCT cost,
+never per-span. This replaces the old sparse ``"attrs": {row: {k: v}}``
+dict-of-dicts header, which serialized every span's attributes through
+the JSON encoder (O(rows) interpreter work on both sides). Frames from
+pre-store encoders still carry ``"attrs"`` and decode unchanged; the
+``attr_format="json"`` escape hatch emits that legacy shape for
+compatibility tests. Metrics and logs ride the same attr-store section
+for their point/record attrs.
 
-Decode is **zero-copy**: columns are read-only ``np.frombuffer`` views into
-the received payload (the encoder pads the JSON header so the first column
-lands 8-byte aligned), copied only when a column's offset is misaligned for
-its dtype. Two consequences the rest of the stack is built around: a decoded
+Decode is **zero-copy**: columns AND attr entry arrays are read-only
+``np.frombuffer`` views into the received payload (the encoder pads the
+JSON header so the first column lands 8-byte aligned, and re-pads before
+the attr section), copied only when an offset is misaligned for its
+dtype. Two consequences the rest of the stack is built around: a decoded
 batch pins its whole frame in memory for as long as any column view lives,
 and in-place writes raise — every mutating path copies first (the pdata
-``replace``/builder discipline), which the wire tests assert.
+``replace``/builder + attr-store copy-on-write discipline), which the
+wire tests assert.
 """
 
 from __future__ import annotations
@@ -37,15 +44,31 @@ import struct
 
 import numpy as np
 
+from ..pdata.attrstore import AttrDictView, AttrStore, columnar_enabled
 from ..pdata.logs import LogBatch
 from ..pdata.metrics import MetricBatch
 from ..pdata.spans import SpanBatch
 
 MAGIC = b"OTW1"
 _HDR = struct.Struct("<I")
+_I32 = np.dtype("<i4")
 
 
-def encode_batch(batch, traceparent: str | None = None) -> bytes:
+def _attrs_field(batch) -> str:
+    if isinstance(batch, MetricBatch):
+        return "point_attrs"
+    if isinstance(batch, LogBatch):
+        return "record_attrs"
+    return "span_attrs"
+
+
+def encode_batch(batch, traceparent: str | None = None,
+                 attr_format: str | None = None) -> bytes:
+    """``attr_format``: None = store arrays when columnar attrs are
+    enabled (default), ``"json"`` = the legacy sparse dict-of-dicts
+    header (compat escape hatch / dict-path A/B)."""
+    if attr_format is None:
+        attr_format = "store" if columnar_enabled() else "json"
     cols = [(name, arr) for name, arr in batch.columns.items()]
     header = {
         "n": len(batch),
@@ -61,8 +84,6 @@ def encode_batch(batch, traceparent: str | None = None) -> bytes:
         header["tp"] = traceparent
     if isinstance(batch, MetricBatch):
         header["kind"] = "metrics"
-        header["attrs"] = {str(i): a
-                           for i, a in enumerate(batch.point_attrs) if a}
         header["hists"] = {str(i): h
                            for i, h in enumerate(batch.histograms) if h}
     elif isinstance(batch, LogBatch):
@@ -70,23 +91,60 @@ def encode_batch(batch, traceparent: str | None = None) -> bytes:
         # the string table) — raw-buffer framing is for the numeric columns
         header["kind"] = "logs"
         header["bodies"] = list(batch.bodies)
-        header["attrs"] = {str(i): a
-                           for i, a in enumerate(batch.record_attrs) if a}
+
+    store: AttrStore | None = None
+    if attr_format == "store":
+        store = batch.attrs()
+        header["astore"] = {"keys": list(store.keys),
+                            "vals": list(store.vals),
+                            "nnz": store.nnz}
     else:
-        header["attrs"] = {str(i): a
-                           for i, a in enumerate(batch.span_attrs) if a}
+        attrs = getattr(batch, _attrs_field(batch))
+        header["attrs"] = {str(i): dict(a)
+                           for i, a in enumerate(attrs) if a}
+
     hdr = json.dumps(header, separators=(",", ":")).encode()
     # pad the header (JSON ignores trailing whitespace) so the first column
     # starts 8-byte aligned — the precondition for the decoder's zero-copy
     # views; u64/f64 columns dominate the span layout
     hdr += b" " * (-(_HDR.size + len(hdr)) % 8)
     parts = [_HDR.pack(len(hdr)), hdr]
-    parts.extend(np.ascontiguousarray(arr).tobytes() for _, arr in cols)
+    col_bytes = 0
+    for _, arr in cols:
+        b = np.ascontiguousarray(arr).tobytes()
+        parts.append(b)
+        col_bytes += len(b)
+    if store is not None:
+        # re-align so the int32 entry arrays land 8-byte aligned (narrow
+        # int8 columns can leave the section end odd). The pad depends
+        # ONLY on the column section's length — never on the header's —
+        # so a frame whose header was rewritten (or came from an encoder
+        # without header padding) still locates the attr section; the
+        # decoder's misalignment copy handles the rest.
+        parts.append(b"\0" * (-col_bytes % 8))
+        parts.append(np.ascontiguousarray(store.row_ptr,
+                                          dtype=_I32).tobytes())
+        parts.append(np.ascontiguousarray(store.key_idx,
+                                          dtype=_I32).tobytes())
+        parts.append(np.ascontiguousarray(store.val_idx,
+                                          dtype=_I32).tobytes())
     return b"".join(parts)
 
 
 def decode_batch(payload: bytes):
     return decode_frame(payload)[0]
+
+
+def _read_array(payload: bytes, dt: np.dtype, count: int,
+                off: int) -> tuple[np.ndarray, int]:
+    """Zero-copy view when aligned; the lone per-column memcpy when not."""
+    nbytes = dt.itemsize * count
+    if off % dt.alignment:
+        arr = np.frombuffer(payload, dtype=np.uint8, count=nbytes,
+                            offset=off).copy().view(dt)
+    else:
+        arr = np.frombuffer(payload, dtype=dt, count=count, offset=off)
+    return arr, off + nbytes
 
 
 def decode_frame(payload: bytes):
@@ -95,26 +153,32 @@ def decode_frame(payload: bytes):
     (hdr_len,) = _HDR.unpack_from(payload, 0)
     header = json.loads(payload[4:4 + hdr_len])
     n = header["n"]
-    attrs_sparse = {int(k): v for k, v in header["attrs"].items()}
-    attrs = tuple(attrs_sparse.get(i, {}) for i in range(n))
     columns = {}
-    off = 4 + hdr_len
+    cols_start = off = 4 + hdr_len
     for name, dtype_str in header["cols"]:
-        dt = np.dtype(dtype_str)
-        nbytes = dt.itemsize * n
-        if off % dt.alignment:
-            # misaligned (odd-length narrow column upstream, or a frame
-            # from a pre-padding encoder): copy into an aligned buffer —
-            # the only per-column memcpy left on the decode path
-            columns[name] = np.frombuffer(
-                payload, dtype=np.uint8, count=nbytes,
-                offset=off).copy().view(dt)
-        else:
-            # zero-copy read-only view into the payload; writers must copy
-            # first (numpy raises on in-place writes, by design)
-            columns[name] = np.frombuffer(
-                payload, dtype=dt, count=n, offset=off)
-        off += nbytes
+        columns[name], off = _read_array(payload, np.dtype(dtype_str),
+                                         n, off)
+
+    astore = header.get("astore")
+    if astore is not None:
+        # encoder's inter-section pad — a function of the column
+        # section's length only (see encode_batch)
+        off += -(off - cols_start) % 8
+        nnz = int(astore["nnz"])
+        row_ptr, off = _read_array(payload, _I32, n + 1, off)
+        key_idx, off = _read_array(payload, _I32, nnz, off)
+        val_idx, off = _read_array(payload, _I32, nnz, off)
+        store = AttrStore(keys=tuple(astore["keys"]),
+                          vals=tuple(astore["vals"]),
+                          row_ptr=row_ptr, key_idx=key_idx,
+                          val_idx=val_idx)
+        attrs = AttrDictView(store)
+    else:
+        # legacy frame: sparse JSON dict-of-dicts (pre-store encoders)
+        attrs_sparse = {int(k): v
+                        for k, v in header.get("attrs", {}).items()}
+        attrs = tuple(attrs_sparse.get(i, {}) for i in range(n))
+
     tp = header.get("tp")
     if header.get("kind") == "metrics":
         hists_sparse = {int(k): v for k, v in header.get("hists", {}).items()}

@@ -1,0 +1,346 @@
+"""Parity suite: every attrs-touching stage ported to the columnar
+AttrStore must produce BIT-IDENTICAL output to the historical
+tuple-of-dicts path — same attrs (values AND per-row key order), same
+columns, same string table, same resources.
+
+Each case builds the same input twice (once per representation, under
+the ``columnar_attrs`` toggle), runs the stage under its own mode, and
+compares. Covers the edge shapes the CSR math can get wrong: empty-attrs
+rows, all-empty batches, zero-row batches, None values, shared stores
+after filter (aliasing), and mixed store/dict statement groups in ottl.
+"""
+
+import numpy as np
+import pytest
+
+from odigos_tpu.pdata import (SpanBatchBuilder, columnar_attrs,
+                              concat_batches, synthesize_traces)
+from odigos_tpu.pdata.attrstore import AttrDictView
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def build_batch(n=64, seed=0, empty=False):
+    """Deterministic attrs-heavy batch with shared dicts, empties, None
+    values, and type-colliding values (0 vs "0" vs False)."""
+    rng = np.random.default_rng(seed)
+    b = SpanBatchBuilder()
+    for i in range(n):
+        attrs = {}
+        if not empty:
+            r = int(rng.integers(0, 6))
+            if r == 0:
+                attrs = {"http.route": f"/r{i % 3}", "http.status": 200,
+                         "card": "4111111111111111"}
+            elif r == 1:
+                attrs = {"n": i % 4, "tier": None, "host.name": f"h{i % 2}"}
+            elif r == 2:
+                attrs = {"n": str(i % 4), "flag": bool(i % 2),
+                         "secret.token": "tok"}
+            elif r == 3:
+                attrs = {"zero": 0, "host.name": f"h{i % 2}"}
+            # r in (4, 5): empty attrs
+        b.add_span(trace_id=(i // 4) + 1, span_id=i + 1,
+                   parent_span_id=i if i % 4 else 0,
+                   name=f"op{i % 5}", service=f"svc{i % 3}",
+                   kind=(i % 5) + 1, status_code=i % 3,
+                   start_unix_nano=1000 + i, end_unix_nano=2000 + i * 7,
+                   attrs=attrs or None)
+    return b.build()
+
+
+def assert_identical(a, b):
+    """Bit-identical batches: columns, strings, resources, and attrs
+    including per-row key ORDER."""
+    assert len(a) == len(b)
+    assert set(a.columns) == set(b.columns)
+    for col in a.columns:
+        assert (a.col(col) == b.col(col)).all(), col
+    assert tuple(a.strings) == tuple(b.strings)
+    assert [list(r.items()) for r in a.resources] == \
+        [list(r.items()) for r in b.resources]
+    assert [list(d.items()) for d in a.span_attrs] == \
+        [list(d.items()) for d in b.span_attrs]
+
+
+def run_both(stage, mk=build_batch, **mk_kw):
+    """Run ``stage(batch)`` under each representation; return (columnar,
+    dict) results. The input is rebuilt inside each mode so each side
+    sees its native layout end to end."""
+    with columnar_attrs(True):
+        col = stage(mk(**mk_kw))
+        assert col is None or isinstance(col.span_attrs,
+                                         (AttrDictView, tuple))
+    with columnar_attrs(False):
+        ref = stage(mk(**mk_kw))
+    return col, ref
+
+
+BATCH_SHAPES = ({}, {"empty": True}, {"n": 0}, {"n": 1}, {"n": 7})
+
+
+def check_stage(stage):
+    for kw in BATCH_SHAPES:
+        col, ref = run_both(stage, **kw)
+        if ref is None:
+            assert col is None
+        else:
+            assert_identical(col, ref)
+
+
+# ------------------------------------------------------------- pdata ops
+
+
+class TestPdataParity:
+    def test_filter(self):
+        check_stage(lambda b: b.filter(
+            np.arange(len(b)) % 3 != 1))
+
+    def test_take(self):
+        check_stage(lambda b: b.take(
+            np.argsort(b.col("span_id"), kind="stable")[::2]))
+
+    def test_slice(self):
+        check_stage(lambda b: b.slice(1, max(len(b) - 2, 1))
+                    if len(b) else b.slice(0, 0))
+
+    def test_concat(self):
+        def stage(b):
+            other = b.filter(np.arange(len(b)) % 2 == 0)
+            return concat_batches([b, other, b.slice(0, len(b) // 2)])
+        check_stage(stage)
+
+    def test_with_span_attrs(self):
+        def stage(b):
+            mask = np.arange(len(b)) % 2 == 0
+            k = int(mask.sum())
+            return b.with_span_attrs(
+                {"odigos.anomaly.score": [round(0.1 * j, 2)
+                                          for j in range(k)],
+                 "odigos.anomaly": [True] * k}, mask)
+        check_stage(stage)
+
+    def test_shared_store_after_filter_aliasing(self):
+        """A filtered child shares the parent's pools; mutating the child
+        must never leak into the parent (CoW), on both paths."""
+        def stage(b):
+            child = b.filter(np.arange(len(b)) % 2 == 0)
+            tagged = child.with_span_attr("t", ["x"] * len(child))
+            # parent rows untouched by the child's mutation
+            assert all("t" not in d for d in b.span_attrs)
+            assert all(d.get("t") == "x" for d in tagged.span_attrs)
+            return tagged
+        check_stage(stage)
+        # and the columnar child genuinely aliases the parent's pools
+        with columnar_attrs(True):
+            b = build_batch()
+            child = b.filter(np.arange(len(b)) % 2 == 0)
+            assert child.attrs().keys is b.attrs().keys
+            assert child.attrs().vals is b.attrs().vals
+
+    def test_with_names_shares_untouched_columns(self):
+        b = build_batch()
+        out = b.with_names({0: "renamed", 3: f"op{1}"})
+        assert out.span_names()[0] == "renamed"
+        assert out.span_names()[3] == "op1"
+        # untouched columns share memory with the parent batch
+        for col in out.columns:
+            if col != "name":
+                assert np.shares_memory(out.col(col), b.col(col)), col
+        ref = build_batch()
+        expect = ref.span_names()
+        expect[0], expect[3] = "renamed", "op1"
+        assert out.span_names() == expect
+
+
+# --------------------------------------------------------- processors
+
+
+def _mk_proc(type_name, config):
+    import odigos_tpu.components  # noqa: F401  (registers factories)
+    from odigos_tpu.components.api import ComponentKind, registry
+    return registry.get(ComponentKind.PROCESSOR, type_name).build(
+        f"{type_name}/parity", config)
+
+
+class TestProcessorParity:
+    def test_filter_attr_clauses(self):
+        for cond in ([{"attr": {"key": "n", "value": 0}}],
+                     [{"attr": {"key": "n", "value": "0"}}],
+                     [{"attr": {"key": "host.name"}}],
+                     [{"attr": {"key": "tier", "value": None}}],
+                     [{"attr": {"key": "absent", "value": 1}}],
+                     [{"service": "svc1",
+                       "attr": {"key": "http.route", "value": "/r0"}}]):
+            proc = _mk_proc("filter", {"exclude": cond})
+            proc.start()
+            check_stage(proc.process)
+
+    def test_filter_include_allowlist(self):
+        proc = _mk_proc("filter", {
+            "include": [{"attr": {"key": "http.route"}}],
+            "exclude": [{"attr": {"key": "http.status", "value": 200}}]})
+        proc.start()
+        check_stage(proc.process)
+
+    def test_attributes_actions(self):
+        actions = [
+            {"action": "insert", "key": "env", "value": "prod"},
+            {"action": "update", "key": "n", "value": -1},
+            {"action": "upsert", "key": "zone", "value": "z"},
+            {"action": "delete", "key": "secret.token"},
+            {"action": "rename", "key": "http.route", "new_key": "route"},
+            {"action": "rename", "key": "zero", "new_key": "n"},
+            {"action": "upsert", "key": "res", "value": 1,
+             "scope": "resource"},
+        ]
+        for a in actions:
+            proc = _mk_proc("attributes", {"actions": [a]})
+            check_stage(proc.process)
+        proc = _mk_proc("attributes", {"actions": actions})
+        check_stage(proc.process)
+
+    def test_attributes_composed_single_rebuild(self):
+        """Disjoint new-key actions take the one-pass rebuild_entries
+        path (bench chain shape) — must stay bit-identical too."""
+        proc = _mk_proc("attributes", {"actions": [
+            {"action": "insert", "key": "env", "value": "prod"},
+            {"action": "upsert", "key": "zone", "value": "z1"},
+            {"action": "rename", "key": "n", "new_key": "n.count"},
+            {"action": "delete", "key": "host.name"},
+        ]})
+        check_stage(proc.process)
+
+    def test_transform_ottl_get_set(self):
+        proc = _mk_proc("transform", {"trace_statements": [
+            'set(attributes["env"], "prod") where attributes["n"] == 0',
+            'set(attributes["dur"], duration_ms) where duration_ms > 0.001',
+            'set(attributes["n"], 99) where attributes["flag"] == true',
+        ]})
+        check_stage(proc.process)
+
+    def test_transform_mixed_store_and_dict_edits(self):
+        """A store-mode set, then a dict-downgrading delete_key, then
+        another set: the fold-in must keep earlier edits visible."""
+        proc = _mk_proc("transform", {"trace_statements": [
+            'set(attributes["env"], "prod")',
+            'delete_key(attributes, "secret.token")',
+            'set(attributes["post"], true) where attributes["env"] == "prod"',
+            'keep_keys(attributes, ["env", "post", "n", "http.route"])',
+        ]})
+        check_stage(proc.process)
+
+    def test_groupbyattrs(self):
+        for keys in ([], ["host.name"], ["host.name", "n"],
+                     ["absent.key"], ["tier"]):
+            proc = _mk_proc("groupbyattrs", {"keys": keys})
+            check_stage(proc.process)
+
+    def test_groupbyattrs_resource_fallback_and_compaction(self):
+        def mk(**kw):
+            b = SpanBatchBuilder()
+            r1 = b.add_resource({"service.name": "a", "host.name": "H"})
+            b._resources.append({"service.name": "a", "host.name": "H"})
+            r2 = len(b._resources) - 1  # duplicate resource content
+            for i in range(8):
+                b.add_span(trace_id=1, span_id=i + 1, name="op",
+                           service="a", start_unix_nano=1, end_unix_nano=2,
+                           resource_index=r1 if i % 2 else r2,
+                           attrs={"host.name": "X"} if i % 3 == 0 else
+                           ({"host.name": None} if i % 3 == 1 else None))
+            return b.build()
+        proc = _mk_proc("groupbyattrs", {"keys": ["host.name"]})
+        col, ref = run_both(proc.process, mk=mk)
+        assert_identical(col, ref)
+
+    def test_redaction(self):
+        for cfg in ({"blocked_values": [r"4[0-9]{12}(?:[0-9]{3})?"],
+                     "summary": "info"},
+                    {"blocked_values": [r"4[0-9]{12}(?:[0-9]{3})?", "tok"],
+                     "summary": "debug"},
+                    {"allow_all_keys": False,
+                     "allowed_keys": ["n", "http.route"],
+                     "ignored_keys": ["flag"],
+                     "blocked_values": ["tok"], "summary": "info"},
+                    {"summary": "silent", "blocked_values": ["^/r1$"]}):
+            proc = _mk_proc("redaction", cfg)
+            check_stage(proc.process)
+
+    def test_conditionalattributes_via_tagging_primitive(self):
+        proc = _mk_proc("odigosconditionalattributes", {
+            "rules": [{
+                "field_to_check": "http.route",
+                "new_attribute_value_configurations": {
+                    "/r0": [{"new_attribute": "category",
+                             "value": "revenue"}],
+                    "/r1": [{"new_attribute": "category",
+                             "from_field": "host.name"}],
+                }}],
+            "global_default": "other"})
+        check_stage(proc.process)
+
+
+# --------------------------------------------------------- featurizer
+
+
+class TestFeaturizerParity:
+    def test_attr_slots_match_dict_reference(self):
+        from odigos_tpu.components.processors._attrs_dictpath import (
+            featurize_attr_slots)
+        from odigos_tpu.features import FeaturizerConfig, featurize
+        from odigos_tpu.features.featurizer import (_attr_slot_hashes,
+                                                    _attr_slot_matrix)
+
+        for kw in BATCH_SHAPES:
+            batch = build_batch(**kw)
+            for slots in (1, 4, 8):
+                got = _attr_slot_matrix(batch, slots, 4096)
+                want = featurize_attr_slots(batch, _attr_slot_hashes,
+                                            slots, 4096)
+                assert (got == want).all(), (kw, slots)
+            # and end-to-end through featurize()
+            f = featurize(batch, FeaturizerConfig(attr_slots=4))
+            assert f.categorical.shape == (len(batch), 5 + 4)
+
+    def test_slot_collision_order_matches(self):
+        """Many keys forced into one slot: the dict path's last-writer
+        (sorted item order) must win on the columnar path too."""
+        from odigos_tpu.components.processors._attrs_dictpath import (
+            featurize_attr_slots)
+        from odigos_tpu.features.featurizer import (_attr_slot_hashes,
+                                                    _attr_slot_matrix)
+
+        b = SpanBatchBuilder()
+        for i in range(16):
+            attrs = {f"k{j}": f"v{(i + j) % 5}" for j in range(6)}
+            b.add_span(trace_id=1, span_id=i + 1, name="op", service="s",
+                       start_unix_nano=1, end_unix_nano=2, attrs=attrs)
+        batch = b.build()
+        got = _attr_slot_matrix(batch, 1, 64)  # slots=1: max collisions
+        want = featurize_attr_slots(batch, _attr_slot_hashes, 1, 64)
+        assert (got == want).all()
+
+
+# ------------------------------------------------------------ wire
+
+
+class TestCodecParity:
+    def test_roundtrip_both_formats_identical(self):
+        from odigos_tpu.wire.codec import decode_batch, encode_batch
+
+        for kw in BATCH_SHAPES:
+            batch = build_batch(**kw)
+            new = decode_batch(encode_batch(batch, attr_format="store"))
+            legacy = decode_batch(encode_batch(batch, attr_format="json"))
+            assert_identical(new, legacy)
+
+    def test_engine_and_router_flag_probe(self):
+        from odigos_tpu.components.processors._attrs_dictpath import (
+            flagged_mask)
+
+        for kw in BATCH_SHAPES:
+            with columnar_attrs(True):
+                batch = build_batch(**kw)
+                got = batch.attrs().mask_has("flag")
+                want = flagged_mask(batch, "flag")
+            assert (got == want).all()

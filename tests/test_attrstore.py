@@ -1,0 +1,165 @@
+"""Unit tests for the dictionary-encoded CSR attribute store
+(odigos_tpu/pdata/attrstore.py): dict-order semantics of the CoW ops,
+pure-array reshapes, aliasing/sharing guarantees, and the lazy view."""
+
+import numpy as np
+import pytest
+
+from odigos_tpu.pdata.attrstore import (AttrDictView, AttrStore,
+                                        attr_store_of, columnar_attrs,
+                                        columnar_enabled)
+
+DICTS = (
+    {"http.route": "/a", "n": 0},
+    {},
+    {"n": 1, "flag": True, "none": None},
+    {"http.route": "/a", "n": 0},   # shares values with row 0
+    {"n": "0"},                     # "0" must stay distinct from 0
+)
+
+
+def mk():
+    return AttrStore.from_dicts(DICTS)
+
+
+class TestBuildAndRead:
+    def test_roundtrip_preserves_dicts_and_order(self):
+        st = mk()
+        assert st.to_dicts() == DICTS
+        assert [list(d.items()) for d in st.to_dicts()] == \
+            [list(d.items()) for d in DICTS]
+
+    def test_pools_are_deduped_and_typed(self):
+        st = mk()
+        assert len(st.keys) == len(set(st.keys))
+        # 0 (int), "0" (str), 1, True, None, "/a" all distinct
+        assert st.vals.count("/a") == 1
+        assert 0 in st.vals and "0" in st.vals
+        assert True in [v for v in st.vals if isinstance(v, bool)]
+
+    def test_column_values_and_presence(self):
+        st = mk()
+        vals, present = st.column("n")
+        assert list(present) == [True, False, True, True, True]
+        assert [vals[i] for i in (0, 2, 3, 4)] == [0, 1, 0, "0"]
+        assert vals[1] is None
+        # present-with-None differs from absent
+        _, p_none = st.column("none")
+        assert list(p_none) == [False, False, True, False, False]
+
+    def test_mask_eq_and_has(self):
+        st = mk()
+        assert list(st.mask_eq("n", 0)) == [True, False, False, True, False]
+        assert list(st.mask_eq("n", "0")) == [False] * 4 + [True]
+        assert list(st.mask_eq("missing", 1)) == [False] * 5
+        assert list(st.mask_has("flag")) == [False, False, True, False,
+                                             False]
+
+    def test_column_is_memoized(self):
+        st = mk()
+        assert st.column("n") is st.column("n")
+
+
+class TestReshapes:
+    def test_filter_take_share_pools(self):
+        st = mk()
+        f = st.filter(np.array([1, 0, 1, 0, 1], bool))
+        assert f.to_dicts() == (DICTS[0], DICTS[2], DICTS[4])
+        assert f.keys is st.keys and f.vals is st.vals
+        t = st.take(np.array([4, 0]))
+        assert t.to_dicts() == (DICTS[4], DICTS[0])
+
+    def test_slice_is_entry_view(self):
+        st = mk()
+        s = st.slice(1, 4)
+        assert s.to_dicts() == DICTS[1:4]
+        assert np.shares_memory(s.key_idx, st.key_idx)
+        assert np.shares_memory(s.val_idx, st.val_idx)
+
+    def test_concat_reinterns(self):
+        a, b = mk(), AttrStore.from_dicts(({"n": 0, "x": 9}, {}))
+        c = AttrStore.concat([a, b])
+        assert c.to_dicts() == DICTS + ({"n": 0, "x": 9}, {})
+        # value 0 interned once across both inputs
+        assert sum(1 for v in c.vals
+                   if isinstance(v, int) and not isinstance(v, bool)
+                   and v == 0) == 1
+
+    def test_empty(self):
+        st = AttrStore.empty(3)
+        assert st.to_dicts() == ({}, {}, {})
+        assert AttrStore.from_dicts(()).n_rows == 0
+        assert AttrStore.concat([]).n_rows == 0
+
+
+class TestCowOps:
+    def test_set_column_update_keeps_position_insert_appends(self):
+        st = mk()
+        mask = np.array([1, 1, 0, 0, 0], bool)
+        out = st.set_column("n", [7, 8], mask)
+        assert list(out.to_dicts()[0].items()) == \
+            [("http.route", "/a"), ("n", 7)]       # updated in place
+        assert list(out.to_dicts()[1].items()) == [("n", 8)]  # appended
+        assert st.to_dicts() == DICTS              # original untouched
+
+    def test_set_const_and_masks(self):
+        st = mk()
+        up = st.set_const("env", "prod")
+        assert all(d["env"] == "prod" for d in up.to_dicts())
+        ins = st.set_const("n", 9, ~st.mask_has("n"))  # insert semantics
+        assert ins.to_dicts()[1] == {"n": 9}
+        assert ins.to_dicts()[0]["n"] == 0
+
+    def test_delete_and_rename_follow_dict_semantics(self):
+        st = mk()
+        assert st.delete_key("n").to_dicts() == tuple(
+            {k: v for k, v in d.items() if k != "n"} for d in DICTS)
+        ren = st.rename_key("n", "m")
+        expect = []
+        for d in DICTS:
+            d = dict(d)
+            if "n" in d:
+                d["m"] = d.pop("n")
+            expect.append(d)
+        assert [list(d.items()) for d in ren.to_dicts()] == \
+            [list(d.items()) for d in expect]
+        # rename onto an existing key keeps the TARGET's position
+        onto = st.rename_key("n", "http.route")
+        d0 = list(onto.to_dicts()[0].items())
+        assert d0 == [("http.route", 0)]
+
+    def test_errors(self):
+        st = mk()
+        with pytest.raises(ValueError):
+            st.set_column("k", [1], np.ones(5, bool))  # length mismatch
+        with pytest.raises(ValueError):
+            st.filter(np.ones(4, bool))
+
+
+class TestView:
+    def test_view_behaves_like_tuple_of_dicts(self):
+        st = mk()
+        v = AttrDictView(st)
+        assert len(v) == 5
+        assert v[0] == DICTS[0] and v[-1] == DICTS[4]
+        assert list(v) == list(DICTS)
+        assert v == DICTS
+        assert tuple(v[1:3]) == DICTS[1:3]
+        with pytest.raises(IndexError):
+            v[5]
+
+    def test_attr_store_of_passthrough_and_build(self):
+        st = mk()
+        assert attr_store_of(AttrDictView(st)) is st
+        assert attr_store_of(DICTS).to_dicts() == DICTS
+
+
+class TestToggle:
+    def test_scoped_toggle_restores(self):
+        before = columnar_enabled()
+        with columnar_attrs(False):
+            assert not columnar_enabled()
+            with columnar_attrs(True):
+                assert columnar_enabled()
+            assert not columnar_enabled()
+        assert columnar_enabled() == before

@@ -210,6 +210,63 @@ class TestJitShapeBucketing:
             "(unbounded-recompile hazard):\n  " + "\n  ".join(problems))
 
 
+class TestColumnarAttrsHygiene:
+    """No hot-path module may fall back to per-span attribute Python
+    (ISSUE 4 satellite): span attributes are canonically the columnar
+    AttrStore, and a ``for ... in batch.span_attrs`` loop or an
+    ``np.fromiter(... span_attrs ...)`` scan re-introduces O(n)
+    interpreter work per batch exactly where throughput is bought.
+    Scope: the scoring-route processors/connectors, the featurizer, and
+    the serving engine. The sanctioned dict-path reference lives in
+    ``components/processors/_attrs_dictpath.py`` (bench A/B + parity
+    oracle) and is deliberately outside this list."""
+
+    HOT_MODULES = (
+        "features/featurizer.py",
+        "serving/engine.py",
+        "components/processors/filter.py",
+        "components/processors/attributes.py",
+        "components/processors/batch.py",
+        "components/processors/tpuanomaly.py",
+        "components/processors/redaction.py",
+        "components/processors/groupbyattrs.py",
+        "components/processors/ottl.py",
+        "components/processors/transform.py",
+        "components/connectors/anomalyrouter.py",
+        "components/connectors/exceptions.py",
+    )
+    FORBIDDEN = (
+        re.compile(r"for\s+.+?\s+in\s+[\w.]*\bspan_attrs\b"),
+        re.compile(r"np\.fromiter\([^)]*span_attrs", re.S),
+    )
+
+    def test_no_per_span_attr_python_on_hot_paths(self):
+        problems = []
+        for rel in self.HOT_MODULES:
+            path = os.path.join(PKG_ROOT, rel)
+            with open(path) as f:
+                src = f.read()
+            for rx in self.FORBIDDEN:
+                m = rx.search(src)
+                if m:
+                    line = src[:m.start()].count("\n") + 1
+                    problems.append(
+                        f"{rel}:{line}: {m.group(0)[:60]!r}")
+        assert not problems, (
+            "per-span attribute Python on a hot-path module — use "
+            "batch.attrs() (mask_eq/mask_has/column/set_column) or move "
+            "the dict path to _attrs_dictpath.py:\n  "
+            + "\n  ".join(problems))
+
+    def test_dictpath_module_is_the_only_processor_fallback(self):
+        """The reference module must still exist (parity oracle) and the
+        lint list must keep covering every file it is the fallback for."""
+        assert os.path.exists(os.path.join(
+            PKG_ROOT, "components", "processors", "_attrs_dictpath.py"))
+        for rel in self.HOT_MODULES:
+            assert os.path.exists(os.path.join(PKG_ROOT, rel)), rel
+
+
 class TestMetricNameHygiene:
     """Every instrument name that reaches the ``Meter`` (``meter.add`` /
     ``record`` / ``set_gauge`` and ``labeled_key``) must match the

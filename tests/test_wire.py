@@ -57,6 +57,57 @@ class TestCodec:
         out = decode_batch(encode_batch(batch))
         assert_batches_equal(out, batch)
 
+    def test_roundtrip_store_and_legacy_json_formats(self):
+        """New attr-store frames AND legacy dict-of-dicts frames both
+        round-trip to identical attrs (the decode-old-frames contract)."""
+        batch = synthesize_traces(30, seed=11)
+        mask = np.zeros(len(batch), dtype=bool)
+        mask[::3] = True
+        batch = batch.with_span_attrs(
+            {"http.route": ["/r"] * int(mask.sum()),
+             "retry": list(range(int(mask.sum())))}, mask)
+        new = decode_batch(encode_batch(batch, attr_format="store"))
+        legacy = decode_batch(encode_batch(batch, attr_format="json"))
+        assert_batches_equal(new, batch)
+        assert_batches_equal(legacy, batch)
+        assert list(new.span_attrs) == list(legacy.span_attrs)
+
+    def test_store_frame_attrs_never_ride_json_per_row(self):
+        """The header carries only DEDUPED pools: 1000 spans sharing one
+        attr dict must not serialize 1000 dicts."""
+        import json as _json
+
+        from odigos_tpu.pdata.spans import SpanBatchBuilder
+
+        b = SpanBatchBuilder()
+        for i in range(1000):
+            b.add_span(trace_id=i + 1, span_id=i + 1, name="op",
+                       service="svc", start_unix_nano=1, end_unix_nano=2,
+                       attrs={"env": "prod", "zone": "a"})
+        payload = encode_batch(b.build())
+        hdr_len = int.from_bytes(payload[:4], "little")
+        hdr = _json.loads(payload[4:4 + hdr_len])
+        assert hdr["astore"]["keys"] == ["env", "zone"]
+        assert hdr["astore"]["vals"] == ["prod", "a"]
+        assert hdr["astore"]["nnz"] == 2000  # int32 raw arrays, not JSON
+
+    def test_decoded_store_is_zero_copy_and_cow(self):
+        """Entry arrays are read-only views into the frame; mutating ops
+        copy-on-write instead of corrupting the wire buffer."""
+        batch = synthesize_traces(20, seed=7)
+        batch = batch.with_span_attr("k", list(range(len(batch))))
+        payload = encode_batch(batch)
+        out = decode_batch(payload)
+        store = out.attrs()
+        assert not store.key_idx.flags.writeable
+        assert np.shares_memory(store.key_idx,
+                                np.frombuffer(payload, dtype=np.uint8))
+        with pytest.raises(ValueError):
+            store.key_idx[0] = 1
+        tagged = out.with_span_attr("t", ["x"] * len(out))
+        assert tagged.span_attrs[0]["t"] == "x"
+        assert "t" not in out.span_attrs[0]  # original untouched
+
     def test_logs_roundtrip(self):
         from odigos_tpu.pdata.logs import LogBatch, LogBatchBuilder
 
@@ -167,11 +218,19 @@ class TestCodec:
         batch = b.build()
         payload = encode_batch(batch)
         import json as _json
-        # no per-span attr dicts serialized for attr-less spans
+        # no per-span attr dicts serialized for attr-less spans: the
+        # store header carries empty pools and zero entries
         hdr_len = int.from_bytes(payload[:4], "little")
-        assert _json.loads(payload[4:4 + hdr_len])["attrs"] == {}
+        hdr = _json.loads(payload[4:4 + hdr_len])
+        assert "attrs" not in hdr
+        assert hdr["astore"] == {"keys": [], "vals": [], "nnz": 0}
         out = decode_batch(payload)
         assert all(a == {} for a in out.span_attrs)
+        # and the legacy escape hatch still emits the sparse dict shape
+        legacy = encode_batch(batch, attr_format="json")
+        hdr_len = int.from_bytes(legacy[:4], "little")
+        assert _json.loads(legacy[4:4 + hdr_len])["attrs"] == {}
+        assert all(a == {} for a in decode_batch(legacy).span_attrs)
 
 
 def start_receiver(**cfg):
