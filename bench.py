@@ -181,6 +181,13 @@ def main() -> None:
     print(json.dumps(result), flush=True)
 
     try:
+        result.update(flow_overhead_bench())
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"flow overhead bench failed: {type(e).__name__}: {e}")
+        result["flow_overhead_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result), flush=True)
+
+    try:
         pipe = with_retry(lambda: pipeline_bench(on_tpu), "pipeline")
         result.update(pipe)
     except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
@@ -400,6 +407,124 @@ def attrs_pipeline_bench() -> dict:
             "memoized steady state (re-featurizing a batch is a lookup; "
             "cold cost is O(distinct key/value pairs) hashing + "
             "O(entries) scatter)"),
+    }
+
+
+def flow_overhead_bench() -> dict:
+    """Flow-ledger overhead A/B (ISSUE 5 acceptance: < 2% spans/s): the
+    SAME filter→attributes→transform→batch chain driven through its
+    consume() seams with the conservation edges installed vs. bare,
+    interleaved rounds (profiler-overhead discipline — monotone machine
+    drift must not land on one condition), per-mode p50 spans/s."""
+    from odigos_tpu.components.processors.attributes import (
+        AttributesProcessor)
+    from odigos_tpu.components.processors.batch import BatchProcessor
+    from odigos_tpu.components.processors.filter import FilterProcessor
+    from odigos_tpu.components.processors.transform import (
+        TransformProcessor)
+    from odigos_tpu.pdata import synthesize_traces
+    from odigos_tpu.selftelemetry.flow import (
+        ENTRY_NODE, OUTPUT_NODE, FlowEdge, flow_ledger)
+
+    class Sink:
+        def consume(self, batch):
+            pass
+
+    def make_batch(seed):
+        batch = synthesize_traces(2000, seed=seed)
+        rng = np.random.default_rng(seed)
+        mask = rng.random(len(batch)) < 0.7
+        k = int(mask.sum())
+        return batch.with_span_attrs({
+            "http.status": rng.choice([200, 404, 500], k).tolist(),
+            "tenant": [f"t{i % 17}" for i in range(k)],
+        }, mask)
+
+    N_VARIANTS = 8
+
+    def make_chain(with_edges: bool, pname: str):
+        procs = [
+            FilterProcessor("filter/bench", {"exclude": [
+                {"attr": {"key": "http.status", "value": 500}}]}),
+            AttributesProcessor("attributes/bench", {"actions": [
+                {"action": "insert", "key": "env", "value": "prod"},
+                {"action": "rename", "key": "tenant",
+                 "new_key": "tenant.id"}]}),
+            TransformProcessor("transform/bench", {"trace_statements": [
+                'set(attributes["slow"], true) where duration_ms > 1']}),
+            BatchProcessor("batch/bench", {
+                "send_batch_size": 1, "timeout_s": 0.0}),
+        ]
+        procs[0].start()
+        tail = Sink()
+        if not with_edges:
+            for i in range(len(procs) - 1, -1, -1):
+                procs[i].set_consumer(tail)
+                tail = procs[i]
+            return tail
+        # the exact wiring build_graph installs: branch + output +
+        # stage + entry edges, sites stamped
+        sig = "traces"
+        last = procs[-1].name
+        tail = FlowEdge(tail, flow_ledger.edge(pname, last, "sink", sig,
+                                               balance=False),
+                        (pname, "sink", sig))
+        tail = FlowEdge(tail, flow_ledger.edge(pname, last, OUTPUT_NODE,
+                                               sig, output=True),
+                        (pname, OUTPUT_NODE, sig))
+        for i in range(len(procs) - 1, -1, -1):
+            procs[i].set_consumer(tail)
+            procs[i]._flow_site = (pname, procs[i].name, sig)
+            from_name = procs[i - 1].name if i else ENTRY_NODE
+            tail = FlowEdge(
+                procs[i],
+                flow_ledger.edge(pname, from_name, procs[i].name, sig,
+                                 entry=(i == 0)),
+                (pname, procs[i].name, sig))
+        flow_ledger.register_pipeline(pname, procs, ["sink"], sig)
+        return tail
+
+    batches = [make_batch(99 + v) for v in range(N_VARIANTS)]
+    n_spans = sum(len(b) for b in batches) / N_VARIANTS
+    chains = {False: make_chain(False, "traces/bench-off"),
+              True: make_chain(True, "traces/bench-on")}
+    state = {False: 0, True: 0}
+    prev_enabled = flow_ledger.enabled
+
+    def once(with_edges: bool):
+        flow_ledger.enabled = with_edges
+        chains[with_edges].consume(
+            batches[state[with_edges] % N_VARIANTS])
+        state[with_edges] += 1
+
+    try:
+        for mode in (False, True):
+            once(mode)  # settle caches outside the timed region
+        samples: dict[bool, list] = {True: [], False: []}
+        for r in range(32):
+            order = (False, True) if r % 2 == 0 else (True, False)
+            for mode in order:
+                t0 = time.perf_counter()
+                once(mode)
+                samples[mode].append(time.perf_counter() - t0)
+    finally:
+        flow_ledger.enabled = prev_enabled
+    sps_off = n_spans / float(np.percentile(samples[False], 50))
+    sps_on = n_spans / float(np.percentile(samples[True], 50))
+    overhead = max(sps_off / max(sps_on, 1e-9) - 1.0, 0.0)
+    log(f"flow_overhead: {overhead:.4f} "
+        f"({sps_on:,.0f} spans/s with ledger vs {sps_off:,.0f} bare; "
+        f"bound < 2%)")
+    return {
+        "flow_overhead": round(float(overhead), 4),
+        "flow_spans_per_sec_on": round(sps_on, 1),
+        "flow_spans_per_sec_off": round(sps_off, 1),
+        "flow_overhead_note": (
+            "fraction of p50 spans/s lost to conservation-edge "
+            "accounting on the filter->attributes->transform->batch "
+            "chain (5 FlowEdges incl. per-destination branch), "
+            "interleaved off/on rounds on rotating inputs; acceptance "
+            "bound < 0.02"),
     }
 
 

@@ -23,6 +23,67 @@ def _fmt_condition(c) -> str:
     return f"  [{mark}] {c.type}: {c.reason}{msg}"
 
 
+# flow-rollup status -> k8s-style condition status (reused by
+# _fmt_condition: Degraded renders as the '?' Unknown mark)
+_FLOW_STATUS = {"Healthy": "True", "Degraded": "Unknown",
+                "Unhealthy": "False"}
+
+
+def _flow_condition(cond: dict):
+    """Adapt a HealthRollup condition dict to the Condition shape
+    ``_fmt_condition`` renders (one formatting path for CRD conditions
+    and live component conditions)."""
+    from ..api.resources import Condition, ConditionStatus
+
+    return Condition(
+        type=cond["component"],
+        status=ConditionStatus(_FLOW_STATUS.get(cond["status"], "Unknown")),
+        reason=cond["reason"], message=cond.get("message", ""),
+        last_transition=cond.get("last_transition", 0.0))
+
+
+def _flow_rows(pipelines=None, component_match=None,
+               conditions=None) -> list[tuple]:
+    """(edge, dropped-by-reason, condition-or-None) per terminal branch
+    edge in the process-global flow ledger — the per-destination
+    accounting ``describe`` prints. Empty when no collector runs in this
+    process (plain CLI against on-disk state). ``conditions`` accepts a
+    precomputed ``{component: condition}`` map so one describe render
+    evaluates the rollups once."""
+    from ..selftelemetry.flow import active_conditions, flow_ledger
+
+    snap = flow_ledger.snapshot()
+    if conditions is None:
+        conditions = {c["component"]: c for c in active_conditions()}
+    drops_by_comp: dict[str, dict[str, int]] = {}
+    for dr in snap["drops"]:
+        agg = drops_by_comp.setdefault(dr["component"], {})
+        for reason, n in dr["reasons"].items():
+            agg[reason] = agg.get(reason, 0) + n
+    terminals = {(p, t) for p, reg in snap["pipelines"].items()
+                 for t in reg["terminals"]}
+    rows = []
+    for e in snap["edges"]:
+        if (e["pipeline"], e["to"]) not in terminals:
+            continue
+        if pipelines is not None and e["pipeline"] not in pipelines:
+            continue
+        if component_match is not None and not component_match(e["to"]):
+            continue
+        rows.append((e, drops_by_comp.get(e["to"], {}),
+                     conditions.get(e["to"])))
+    return rows
+
+
+def _fmt_flow_row(e: dict, dropped: dict[str, int]) -> str:
+    n_drop = sum(dropped.values())
+    top = max(dropped, key=dropped.get) if dropped else "-"
+    n_fail = sum(e["failed"].values())
+    return (f"  flow[{e['pipeline']} -> {e['to']}]: "
+            f"accepted={e['accepted']} forwarded={e['forwarded']} "
+            f"dropped={n_drop}({top}) failed={n_fail}")
+
+
 def workload_ic(state: CliState, ref: WorkloadRef
                 ) -> Optional[InstrumentationConfig]:
     for ic in state.store.list("InstrumentationConfig"):
@@ -89,6 +150,24 @@ def describe_workload(state: CliState, namespace: str, kind: str,
                        if p.endswith(f"/{stream}") or stream in p]
     lines.append(f"Pipeline placement: streams={streams} "
                  f"pipelines={sorted(set(placed)) or '(gateway not rendered)'}")
+
+    # live flow accounting (flow ledger): per-destination counters and
+    # current condition for the pipelines carrying this workload's spans
+    # (the rollups are evaluated ONCE per render)
+    from ..selftelemetry.flow import active_conditions
+
+    placed_set = set(placed)
+    conditions = {c["component"]: c for c in active_conditions()} \
+        if placed_set else {}
+    for e, dropped, cond in _flow_rows(pipelines=placed_set,
+                                       conditions=conditions):
+        lines.append(_fmt_flow_row(e, dropped))
+        if cond is not None:
+            lines.append(_fmt_condition(_flow_condition(cond)))
+    for p in sorted(placed_set):
+        cond = conditions.get(f"pipeline/{p}")
+        if cond is not None:
+            lines.append(_fmt_condition(_flow_condition(cond)))
     return "\n".join(lines)
 
 
@@ -107,10 +186,26 @@ def describe_install(state: CliState) -> str:
             lines.append("  " + _fmt_condition(c))
     dests = state.store.list("DestinationResource")
     lines.append(f"  destinations: {len(dests)}")
+    if dests:
+        from ..selftelemetry.flow import active_conditions
+
+        live_conditions = {c["component"]: c for c in active_conditions()}
     for d in dests:
         lines.append(f"    {d.name}: {d.dest_type} signals={d.signals}")
         for c in d.conditions:
             lines.append("  " + _fmt_condition(c))
+        # live per-destination flow lines (flow ledger): configers emit
+        # exporter ids `<type>/<dest_type>-<id>` (or `<type>/<id>`), so
+        # match the suffix EXACTLY — a substring test would cross-
+        # attribute destinations whose names prefix each other
+        suffixes = {f"{d.dest_type}-{d.name}", d.name}
+        for e, dropped, cond in _flow_rows(
+                component_match=lambda to: (
+                    to.split("/", 1)[-1] in suffixes),
+                conditions=live_conditions):
+            lines.append("  " + _fmt_flow_row(e, dropped))
+            if cond is not None:
+                lines.append("  " + _fmt_condition(_flow_condition(cond)))
     ics = state.store.list("InstrumentationConfig")
     lines.append(f"  instrumented workloads: {len(ics)}")
     for ic in ics:

@@ -114,6 +114,16 @@ def collect_bundle(state: CliState, out_path: Optional[str] = None,
         # pairing the metrics snapshot with the span ring above
         add("exemplars.json",
             json.dumps(meter.exemplars(), indent=1, sort_keys=True))
+        # flow ledger (ISSUE 5): per-edge conservation counters, named
+        # drops with last-drop trace witnesses, queue high-watermarks,
+        # the per-pipeline balance, and the live condition rollup —
+        # "where did my spans go", frozen at bundle time
+        from ..selftelemetry.flow import active_conditions, flow_ledger
+
+        flow_doc = flow_ledger.snapshot()
+        flow_doc["conservation"] = flow_ledger.conservation()
+        flow_doc["conditions"] = active_conditions()
+        add("flow.json", json.dumps(flow_doc, indent=1, sort_keys=True))
         # device-runtime snapshot, taken fresh at bundle time: engine
         # gauges + (when jax is loaded) live arrays, device memory, and
         # per-jit-site cache/compile accounting. Read-only: a one-shot

@@ -96,6 +96,17 @@ class Component:
     def healthy(self) -> bool:
         return True
 
+    # condition hook: (status, reason, message) consumed by the flow
+    # ledger's HealthRollup (selftelemetry/flow.py). The contract with
+    # healthy() is fixed — Unhealthy iff healthy() is False — so the
+    # healthcheck extension's 200/503 behavior never drifts from the
+    # rollup; components override to attach richer reasons/messages.
+    def health(self) -> tuple[str, str, str]:
+        if self.healthy():
+            return ("Healthy", "Running", "")
+        return ("Unhealthy", "ReportedUnhealthy",
+                f"{self.name} reports unhealthy")
+
 
 class Receiver(Component):
     """Produces batches. ``next_consumer`` is set by the pipeline builder."""

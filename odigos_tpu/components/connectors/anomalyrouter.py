@@ -22,6 +22,7 @@ from typing import Any
 import numpy as np
 
 from ...pdata.spans import SpanBatch
+from ...selftelemetry.flow import FlowContext
 from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Connector, Factory, register
 from ..processors.tpuanomaly import FLAG_ATTR
@@ -57,17 +58,32 @@ class AnomalyRouterConnector(Connector):
         anomalous = batch.filter(flagged) if not flagged.all() else batch
         normal = batch.filter(~flagged) if flagged.any() else batch
 
+        sent_anomaly = sent_rest = False
         if flagged.any():
             for p in self.anomaly_pipelines:
                 consumer = self.outputs.get(p)
                 if consumer is not None:
                     consumer.consume(anomalous)
+                    sent_anomaly = True
         rest = batch if self.mirror else normal
         if len(rest):
             for p in self.default_pipelines:
                 consumer = self.outputs.get(p)
                 if consumer is not None:
                     consumer.consume(rest)
+                    sent_rest = True
+        # spans routed nowhere (no anomaly pipeline wired, or no default
+        # path) are shed here — named in the flow ledger, attributed to
+        # the pipeline currently flowing through (contextvar site)
+        delivered = np.zeros(len(batch), dtype=bool)
+        if sent_anomaly:
+            delivered |= flagged
+        if sent_rest:
+            delivered |= (np.ones(len(batch), dtype=bool) if self.mirror
+                          else ~flagged)
+        n_dropped = int((~delivered).sum())
+        if n_dropped:
+            FlowContext.drop(n_dropped, "filtered", component=self)
 
 
 register(Factory(

@@ -23,6 +23,7 @@ from typing import Any
 import numpy as np
 
 from ...pdata.spans import SpanBatch
+from ...selftelemetry.flow import FlowContext
 from ...utils.telemetry import meter
 from ..api import ComponentKind, Connector, Factory, register
 
@@ -98,6 +99,7 @@ class RouterConnector(Connector):
         if n_dropped:
             meter.add(f"odigos_router_dropped_spans_total{{connector={self.name}}}",
                       n_dropped)
+            FlowContext.drop(n_dropped, "filtered", component=self)
 
 
 register(Factory(

@@ -92,10 +92,18 @@ class RoutingConnector(Connector):
                        self.default_pipelines)
 
     def _emit(self, batch: Any, pipelines: list[str]) -> None:
+        delivered = False
         for pname in pipelines:
             out = self.outputs.get(pname)
             if out is not None:
                 out.consume(batch)
+                delivered = True
+        if not delivered and len(batch):
+            # no wired route (empty default_pipelines or dangling
+            # pipeline name): the shed is named in the flow ledger
+            from ...selftelemetry.flow import FlowContext
+
+            FlowContext.drop(len(batch), "filtered", component=self)
 
 
 register(Factory(

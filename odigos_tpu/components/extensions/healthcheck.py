@@ -36,9 +36,23 @@ class HealthCheckExtension(HttpExtension):
         unhealthy = [c.name for c in graph.all_components()
                      if c is not self and not c.healthy()]
         if unhealthy:
-            return 503, {"status": "unavailable",
-                         "unhealthy": sorted(unhealthy)}
-        return 200, {"status": "ok"}
+            body = {"status": "unavailable",
+                    "unhealthy": sorted(unhealthy)}
+            code = 503
+        else:
+            body = {"status": "ok"}
+            code = 200
+        # ?verbose=1: the full per-component condition rollup (status /
+        # reason / message / last transition) from the flow ledger's
+        # HealthRollup. Additive only — the 200/503 contract and the
+        # non-verbose body stay byte-identical (k8s probes parse them).
+        if q.get("verbose") in ("1", "true"):
+            rollup = getattr(graph, "flow_health", None)
+            if rollup is not None:
+                body["components"] = [
+                    c for c in rollup.evaluate()
+                    if c["component"] != self.name]
+        return code, body
 
     def pages(self) -> dict[str, Page]:
         return {"": self._status, "/health": self._status}

@@ -14,6 +14,12 @@ serves JSON (terminal-first operators curl it):
                            pivots to that trace's full span list — the
                            landing page for ``/metrics`` ``# EXEMPLAR``
                            annotations (upstream zpages' tracez role)
+* ``/debug/flowz``       — the flow ledger (ISSUE 5): per-edge
+                           accepted/forwarded/failed counters, named
+                           drops with last-drop trace witnesses, queue
+                           high-watermarks, the per-pipeline
+                           conservation balance, and the component
+                           condition rollup
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
 """
@@ -98,11 +104,23 @@ class ZPagesExtension(HttpExtension):
                      "spans_buffered": len(tracer.ring),
                      "by_span": rows}
 
+    def _flowz(self, q: dict[str, str]) -> tuple[int, dict]:
+        from ...selftelemetry.flow import flow_ledger
+
+        out = flow_ledger.snapshot()
+        out["conservation"] = flow_ledger.conservation()
+        g = self._graph
+        rollup = getattr(g, "flow_health", None) if g is not None else None
+        if rollup is not None:
+            out["conditions"] = rollup.evaluate()
+        return 200, out
+
     def pages(self) -> dict[str, Page]:
         return {"/debug/pipelinez": self._pipelinez,
                 "/debug/servicez": self._servicez,
                 "/debug/extensionz": self._extensionz,
-                "/debug/tracez": self._tracez}
+                "/debug/tracez": self._tracez,
+                "/debug/flowz": self._flowz}
 
 
 register(Factory(

@@ -15,6 +15,7 @@ from typing import Any, Optional
 
 from ...pdata import concat_any
 from ...pdata.spans import SpanBatch
+from ...selftelemetry.flow import FlowContext
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
 
@@ -36,6 +37,8 @@ class BatchProcessor(Processor):
         with self._lock:
             self._pending.append(batch)
             self._pending_spans += len(batch)
+            FlowContext.watermark(self.name, "pending_spans",
+                                  self._pending_spans)
             if self._pending_spans >= self.send_batch_size:
                 to_send = self._take_locked()
             elif self._timer is None and self.timeout_s > 0:
@@ -89,6 +92,14 @@ class BatchProcessor(Processor):
             taken = self._take_locked()
         if taken:
             self._send(taken)
+
+    def flow_pending(self) -> int:
+        """Spans buffered here, not yet forwarded — the conservation
+        checker's in-flight term (selftelemetry/flow.py). A downstream
+        refusal on the timer path needs no extra ledger call: the
+        out-edge already counted those spans as failed."""
+        with self._lock:
+            return self._pending_spans
 
     def shutdown(self) -> None:
         self.flush()

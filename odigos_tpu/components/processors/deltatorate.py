@@ -103,7 +103,14 @@ class DeltaToRateProcessor(Processor):
         cols["value"] = values.astype(np.float64)
         cols["type"] = types.astype(np.int8)
         out = replace(batch, columns=cols)
-        return out.filter(keep) if not keep.all() else out
+        if keep.all():
+            return out
+        # first-observation points have no interval to rate over — an
+        # intentional shed, named in the flow ledger (ISSUE 5 lint)
+        from ...selftelemetry.flow import FlowContext
+
+        FlowContext.drop(int((~keep).sum()), "invalid", component=self)
+        return out.filter(keep)
 
 
 register(Factory(

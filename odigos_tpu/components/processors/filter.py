@@ -32,6 +32,7 @@ import numpy as np
 
 from ...pdata.attrstore import columnar_enabled
 from ...pdata.spans import SpanBatch
+from ...selftelemetry.flow import FlowContext
 from ...utils.telemetry import meter
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 from . import _attrs_dictpath as _dictpath
@@ -129,6 +130,7 @@ class FilterProcessor(Processor):
         if n_dropped == 0:
             return batch
         meter.add(f"{DROPPED_METRIC}{{processor={self.name}}}", n_dropped)
+        FlowContext.drop(n_dropped, "filtered", component=self)
         if not keep.any():
             return None  # whole batch filtered: stop the pipeline here
         return batch.filter(keep)

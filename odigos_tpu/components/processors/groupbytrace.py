@@ -113,6 +113,12 @@ class GroupByTraceProcessor(Processor):
         if out:
             self._emit(out)
 
+    def flow_pending(self) -> int:
+        """Spans buffered awaiting trace completion — the conservation
+        checker's in-flight term (selftelemetry/flow.py)."""
+        with self._lock:
+            return sum(len(b) for b in self._pending)
+
     def _emit(self, out: SpanBatch) -> None:
         """Release hook: subclasses (tailsampling) decide per released
         trace before forwarding; the base forwards everything."""

@@ -20,7 +20,8 @@ import threading
 from typing import Any
 
 from ...pdata.spans import SpanBatch
-from ...utils.telemetry import meter
+from ...selftelemetry.flow import FlowContext
+from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Factory, Processor, register
 
 REJECTION_METRIC = "odigos_gateway_memory_limiter_rejections_total"
@@ -53,17 +54,39 @@ class MemoryLimiterProcessor(Processor):
         self.soft_bytes = int(self.limit_bytes * (1.0 - spike))
         self._inflight = 0
         self._lock = threading.Lock()
+        # labeled rejection counter: the pipeline label the autoscaler
+        # already keys on elsewhere. Rendered lazily — _flow_site is
+        # stamped by the graph builder after construction. The old
+        # unlabeled name stays as an alias (the HPA custom-metric path
+        # keys on it verbatim).
+        self._rejections_key: str | None = None
 
     def consume(self, batch: SpanBatch) -> None:
         size = batch_nbytes(batch)
         with self._lock:
             if self._inflight + size > self.limit_bytes:
                 meter.add(REJECTION_METRIC)
-                raise MemoryLimiterError(
+                key = self._rejections_key
+                if key is None:
+                    site = getattr(self, "_flow_site", None)
+                    key = self._rejections_key = labeled_key(
+                        REJECTION_METRIC,
+                        pipeline=site[0] if site else "(none)")
+                meter.add(key)
+                err = MemoryLimiterError(
                     f"{self.name}: refusing batch of {size} B "
                     f"({self._inflight} B in flight, limit {self.limit_bytes} B)")
+                # one source of truth: the rejection lands in the flow
+                # ledger as dropped{reason=memory_limited}; the marked
+                # exception tells the edge wrappers NOT to also count
+                # the unwind as failed (it would double-book the batch)
+                FlowContext.drop(len(batch), "memory_limited",
+                                 component=self, exc=err)
+                raise err
             soft_exceeded = self._inflight + size > self.soft_bytes
             self._inflight += size
+            FlowContext.watermark(self.name, "inflight_bytes",
+                                  self._inflight)
         if soft_exceeded:
             gc.collect(0)
         try:

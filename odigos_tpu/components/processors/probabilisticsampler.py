@@ -29,6 +29,7 @@ import numpy as np
 
 from ...pdata.logs import LogBatch
 from ...pdata.spans import SpanBatch
+from ...selftelemetry.flow import FlowContext
 from ...utils.mix import splitmix64
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
@@ -64,7 +65,11 @@ class ProbabilisticSamplerProcessor(Processor):
         if isinstance(batch, SpanBatch) and len(batch):
             keep = self._keep_mask(batch.col("trace_id_hi"),
                                    batch.col("trace_id_lo"))
-            return batch if keep.all() else batch.filter(keep)
+            if keep.all():
+                return batch
+            FlowContext.drop(int((~keep).sum()), "sampled",
+                             component=self)
+            return batch.filter(keep)
         if isinstance(batch, LogBatch) and len(batch):
             hi = batch.col("trace_id_hi")
             lo = batch.col("trace_id_lo")
@@ -79,7 +84,11 @@ class ProbabilisticSamplerProcessor(Processor):
                 with np.errstate(over="ignore"):
                     alt = splitmix64(idx ^ self.seed) < self.threshold
                 keep = np.where(traceless, alt, keep)
-            return batch if keep.all() else batch.filter(keep)
+            if keep.all():
+                return batch
+            FlowContext.drop(int((~keep).sum()), "sampled",
+                             component=self)
+            return batch.filter(keep)
         return batch
 
 

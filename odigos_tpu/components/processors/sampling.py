@@ -30,6 +30,7 @@ import numpy as np
 
 from ...pdata.spans import SpanBatch, StatusCode
 from ...pdata.traces import TraceView, service_span_mask
+from ...selftelemetry.flow import FlowContext
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
 
@@ -423,7 +424,10 @@ class SamplingProcessor(Processor):
         keep = self.engine.keep_traces(view)
         if keep.all():
             return batch
-        return batch.filter(view.span_mask_for(keep))
+        span_mask = view.span_mask_for(keep)
+        FlowContext.drop(int((~span_mask).sum()), "sampled",
+                         component=self)
+        return batch.filter(span_mask)
 
 
 register(Factory(

@@ -52,6 +52,7 @@ import numpy as np
 
 from ...pdata.spans import SpanBatch, StatusCode
 from ...pdata.traces import TraceView
+from ...selftelemetry.flow import FlowContext
 from ...utils.mix import splitmix64
 from ...utils.telemetry import meter
 from ..api import Capabilities, ComponentKind, Factory, register
@@ -196,6 +197,9 @@ class TailSamplingProcessor(GroupByTraceProcessor):
         if dropped:
             meter.add(f"{DROPPED_METRIC}{{processor={self.name}}}",
                       dropped)
+            # _emit runs on the groupbytrace timer thread too: the
+            # graph-stamped _flow_site keeps attribution exact there
+            FlowContext.drop(dropped, "sampled", component=self)
         kept = out.filter(span_mask)
         if len(kept):
             self.next_consumer.consume(kept)

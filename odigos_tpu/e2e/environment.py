@@ -149,6 +149,33 @@ class E2EEnvironment:
             for od in self.odiglets:
                 od.poll()
         self._refresh_gateway_service()
+        self._publish_gateway_health()
+
+    def _publish_gateway_health(self) -> None:
+        """Mirror the gateway collector's flow-ledger condition rollup
+        into the CollectorsGroup status (the OpAMP status-reporting role:
+        the control-plane store is a consumer of the rollup, so
+        `describe`/the UI see collector health without reaching into the
+        collector process)."""
+        if self.gateway is None:
+            return
+        from ..api.resources import (
+            CollectorsGroupRole, Condition, ConditionStatus)
+
+        group = next(
+            (g for g in self.store.list("CollectorsGroup")
+             if g.role == CollectorsGroupRole.CLUSTER_GATEWAY), None)
+        if group is None:
+            return
+        rollup = self.gateway.graph.flow_health
+        rollup.evaluate()  # refresh conditions before summarizing
+        status, reason, message = rollup.worst()
+        cond_status = {"Healthy": ConditionStatus.TRUE,
+                       "Degraded": ConditionStatus.UNKNOWN,
+                       "Unhealthy": ConditionStatus.FALSE}[status]
+        if group.set_condition(Condition(
+                "CollectorHealth", cond_status, reason, message)):
+            self.store.update_status(group)
 
     def _refresh_gateway_service(self) -> None:
         """Keep the service registration pointing at the gateway's CURRENT

@@ -281,8 +281,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # metrics (own-observability ServiceMonitor scrape role)
                 # with # EXEMPLAR annotations linking histogram tails to
                 # self-traces (resolve via /api/selftrace?trace_id=)
+                from ..selftelemetry.flow import flow_ledger
                 from ..utils.telemetry import prometheus_text
 
+                # flow-ledger edge counters publish on scrape (delta-
+                # advanced): the hot path never touches the meter lock
+                flow_ledger.publish(meter)
                 body = prometheus_text(meter.snapshot(),
                                        meter.exemplars()).encode()
                 self.send_response(200)
@@ -360,6 +364,25 @@ class _Handler(BaseHTTPRequestHandler):
                                   if cm is not None else {})
             if path == "/api/pipeline":
                 return self._json(pipeline_topology(store))
+            if path == "/api/flow":
+                # the flow ledger (ISSUE 5): edge-annotated live
+                # topology — per-edge accepted/forwarded/failed, named
+                # drops with last-drop trace witnesses, queue high-
+                # watermarks, the per-pipeline conservation balance,
+                # and the merged condition rollup of every collector
+                # running in this process
+                from ..selftelemetry.flow import (
+                    active_conditions, flow_ledger)
+
+                snap = flow_ledger.snapshot()
+                return self._json({
+                    "enabled": snap["enabled"],
+                    "pipelines": flow_ledger.conservation(),
+                    "edges": snap["edges"],
+                    "drops": snap["drops"],
+                    "watermarks": snap["watermarks"],
+                    "conditions": active_conditions(),
+                })
             if path == "/api/metrics":
                 out = fe.metrics.throughput()
                 # the server process's own meter complements the stream

@@ -120,6 +120,9 @@ def _serve_metrics(port: int, collector=None):
                 return
             # exemplar annotations ride the collector scrape too —
             # this process hosts the engine/pipeline histograms
+            from ..selftelemetry.flow import flow_ledger
+
+            flow_ledger.publish(meter)
             body = prometheus_text(meter.snapshot(),
                                    meter.exemplars()).encode()
             self.send_response(200)

@@ -38,6 +38,13 @@ from ..components.api import (
     registry as default_registry,
 )
 from ..selftelemetry import trace_pipeline_entry
+from ..selftelemetry.flow import (
+    ENTRY_NODE,
+    OUTPUT_NODE,
+    FlowEdge,
+    HealthRollup,
+    flow_ledger,
+)
 
 
 @dataclass
@@ -56,6 +63,10 @@ class Graph:
     pipeline_order: list[str] = field(default_factory=list)
     # pipeline -> processors in chain (declaration) order
     pipeline_processors: dict[str, list[Processor]] = field(default_factory=dict)
+    # per-graph component condition rollup (selftelemetry/flow.py);
+    # shared by healthcheck + zpages + the owning Collector so
+    # last-transition times are one consistent history
+    flow_health: Any = None
 
     def all_components(self) -> list[Component]:
         # extensions first: healthcheck must be able to answer before any
@@ -243,22 +254,48 @@ def build_graph(config: dict[str, Any],
     for cid, ccfg in conn_cfgs.items():
         g.connectors[cid] = reg.get(ComponentKind.CONNECTOR, cid).build(cid, ccfg)
 
-    # 2. per-pipeline chains, built exporters-first so entries exist
+    # 2. per-pipeline chains, built exporters-first so entries exist.
+    # Every consumer seam gets a FlowEdge (conservation accounting,
+    # ISSUE 5): a terminal branch edge per exporter/connector (the
+    # per-destination ledger), one __output__ edge counting what left
+    # the pipeline exactly once (fan-out does not multiply the balance),
+    # stage edges between processors, and the __input__ entry edge.
     for pname, p in pipelines.items():
-        terminal: list[Consumer] = []
-        for eid in p.get("exporters", []):
-            terminal.append(g.connectors[eid] if eid in g.connectors
-                            else g.exporters[eid])
-        tail: Consumer = terminal[0] if len(terminal) == 1 else FanoutConsumer(terminal)
-        chain: list[Processor] = []
-        for pid in reversed(p.get("processors", [])):
-            proc = reg.get(ComponentKind.PROCESSOR, pid).build(
+        signal = pname.split("/", 1)[0]
+        terminal_ids = list(p.get("exporters", []))
+        chain: list[Processor] = [
+            reg.get(ComponentKind.PROCESSOR, pid).build(
                 pid, config.get("processors", {}).get(pid))
+            for pid in p.get("processors", [])]
+        last_name = chain[-1].name if chain else ENTRY_NODE
+        branches: list[Consumer] = []
+        for eid in terminal_ids:
+            cons: Consumer = (g.connectors[eid] if eid in g.connectors
+                              else g.exporters[eid])
+            branches.append(FlowEdge(
+                cons, flow_ledger.edge(pname, last_name, eid, signal,
+                                       balance=False),
+                (pname, eid, signal)))
+        fan: Consumer = branches[0] if len(branches) == 1 \
+            else FanoutConsumer(branches)
+        no_chain = not chain
+        tail: Consumer = FlowEdge(
+            fan, flow_ledger.edge(pname, last_name, OUTPUT_NODE, signal,
+                                  entry=no_chain, output=True),
+            (pname, OUTPUT_NODE, signal))
+        for i in range(len(chain) - 1, -1, -1):
+            proc = chain[i]
             proc.set_consumer(tail)
-            g.processors[(pname, pid)] = proc
-            chain.append(proc)
-            tail = proc
-        g.pipeline_processors[pname] = list(reversed(chain))
+            # drop-attribution site: stable on any thread (timer flushes)
+            proc._flow_site = (pname, proc.name, signal)
+            g.processors[(pname, proc.name)] = proc
+            from_name = chain[i - 1].name if i else ENTRY_NODE
+            tail = FlowEdge(
+                proc, flow_ledger.edge(pname, from_name, proc.name,
+                                       signal, entry=(i == 0)),
+                (pname, proc.name, signal))
+        g.pipeline_processors[pname] = chain
+        flow_ledger.register_pipeline(pname, chain, terminal_ids, signal)
         # self-tracing weave: one pipeline/<name> span per batch at the
         # entry; receivers and connector outputs both route through the
         # entry map, so every ingress edge is covered. Free when the
@@ -285,6 +322,11 @@ def build_graph(config: dict[str, Any],
         recv = reg.get(ComponentKind.RECEIVER, rid).build(rid, rcfg)
         recv.set_consumer(feeds[0] if len(feeds) == 1 else FanoutConsumer(feeds))
         g.receivers[rid] = recv
+
+    # condition rollup over the finished graph (flow ledger, ISSUE 5):
+    # healthcheck/zpages/the Collector all read this one instance so
+    # last-transition history is consistent across surfaces
+    g.flow_health = HealthRollup(g)
 
     # graph-aware extensions (zpages topology, healthcheck component
     # polling) see the finished graph before anything starts

@@ -15,6 +15,7 @@ from typing import Any, Optional
 
 import odigos_tpu.components  # noqa: F401  (registers builtin factories)
 
+from ..selftelemetry.flow import register_rollup, unregister_rollup
 from ..selftelemetry.profiler import start_from_config, stop_started
 from ..utils.telemetry import meter
 from .graph import Graph, build_graph
@@ -40,6 +41,9 @@ class Collector:
             for comp in self.graph.all_components():
                 comp.start()
             self._running = True
+            # surface the graph's condition rollup to graph-less readers
+            # (frontend /api/flow, diagnose) while this collector runs
+            register_rollup(self.graph.flow_health)
             self._telemetry_started = start_from_config(
                 self.config.get("service", {}).get("telemetry"))
         meter.add("odigos_collector_starts_total")
@@ -50,6 +54,7 @@ class Collector:
             if not self._running:
                 return
             self._stop_graph(self.graph)
+            unregister_rollup(self.graph.flow_health)
             stop_started(self._telemetry_started)
             self._telemetry_started = []
             self._running = False
@@ -63,6 +68,11 @@ class Collector:
     # ------------------------------------------------------------- helpers
     def component(self, component_id: str):
         return self.graph.component(component_id)
+
+    def health_conditions(self) -> list[dict]:
+        """Per-component condition list (flow-ledger rollup) — the
+        replacement for polling ``healthy()`` booleans one by one."""
+        return self.graph.flow_health.evaluate()
 
     def drain_receivers(self, timeout: float = 30.0) -> None:
         """Wait for finite receivers (n_batches set) to finish, then flush
@@ -123,6 +133,13 @@ class Collector:
                         comp.start()
                     meter.add("odigos_collector_reload_failures_total")
                     raise
+            # condition continuity across the swap: same-named components
+            # keep their last-transition history (k8s lastTransitionTime
+            # semantics survive a hot reload)
+            new_graph.flow_health.adopt(old_graph.flow_health)
+            if old_running:
+                unregister_rollup(old_graph.flow_health)
+                register_rollup(new_graph.flow_health)
             self.graph, self.config = new_graph, new_config
             if old_running:
                 # re-anchor the telemetry subsystems on the new stanza

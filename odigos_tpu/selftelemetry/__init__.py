@@ -7,6 +7,15 @@ pipeline-graph weave; the ``selftelemetry`` receiver factory
 configured pipeline as ordinary pdata.
 """
 
+from .flow import (  # noqa: F401
+    DROP_REASONS,
+    FlowContext,
+    FlowEdge,
+    FlowLedger,
+    HealthRollup,
+    active_conditions,
+    flow_ledger,
+)
 from .instrument import TracedEntry, trace_pipeline_entry  # noqa: F401
 from .profiler import (  # noqa: F401
     ContinuousProfiler,

@@ -54,6 +54,7 @@ from ..features.featurizer import (
     FeaturizerConfig, SpanFeatures, assemble_sequences, featurize,
     pack_sequences)
 from ..pdata.spans import SpanBatch
+from ..selftelemetry.flow import FlowContext
 from ..selftelemetry.profiler import engines as _engine_registry
 from ..selftelemetry.tracer import (
     NULL_SPAN, is_selftelemetry_batch, tracer)
@@ -569,6 +570,10 @@ class ScoringEngine:
                 break
             req.scores = None
             req.done.set()
+            FlowContext.drop(len(req.batch), "shutdown_drain",
+                             pipeline="(engine)",
+                             component_name=f"engine/{self.cfg.model}",
+                             signal="requests")
 
     # ------------------------------------------------------------- scoring
     def submit(self, batch: SpanBatch,
@@ -579,6 +584,13 @@ class ScoringEngine:
             # shutting down: the worker is draining; new work would race
             # the lossless-drain guarantee
             meter.add(QUEUE_FULL_METRIC)
+            # a shed score REQUEST, not a span loss: the batch passes
+            # through unscored, so this rides the "requests" signal in
+            # the ledger (never a pipeline conservation term)
+            FlowContext.drop(len(batch), "shutdown_drain",
+                             pipeline="(engine)",
+                             component_name=f"engine/{self.cfg.model}",
+                             signal="requests")
             return None
         if features is None and getattr(self.backend, "needs_features", True):
             # a remote backend ships the raw batch and the sidecar
@@ -591,7 +603,13 @@ class ScoringEngine:
             self._queue.put_nowait(req)
         except queue.Full:
             meter.add(QUEUE_FULL_METRIC)
+            FlowContext.drop(len(batch), "queue_full",
+                             pipeline="(engine)",
+                             component_name=f"engine/{self.cfg.model}",
+                             signal="requests")
             return None
+        FlowContext.watermark(f"engine/{self.cfg.model}", "queue_depth",
+                              self._queue.qsize())
         return req
 
     def score_sync(self, batch: SpanBatch,

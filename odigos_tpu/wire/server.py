@@ -136,6 +136,15 @@ class WireReceiver(Receiver):
                                 meter.add(
                                     "odigos_receiver_malformed_frames_total"
                                     f"{{receiver={receiver.name}}}")
+                                # pre-pipeline shed, named in the flow
+                                # ledger (item count unknowable pre-
+                                # decode: one frame)
+                                from ..selftelemetry.flow import FlowContext
+
+                                FlowContext.drop(
+                                    1, "invalid", pipeline="(ingress)",
+                                    component_name=receiver.name,
+                                    signal="frames")
                                 sock.sendall(MALFORMED)
                                 continue
                             try:

