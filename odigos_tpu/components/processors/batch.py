@@ -31,13 +31,21 @@ class BatchProcessor(Processor):
         self.send_batch_size = int(config.get("send_batch_size", 8192))
         self.send_batch_max_size = int(config.get("send_batch_max_size", 0))
         self.timeout_s = float(config.get("timeout_s", 0.2))
+        self._wm_name: str | None = None
+
+    def _watermark_name(self) -> str:
+        # resolved lazily: the graph stamps _flow_site after construction
+        name = self._wm_name
+        if name is None:
+            name = self._wm_name = FlowContext.watermark_name(self)
+        return name
 
     def consume(self, batch: SpanBatch) -> None:
         to_send: list[SpanBatch] = []
         with self._lock:
             self._pending.append(batch)
             self._pending_spans += len(batch)
-            FlowContext.watermark(self.name, "pending_spans",
+            FlowContext.watermark(self._watermark_name(), "pending_spans",
                                   self._pending_spans)
             if self._pending_spans >= self.send_batch_size:
                 to_send = self._take_locked()
@@ -52,6 +60,9 @@ class BatchProcessor(Processor):
         taken = self._pending
         self._pending = []
         self._pending_spans = 0
+        # reset the CURRENT watermark reading: admission gates watch it
+        # live, and a stale pre-flush peak would keep shedding upstream
+        FlowContext.watermark(self._watermark_name(), "pending_spans", 0)
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None

@@ -60,6 +60,14 @@ class MemoryLimiterProcessor(Processor):
         # unlabeled name stays as an alias (the HPA custom-metric path
         # keys on it verbatim).
         self._rejections_key: str | None = None
+        self._wm_name: str | None = None
+
+    def _watermark_name(self) -> str:
+        # resolved lazily: the graph stamps _flow_site after construction
+        name = self._wm_name
+        if name is None:
+            name = self._wm_name = FlowContext.watermark_name(self)
+        return name
 
     def consume(self, batch: SpanBatch) -> None:
         size = batch_nbytes(batch)
@@ -85,8 +93,8 @@ class MemoryLimiterProcessor(Processor):
                 raise err
             soft_exceeded = self._inflight + size > self.soft_bytes
             self._inflight += size
-            FlowContext.watermark(self.name, "inflight_bytes",
-                                  self._inflight)
+            FlowContext.watermark(self._watermark_name(),
+                                  "inflight_bytes", self._inflight)
         if soft_exceeded:
             gc.collect(0)
         try:
@@ -94,6 +102,11 @@ class MemoryLimiterProcessor(Processor):
         finally:
             with self._lock:
                 self._inflight -= size
+                # keep the CURRENT reading fresh for watermark-driven
+                # admission: a stale peak would shed at the socket long
+                # after the pressure passed
+                FlowContext.watermark(self._watermark_name(),
+                                      "inflight_bytes", self._inflight)
 
 
 register(Factory(

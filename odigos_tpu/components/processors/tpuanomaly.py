@@ -17,17 +17,19 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-import numpy as np
-
 from ...features.featurizer import FeaturizerConfig
 from ...pdata.spans import SpanBatch
 from ...serving.engine import EngineConfig, ScoringEngine
-from ...utils.telemetry import meter
+
+# tagging lives in serving/fastpath.py so the ingest fast path and this
+# processor share ONE implementation (bit-identical output is the parity
+# contract); the historic import locations keep working via these names
+from ...serving.fastpath import (
+    FLAG_ATTR, FLAGGED_METRIC, SCORE_ATTR, tag_anomalies)
 from ..api import Capabilities, ComponentKind, Factory, Processor, register
 
-SCORE_ATTR = "odigos.anomaly.score"
-FLAG_ATTR = "odigos.anomaly"
-FLAGGED_METRIC = "odigos_anomaly_flagged_spans_total"
+__all__ = ["TpuAnomalyProcessor", "SCORE_ATTR", "FLAG_ATTR",
+           "FLAGGED_METRIC", "tag_anomalies"]
 
 # engines shared across processor instances (one TPU sidecar per collector,
 # like the reference's one gateway-adjacent model server), keyed by config
@@ -137,15 +139,7 @@ class TpuAnomalyProcessor(Processor):
                                         timeout_s=self.timeout_s)
         if scores is None:  # timeout / queue full: pass through untagged
             return batch
-        mask = scores >= self.threshold
-        n_flagged = int(mask.sum())
-        if n_flagged == 0:
-            return batch
-        meter.add(FLAGGED_METRIC, n_flagged)
-        return batch.with_span_attrs({
-            SCORE_ATTR: np.round(scores[mask], 4).tolist(),
-            FLAG_ATTR: [True] * n_flagged,
-        }, mask)
+        return tag_anomalies(batch, scores, self.threshold)
 
 
 register(Factory(

@@ -104,6 +104,11 @@ class AnomalyStageConfiguration:
     timeout_ms: float = 5.0  # pass-through-on-timeout budget (<5ms p99)
     route_to_stream: str = "anomalies"
     devices: int = 1  # data-parallel chips for the scoring sidecar
+    # ingest fast path (ISSUE 6): wire frames featurize once at the
+    # receiver and score through the engine's deadline-based adaptive
+    # coalescer, bypassing the componentwise batch/score seams; the
+    # scoring timeout doubles as the per-frame admission deadline
+    fast_path: bool = False
 
 
 @dataclass

@@ -1,6 +1,7 @@
 from .featurizer import (
     FeaturizerConfig,
     PackedSequences,
+    pack_arrays,
     pack_sequences,
     SpanFeatures,
     TraceSequences,
@@ -13,6 +14,7 @@ from .featurizer import (
 __all__ = [
     "FeaturizerConfig",
     "PackedSequences",
+    "pack_arrays",
     "pack_sequences",
     "SpanFeatures",
     "TraceSequences",

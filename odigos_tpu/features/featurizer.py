@@ -82,11 +82,26 @@ def _stable_hash(s: str) -> int:
                           "little")
 
 
+@lru_cache(maxsize=32)
 def _hash_table(strings: tuple[str, ...], vocab: int) -> np.ndarray:
-    """Hash every string-table entry into [1, vocab) (0 = unknown)."""
+    """Hash every string-table entry into [1, vocab) (0 = unknown).
+
+    Memoized per (interned string tuple, vocab): slices/filters share
+    their parent's ``strings`` tuple and wire senders re-ship the same
+    pools, so repeated featurizations of one pool hash its table exactly
+    once (tuples hash by value — a re-decoded frame with an identical
+    table hits too). The cached array is frozen; callers only gather
+    from it. Unlike ``_attr_slot_matrix`` (keyed on the store object,
+    freed with the batch) this is a value-keyed LRU that PINS its key
+    tuples, and high-cardinality traffic never hits — so maxsize stays
+    tiny: 32 entries × a ~4k-string table is ~10 MB worst case, while a
+    steady sender set re-shipping a handful of pools (× two vocabs
+    each) still hits every frame.
+    """
     out = np.empty(max(len(strings), 1), dtype=np.int32)
     for i, s in enumerate(strings):
         out[i] = 1 + _stable_hash(s) % (vocab - 1)
+    out.flags.writeable = False
     return out
 
 
@@ -395,10 +410,28 @@ def pack_sequences(batch: SpanBatch,
     so pack time directly bounds pipeline throughput.
     """
     features = features if features is not None else featurize(batch, config)
-    n = len(batch)
-    # featurize() returns correctly-shaped (0, C) arrays even when empty
-    C = features.categorical.shape[1]
-    D = features.continuous.shape[1]
+    return pack_arrays(
+        batch.col("trace_id_hi"), batch.col("trace_id_lo"),
+        batch.col("start_unix_nano"), features.categorical,
+        features.continuous, max_len=max_len, pad_rows_to=pad_rows_to)
+
+
+def pack_arrays(trace_id_hi: np.ndarray, trace_id_lo: np.ndarray,
+                start_unix_nano: np.ndarray, categorical: np.ndarray,
+                continuous: np.ndarray, *, max_len: int = 64,
+                pad_rows_to: RowBucket = None) -> PackedSequences:
+    """``pack_sequences`` over bare columns — the ingest fast path's seam.
+
+    A coalesced scoring call only needs three id/time columns plus the
+    (already concatenated) feature tensors; taking them directly means a
+    group of wire frames packs without materializing a merged SpanBatch
+    (no string-table re-interning, no attr-store merge, no copy of the
+    other dozen columns). Bitwise identical to ``pack_sequences`` on the
+    equivalent concatenated batch.
+    """
+    n = int(categorical.shape[0])
+    C = categorical.shape[1]
+    D = continuous.shape[1]
     if n == 0:
         R = _bucket_rows(0, pad_rows_to) if callable(pad_rows_to) \
             else (pad_rows_to or 0)
@@ -412,9 +445,9 @@ def pack_sequences(batch: SpanBatch,
     # one integer lexsort groups spans by trace and time-orders them; a
     # structured-dtype np.unique here costs ~3 ms at 8k spans (generic
     # compares), which alone would blow the <5 ms serving budget
-    hi = batch.col("trace_id_hi")
-    lo = batch.col("trace_id_lo")
-    order = np.lexsort((batch.col("start_unix_nano"), lo, hi))
+    hi = trace_id_hi
+    lo = trace_id_lo
+    order = np.lexsort((start_unix_nano, lo, hi))
     hi_s = hi[order]
     lo_s = lo[order]
     new_trace = np.empty(n, bool)
@@ -489,8 +522,8 @@ def pack_sequences(batch: SpanBatch,
 
     span_row = seg_row[span_seg]
     span_col = seg_off[span_seg] + pos_in_chunk
-    cat[span_row, span_col] = features.categorical[order]
-    cont[span_row, span_col] = features.continuous[order]
+    cat[span_row, span_col] = categorical[order]
+    cont[span_row, span_col] = continuous[order]
     segments[span_row, span_col] = seg_slot[span_seg]
     positions[span_row, span_col] = pos_in_chunk
     span_index[span_row, span_col] = order

@@ -30,11 +30,21 @@ from . import jitstats
 # see models/transformer.py: every jitted scoring entry point declares its
 # recompile-bounding strategy (asserted by the package hygiene test)
 SHAPE_BUCKETING = {
-    "update_kernel": "state tables fixed at (n_groups,); the span axis is "
-                     "unbucketed — elementwise VPU kernels compile in "
-                     "milliseconds and batch sizes are bounded upstream by "
-                     "the batch processor's fixed send_batch_size",
-    "score_kernel": "same as update_kernel (shared (G,) state geometry)",
+    "update_kernel": "state tables fixed at (n_groups,); the span axis of "
+                     "the stateful update()/score() path is padded to "
+                     "geometric buckets (span_bucket, 2x, 4x, ...) with a "
+                     "weight mask so the engine's adaptive coalescer — "
+                     "which emits deadline-sized, variable span counts — "
+                     "compiles O(log max_batch) kernels, not one per size. "
+                     "The functional *_fn forms stay exact-shape (tests "
+                     "and fixed-size callers)",
+    "update_masked_kernel": "the weighted form behind the padded path "
+                            "(weights zero out pad rows in every "
+                            "segment_sum, so padding never perturbs "
+                            "the streaming state)",
+    "score_kernel": "same bucketing via update_kernel's pad-and-slice "
+                    "(shared (G,) state geometry; pad rows score garbage "
+                    "that is sliced off before returning)",
 }
 
 
@@ -88,9 +98,35 @@ def _score_kernel(state: ZScoreState, categorical: jax.Array,
     return jnp.where(count >= min_count, z, 0.0)
 
 
+@partial(jax.jit, static_argnames=("n_groups",))
+def _update_masked_kernel(state: ZScoreState, categorical: jax.Array,
+                          log_dur: jax.Array, weights: jax.Array,
+                          n_groups: int) -> ZScoreState:
+    """The weighted Welford merge behind span-axis bucketing: pad rows
+    carry weight 0, so every segment_sum term they touch contributes
+    exactly +0.0 — the merged state is identical to the unpadded
+    kernel's on the real rows."""
+    gid = _group_ids(categorical, n_groups)
+    b_count = jax.ops.segment_sum(weights, gid, num_segments=n_groups)
+    b_sum = jax.ops.segment_sum(weights * log_dur, gid,
+                                num_segments=n_groups)
+    safe = jnp.maximum(b_count, 1.0)
+    b_mean = b_sum / safe
+    b_m2 = jax.ops.segment_sum(weights * (log_dur - b_mean[gid]) ** 2,
+                               gid, num_segments=n_groups)
+    n_a, n_b = state.count, b_count
+    n_ab = n_a + n_b
+    safe_ab = jnp.maximum(n_ab, 1.0)
+    delta = b_mean - state.mean
+    mean_ab = state.mean + delta * (n_b / safe_ab)
+    m2_ab = state.m2 + b_m2 + delta**2 * (n_a * n_b / safe_ab)
+    return ZScoreState(count=n_ab, mean=mean_ab, m2=m2_ab)
+
+
 # compile accounting for the module-level jitted kernels (ISSUE 3
 # device-runtime telemetry: jit cache size per site)
 jitstats.track_jit("zscore.update", _update_kernel)
+jitstats.track_jit("zscore.update_masked", _update_masked_kernel)
 jitstats.track_jit("zscore.score", _score_kernel)
 
 
@@ -105,6 +141,13 @@ class ZScoreDetector:
 
     n_groups: int = 8192
     min_count: int = 32
+    # span-axis shape bucket for the stateful update()/score() path:
+    # inputs pad up to span_bucket, 2x, 4x, ... (0 = exact shapes). The
+    # serving engine's adaptive coalescer emits deadline-sized batches of
+    # near-arbitrary span counts; without bucketing every novel count
+    # pays an XLA compile on the hot path (measured ~1.2 s per 64k-span
+    # shape on CPU — the soak-tail pathology this bound removes)
+    span_bucket: int = 4096
 
     def __post_init__(self) -> None:
         self.state = self.init()
@@ -123,13 +166,78 @@ class ZScoreDetector:
         return _score_kernel(state, categorical, log_dur, self.n_groups,
                              self.min_count)
 
+    def _bucket_rows(self, n: int) -> int:
+        """Geometric span bucket ≥ n: O(log max_batch) distinct shapes."""
+        b = self.span_bucket
+        while b < n:
+            b <<= 1
+        return b
+
+    def warm(self, max_spans: int, cat_width: int) -> None:
+        """Compile every span bucket up to ``max_spans`` ahead of
+        serving. The masked update runs with all-zero weights, so every
+        merge term contributes exactly +0.0 — warming is a pure compile,
+        bit-safe on live state (the engine's adaptive coalescer will hit
+        these shapes mid-stream otherwise, each a worker-stalling XLA
+        compile). Warms ONE bucket past ``max_spans``: the engine's
+        coalescer checks its cap before appending a request, so a group
+        can end up to one request over it — that overshoot must land on
+        a warmed shape too. (A SINGLE request larger than ``max_spans``
+        can still exceed the warmed set — but such a batch pays its
+        compile on the componentwise path identically; the wire
+        receiver's byte budget bounds frame size in practice.)"""
+        if not self.span_bucket:
+            return
+        b = self.span_bucket
+        past = False
+        while True:
+            cat = jnp.zeros((b, cat_width), jnp.int32)
+            ld = jnp.zeros(b, jnp.float32)
+            state = _update_masked_kernel(self.state, cat, ld,
+                                          jnp.zeros(b, jnp.float32),
+                                          self.n_groups)
+            np.asarray(state.count)  # block: compile finished
+            np.asarray(self.score_fn(self.state, cat, ld))
+            if past:
+                return
+            past = b >= max_spans
+            b <<= 1
+
     # -- stateful convenience over SpanFeatures
     def update(self, features: SpanFeatures) -> None:
-        self.state = self.update_fn(
-            self.state, jnp.asarray(features.categorical),
-            jnp.asarray(features.continuous[:, 0]))
+        cat = features.categorical
+        log_dur = features.continuous[:, 0]
+        n = cat.shape[0]
+        if not self.span_bucket or n == 0:
+            self.state = self.update_fn(self.state, jnp.asarray(cat),
+                                        jnp.asarray(log_dur))
+            return
+        b = self._bucket_rows(n)
+        pad = b - n
+        if pad:
+            cat = np.concatenate(
+                [cat, np.zeros((pad, cat.shape[1]), cat.dtype)])
+            log_dur = np.concatenate(
+                [log_dur, np.zeros(pad, log_dur.dtype)])
+        weights = np.zeros(b, np.float32)
+        weights[:n] = 1.0
+        self.state = _update_masked_kernel(
+            self.state, jnp.asarray(cat), jnp.asarray(log_dur),
+            jnp.asarray(weights), self.n_groups)
 
     def score(self, features: SpanFeatures) -> np.ndarray:
-        z = self.score_fn(self.state, jnp.asarray(features.categorical),
-                          jnp.asarray(features.continuous[:, 0]))
-        return np.asarray(z)
+        cat = features.categorical
+        log_dur = features.continuous[:, 0]
+        n = cat.shape[0]
+        if self.span_bucket and n:
+            pad = self._bucket_rows(n) - n
+            if pad:
+                # pad rows score garbage against group 0's state and are
+                # sliced off — the state is never touched by score()
+                cat = np.concatenate(
+                    [cat, np.zeros((pad, cat.shape[1]), cat.dtype)])
+                log_dur = np.concatenate(
+                    [log_dur, np.zeros(pad, log_dur.dtype)])
+        z = self.score_fn(self.state, jnp.asarray(cat),
+                          jnp.asarray(log_dur))
+        return np.asarray(z)[:n]

@@ -58,6 +58,11 @@ class Graph:
     extensions: dict[str, "Component"] = field(default_factory=dict)
     # (pipeline, id) -> processor instance
     processors: dict[tuple[str, str], Processor] = field(default_factory=dict)
+    # pipeline -> IngestFastPath route (pipelines that set fast_path):
+    # the receiver-facing entry that featurizes decoded frames once and
+    # scores them through the engine's adaptive coalescer, bypassing the
+    # componentwise memory_limiter -> batch -> tpuanomaly seams
+    fastpaths: dict[str, Any] = field(default_factory=dict)
     pipeline_entries: dict[str, Consumer] = field(default_factory=dict)
     # pipelines in topological order (upstream before downstream via connectors)
     pipeline_order: list[str] = field(default_factory=list)
@@ -70,11 +75,13 @@ class Graph:
 
     def all_components(self) -> list[Component]:
         # extensions first: healthcheck must be able to answer before any
-        # data flows (upstream starts extensions ahead of pipelines)
+        # data flows (upstream starts extensions ahead of pipelines);
+        # fast paths start after their downstream chain, before receivers
         return (list(self.extensions.values())
                 + list(self.exporters.values())
                 + list(self.connectors.values())
                 + list(self.processors.values())
+                + list(self.fastpaths.values())
                 + list(self.receivers.values()))
 
     def processors_topological(self) -> list[Processor]:
@@ -95,6 +102,9 @@ class Graph:
         for (_, cid), proc in self.processors.items():
             if cid == component_id:
                 return proc
+        for fp in self.fastpaths.values():
+            if fp.name == component_id:
+                return fp
         raise KeyError(component_id)
 
 
@@ -125,6 +135,33 @@ def validate_config(config: dict[str, Any]) -> list[str]:
         for eid in p.get("exporters", []):
             if eid not in declared[ComponentKind.EXPORTER] and eid not in conn_ids:
                 problems.append(f"pipeline {pname}: unknown exporter {eid}")
+        if p.get("fast_path"):
+            pids = [pid.split("/", 1)[0] for pid in p.get("processors", [])]
+            if "tpuanomaly" not in pids:
+                # the fast path reuses the pipeline's scoring engine +
+                # threshold; without a tpuanomaly stage there is nothing
+                # to route around — fail loudly, never silently slow-path
+                problems.append(
+                    f"pipeline {pname}: fast_path requires a tpuanomaly "
+                    f"processor in the chain")
+            else:
+                # the route enters at the scorer and forwards through its
+                # out-edge: stages BEFORE tpuanomaly are bypassed. Only
+                # the two whose jobs the fast path itself replaces
+                # (admission, coalescing) may sit there — anything else
+                # (resource stamping, sampling, transforms) would
+                # silently stop applying to wire traffic
+                bypassable = {"memory_limiter", "batch"}
+                skipped = [pid for pid in
+                           pids[:pids.index("tpuanomaly")]
+                           if pid not in bypassable]
+                if skipped:
+                    problems.append(
+                        f"pipeline {pname}: fast_path would bypass "
+                        f"processors {skipped} ahead of tpuanomaly — "
+                        f"move them after the scorer (only "
+                        f"memory_limiter/batch are replaced by the "
+                        f"fast path)")
 
     # authenticator references must resolve to a defined+enabled extension
     # (the collector fails startup on a dangling authenticator; an auth'd
@@ -295,12 +332,51 @@ def build_graph(config: dict[str, Any],
                                        signal, entry=(i == 0)),
                 (pname, proc.name, signal))
         g.pipeline_processors[pname] = chain
-        flow_ledger.register_pipeline(pname, chain, terminal_ids, signal)
+        # ingest fast path (ISSUE 6): replace the pipeline entry with a
+        # route that featurizes each decoded frame once and scores it
+        # through the engine's deadline-sized adaptive coalescer. The
+        # componentwise chain stays built (hot reloads, direct feeds);
+        # conservation holds because the fast path gets its own entry
+        # edge and forwards through the scoring stage's existing out-edge
+        # (stage seams it skips simply record zero traffic).
+        entry: Consumer = tail
+        reg_procs: list = list(chain)
+        fp_cfg = p.get("fast_path")
+        if fp_cfg:
+            from ..serving.fastpath import IngestFastPath
+
+            scorer = next(
+                (proc for proc in chain
+                 if getattr(proc, "engine", None) is not None
+                 and hasattr(proc, "threshold")), None)
+            if scorer is None:
+                # validate_config guards the normal build path by id
+                # prefix; a registry substituting a non-scoring
+                # 'tpuanomaly' type would otherwise die in a bare
+                # StopIteration with no mention of fast_path
+                raise ValueError(
+                    f"pipeline {pname}: fast_path requires a scoring "
+                    f"processor (engine + threshold) in the chain")
+            cfg = dict(fp_cfg) if isinstance(fp_cfg, dict) else {}
+            # default deadline = the scoring stage's own latency budget
+            cfg.setdefault("deadline_ms", scorer.timeout_s * 1e3)
+            fp = IngestFastPath(pname, scorer.engine, scorer.threshold,
+                                downstream=scorer.next_consumer,
+                                config=cfg)
+            fp._flow_site = (pname, fp.name, signal)
+            g.fastpaths[pname] = fp
+            reg_procs.append(fp)
+            entry = FlowEdge(
+                fp, flow_ledger.edge(pname, ENTRY_NODE, fp.name, signal,
+                                     entry=True),
+                (pname, fp.name, signal))
+        flow_ledger.register_pipeline(pname, reg_procs, terminal_ids,
+                                      signal)
         # self-tracing weave: one pipeline/<name> span per batch at the
         # entry; receivers and connector outputs both route through the
         # entry map, so every ingress edge is covered. Free when the
         # tracer is disabled (TracedEntry's fast path).
-        g.pipeline_entries[pname] = trace_pipeline_entry(pname, tail)
+        g.pipeline_entries[pname] = trace_pipeline_entry(pname, entry)
     g.pipeline_order = _topological_pipelines(pipelines)
 
     # 3. connector outputs: downstream pipeline name -> entry consumer

@@ -81,6 +81,10 @@ class Collector:
             drain = getattr(recv, "drain", None)
             if drain is not None:
                 drain(timeout)
+        # fast-path windows drain after intake stops: everything
+        # submitted must forward downstream before processors flush
+        for fp in self.graph.fastpaths.values():
+            fp.drain(timeout)
         for proc in self.graph.processors_topological():
             flush = getattr(proc, "flush", None)
             if flush is not None:
@@ -93,6 +97,10 @@ class Collector:
         connectors and exporters."""
         for recv in graph.receivers.values():
             recv.shutdown()
+        # fast paths next: their shutdown drains the pending window into
+        # the (still running) downstream chain losslessly
+        for fp in graph.fastpaths.values():
+            fp.shutdown()
         for proc in graph.processors_topological():
             proc.shutdown()
         for conn in graph.connectors.values():

@@ -309,6 +309,28 @@ def build_gateway_config(
             "processors": procs,
             "exporters": exporters,
         }
+        if sig == Signal.TRACES and anomaly_on \
+                and getattr(anomaly, "fast_path", False):
+            # ingest fast path: decoded wire frames featurize once and
+            # ride the engine's deadline-based adaptive coalescer; the
+            # scoring timeout doubles as the admission deadline. The
+            # route enters at the scorer, so tpuanomaly moves up right
+            # behind memory_limiter (the one stage the fast path
+            # replaces) — version stamping and compiled Actions keep
+            # applying on the scorer's out-edge instead of being
+            # silently bypassed (graph.validate_config enforces this
+            # ordering for every fast_path pipeline)
+            root = config["service"]["pipelines"][root_pipeline_name(sig)]
+            root["fast_path"] = {"deadline_ms": anomaly.timeout_ms}
+            root["processors"] = (
+                ["memory_limiter", "tpuanomaly"]
+                + [pid for pid in root["processors"]
+                   if pid not in ("memory_limiter", "tpuanomaly")])
+            # deadline-sized coalescing emits variable shapes: every
+            # scoring bucket must precompile at start or the first
+            # traffic at each size pays a worker-stalling XLA compile
+            # while the admission gate sheds the resulting backlog
+            config["processors"]["tpuanomaly"]["warm_ladder"] = True
 
     # --- servicegraph (:231): root traces pipeline also feeds the
     # servicegraph connector; its metrics surface on a dedicated pipeline.

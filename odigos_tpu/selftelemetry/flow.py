@@ -310,6 +310,16 @@ class FlowLedger:
                 if value > wm[1]:
                     wm[1] = value
 
+    def watermark_current(self, component: str,
+                          queue: str) -> Optional[float]:
+        """Latest reported value of one queue watermark (None = never
+        reported). The wire receiver's admission gate polls this on the
+        pre-decode path, so it is a single dict lookup — never a
+        snapshot."""
+        with self._lock:
+            wm = self._watermarks.get((component, queue))
+            return wm[0] if wm is not None else None
+
     # ----------------------------------------------------- aggregation
 
     def snapshot(self) -> dict[str, Any]:
@@ -514,6 +524,20 @@ class FlowContext:
     @staticmethod
     def watermark(component: str, queue: str, value: float) -> None:
         flow_ledger.watermark(component, queue, value)
+
+    @staticmethod
+    def watermark_name(component: Any) -> str:
+        """Pipeline-qualified watermark identity for a graph component:
+        ``<pipeline>/<id>`` from the graph-stamped ``_flow_site``, bare
+        id before stamping. Admission gates read watermark values LIVE,
+        so two pipelines' same-named stages must never share a key
+        (last-writer-wins would let a quiet stage mask a saturated
+        one). One derivation for every producer — batch, memory
+        limiter, future buffering stages — so the gate's config keys
+        cannot drift from the reported names."""
+        site = getattr(component, "_flow_site", None)
+        name = getattr(component, "name", "(unknown)")
+        return f"{site[0]}/{name}" if site else name
 
 
 class FlowEdge:

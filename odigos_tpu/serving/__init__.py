@@ -1,5 +1,7 @@
 from .engine import BucketLadder, ScoringEngine, EngineConfig, ScoreRequest
+from .fastpath import FastPathSaturated, IngestFastPath, tag_anomalies
 from .sidecar import RemoteBackend, SidecarClient, SidecarServer
 
 __all__ = ["BucketLadder", "ScoringEngine", "EngineConfig", "ScoreRequest",
+           "FastPathSaturated", "IngestFastPath", "tag_anomalies",
            "RemoteBackend", "SidecarClient", "SidecarServer"]

@@ -58,7 +58,7 @@ from ..selftelemetry.flow import FlowContext
 from ..selftelemetry.profiler import engines as _engine_registry
 from ..selftelemetry.tracer import (
     NULL_SPAN, is_selftelemetry_batch, tracer)
-from ..utils.telemetry import meter
+from ..utils.telemetry import labeled_key, meter
 
 
 def _record_compile_seconds(site: str, seconds: float) -> None:
@@ -81,6 +81,12 @@ DEVICE_BUSY_GAUGE = "odigos_anomaly_device_busy_frac"
 STAGE_PACK_METRIC = "odigos_anomaly_stage_pack_ms"
 STAGE_DEVICE_METRIC = "odigos_anomaly_stage_device_ms"
 STAGE_HARVEST_METRIC = "odigos_anomaly_stage_harvest_ms"
+ADAPTIVE_CAP_GAUGE = "odigos_engine_adaptive_cap_spans"
+
+# EWMA smoothing of the per-span device-step cost estimate; 0.2 follows
+# load shifts within ~5 calls without letting one outlier call resize
+# the next batch
+_ADAPT_ALPHA = 0.2
 
 
 @dataclass(frozen=True)
@@ -172,6 +178,21 @@ class BucketLadder:
                 self._compiled.popitem(last=False)
         return hit
 
+    def floor_rows(self, rows: float) -> int:
+        """Largest padded row count ≤ ``rows`` that ``round_rows`` could
+        emit (the smallest bucket when nothing fits): the adaptive
+        coalescer sizes deadline-bounded batches DOWN onto shapes the
+        ladder serves, never up into a recompile. Beyond the top bucket
+        that is a multiple of it, mirroring ``round_rows``."""
+        top = self.buckets[-1]
+        if rows >= top:
+            return (int(rows) // top) * top
+        best = self.buckets[0]
+        for b in self.buckets:
+            if b <= rows:
+                best = b
+        return best
+
     def stats(self) -> dict[str, Any]:
         total = self.hits + self.misses
         return {
@@ -200,6 +221,10 @@ class ZScoreBackend:
     # no async dispatch: score-then-update must stay ordered per device
     # call, so the engine clamps this backend to pipeline depth 1
 
+    # column-only coalescing (ingest fast path): scoring reads features
+    # exclusively, so a coalesced group never needs a merged SpanBatch
+    coalesce_columns: tuple = ()
+
     def __init__(self, cfg: EngineConfig):
         from ..models.zscore import ZScoreDetector
 
@@ -216,6 +241,17 @@ class ZScoreBackend:
         # map |z| to (0, 1): 1 - exp(-z/4) puts z=3 ≈ 0.53, z=8 ≈ 0.86
         return (1.0 - np.exp(-z / 4.0)).astype(np.float32)
 
+    def warm(self) -> None:
+        """``warm_ladder`` analogue: precompile every span-bucket shape
+        the adaptive coalescer can emit (state-safe — zero-weighted
+        updates merge nothing), so a deadline-sized batch never pays a
+        mid-stream XLA compile."""
+        t0 = time.monotonic()
+        self.det.warm(self.cfg.max_batch_spans,
+                      self.cfg.featurizer.cat_width)
+        _record_compile_seconds("zscore.update_masked",
+                                time.monotonic() - t0)
+
     def warmup(self, batch: SpanBatch) -> None:
         self.det.update(featurize(batch, self.cfg.featurizer))
 
@@ -230,6 +266,13 @@ class SequenceBackend:
     the blocking ``np.asarray`` fetch happen at harvest, against the
     *previous* in-flight call's result).
     """
+
+    # column-only coalescing (ingest fast path): when every request in a
+    # group carries precomputed features, packing/assembly reads just the
+    # trace ids and start times — a _ColumnBatch view over the group skips
+    # the merged batch's string re-interning and attr-store merge entirely
+    coalesce_columns: tuple = ("trace_id_hi", "trace_id_lo",
+                               "start_unix_nano")
 
     def __init__(self, cfg: EngineConfig):
         import jax
@@ -444,6 +487,36 @@ _BACKENDS = {
 }
 
 
+class _ColumnBatch:
+    """Columns-only stand-in for a concatenated SpanBatch.
+
+    A coalesced device call with precomputed features touches a handful
+    of numeric columns (trace grouping + packing); concatenating those
+    lazily keeps the pack seam zero-copy with respect to everything else
+    a full ``concat_batches`` would re-materialize per call (string
+    tables re-interned span-by-span, attr pools merged, every other
+    column copied). Only handed to backends that declare
+    ``coalesce_columns``.
+    """
+
+    __slots__ = ("_batches", "_cols", "_n")
+
+    def __init__(self, batches: list[SpanBatch]):
+        self._batches = batches
+        self._cols: dict[str, np.ndarray] = {}
+        self._n = sum(len(b) for b in batches)
+
+    def col(self, name: str) -> np.ndarray:
+        arr = self._cols.get(name)
+        if arr is None:
+            arr = self._cols[name] = np.concatenate(
+                [b.col(name) for b in self._batches])
+        return arr
+
+    def __len__(self) -> int:
+        return self._n
+
+
 @dataclass
 class ScoreRequest:
     batch: SpanBatch
@@ -451,6 +524,10 @@ class ScoreRequest:
     done: threading.Event = field(default_factory=threading.Event)
     scores: Optional[np.ndarray] = None
     submitted_ns: int = 0
+    # admission deadline (monotonic ns): the pack stage sizes the
+    # coalesced call so the harvest lands inside it (adaptive batching);
+    # None = legacy fixed coalescing up to max_batch_spans
+    deadline_ns: Optional[int] = None
 
 
 @dataclass
@@ -528,6 +605,22 @@ class ScoringEngine:
         # deque (int store is atomic) so the device-runtime collector can
         # sample it without touching worker state
         self._inflight_count = 0
+        # deadline-based adaptive batching: observed device-step cost
+        # sizes the next coalesced call so harvest lands inside the
+        # oldest request's deadline; the ladder keeps the resulting row
+        # counts on precompiled shapes. The per-span rate is a RATIO OF
+        # AVERAGES (EWMA of call ms over EWMA of call spans): device
+        # calls carry a fixed dispatch cost, so averaging per-call
+        # ratios would let one small call (warmup, a lone probe) read as
+        # a catastrophic per-span cost and collapse the cap. None until
+        # the first call retires — no estimate means no adaptive cap.
+        self._ewma_call_ms: Optional[float] = None
+        self._ewma_call_spans: Optional[float] = None
+        self._ewma_spans_per_row: Optional[float] = None
+        self._ewma_harvest_ms = 0.0
+        self._last_adaptive_cap: Optional[int] = None
+        self._adaptive_gauge_key = labeled_key(
+            ADAPTIVE_CAP_GAUGE, model=self.cfg.model)
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "ScoringEngine":
@@ -577,9 +670,13 @@ class ScoringEngine:
 
     # ------------------------------------------------------------- scoring
     def submit(self, batch: SpanBatch,
-               features: Optional[SpanFeatures] = None) -> Optional[ScoreRequest]:
+               features: Optional[SpanFeatures] = None,
+               deadline_ns: Optional[int] = None) -> Optional[ScoreRequest]:
         """Enqueue for scoring; returns None (and counts) if queue is full
-        or the engine is draining for shutdown."""
+        or the engine is draining for shutdown. ``deadline_ns`` (monotonic)
+        opts the request into deadline-based adaptive batching: the pack
+        stage caps the coalesced call so its harvest lands inside the
+        earliest deadline instead of letting batch growth blow p99."""
         if self._stop.is_set():
             # shutting down: the worker is draining; new work would race
             # the lossless-drain guarantee
@@ -598,7 +695,8 @@ class ScoringEngine:
             # host cost twice against the latency budget
             features = featurize(batch, self.cfg.featurizer)
         req = ScoreRequest(batch=batch, features=features,
-                           submitted_ns=time.monotonic_ns())
+                           submitted_ns=time.monotonic_ns(),
+                           deadline_ns=deadline_ns)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -690,6 +788,12 @@ class ScoringEngine:
         ladder = getattr(self.backend, "ladder", None)
         if ladder is not None:
             out["bucket_ladder"] = ladder.stats()
+        out["adaptive"] = {
+            "ms_per_span": self._ms_per_span(),
+            "spans_per_row": self._ewma_spans_per_row,
+            "harvest_ms": round(self._ewma_harvest_ms, 4),
+            "last_cap_spans": self._last_adaptive_cap,
+        }
         return out
 
     # -------------------------------------------------------------- worker
@@ -729,7 +833,10 @@ class ScoringEngine:
     def _collect(self, block: bool) -> Optional[list[ScoreRequest]]:
         """Pack-stage intake: one request (blocking briefly only when the
         pipeline is idle) plus whatever else is already waiting (bounded
-        coalescing)."""
+        coalescing). Deadline-carrying requests size the coalesced call
+        adaptively (``_adaptive_cap``): batches grow under load while the
+        oldest deadline affords it and shrink back when it does not, so
+        harvest latency — not queue wait — bounds the request's p99."""
         try:
             if block:
                 first = self._queue.get(timeout=0.05)
@@ -739,14 +846,57 @@ class ScoringEngine:
             return None
         reqs = [first]
         total = len(first.batch)
-        while total < self.cfg.max_batch_spans:
+        cap = self.cfg.max_batch_spans
+        if first.deadline_ns is not None:
+            cap = min(cap, self._adaptive_cap(first.deadline_ns))
+            self._last_adaptive_cap = cap
+            meter.set_gauge(self._adaptive_gauge_key, cap)
+        while total < cap:
             try:
                 nxt = self._queue.get_nowait()
             except queue.Empty:
                 break
             reqs.append(nxt)
             total += len(nxt.batch)
+        # re-report the drained depth: watermark consumers (the wire
+        # receiver's admission gate) read the CURRENT value — leaving the
+        # submit-time high reading in place would keep shedding traffic
+        # long after the queue emptied
+        FlowContext.watermark(f"engine/{self.cfg.model}", "queue_depth",
+                              self._queue.qsize())
         return reqs
+
+    def _adaptive_cap(self, deadline_ns: int) -> int:
+        """Span budget for one coalesced call such that its harvest is
+        expected inside ``deadline_ns``: remaining headroom divided by the
+        observed per-span device-step cost, snapped DOWN onto the bucket
+        ladder's precompiled row shapes (never up into a recompile). With
+        no estimate yet (cold engine) the fixed cap applies."""
+        per_span = self._ms_per_span()
+        if per_span is None or per_span <= 0:
+            return self.cfg.max_batch_spans
+        headroom_ms = ((deadline_ns - time.monotonic_ns()) / 1e6
+                       - self._ewma_harvest_ms)
+        if headroom_ms <= 0:
+            # already late: queue wait ate the deadline, so per-request
+            # latency is lost either way — switch to DRAIN mode (maximal
+            # coalescing) to clear the backlog at peak device efficiency;
+            # shipping minimal calls here would shrink batches exactly
+            # when load demands growth and collapse throughput
+            return self.cfg.max_batch_spans
+        afford = int(headroom_ms / per_span)
+        ladder = getattr(self.backend, "ladder", None)
+        spans_per_row = self._ewma_spans_per_row
+        if ladder is not None and spans_per_row and spans_per_row > 0:
+            rows = afford / spans_per_row
+            afford = int(ladder.floor_rows(rows) * spans_per_row)
+        return max(1, min(afford, self.cfg.max_batch_spans))
+
+    def _ms_per_span(self) -> Optional[float]:
+        """Volume-weighted device-step cost per span (see __init__)."""
+        if not self._ewma_call_ms or not self._ewma_call_spans:
+            return None
+        return self._ewma_call_ms / self._ewma_call_spans
 
     def _dispatch_group(self, reqs: list[ScoreRequest],
                         overlapped: bool) -> Optional[_InflightGroup]:
@@ -768,9 +918,6 @@ class ScoringEngine:
             if len(reqs) == 1:
                 merged, feats = reqs[0].batch, reqs[0].features
             else:
-                from ..pdata.spans import concat_batches
-
-                merged = concat_batches([r.batch for r in reqs])
                 feats = None
                 if all(r.features is not None for r in reqs):
                     feats = SpanFeatures(
@@ -778,6 +925,16 @@ class ScoringEngine:
                                         for r in reqs]),
                         np.concatenate([r.features.continuous
                                         for r in reqs]))
+                if feats is not None and getattr(
+                        self.backend, "coalesce_columns", None) is not None:
+                    # every request pre-featurized + a backend that only
+                    # reads id/time columns: skip the merged batch — the
+                    # ingest fast path's zero-rematerialization seam
+                    merged: Any = _ColumnBatch([r.batch for r in reqs])
+                else:
+                    from ..pdata.spans import concat_batches
+
+                    merged = concat_batches([r.batch for r in reqs])
             dispatch = getattr(self.backend, "dispatch", None)
             with self._backend_lock:
                 if dispatch is not None:
@@ -858,6 +1015,29 @@ class ScoringEngine:
         pack_ms = (grp.t_dispatch - grp.t_pack0) / 1e6
         device_ms = (t_end - grp.t_dispatch) / 1e6
         harvest_ms = (t_end - t_h0) / 1e6
+        # adaptive-batching estimators: device-step cost (pack + device,
+        # the wall the next group's deadline must absorb) and span volume
+        # as SEPARATE EWMAs (ratio of averages — see __init__), spans per
+        # packed row (converts span budgets to ladder rows), and the
+        # harvest allowance subtracted from headroom
+        if grp.n_spans > 0:
+            call_ms = pack_ms + device_ms
+            self._ewma_call_ms = call_ms \
+                if self._ewma_call_ms is None else \
+                (1 - _ADAPT_ALPHA) * self._ewma_call_ms \
+                + _ADAPT_ALPHA * call_ms
+            self._ewma_call_spans = float(grp.n_spans) \
+                if self._ewma_call_spans is None else \
+                (1 - _ADAPT_ALPHA) * self._ewma_call_spans \
+                + _ADAPT_ALPHA * grp.n_spans
+            if grp.shape and grp.shape[0] > 0:
+                spr = grp.n_spans / grp.shape[0]
+                self._ewma_spans_per_row = spr \
+                    if self._ewma_spans_per_row is None else \
+                    (1 - _ADAPT_ALPHA) * self._ewma_spans_per_row \
+                    + _ADAPT_ALPHA * spr
+        self._ewma_harvest_ms = (1 - _ADAPT_ALPHA) * self._ewma_harvest_ms \
+            + _ADAPT_ALPHA * harvest_ms
         self._stage_log.append({
             "pack_ms": pack_ms, "device_ms": device_ms,
             "harvest_ms": harvest_ms, "overlap_ms": grp.overlap_ms,

@@ -1,0 +1,275 @@
+"""Ingest fast path: wire frame → featurized, device-ready arrays with
+no per-span Python and no intermediate re-materialization.
+
+The componentwise route re-touches every span several times between the
+socket and the device: the memory limiter estimates bytes, the batch
+processor buffers and re-concatenates (string tables re-interned
+span-by-span), and the engine re-derives features for each merged batch.
+``SOAK.json`` shows the consequence — a single sender drives e2e p99 to
+~1.2 s while the device itself scores in 2 ms. This module is the
+shortcut the ROADMAP's "kill the soak tail" item asks for:
+
+* the receiver hands each zero-copy ``decode_frame`` batch straight to
+  :class:`IngestFastPath`, which featurizes it ONCE (hash tables
+  memoized per interned string pool, attr slots memoized per store) and
+  submits to the scoring engine with an **admission deadline**;
+* the engine coalesces those pre-featurized requests column-only
+  (``_ColumnBatch`` — no merged SpanBatch, no re-intern, no attr-store
+  merge) and sizes each device call adaptively from the observed step
+  cost so harvest lands inside the deadline (``engine._adaptive_cap``);
+* a single forwarder thread retires requests FIFO, tags anomalies, and
+  forwards downstream — the receiver thread never blocks on scoring, so
+  wire intake overlaps device execution end-to-end;
+* overload is bounded twice: the engine's own queue (engine-side
+  ``queue_full`` accounting) and this route's pending-span window —
+  saturation raises :class:`FastPathSaturated`, which the wire receiver
+  answers with REJECTED (clients back off and retry), named in the flow
+  ledger as ``queue_full`` so no shed span is ever silent. Watermarks
+  published here and by the engine feed the receiver's pre-decode
+  admission gate (wire/server.py) so a storm is shed before decode.
+
+Deadline expiry never drops data: like the tpuanomaly processor's
+timeout, an expired request forwards unscored (pass-through counter
+fires) and the late scores still land in online state.
+
+Built by ``pipeline/graph.build_graph`` when a pipeline sets
+``fast_path`` — it reuses the pipeline's tpuanomaly engine + threshold,
+so fast-path scores are bit-identical to the componentwise path at equal
+request grouping (tests/test_ingest_fastpath.py pins this).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+import numpy as np
+
+# deliberately no components.api import: the tpuanomaly processor imports
+# this module for the shared tagging helper, so depending on the
+# components package here would be a cycle whichever package loads first
+from ..features.featurizer import featurize
+from ..pdata.spans import SpanBatch
+from ..selftelemetry.flow import FlowContext
+from ..utils.telemetry import labeled_key, meter
+from .engine import PASSTHROUGH_METRIC, ScoringEngine
+
+SCORE_ATTR = "odigos.anomaly.score"
+FLAG_ATTR = "odigos.anomaly"
+FLAGGED_METRIC = "odigos_anomaly_flagged_spans_total"
+
+SPANS_METRIC = "odigos_fastpath_spans_total"
+SATURATED_METRIC = "odigos_fastpath_saturated_total"
+FORWARD_ERRORS_METRIC = "odigos_fastpath_forward_errors_total"
+
+# flow-ledger watermark identity prefix: each instance reports as
+# "fastpath/<pipeline>" — two fast-path pipelines must never clobber
+# each other's pending_spans reading (last-writer-wins would let a
+# quiet pipeline mask a saturated one at the admission gate)
+WATERMARK_PREFIX = "fastpath"
+
+
+def tag_anomalies(batch: SpanBatch, scores: np.ndarray,
+                  threshold: float) -> SpanBatch:
+    """Attribute-tag spans scoring at or above ``threshold`` — the one
+    tagging implementation shared by the tpuanomaly processor and the
+    fast path (bit-identical output is the parity contract)."""
+    mask = scores >= threshold
+    n_flagged = int(mask.sum())
+    if n_flagged == 0:
+        return batch
+    meter.add(FLAGGED_METRIC, n_flagged)
+    return batch.with_span_attrs({
+        SCORE_ATTR: np.round(scores[mask], 4).tolist(),
+        FLAG_ATTR: [True] * n_flagged,
+    }, mask)
+
+
+class FastPathSaturated(RuntimeError):
+    """Raised to the receiver when the pending window is full: the wire
+    answer is REJECTED, the client backs off, the ledger names the shed."""
+
+
+class IngestFastPath:
+    """Config (the pipeline's ``fast_path`` mapping; ``true`` = defaults):
+    deadline_ms:       admission deadline per frame (default: the
+                       scoring processor's timeout_ms)
+    max_pending_spans: pending-window bound before REJECTED (default 128k)
+
+    Duck-types the Component lifecycle (name/start/shutdown/health) so
+    the graph can manage it, without importing components.api (see the
+    module-cycle note above).
+    """
+
+    def __init__(self, pipeline: str, engine: ScoringEngine,
+                 threshold: float, downstream: Any,
+                 config: dict[str, Any]):
+        self.name = str(config.get("name", "fastpath"))
+        self.config = config
+        self._started = False
+        self.pipeline = pipeline
+        self.engine = engine
+        self.threshold = float(threshold)
+        self.downstream = downstream
+        self.deadline_ms = float(config.get("deadline_ms", 25.0))
+        self.max_pending_spans = int(config.get("max_pending_spans",
+                                                128 * 1024))
+        self._feat_cfg = engine.cfg.featurizer
+        self._needs_features = getattr(engine.backend, "needs_features",
+                                       True)
+        # (batch, request, deadline_ns, enqueued_ns)
+        self._window: deque[tuple[SpanBatch, Any, int, int]] = deque()
+        self._lock = threading.Lock()
+        self._have = threading.Condition(self._lock)
+        self._pending_spans = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wm_component = f"{WATERMARK_PREFIX}/{pipeline}"
+        self._spans_key = labeled_key(SPANS_METRIC, pipeline=pipeline)
+        self._saturated_key = labeled_key(SATURATED_METRIC,
+                                          pipeline=pipeline)
+        self._errors_key = labeled_key(FORWARD_ERRORS_METRIC,
+                                       pipeline=pipeline)
+
+    # ------------------------------------------------------------ intake
+    def consume(self, batch: SpanBatch) -> None:
+        """Receiver-thread half: featurize once (memoized pools), stamp
+        the admission deadline, submit, append to the FIFO window. Never
+        blocks on scoring."""
+        n = len(batch)
+        if n == 0:
+            return  # the componentwise path drops empties in batch concat
+        with self._lock:
+            if self._pending_spans + n > self.max_pending_spans:
+                meter.add(self._saturated_key)
+                err = FastPathSaturated(
+                    f"{self.name}: {self._pending_spans} spans pending "
+                    f"(bound {self.max_pending_spans}); receiver should "
+                    f"answer REJECTED")
+                # named shed, marked so the entry edge does not also
+                # count the unwind as failed (memory_limiter discipline)
+                FlowContext.drop(n, "queue_full", component=self, exc=err)
+                raise err
+            # RESERVE inside the check's lock hold: concurrent receiver
+            # threads racing the featurize window below must not all
+            # pass the bound at once — the pending window IS the
+            # latency budget, so an N-thread overshoot is p99 inflation
+            self._pending_spans += n
+            FlowContext.watermark(self._wm_component, "pending_spans",
+                                  self._pending_spans)
+        try:
+            feats = featurize(batch, self._feat_cfg) \
+                if self._needs_features else None
+            now = time.monotonic_ns()
+            deadline = now + int(self.deadline_ms * 1e6)
+            # req None = engine queue full / draining: the engine already
+            # counted the shed request; the batch still forwards unscored
+            # (lossless pass-through, exactly the tpuanomaly contract)
+            req = self.engine.submit(batch, feats, deadline_ns=deadline)
+        except BaseException:
+            with self._lock:
+                self._pending_spans -= n  # release the reservation
+                FlowContext.watermark(self._wm_component,
+                                      "pending_spans",
+                                      self._pending_spans)
+            raise
+        meter.add(self._spans_key, n)
+        with self._have:
+            self._window.append((batch, req, deadline, now))
+            # pending_ms — age of the OLDEST pending frame — is the
+            # throughput-invariant admission signal: a span-denominated
+            # bound means N ms of queue on a slow box but over-sheds a
+            # fast one, while head age IS the latency budget directly
+            FlowContext.watermark(
+                self._wm_component, "pending_ms",
+                (now - self._window[0][3]) / 1e6)
+            self._have.notify()
+
+    # --------------------------------------------------------- forwarding
+    def _run(self) -> None:
+        """Forwarder half: retire FIFO, wait out at most the remaining
+        deadline, tag, forward. Downstream failures are accounted by the
+        flow edges and must never kill this thread."""
+        while True:
+            with self._have:
+                while not self._window:
+                    if self._stop.is_set():
+                        return
+                    self._have.wait(0.05)
+                batch, req, deadline, _t0 = self._window[0]
+            try:
+                scores = None
+                if req is not None:
+                    wait_s = max((deadline - time.monotonic_ns()) / 1e9,
+                                 0.0)
+                    if req.done.wait(wait_s):
+                        scores = req.scores
+                    else:
+                        meter.add(PASSTHROUGH_METRIC, len(batch))
+                out = batch if scores is None else \
+                    tag_anomalies(batch, scores, self.threshold)
+                self.downstream.consume(out)
+            except Exception:  # noqa: BLE001 — edge-accounted; keep serving
+                meter.add(self._errors_key)
+            finally:
+                with self._lock:
+                    self._window.popleft()
+                    self._pending_spans -= len(batch)
+                    FlowContext.watermark(self._wm_component,
+                                          "pending_spans",
+                                          self._pending_spans)
+                    FlowContext.watermark(
+                        self._wm_component, "pending_ms",
+                        (time.monotonic_ns() - self._window[0][3]) / 1e6
+                        if self._window else 0.0)
+
+    # ------------------------------------------------------------ ledger
+    def flow_pending(self) -> int:
+        """Spans submitted but not yet forwarded — the conservation
+        checker's in-flight term for this route."""
+        with self._lock:
+            return self._pending_spans
+
+    # --------------------------------------------------------- lifecycle
+    def healthy(self) -> bool:
+        return True
+
+    def health(self) -> tuple[str, str, str]:
+        # the rollup attaches Degraded(QueueSaturation) itself from the
+        # ledger's queue_full evidence; base condition mirrors Component
+        return ("Healthy", "Running", "")
+
+    def start(self) -> None:
+        self._started = True
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"fastpath-{self.pipeline}")
+            self._thread.start()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait until the pending window empties (everything submitted
+        has been forwarded downstream)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._window:
+                    return True
+            time.sleep(0.002)
+        return False
+
+    def shutdown(self) -> None:
+        # lossless drain: the engine keeps scoring until its own
+        # shutdown, so every windowed request resolves (or times out
+        # into pass-through) before the forwarder exits
+        self.drain()
+        self._stop.set()
+        with self._have:
+            self._have.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._started = False

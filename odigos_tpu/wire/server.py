@@ -17,13 +17,15 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from ..components.api import ComponentKind, Factory, Receiver, Signal, register
 from ..pdata.spans import SpanKind
+from ..selftelemetry.flow import FlowContext, flow_ledger
 from ..selftelemetry.tracer import is_selftelemetry_batch, tracer
 from ..utils.framing import recv_exact as _recv_exact
-from ..utils.telemetry import meter
+from ..utils.telemetry import labeled_key, meter
 from .codec import MAGIC, decode_frame, read_frame_header
 
 ACCEPTED = b"\x00"
@@ -32,27 +34,119 @@ MALFORMED = b"\x02"
 
 REJECTIONS_METRIC = "odigos_gateway_memory_limiter_rejections_total"
 
+# the odigos_admission_* family (ISSUE 6): every pre-decode shed is
+# countable by reason, and the watermark snapshot the decision consulted
+# is published alongside it — "why was I rejected" is answerable from
+# /metrics alone
+ADMISSION_REJECTED_METRIC = "odigos_admission_rejected_frames_total"
+ADMISSION_REJECTED_BYTES_METRIC = "odigos_admission_rejected_bytes_total"
+ADMISSION_WATERMARK_GAUGE = "odigos_admission_watermark"
+ADMISSION_INFLIGHT_GAUGE = "odigos_admission_inflight_bytes"
+
+
+class WatermarkGate:
+    """Pre-decode admission from the flow ledger's queue watermarks.
+
+    ``limits`` maps a watermark identity to its shed threshold. Engines
+    report process-scoped as ``engine/<model>``; pipeline stages and the
+    fast path report PIPELINE-QUALIFIED (two pipelines' same-named
+    stages must never clobber one key)::
+
+        {"engine/zscore":              {"queue_depth": 48},
+         "traces/in/memory_limiter":   {"inflight_bytes": 400e6},
+         "traces/in/batch":            {"pending_spans": 65536},
+         "fastpath/traces/in":         {"pending_spans": 98304}}
+
+    ``check()`` answers from a cached verdict refreshed at most every
+    ``refresh_s`` (one dict lookup per watched queue, only on refresh),
+    so the per-frame cost on the accept path is one monotonic read — the
+    shed-before-work discipline must not itself become work. Each
+    refresh publishes the consulted values as
+    ``odigos_admission_watermark{component=,queue=}`` gauges (plus the
+    byte-budget inflight gauge), so the exact snapshot behind a REJECTED
+    is on /metrics.
+    """
+
+    def __init__(self, limits: dict[str, dict[str, float]],
+                 refresh_s: float = 0.005,
+                 inflight_fn: Optional[Callable[[], int]] = None,
+                 receiver_name: str = ""):
+        self.limits = {
+            comp: {q: float(v) for q, v in queues.items()}
+            for comp, queues in (limits or {}).items()}
+        self.refresh_s = float(refresh_s)
+        self.inflight_fn = inflight_fn
+        self._gauge_keys = {
+            (comp, q): labeled_key(ADMISSION_WATERMARK_GAUGE,
+                                   component=comp, queue=q)
+            for comp, queues in self.limits.items() for q in queues}
+        self._inflight_key = labeled_key(ADMISSION_INFLIGHT_GAUGE,
+                                         receiver=receiver_name)
+        self._lock = threading.Lock()
+        self._next_eval = 0.0
+        # (component, queue, ledger_reason) or None
+        self._verdict: Optional[tuple[str, str, str]] = None
+
+    def check(self) -> Optional[tuple[str, str, str]]:
+        now = time.monotonic()
+        with self._lock:
+            if now < self._next_eval:
+                return self._verdict
+            self._next_eval = now + self.refresh_s
+        verdict = None
+        for comp, queues in self.limits.items():
+            for q, limit in queues.items():
+                v = flow_ledger.watermark_current(comp, q)
+                meter.set_gauge(self._gauge_keys[(comp, q)],
+                                float(v or 0.0))
+                if v is not None and v >= limit and verdict is None:
+                    # byte-pressure watermarks shed as memory_limited
+                    # (the reference's memory-limiter discipline); depth
+                    # watermarks as queue_full
+                    reason = "memory_limited" if "bytes" in q \
+                        else "queue_full"
+                    verdict = (comp, q, reason)
+        if self.inflight_fn is not None:
+            meter.set_gauge(self._inflight_key,
+                            float(self.inflight_fn()))
+        with self._lock:
+            self._verdict = verdict
+        return verdict
+
 
 class AdmissionController:
     """Tracks bytes admitted-but-not-yet-consumed; over the soft limit new
     frames are rejected pre-decode. A custom ``pressure_fn`` can add process
-    signals (RSS, queue depth)."""
+    signals (RSS, queue depth); a :class:`WatermarkGate` adds the flow
+    ledger's downstream watermarks (engine queue depth, memory-limiter
+    inflight bytes, batcher/fast-path pending spans) so overload anywhere
+    in the pipeline sheds at the socket, before any decode work."""
 
     def __init__(self, max_inflight_bytes: int = 64 << 20,
-                 pressure_fn: Optional[Callable[[], bool]] = None):
+                 pressure_fn: Optional[Callable[[], bool]] = None,
+                 watermark_gate: Optional[WatermarkGate] = None):
         self.max_inflight_bytes = max_inflight_bytes
         self.pressure_fn = pressure_fn
+        self.watermark_gate = watermark_gate
         self._inflight = 0
         self._lock = threading.Lock()
 
-    def try_admit(self, nbytes: int) -> bool:
+    def admit(self, nbytes: int) -> Optional[tuple[str, str]]:
+        """None = admitted (inflight charged); otherwise
+        ``(ledger_reason, detail_label)`` naming the shed."""
+        gate = self.watermark_gate
+        if gate is not None:
+            w = gate.check()
+            if w is not None:
+                comp, q, reason = w
+                return (reason, f"{comp}:{q}")
         with self._lock:
             if self._inflight + nbytes > self.max_inflight_bytes:
-                return False
+                return ("memory_limited", "inflight_bytes")
             if self.pressure_fn is not None and self.pressure_fn():
-                return False
+                return ("memory_limited", "pressure")
             self._inflight += nbytes
-            return True
+            return None
 
     def release(self, nbytes: int) -> None:
         with self._lock:
@@ -83,13 +177,42 @@ class WireReceiver(Receiver):
 
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
+        adm = config.get("admission") or {}
+        gate = None
+        if adm.get("watermarks"):
+            gate = WatermarkGate(
+                adm["watermarks"],
+                refresh_s=float(adm.get("refresh_ms", 5.0)) / 1e3,
+                inflight_fn=lambda: self.admission.inflight_bytes,
+                receiver_name=name)
         self.admission = AdmissionController(
-            int(config.get("max_inflight_bytes", 64 << 20)))
+            int(config.get("max_inflight_bytes", 64 << 20)),
+            watermark_gate=gate)
+        # per-reason rejection counter keys, cached (reason cardinality is
+        # the handful of configured watermark names)
+        self._reject_keys: dict[str, tuple[str, str]] = {}
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+
+    def _count_rejection(self, reason: str, detail: str,
+                         nbytes: int) -> None:
+        keys = self._reject_keys.get(detail)
+        if keys is None:
+            keys = self._reject_keys[detail] = (
+                labeled_key(ADMISSION_REJECTED_METRIC,
+                            receiver=self.name, reason=detail),
+                labeled_key(ADMISSION_REJECTED_BYTES_METRIC,
+                            receiver=self.name, reason=detail))
+        meter.add(keys[0])
+        meter.add(keys[1], nbytes)
+        # pre-decode shed: the span count is unknowable (nothing was
+        # decoded), so the ledger names the loss in FRAMES — same
+        # discipline as malformed-frame accounting
+        FlowContext.drop(1, reason, pipeline="(ingress)",
+                         component_name=self.name, signal="frames")
 
     def start(self) -> None:
         super().start()
@@ -116,10 +239,14 @@ class WireReceiver(Receiver):
                         except ValueError:
                             sock.sendall(MALFORMED)
                             return
-                        if not receiver.admission.try_admit(payload_len):
+                        verdict = receiver.admission.admit(payload_len)
+                        if verdict is not None:
                             # pre-decode rejection: drain the socket bytes,
                             # never allocate/decode, tell client to back off
+                            reason, detail = verdict
                             meter.add(REJECTIONS_METRIC)
+                            receiver._count_rejection(reason, detail,
+                                                      payload_len)
                             if not _discard_exact(sock, payload_len):
                                 return
                             sock.sendall(REJECTED)
@@ -139,8 +266,6 @@ class WireReceiver(Receiver):
                                 # pre-pipeline shed, named in the flow
                                 # ledger (item count unknowable pre-
                                 # decode: one frame)
-                                from ..selftelemetry.flow import FlowContext
-
                                 FlowContext.drop(
                                     1, "invalid", pipeline="(ingress)",
                                     component_name=receiver.name,

@@ -211,6 +211,115 @@ def test_pipeline_stats_and_span_annotations():
         assert "pack_ms" in s.attrs and "harvest_ms" in s.attrs
 
 
+# ------------------------------------------- deadline adaptive batching
+
+def test_bucket_ladder_floor_rows():
+    lad = BucketLadder(base=8, n_buckets=3)  # 8, 16, 32
+    assert lad.floor_rows(7) == 8     # nothing fits: smallest bucket
+    assert lad.floor_rows(8) == 8
+    assert lad.floor_rows(31) == 16   # snapped DOWN, never up
+    assert lad.floor_rows(32) == 32
+    # beyond the top bucket: multiples of it (round_rows' shapes)
+    assert lad.floor_rows(100) == 96
+    assert lad.floor_rows(1000) == 992
+
+
+def test_adaptive_cap_sizes_from_deadline_and_ladder():
+    import time as _time
+
+    eng = ScoringEngine(tiny_cfg())
+    # cold engine: no estimate yet -> the fixed cap applies
+    assert eng._adaptive_cap(_time.monotonic_ns() + 10_000_000) \
+        == eng.cfg.max_batch_spans
+    # seed observed step cost: 0.01 ms/span (ratio of averages:
+    # 100 ms over 10k spans), 4 spans/row, ladder {8, 16}
+    eng._ewma_call_ms = 100.0
+    eng._ewma_call_spans = 10_000.0
+    eng._ewma_spans_per_row = 4.0
+    eng._ewma_harvest_ms = 0.0
+    # 1 ms headroom affords 100 spans = 25 rows -> floor to bucket 16
+    # -> 64 spans: the cap lands on a precompiled shape
+    cap = eng._adaptive_cap(_time.monotonic_ns() + 1_000_000)
+    assert cap == 64
+    # generous headroom still clamps to max_batch_spans
+    cap = eng._adaptive_cap(_time.monotonic_ns() + int(1e12))
+    assert cap == eng.cfg.max_batch_spans
+    # an already-expired deadline switches to drain mode: maximal
+    # coalescing clears the backlog (shrinking here would collapse
+    # throughput exactly when load demands growth)
+    assert eng._adaptive_cap(_time.monotonic_ns() - 1_000_000) \
+        == eng.cfg.max_batch_spans
+
+
+def test_adaptive_cap_without_ladder_uses_span_budget():
+    import time as _time
+
+    eng = ScoringEngine(EngineConfig(model="mock"))
+    eng._ewma_call_ms = 100.0
+    eng._ewma_call_spans = 10_000.0
+    eng._ewma_harvest_ms = 0.0
+    cap = eng._adaptive_cap(_time.monotonic_ns() + 1_000_000)  # 1 ms
+    assert 50 <= cap <= 150  # ~100 spans afford, no rung snapping
+
+
+def test_deadline_requests_update_estimators_and_score():
+    """Deadline-carrying submissions flow end-to-end, retire the EWMA
+    estimators, and score identically to undeadlined requests."""
+    import time as _time
+
+    eng = ScoringEngine(tiny_cfg()).start()
+    try:
+        b = synthesize_traces(6, seed=3)
+        f = featurize(b)
+        req = eng.submit(b, f,
+                         deadline_ns=_time.monotonic_ns() + int(60e9))
+        assert req is not None and req.done.wait(60.0)
+        want = ScoringEngine(tiny_cfg()).backend.score(b, f)
+        np.testing.assert_array_equal(req.scores, want)
+        assert eng._ms_per_span() is not None \
+            and eng._ms_per_span() > 0
+        assert eng._ewma_spans_per_row is not None
+        stats = eng.pipeline_stats()
+        assert stats["adaptive"]["ms_per_span"] > 0
+    finally:
+        eng.shutdown()
+
+
+def test_column_coalesce_skips_batch_merge_bitwise():
+    """Coalesced pre-featurized requests ride the _ColumnBatch view (no
+    concat_batches) and still split back bit-identical to scoring the
+    concatenated batch serially."""
+    from odigos_tpu.serving.engine import _ColumnBatch
+
+    eng = ScoringEngine(tiny_cfg())
+    assert eng.backend.coalesce_columns == (
+        "trace_id_hi", "trace_id_lo", "start_unix_nano")
+    batches = [synthesize_traces(n, seed=30 + n) for n in (3, 4, 2)]
+    feats = [featurize(b) for b in batches]
+    view = _ColumnBatch(batches)
+    merged = concat_batches(batches)
+    assert len(view) == len(merged)
+    for col in ("trace_id_hi", "trace_id_lo", "start_unix_nano"):
+        np.testing.assert_array_equal(view.col(col), merged.col(col))
+    # queued-before-start coalescing (one device call over the view)
+    reqs = [eng.submit(b, f) for b, f in zip(batches, feats)]
+    eng.start()
+    try:
+        for r in reqs:
+            assert r.done.wait(60.0) and r.scores is not None
+    finally:
+        eng.shutdown()
+    from odigos_tpu.features.featurizer import SpanFeatures
+
+    mf = SpanFeatures(np.concatenate([f.categorical for f in feats]),
+                      np.concatenate([f.continuous for f in feats]))
+    want = ScoringEngine(tiny_cfg()).backend.score(merged, mf)
+    off = 0
+    for b, r in zip(batches, reqs):
+        np.testing.assert_array_equal(r.scores, want[off:off + len(b)])
+        off += len(b)
+
+
 def test_depth1_backends_keep_serial_behavior():
     eng = ScoringEngine(EngineConfig(model="mock"))
     assert eng._depth == 1  # no dispatch -> no overlap window

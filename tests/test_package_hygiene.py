@@ -109,7 +109,12 @@ class TestJitShapeBucketing:
     factory, or the lazy ``self._<name>_jit`` attribute, underscores and
     the ``_jit``/``_impl``/``_kernel`` suffixes stripped)."""
 
-    JIT_DIRS = ("models", "parallel")
+    # serving + features joined the scan with the ingest fast path
+    # (ISSUE 6 satellite): the adaptive coalescer sizes batches onto
+    # ladder rungs precisely because every jitted scoring entry point
+    # promises bucketed shapes — a jit site appearing in those packages
+    # without a SHAPE_BUCKETING declaration would void that promise
+    JIT_DIRS = ("models", "parallel", "serving", "features")
 
     @staticmethod
     def _is_jit_call(node: ast.AST) -> bool:
@@ -224,6 +229,7 @@ class TestColumnarAttrsHygiene:
     HOT_MODULES = (
         "features/featurizer.py",
         "serving/engine.py",
+        "serving/fastpath.py",
         "components/processors/filter.py",
         "components/processors/attributes.py",
         "components/processors/batch.py",
@@ -265,6 +271,69 @@ class TestColumnarAttrsHygiene:
             PKG_ROOT, "components", "processors", "_attrs_dictpath.py"))
         for rel in self.HOT_MODULES:
             assert os.path.exists(os.path.join(PKG_ROOT, rel)), rel
+
+
+class TestFastPathHygiene:
+    """The ingest fast path exists to remove per-span Python from the
+    wire→device column (ISSUE 6 satellite), so the rule is stricter than
+    the span_attrs lint: NO ``for``/comprehension in
+    ``serving/fastpath.py`` may iterate anything span- or batch-sized.
+    Iterating ``batch``/``spans``/``scores``/feature arrays re-introduces
+    O(n) interpreter work exactly where this PR bought it out. The
+    bounded-cardinality loops the module legitimately needs (flag lists
+    via list-multiply, window drains bounded by frame count) don't
+    iterate those names.
+
+    Also pins the adaptive-batching shape contract: the engine's
+    deadline sizing must snap onto ``BucketLadder`` rungs (floor_rows),
+    never invent a new padded shape — the jit sites it feeds declare
+    SHAPE_BUCKETING for *bucketed* rows.
+    """
+
+    FASTPATH = os.path.join(PKG_ROOT, "serving", "fastpath.py")
+    # identifiers whose iteration is per-span/per-batch-row work
+    SPAN_SIZED = re.compile(
+        r"\b(batch|spans|scores|span_attrs|categorical|continuous"
+        r"|features)\b")
+
+    def _iter_exprs(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.For):
+                yield node.lineno, ast.unparse(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                for gen in node.generators:
+                    yield node.lineno, ast.unparse(gen.iter)
+
+    def test_no_per_span_iteration_in_fastpath_module(self):
+        with open(self.FASTPATH) as f:
+            tree = ast.parse(f.read(), self.FASTPATH)
+        problems = [
+            f"serving/fastpath.py:{lineno}: iterates {expr!r}"
+            for lineno, expr in self._iter_exprs(tree)
+            if self.SPAN_SIZED.search(expr)]
+        assert not problems, (
+            "per-span Python iteration in the fast-path module — the "
+            "whole point of this route is columnar flow:\n  "
+            + "\n  ".join(problems))
+
+    def test_adaptive_batching_snaps_to_ladder_rungs(self):
+        """AST-level: ``_adaptive_cap`` must consult the backend ladder's
+        ``floor_rows`` — the declaration that deadline-sized batches land
+        on SHAPE_BUCKETING'd precompiled shapes."""
+        path = os.path.join(PKG_ROOT, "serving", "engine.py")
+        with open(path) as f:
+            tree = ast.parse(f.read(), path)
+        cap_fns = [n for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "_adaptive_cap"]
+        assert cap_fns, "engine lost its _adaptive_cap stage"
+        calls = {n.func.attr for n in ast.walk(cap_fns[0])
+                 if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)}
+        assert "floor_rows" in calls, (
+            "_adaptive_cap no longer snaps span budgets onto "
+            "BucketLadder rungs — adaptive batches would pay recompiles")
 
 
 class TestFlowAccounting:

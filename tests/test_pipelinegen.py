@@ -169,6 +169,25 @@ class TestAnomalyStage:
                 anomaly=AnomalyStageConfiguration(enabled=False)))
         assert base == off
 
+    def test_anomaly_fast_path_renders_on_root_traces_pipeline(self):
+        """anomaly.fast_path=True marks the root traces pipeline for the
+        ingest fast path (deadline = the scoring timeout); off by
+        default, and the rendered config still builds a valid graph
+        with the fast-path route installed."""
+        cfg, _, _ = build_gateway_config(
+            [jaeger()], options=self.anomaly_opts(fast_path=True,
+                                                  timeout_ms=25.0))
+        root = cfg["service"]["pipelines"]["traces/in"]
+        assert root["fast_path"] == {"deadline_ms": 25.0}
+        from odigos_tpu.pipeline.graph import build_graph
+
+        g = build_graph(cfg)
+        assert "traces/in" in g.fastpaths
+        # default stays componentwise — no fast_path key at all
+        off, _, _ = build_gateway_config([jaeger()],
+                                         options=self.anomaly_opts())
+        assert "fast_path" not in off["service"]["pipelines"]["traces/in"]
+
     def test_anomaly_enabled_inserts_processor_and_router(self):
         cfg, _, _ = build_gateway_config([jaeger()], options=self.anomaly_opts())
         root = cfg["service"]["pipelines"]["traces/in"]
