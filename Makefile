@@ -1,7 +1,7 @@
 # Developer entrypoints (reference: Makefile at the repo root).
 # No install step: the package runs from the repo root.
 
-.PHONY: test test-fast bench dryrun ui preflight tpu-snapshot tpu-snapshot-watch soak quant-geometry ablation
+.PHONY: test test-fast bench dryrun multichip ui preflight tpu-snapshot tpu-snapshot-watch soak quant-geometry ablation
 
 test:            ## full suite on the 8-device virtual CPU mesh (~7 min)
 	python -m pytest tests/ -x -q
@@ -31,6 +31,9 @@ ablation:        ## per-encoder-block timing on TPU (LAYER_ABLATION.json)
 dryrun:          ## multi-chip sharding compile+execute on 8 virtual devices
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+multichip:       ## wire-fed dp-scaling bench (writes MULTICHIP_r06.json)
+	python tools/multichip_bench.py
 
 ui:              ## operator dashboard over the local install
 	python -m odigos_tpu.cli ui

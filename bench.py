@@ -210,6 +210,61 @@ def main() -> None:
         result["latency_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(result), flush=True)
 
+    try:
+        result.update(multichip_bench_summary())
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"multichip bench failed: {type(e).__name__}: {e}")
+        result["multichip_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result), flush=True)
+
+
+def multichip_bench_summary() -> dict:
+    """Wire-fed dp×tp scaling (ISSUE 7), run as a SUBPROCESS: the
+    simulated 8-device host mesh needs XLA_FLAGS set before backend
+    init, and this process already initialized jax (possibly on the
+    real TPU). The full record lands in MULTICHIP_r06.json via the
+    shared tool (tools/multichip_bench.py, `make multichip`); the bench
+    line embeds the headline fields."""
+    import os
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    # unique per-run path: a fixed name lets concurrent bench runs (CI
+    # re-run racing a stuck one) clobber each other's records
+    fd, out = tempfile.mkstemp(prefix="multichip_bench_",
+                               suffix=".json")
+    os.close(fd)
+    r = subprocess.run(
+        [_sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "multichip_bench.py"),
+         "--seconds", "3", "--rounds", "2", "--out", out],
+        timeout=900, capture_output=True, text=True)
+    try:
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"multichip_bench rc={r.returncode}: {r.stderr[-200:]}")
+        with open(out) as f:
+            rec = json.load(f)
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    log(f"multichip: eff@dp_max {rec['scaling_efficiency_at_max_dp']} "
+        f"(simulated={rec['simulated']})")
+    return {
+        "multichip_simulated": rec["simulated"],
+        "multichip_scaling_efficiency_at_max_dp":
+            rec["scaling_efficiency_at_max_dp"],
+        "multichip_bitwise_parity": rec["bitwise_parity"],
+        "multichip_wire_spans_per_sec_by_dp": {
+            str(w["dp"]): w["wire_spans_per_sec"] for w in rec["widths"]},
+        "multichip_zero_recompiles": all(
+            w["zero_recompiles_after_warm"] for w in rec["widths"]),
+    }
+
 
 def throughput_bench(on_tpu: bool) -> dict:
     import jax

@@ -78,6 +78,11 @@ class TpuAnomalyProcessor(Processor):
         (model "remote"; serving/sidecar.py)
     threshold: score in [0,1] above which a span is tagged (default 0.8)
     timeout_ms: scoring latency budget before pass-through (default 5.0)
+    mesh: {"data": N, "model": M} — multi-chip sharded serving (ISSUE 7):
+        the engine owns an N×M device mesh and dispatches every packed
+        call through the partition-rule dp×tp plan. ``devices: N`` (what
+        pipelinegen renders from anomaly.devices) and ``data_parallel``
+        are the legacy pure-DP spellings, honored when mesh is absent.
     attr_slots / max_len / trace_bucket / online_update / checkpoint_path /
     pipeline_depth / bucket_ladder / warm_ladder:
         forwarded to EngineConfig (pipeline_depth 2 = double-buffered
@@ -111,7 +116,11 @@ class TpuAnomalyProcessor(Processor):
             model_config=model_config,
             checkpoint_path=config.get("checkpoint_path"),
             socket_path=config.get("socket_path"),
-            data_parallel=int(config.get("data_parallel", 0)),
+            mesh=config.get("mesh"),
+            # "devices" is what pipelinegen renders from anomaly.devices;
+            # it was silently dropped before ISSUE 7 wired the mesh
+            data_parallel=int(config.get("data_parallel",
+                                         config.get("devices", 0))),
             seed=int(config.get("seed", 0)),
             pipeline_depth=int(config.get("pipeline_depth", 2)),
             bucket_ladder=int(config.get("bucket_ladder", 4)),

@@ -65,6 +65,13 @@ def calculate_effective_config(authored: Configuration,
             f"anomaly.devices={cfg.anomaly.devices} requires the "
             f"shard-map-scoring gate (jax too old) — clamped to 1")
         cfg.anomaly.devices = 1
+    if cfg.anomaly.tensor_parallel > 1 \
+            and not features.enabled("shard-map-scoring"):
+        problems.append(
+            f"anomaly.tensor_parallel={cfg.anomaly.tensor_parallel} "
+            f"requires the shard-map-scoring gate (jax too old) — "
+            f"clamped to 1")
+        cfg.anomaly.tensor_parallel = 1
 
     return EffectiveConfig(
         config=cfg,

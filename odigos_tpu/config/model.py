@@ -63,6 +63,11 @@ class CollectorGatewayConfiguration:
     # TPU co-scheduling: how many gateway replicas should be co-located with
     # a TPU device for the anomaly stage (north-star extension).
     tpu_replicas: Optional[int] = None
+    # Multi-chip sizing knob (ISSUE 7): how many TPU mesh slices the
+    # autoscaler may co-schedule. Each TPU-backed gateway replica owns one
+    # whole slice of anomaly.devices × anomaly.tensor_parallel chips (the
+    # engine's dp×tp mesh); None = as many as the device pools can back.
+    mesh_slices: Optional[int] = None
 
 
 @dataclass
@@ -103,7 +108,11 @@ class AnomalyStageConfiguration:
     max_batch: int = 4096
     timeout_ms: float = 5.0  # pass-through-on-timeout budget (<5ms p99)
     route_to_stream: str = "anomalies"
-    devices: int = 1  # data-parallel chips for the scoring sidecar
+    devices: int = 1  # data-parallel chips ("data" mesh axis) per replica
+    # tensor-parallel shards ("model" mesh axis) per replica: the engine
+    # serves on a devices × tensor_parallel mesh (ISSUE 7); heads/d_ff
+    # shard per parallel.PARTITION_RULES. 1 = pure data parallelism.
+    tensor_parallel: int = 1
     # ingest fast path (ISSUE 6): wire frames featurize once at the
     # receiver and score through the engine's deadline-based adaptive
     # coalescer, bypassing the componentwise batch/score seams; the

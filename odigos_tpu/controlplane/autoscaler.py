@@ -196,9 +196,12 @@ class Autoscaler:
         self.hpa = HpaDecider()
         self.gateway_replicas = 1
         # TPU co-scheduling (north star): node device registries attached by
-        # the environment; held device ids back anomaly-stage replicas
+        # the environment; each held SLICE (plugin, [device ids]) backs one
+        # gateway replica's dp×tp scoring mesh (ISSUE 7: mesh-slice
+        # co-scheduling — the reference co-schedules collector replicas,
+        # we co-schedule replicas with whole accelerator slices)
         self._device_registries: list[Any] = []
-        self._tpu_held: list[tuple[Any, str]] = []  # (plugin, device id)
+        self._tpu_held: list[tuple[Any, list[str]]] = []
         gateway_key = lambda e: [(ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME)]
         manager.register("cluster-collector", self, {
             "DestinationResource": gateway_key,
@@ -343,49 +346,83 @@ class Autoscaler:
                 if TPU_DEVICE in getattr(r, "plugins", {})]
 
     def tpu_devices_held(self) -> int:
+        return sum(len(devs) for _, devs in self._tpu_held)
+
+    def mesh_slices_held(self) -> int:
         return len(self._tpu_held)
 
+    def _mesh_slice_size(self) -> int:
+        """Devices per gateway replica: the anomaly engine's dp×tp mesh
+        (anomaly.devices × anomaly.tensor_parallel, ISSUE 7). 1 when the
+        stage runs single-chip — the pre-mesh behavior exactly."""
+        a = self.config.anomaly
+        tp = getattr(a, "tensor_parallel", 1) or 1
+        return max(1, int(a.devices or 1)) * max(1, int(tp))
+
     def _co_schedule_tpu(self, desired: int, group) -> int:
-        """Align gateway scale with TPU devices: every replica carries the
-        full pipeline (shared-nothing, SURVEY §2.7), so with the anomaly
-        stage enabled each replica needs one device. Scale-out is capped at
-        what the pools can back; a shortfall surfaces as a TpuScheduling
-        condition on the CollectorsGroup (the HPA-visible 'tpu-starved'
-        signal)."""
+        """Align gateway scale with TPU mesh slices: every replica carries
+        the full pipeline (shared-nothing, SURVEY §2.7), so with the
+        anomaly stage enabled each replica needs one WHOLE slice of
+        dp×tp devices for its scoring mesh — a slice never straddles
+        pools (ICI does not cross hosts). Scale-out is capped at what the
+        pools can back and at the ``mesh_slices`` sizing knob; a
+        shortfall surfaces as a TpuScheduling condition on the
+        CollectorsGroup (the HPA-visible 'tpu-starved' signal)."""
         plugins = self._tpu_plugins()
         if group.tpu_replicas <= 0:
             if self._tpu_held:  # anomaly turned off: give devices back
-                for plugin, dev in self._tpu_held:
-                    plugin.release([dev])
+                for plugin, devs in self._tpu_held:
+                    plugin.release(list(devs))
                 self._tpu_held = []
             return desired
 
-        # grow/shrink holdings toward `desired`, one device per replica
-        while len(self._tpu_held) > desired:
-            plugin, dev = self._tpu_held.pop()
-            plugin.release([dev])
+        slice_size = self._mesh_slice_size()
+        max_slices = self.config.collector_gateway.mesh_slices
+        want = desired if max_slices is None else min(desired,
+                                                      int(max_slices))
+
+        # a config reload can resize the slice (anomaly.devices /
+        # tensor_parallel changed): release any held slice of the WRONG
+        # size first, or replicas keep serving dp×tp meshes backed by
+        # stale allocations while the condition reports DevicesAllocated
+        stale = [(p, d) for p, d in self._tpu_held if len(d) != slice_size]
+        if stale:
+            self._tpu_held = [(p, d) for p, d in self._tpu_held
+                              if len(d) == slice_size]
+            for plugin, devs in stale:
+                plugin.release(list(devs))
+
+        # grow/shrink holdings toward `want`, one whole slice per replica
+        while len(self._tpu_held) > want:
+            plugin, devs = self._tpu_held.pop()
+            plugin.release(list(devs))
         for plugin in plugins:
-            while (len(self._tpu_held) < desired
-                   and plugin.ids.free_count > 0):
-                ids, _resp = plugin.allocate(1)
-                self._tpu_held.append((plugin, ids[0]))
-            if len(self._tpu_held) >= desired:
+            while (len(self._tpu_held) < want
+                   and plugin.ids.free_count >= slice_size):
+                ids, _resp = plugin.allocate(slice_size)
+                self._tpu_held.append((plugin, list(ids)))
+            if len(self._tpu_held) >= want:
                 break
 
         held = len(self._tpu_held)
         total = sum(p.ids.capacity for p in plugins)
-        # starved whenever the pools cannot back the HPA's desired scale —
-        # both "no devices at all" and "scale-out capped by devices"
+        # starved whenever the HPA's desired scale cannot be backed —
+        # pools short of whole slices, or the mesh_slices budget capping
+        # scale-out below desire
         starved = held < desired
         capped = desired if held >= desired else max(
             self.hpa.min_replicas, held)
 
+        slice_note = "" if slice_size == 1 else (
+            f", mesh slice = {slice_size} devices"
+            f" ({self.config.anomaly.devices}dp x "
+            f"{getattr(self.config.anomaly, 'tensor_parallel', 1)}tp)")
         if group.set_condition(Condition(
                 "TpuScheduling",
                 ConditionStatus.FALSE if starved else ConditionStatus.TRUE,
                 "TpuStarved" if starved else "DevicesAllocated",
                 f"{held}/{desired} gateway replicas TPU-backed "
-                f"({total} devices in cluster)")):
+                f"({total} devices in cluster{slice_note})")):
             self.store.update_status(group)
         return capped
 

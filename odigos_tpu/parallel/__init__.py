@@ -1,17 +1,27 @@
-from .mesh import make_mesh, mesh_axes
+from .mesh import ensure_host_devices, make_mesh, mesh_axes, mesh_key
 from .sharding import (
-    transformer_param_spec,
-    shard_variables,
+    PARTITION_RULES,
+    ScoringPlan,
     batch_spec,
-    make_sharded_score_fn,
+    compile_plan,
     make_sharded_packed_score_fn,
+    make_sharded_score_fn,
     make_sharded_train_step,
+    match_partition_rules,
+    shard_variables,
+    transformer_param_spec,
 )
 from .ring_attention import ring_attention
 
 __all__ = [
+    "ensure_host_devices",
     "make_mesh",
     "mesh_axes",
+    "mesh_key",
+    "PARTITION_RULES",
+    "ScoringPlan",
+    "compile_plan",
+    "match_partition_rules",
     "transformer_param_spec",
     "shard_variables",
     "batch_spec",

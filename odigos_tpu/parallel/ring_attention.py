@@ -28,6 +28,15 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 
+# Partition-spec declaration per sharded entry point (package-hygiene
+# lint, ISSUE 7 satellite — an undeclared sharded site silently runs
+# replicated): ring attention shards the SEQUENCE axis, nothing else.
+PARTITION_SPECS = {
+    "ring_attention": "q/k/v (B, L, H, D) and mask (B, L) sharded on "
+                      "the 'seq' axis via shard_map in/out_specs; K/V "
+                      "blocks rotate by ppermute, output sharded like q",
+}
+
 
 def _block_attention(q, k, v, kv_mask, scale):
     """One q-block x kv-block attention with streaming stats.

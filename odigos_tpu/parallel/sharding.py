@@ -1,26 +1,43 @@
-"""Sharding rules + sharded score/train step factories.
+"""Partition rules + sharded score/train step factories.
 
-Megatron-style layout for the trace transformer (odigos_tpu.models), expressed
-as PartitionSpecs over the mesh from parallel.mesh:
+Megatron-style layout for the trace models (odigos_tpu.models), expressed
+as a ``match_partition_rules``-style table of (regex, PartitionSpec) pairs
+over the mesh from parallel.mesh:
 
 * attention q/k/v kernels (d_model, n_heads, head_dim): heads on "model"
 * attention out kernel (n_heads, head_dim, d_model): heads on "model"
-* mlp up kernel (d_model, d_ff): d_ff on "model"; down kernel transposed
-* embedding tables + layernorms + heads: replicated
-* batch (trace) axis of inputs: "data"
+* encoder mlp up kernel (d_model, d_ff): d_ff on "model"; down transposed
+* autoencoder decoder ffn + wide vocab heads: d_ff / vocab on "model"
+* embedding tables + layernorms + small heads: replicated
+* batch (packed-row / trace) axis of inputs: "data"
 
 XLA inserts the all-reduces (psum over "model" after attention-out and
-mlp-down) — we only annotate placements, per the scaling-book recipe cited in
-the build brief.
+mlp-down) — we only annotate placements, per the scaling-book recipe cited
+in the build brief. ``compile_plan`` graduates the rules from a demo
+helper into the ScoringEngine's device layer: one plan per (model, mesh)
+holding the rule-matched param placements, the explicit in/out shardings
+of the packed scoring call, and the donation vector threaded through the
+models' ``enable_input_donation`` plumbing.
+
+Numerics contract: "data"-axis sharding is BITWISE identical to single
+device (rows are independent; each shard runs the same per-row program).
+A "model" axis reassociates the contraction reductions (partial matmul +
+psum), so dp×tp parity is ULP-level (~1e-7 at fp32), never bitwise — the
+parity suite and the multichip bench assert bitwise on dp and tight
+allclose once tp > 1.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import re
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import jitstats
+from .mesh import mesh_key
 
 # see models/transformer.py: every jitted scoring/training entry point
 # declares its recompile-bounding strategy (package hygiene test)
@@ -28,17 +45,90 @@ SHAPE_BUCKETING = {
     "make_sharded_score_fn": "delegates to model.score_spans — leading axis "
                              "padded to a data-axis multiple by "
                              "_shard_inputs on top of the engine bucketing",
-    "make_sharded_packed_score_fn": "delegates to model.score_packed — row "
+    "make_sharded_packed_score_fn": "delegates to compile_plan — row "
                                     "axis bucketed by the engine's ladder "
-                                    "(multiples of data_parallel enforced)",
+                                    "(rungs lcm-aligned to the data axis)",
     "make_sharded_train_step": "training loop feeds fixed (batch, L) "
                                "shapes from data.py batching; one compile "
                                "per run",
+    "compile_plan": "packed row axis bucketed by the engine's "
+                    "BucketLadder (rungs lcm-aligned to the data axis, "
+                    "warmed once per mesh shape); L/C fixed by the model "
+                    "config",
+    "packed_score": "the jit compile_plan builds — same row-axis "
+                    "bucketing as compile_plan (one executable per "
+                    "warmed rung per mesh shape)",
+}
+
+# Partition-spec declaration per sharded entry point (package-hygiene
+# lint, ISSUE 7 satellite): any factory in parallel/ that jits or places
+# arrays under a mesh must say where each tensor class lands — an
+# undeclared sharded jit silently runs replicated and burns dp-fold HBM.
+PARTITION_SPECS = {
+    "compile_plan": "params via PARTITION_RULES (heads/d_ff/vocab on "
+                    "'model', rest replicated); packed inputs and scores "
+                    "P('data', ...) on rows",
+    "make_sharded_score_fn": "params via PARTITION_RULES; (T, L, *) "
+                             "inputs P('data', ...) on traces",
+    "make_sharded_packed_score_fn": "alias of compile_plan (packed rows "
+                                    "on 'data', params by rule table)",
+    "make_sharded_train_step": "params/grads/opt state via caller's "
+                               "shard_variables placement; batch inputs "
+                               "P('data', ...); loss replicated",
+    "shard_variables": "rule table (PARTITION_RULES) or explicit spec_fn; "
+                       "non-dividing or absent axes fall back to "
+                       "replication",
+    "packed_score": "the compiled packed-score jit: params by committed "
+                    "rule-table placement, (R, L, *) inputs and (R, L) "
+                    "scores pinned P('data', ...)",
+    "shard_inputs": "batch-leading arrays placed P('data', ...), leading "
+                    "dim padded to a data-axis multiple (pad rows stay "
+                    "masked)",
 }
 
 
+# ------------------------------------------------------ partition rules
+
+# First-match-wins (re.search over the '/'-joined param path). The
+# catch-all replicates embeddings, norms, biases, and small heads —
+# sharding those only buys per-call collectives. Param names cover BOTH
+# sequence models: flax auto-names (Attention_N, block_N/Dense_0 up /
+# Dense_1 down) plus the autoencoder's decoder ffn and wide vocab heads.
+PARTITION_RULES: tuple[tuple[str, P], ...] = (
+    (r"Attention_\d+/(query|key|value)/kernel$", P(None, "model", None)),
+    (r"Attention_\d+/out/kernel$", P("model", None, None)),
+    (r"block_\d+/Dense_0/kernel$", P(None, "model")),  # mlp up: d_ff cols
+    (r"block_\d+/Dense_1/kernel$", P("model", None)),  # mlp down: d_ff rows
+    (r"dec_ff1/kernel$", P(None, "model")),            # autoencoder decoder
+    (r"dec_ff2/kernel$", P("model", None)),
+    (r"(service|name)_head/kernel$", P(None, "model")),  # wide vocab heads
+    (r"", P()),  # embeddings, norms, biases, small heads: replicated
+)
+
+
+def match_partition_rules(params: Any,
+                          rules: tuple = PARTITION_RULES) -> Any:
+    """Pytree of PartitionSpecs per the rule table (the SNIPPETS.md [1]
+    idiom): scalars/size-1 leaves never partition; otherwise the first
+    rule whose regex matches the '/'-joined path wins. The shipped table
+    ends with a catch-all, so every leaf resolves."""
+    def spec_for(path, leaf) -> P:
+        if getattr(leaf, "ndim", 0) == 0 or np.prod(
+                getattr(leaf, "shape", ())) == 1:
+            return P()
+        name = "/".join(str(k.key) for k in path)
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"partition rule not found for param: {name}")
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
 def transformer_param_spec(path: tuple, leaf: Any) -> P:
-    """Map a flax param path (tuple of str keys) to a PartitionSpec."""
+    """Shape-heuristic fallback (pre-rule-table API, kept for callers
+    that shard pytrees with no stable names): q/k/v/out by position,
+    any large 2D kernel by its grown dimension."""
     names = [str(p) for p in path]
     joined = "/".join(names)
     ndim = getattr(leaf, "ndim", 0)
@@ -48,9 +138,9 @@ def transformer_param_spec(path: tuple, leaf: Any) -> P:
             return P(None, "model", None)  # (d_model, heads, head_dim)
         if "out" in names and ndim == 3:
             return P("model", None, None)  # (heads, head_dim, d_model)
-    # transformer mlp: first Dense grows to d_ff (shard cols), second
-    # shrinks. Size gate keeps tiny matmuls (span/trace heads, embedder
-    # projections) replicated — sharding them only buys per-call collectives.
+    # mlp: first Dense grows to d_ff (shard cols), second shrinks. Size
+    # gate keeps tiny matmuls (span/trace heads, embedder projections)
+    # replicated — sharding them only buys per-call collectives.
     if ndim == 2 and names[-1] == "kernel":
         in_dim, out_dim = leaf.shape
         if min(in_dim, out_dim) >= 64:
@@ -61,25 +151,37 @@ def transformer_param_spec(path: tuple, leaf: Any) -> P:
     return P()  # replicate embeddings, norms, biases, heads
 
 
-def shard_variables(variables: Any, mesh: Mesh,
-                    spec_fn: Callable[[tuple, Any], P] = transformer_param_spec,
-                    ) -> Any:
-    """Place a variable pytree onto the mesh per spec_fn."""
-    def place(path, leaf):
-        spec = spec_fn(tuple(k.key for k in path), leaf)
-        # axes must exist in this mesh and divide the dim; fall back to
-        # replication when they don't (a pure-"data" DP mesh replicates
-        # every "model"-sharded param)
-        for axis_name, dim in zip(spec, getattr(leaf, "shape", ())):
-            if axis_name is None:
-                continue
-            if (axis_name not in mesh.shape
-                    or dim % mesh.shape[axis_name] != 0):
-                spec = P()
-                break
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+def _guard_spec(spec: P, leaf: Any, mesh: Mesh) -> P:
+    """Axes must exist in this mesh and divide the dim; fall back to
+    replication when they don't (a pure-"data" DP mesh replicates every
+    "model"-sharded param)."""
+    for axis_name, dim in zip(spec, getattr(leaf, "shape", ())):
+        if axis_name is None:
+            continue
+        if axis_name not in mesh.shape or dim % mesh.shape[axis_name] != 0:
+            return P()
+    return spec
 
-    return jax.tree_util.tree_map_with_path(place, variables)
+
+def shard_variables(variables: Any, mesh: Mesh,
+                    spec_fn: Optional[Callable[[tuple, Any], P]] = None,
+                    rules: tuple = PARTITION_RULES) -> Any:
+    """Place a variable pytree onto the mesh: by the rule table (default,
+    resolved through ``match_partition_rules`` — ONE rule-resolution
+    path, so placements can never drift from the specs tests and
+    describe surfaces report) or an explicit ``spec_fn(path, leaf)``."""
+    if spec_fn is not None:
+        def place(path, leaf):
+            spec = spec_fn(tuple(k.key for k in path), leaf)
+            return jax.device_put(
+                leaf, NamedSharding(mesh, _guard_spec(spec, leaf, mesh)))
+
+        return jax.tree_util.tree_map_with_path(place, variables)
+    specs = match_partition_rules(variables, rules)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(
+            leaf, NamedSharding(mesh, _guard_spec(spec, leaf, mesh))),
+        variables, specs)
 
 
 def batch_spec(mesh: Mesh) -> P:
@@ -100,6 +202,123 @@ def _shard_inputs(mesh: Mesh, arrays: tuple) -> tuple:
         sharded.append(jax.device_put(
             a, NamedSharding(mesh, P("data", *([None] * (a.ndim - 1))))))
     return tuple(sharded)
+
+
+# ------------------------------------------------------- scoring plans
+
+
+def _packed_score_jit(model, mesh: Mesh, donate: bool):
+    """Compile the packed-scoring fn for one (model, mesh) pairing:
+    params ride their committed placement (``place_variables`` has
+    already device_put them per the rule table — an explicit in_sharding
+    would just restate it); inputs and output are pinned to "data" so
+    the call NEVER silently runs replicated even if a caller hands host
+    arrays. The donation vector follows the model's
+    ``enable_input_donation`` opt-in (TPU-gated by serving_donation)."""
+    impl = getattr(model, "_score_packed_impl", None)
+    if impl is None:
+        return None
+    from ..models.transformer import serving_donation
+
+    row = NamedSharding(mesh, P("data", None))
+    row3 = NamedSharding(mesh, P("data", None, None))
+    return jitstats.track_jit(
+        f"parallel.plan.score_packed[{mesh_key(mesh)}]",
+        jax.jit(impl,
+                in_shardings=(None, row3, row3, row, row),
+                out_shardings=row,
+                donate_argnums=serving_donation((1, 2, 3, 4), donate)))
+
+
+class ScoringPlan:
+    """One (model, mesh) pairing compiled for serving — the engine's
+    device layer (ISSUE 7 tentpole, the ``compile_step_with_plan``
+    pattern from SNIPPETS.md [3]).
+
+    Owns: the rule-matched param PartitionSpecs, an identity-cached
+    ``place_variables`` (params move to device once per weight pytree,
+    not per call), the packed scoring fn jitted with EXPLICIT in/out
+    shardings (inputs on "data", scores on "data", params per rules) and
+    the donation vector from the model's ``enable_input_donation``
+    plumbing, and a propagation-sharded ``score_spans`` for the
+    sequence (autoencoder) route. Neither entry blocks on the device:
+    the engine harvests against the next in-flight call.
+    """
+
+    def __init__(self, model: Any, mesh: Mesh,
+                 rules: tuple = PARTITION_RULES,
+                 donate: bool = False):
+        self.model = model
+        self.mesh = mesh
+        self.rules = rules
+        self.dp = int(mesh.shape.get("data", 1))
+        self.tp = int(mesh.shape.get("model", 1))
+        self.key = mesh_key(mesh)
+        # cache the placed pytree of the last-seen weights. Keyed by id()
+        # ALONE this is unsound — a GC'd pytree's address can be reused
+        # and serve stale weights — so the cache holds a strong ref to
+        # the source pytree and revalidates by identity against it.
+        self._cache: dict[str, Any] = {"source": None, "placed": None}
+        self._packed_jit = _packed_score_jit(model, mesh, donate)
+
+    def param_specs(self, variables: Any) -> Any:
+        """Rule-matched PartitionSpec pytree for a weight pytree
+        (mesh-guarded: non-dividing or absent axes replicate) — what
+        ``place_variables`` commits, exposed for tests and describe
+        surfaces."""
+        specs = match_partition_rules(variables, self.rules)
+        flat_v = jax.tree_util.tree_leaves(variables)
+        flat_s, treedef = jax.tree_util.tree_flatten(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        guarded = [_guard_spec(s, v, self.mesh)
+                   for s, v in zip(flat_s, flat_v)]
+        return jax.tree_util.tree_unflatten(treedef, guarded)
+
+    def place_variables(self, variables: Any) -> Any:
+        """Device placement per the rule table, cached by identity."""
+        if self._cache["source"] is not variables:
+            self._cache["source"] = variables
+            self._cache["placed"] = shard_variables(
+                variables, self.mesh, rules=self.rules)
+        return self._cache["placed"]
+
+    def score_packed(self, variables, categorical, continuous, segments,
+                     positions):
+        """Sharded packed scoring; returns the (R, L) device array
+        WITHOUT blocking (the engine's harvest stage fetches it)."""
+        R = np.asarray(segments).shape[0]
+        if R % self.dp:
+            raise ValueError(
+                f"packed rows {R} not divisible by data axis {self.dp}; "
+                f"the engine's BucketLadder aligns rungs to the mesh — "
+                f"pad rows with ladder.round_rows")
+        v = self.place_variables(variables)
+        categorical, continuous, segments, positions = _shard_inputs(
+            self.mesh, (categorical, continuous, segments, positions))
+        return self._packed_jit(v, categorical, continuous, segments,
+                                positions)
+
+    def score_spans(self, variables, categorical, continuous, mask):
+        """Sequence-route scoring (autoencoder): params per rules, inputs
+        on "data"; the model's own jit propagates the placements and XLA
+        inserts the collectives. Non-blocking device results."""
+        v = self.place_variables(variables)
+        categorical, continuous, mask = _shard_inputs(
+            self.mesh, (categorical, continuous, mask))
+        return self.model.score_spans(v, categorical, continuous, mask)
+
+
+def compile_plan(model, mesh: Mesh, *, rules: tuple = PARTITION_RULES,
+                 donate: Optional[bool] = None) -> ScoringPlan:
+    """Build the (model, mesh) serving plan. ``donate=None`` follows the
+    model's ``enable_input_donation`` opt-in (the engine calls it before
+    compiling the plan, so the donation vector rides through here)."""
+    if donate is None:
+        donate = bool(getattr(model, "_donate_inputs", False))
+    return ScoringPlan(model, mesh, rules=rules, donate=donate)
+
+
+# ------------------------------------------------ legacy factory seams
 
 
 def make_sharded_score_fn(model, mesh: Mesh):
@@ -145,36 +364,19 @@ def make_sharded_train_step(model, tx, mesh: Mesh):
 
 def make_sharded_packed_score_fn(model, mesh: Mesh, block: bool = True):
     """Data-parallel **packed** scoring (BASELINE config #5: DP across
-    v5e-8) — the serving path's flagship shape. Packed rows shard on
-    "data"; variables placed per the transformer rules (pure-DP meshes
-    replicate them; a "model" axis shards heads/ffn too). XLA inserts the
-    collectives from the placements.
+    v5e-8) — kept as the thin pre-plan API over ``compile_plan``.
 
     ``block=False`` returns the (R, L) device array without the host
     fetch: the pipelined engine harvests it against the *next* in-flight
     call so the transfer overlaps device execution. R is unpadded (the
     divisibility check guarantees it), so no trailing-slice is needed.
     """
-    dp = mesh.shape["data"]
-    # cache the sharded placement of the last-seen pytree. Keyed by id()
-    # ALONE this is unsound — a GC'd pytree's address can be reused and
-    # serve stale weights — so the cache holds a strong ref to the source
-    # pytree and revalidates by identity against it.
-    cache: dict[str, Any] = {"source": None, "sharded": None}
+    plan = compile_plan(model, mesh, donate=False)
 
-    def score(variables, cat, cont, segments, positions) -> np.ndarray:
-        if cache["source"] is not variables:
-            cache["source"] = variables
-            cache["sharded"] = shard_variables(variables, mesh)
-        v = cache["sharded"]
+    def score(variables, cat, cont, segments, positions):
         R = np.asarray(segments).shape[0]
-        if R % dp:
-            raise ValueError(
-                f"packed rows {R} not divisible by data axis {dp}; "
-                f"choose trace_bucket as a multiple of data_parallel")
-        cat, cont, segments, positions = _shard_inputs(
-            mesh, (cat, cont, segments, positions))
-        span_p = model.score_packed(v, cat, cont, segments, positions)
+        span_p = plan.score_packed(variables, cat, cont, segments,
+                                   positions)
         if not block:
             return span_p
         return np.asarray(span_p)[:R]

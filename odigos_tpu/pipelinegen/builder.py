@@ -302,6 +302,14 @@ def build_gateway_config(
                 "timeout_ms": anomaly.timeout_ms,
                 "devices": anomaly.devices,
             }
+            tp = getattr(anomaly, "tensor_parallel", 1) or 1
+            if anomaly.devices > 1 or tp > 1:
+                # multi-chip sharded serving (ISSUE 7): render the full
+                # dp×tp mesh spec; the engine owns the Mesh and dispatches
+                # through the partition-rule plan. Single-chip configs
+                # stay byte-identical (no mesh key at all).
+                config["processors"]["tpuanomaly"]["mesh"] = {
+                    "data": anomaly.devices, "model": tp}
             procs.append("tpuanomaly")
             exporters.append("anomalyrouter")
         config["service"]["pipelines"][root_pipeline_name(sig)] = {

@@ -477,13 +477,20 @@ class DeviceRuntimeCollector:
             except Exception:  # noqa: BLE001 — a dying engine: skip it
                 continue
             model = gauges.pop("model", "unknown")
+            # multi-chip engines label their gauges per mesh shape
+            # (ISSUE 7: padding_waste_frac / bucket_ladder_hit_rate are
+            # per-mesh quantities once the engine owns a dp×tp mesh);
+            # single-device engines keep the unlabeled legacy keys
+            mesh = gauges.pop("mesh", None)
+            labels = {"model": model, "engine": str(ordinal)}
+            if mesh is not None:
+                labels["mesh"] = str(mesh)
             for key, value in gauges.items():
                 name = cls.ENGINE_GAUGES.get(key)
                 if name is not None:
                     # engine ordinal disambiguates two live engines of
                     # the same model (blue/green overlap, A/B)
-                    out[labeled_key(name, model=model,
-                                    engine=str(ordinal))] = float(value)
+                    out[labeled_key(name, **labels)] = float(value)
         return out
 
     @staticmethod

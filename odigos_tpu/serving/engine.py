@@ -41,6 +41,7 @@ odigos_tpu.serving.sidecar.
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
@@ -82,11 +83,20 @@ STAGE_PACK_METRIC = "odigos_anomaly_stage_pack_ms"
 STAGE_DEVICE_METRIC = "odigos_anomaly_stage_device_ms"
 STAGE_HARVEST_METRIC = "odigos_anomaly_stage_harvest_ms"
 ADAPTIVE_CAP_GAUGE = "odigos_engine_adaptive_cap_spans"
+MESH_UNAVAILABLE_METRIC = "odigos_engine_mesh_unavailable_total"
 
 # EWMA smoothing of the per-span device-step cost estimate; 0.2 follows
 # load shifts within ~5 calls without letting one outlier call resize
 # the next batch
 _ADAPT_ALPHA = 0.2
+
+
+def _mesh_label(mesh_spec) -> str:
+    """Gauge/stats label for a normalized mesh spec ("data4xmodel2").
+    jax-free mirror of parallel.mesh.mesh_key — the engine must never be
+    the reason jax loads in a mock/zscore process."""
+    parts = [f"{a}{int(n)}" for a, n in (mesh_spec or ()) if int(n) > 1]
+    return "x".join(parts) if parts else "single"
 
 
 @dataclass(frozen=True)
@@ -105,9 +115,17 @@ class EngineConfig:
     checkpoint_path: Optional[str] = None
     socket_path: Optional[str] = None  # model "remote": sidecar unix socket
     remote_timeout_s: float = 10.0  # model "remote": per-call socket deadline
-    # data-parallel scoring across chips (BASELINE config #5: dp over
-    # v5e-8). 0/1 = single device; N>1 builds an N-device "data" mesh and
-    # shards packed rows over it. trace_bucket must divide by N.
+    # device mesh for sharded serving (ISSUE 7 tentpole): the ENGINE owns
+    # one jax.sharding.Mesh and dispatches every packed call through a
+    # partition-rule dp×tp plan (parallel.compile_plan). Accepts
+    # {"data": N, "model": M} or ((axis, size), ...) pairs; normalized in
+    # __post_init__ to a hashable tuple (shared-engine keying hashes the
+    # config) and to None when the product is 1. Sequence models only —
+    # zscore/mock/remote ignore it.
+    mesh: Any = None
+    # legacy spelling of mesh={"data": N} (BASELINE config #5: dp over
+    # v5e-8); kept so existing configs and checkpoints keep working.
+    # 0/1 = single device; ignored when mesh is set.
     data_parallel: int = 0
     seed: int = 0
     # ---- pipelining (sequence backends only; others clamp to depth 1).
@@ -120,6 +138,27 @@ class EngineConfig:
     pipeline_depth: int = 2
     bucket_ladder: int = 4      # geometric row buckets above trace_bucket
     warm_ladder: bool = False   # compile the whole ladder at start()
+
+    def __post_init__(self) -> None:
+        m = self.mesh
+        if m is not None:
+            items = m.items() if isinstance(m, dict) else tuple(m)
+            m = tuple((str(a), int(s)) for a, s in items)
+            bad = [(a, s) for a, s in m if s <= 0]
+            if bad:
+                # silently dropping a zero-size axis would serve pure-DP
+                # while the operator believes tp is active — refuse
+                # (same stance as quantized+mesh)
+                raise ValueError(f"mesh axes must be positive: {bad}")
+        if m is None and self.data_parallel and self.data_parallel > 1:
+            m = (("data", int(self.data_parallel)),)
+        if m is not None and math.prod(s for _, s in m) <= 1:
+            m = None  # a 1x1 mesh is the single-device path
+        object.__setattr__(self, "mesh", m)
+
+    def mesh_shape(self) -> Optional[dict[str, int]]:
+        """Normalized mesh spec as the dict parallel.make_mesh takes."""
+        return dict(self.mesh) if self.mesh else None
 
 
 class ModelBackend(Protocol):
@@ -143,10 +182,17 @@ class BucketLadder:
     compiled this process (LRU-bounded so an adversarial shape storm cannot
     grow the table), feeding the bench's hit-rate and the zero-recompile
     assertion; ``mark_warm`` pre-seeds it from ``warm()`` compilations.
+
+    ``align`` (ISSUE 7): every rung is lifted to lcm(base, align) so that
+    under a dp-wide mesh each padded row count stays shard-divisible —
+    the pack stage emits dp-aligned row groups by construction and the
+    sharded call never re-pads (re-padding would mint shapes the warmed
+    ladder has not compiled).
     """
 
-    def __init__(self, base: int, n_buckets: int = 4):
-        self.base = max(1, int(base))
+    def __init__(self, base: int, n_buckets: int = 4, align: int = 1):
+        self.align = max(1, int(align))
+        self.base = math.lcm(max(1, int(base)), self.align)
         self.buckets = [self.base << k for k in range(max(1, int(n_buckets)))]
         self.hits = 0
         self.misses = 0
@@ -200,6 +246,7 @@ class BucketLadder:
             "misses": self.misses,
             "hit_rate": round(self.hits / total, 4) if total else 0.0,
             "buckets": list(self.buckets),
+            "align": self.align,
         }
 
 
@@ -207,8 +254,8 @@ class MockBackend:
     """Deterministic TPU-free backend: score = duration percentile proxy.
     Spans with attr ``mock.anomaly`` always score 1.0 (test hook)."""
 
-    def __init__(self, cfg: EngineConfig):
-        self.cfg = cfg
+    def __init__(self, cfg: EngineConfig, mesh: Any = None):
+        self.cfg = cfg  # mesh ignored: no device work to shard
 
     def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
         log_dur = features.continuous[:, 0]
@@ -225,10 +272,10 @@ class ZScoreBackend:
     # exclusively, so a coalesced group never needs a merged SpanBatch
     coalesce_columns: tuple = ()
 
-    def __init__(self, cfg: EngineConfig):
+    def __init__(self, cfg: EngineConfig, mesh: Any = None):
         from ..models.zscore import ZScoreDetector
 
-        self.cfg = cfg
+        self.cfg = cfg  # mesh ignored: streaming CPU state is unsharded
         self.det = ZScoreDetector()
 
     def score(self, batch: SpanBatch, features: SpanFeatures) -> np.ndarray:
@@ -265,6 +312,12 @@ class SequenceBackend:
     engine can overlap host packing with device execution (the scatter and
     the blocking ``np.asarray`` fetch happen at harvest, against the
     *previous* in-flight call's result).
+
+    The mesh (if any) is ENGINE-owned and passed in — this backend never
+    constructs one (ISSUE 7 satellite: one mesh, one owner). Under a mesh
+    every device call routes through the partition-rule dp×tp plan
+    (parallel.compile_plan), and the ladder aligns its rungs to the data
+    axis so packed row groups are shard-divisible by construction.
     """
 
     # column-only coalescing (ingest fast path): when every request in a
@@ -274,10 +327,11 @@ class SequenceBackend:
     coalesce_columns: tuple = ("trace_id_hi", "trace_id_lo",
                                "start_unix_nano")
 
-    def __init__(self, cfg: EngineConfig):
+    def __init__(self, cfg: EngineConfig, mesh: Any = None):
         import jax
 
         self.cfg = cfg
+        self.mesh = mesh
         model_config = cfg.model_config
         variables = None
         if cfg.checkpoint_path:
@@ -320,7 +374,11 @@ class SequenceBackend:
         donate = getattr(self.model, "enable_input_donation", None)
         if donate is not None:
             donate()
-        self.ladder = BucketLadder(cfg.trace_bucket, cfg.bucket_ladder)
+        # rungs lcm-aligned to the data axis: the pack stage then emits
+        # dp-divisible row groups and the sharded call never re-pads
+        dp = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+        self.ladder = BucketLadder(cfg.trace_bucket, cfg.bucket_ladder,
+                                   align=dp)
         # jitstats site this backend's device calls compile under — must
         # match the track_jit registration in models/ so compile seconds
         # and cache size land on the same label value
@@ -332,33 +390,34 @@ class SequenceBackend:
         self.last_bucket_hit: Optional[bool] = None
         self.variables = variables if variables is not None else \
             self.model.init(jax.random.PRNGKey(cfg.seed))
-        self._packed_score = None
+        self._plan = None
         self._quantized = None
         if cfg.quantized and cfg.model == "transformer":
-            if cfg.data_parallel and cfg.data_parallel > 1:
+            if cfg.mesh is not None:
                 # refusing beats silently serving bf16 while holding an
                 # unused int8 weight copy on device
                 raise ValueError(
-                    "quantized serving does not compose with "
-                    "data_parallel yet; pick one")
+                    "quantized serving does not compose with a device "
+                    "mesh yet; pick one")
             from ..models.quantized import QuantizedTraceScorer
 
             self._quantized = QuantizedTraceScorer(self.model,
                                                    self.variables)
             self._quantized.enable_input_donation()
             self.jit_site = "quantized.score_packed"  # the jit that runs
-        if cfg.data_parallel and cfg.data_parallel > 1:
-            if cfg.trace_bucket % cfg.data_parallel:
-                raise ValueError(
-                    f"trace_bucket {cfg.trace_bucket} must be a multiple "
-                    f"of data_parallel {cfg.data_parallel}")
-            from ..parallel import make_mesh, make_sharded_packed_score_fn
+        if mesh is not None:
+            from ..parallel import compile_plan
 
-            mesh = make_mesh({"data": cfg.data_parallel})
-            # block=False: the engine harvests the device array itself so
-            # the fetch overlaps the next in-flight call
-            self._packed_score = make_sharded_packed_score_fn(
-                self.model, mesh, block=False)
+            # partition-rule dp×tp plan: params per PARTITION_RULES,
+            # packed rows on "data", donation following the
+            # enable_input_donation opt-in above. Non-blocking by design:
+            # the engine harvests the device array itself so the fetch
+            # overlaps the next in-flight call.
+            self._plan = compile_plan(self.model, mesh)
+            if cfg.model == "transformer":
+                # per-mesh compile attribution: each mesh shape warms its
+                # own ladder, and the jitstats ledger must say which one
+                self.jit_site = f"parallel.plan.score_packed[{self._plan.key}]"
 
     # ------------------------------------------------------- device stage
 
@@ -367,8 +426,8 @@ class SequenceBackend:
         WITHOUT blocking on it (JAX async dispatch)."""
         import jax.numpy as jnp
 
-        if self._packed_score is not None:  # dp across chips
-            return self._packed_score(
+        if self._plan is not None:  # dp×tp across chips (partition plan)
+            return self._plan.score_packed(
                 self.variables, packed.categorical, packed.continuous,
                 packed.segments, packed.positions)
         if self._quantized is not None:  # int8 serving path
@@ -409,10 +468,20 @@ class SequenceBackend:
         self.last_padding_waste = round(1.0 - float(seqs.mask.mean()), 4) \
             if seqs.mask.size else 0.0
         self.last_bucket_hit = self.ladder.observe(seqs.n_traces)
-        dev, _ = self.model.score_spans(
-            self.variables, jnp.asarray(seqs.categorical),
-            jnp.asarray(seqs.continuous), jnp.asarray(seqs.mask))
+        dev, _ = self._seq_call(seqs.categorical, seqs.continuous,
+                                seqs.mask)
         return ("seq", dev, seqs.span_index, seqs.mask, len(batch))
+
+    def _seq_call(self, cat, cont, mask) -> Any:
+        """Sequence-route device call (autoencoder): through the mesh
+        plan when sharded, the model's own jit otherwise."""
+        import jax.numpy as jnp
+
+        if self._plan is not None:
+            return self._plan.score_spans(self.variables, cat, cont, mask)
+        return self.model.score_spans(
+            self.variables, jnp.asarray(cat), jnp.asarray(cont),
+            jnp.asarray(mask))
 
     def harvest(self, handle: Any) -> np.ndarray:
         """Harvest stage: block on the device result (the only blocking
@@ -435,9 +504,10 @@ class SequenceBackend:
         """Compile every ladder bucket with zero-filled inputs so
         steady-state traffic never pays an XLA recompile (all-padding
         inputs trace the same program as real ones — shapes are all that
-        matter to jit)."""
-        import jax.numpy as jnp
-
+        matter to jit). Rungs are mesh-aligned, so each compile happens
+        ONCE PER MESH SHAPE — per-mesh jit sites make that auditable in
+        the compile-seconds ledger, and replicas dispatching through the
+        same engine share the warm ladder."""
         C = self.cfg.featurizer.cat_width
         D = self.cfg.featurizer.cont_width
         L = self.max_len
@@ -451,10 +521,10 @@ class SequenceBackend:
                     np.zeros((R, L), np.int32),
                     np.zeros((R, L), np.int32)))
             else:
-                dev, _ = self.model.score_spans(
-                    self.variables, jnp.zeros((R, L, C), jnp.int32),
-                    jnp.zeros((R, L, D), jnp.float32),
-                    jnp.zeros((R, L), bool))
+                dev, _ = self._seq_call(
+                    np.zeros((R, L, C), np.int32),
+                    np.zeros((R, L, D), np.float32),
+                    np.zeros((R, L), bool))
             np.asarray(dev)  # block: compile finished before serving
             self.ladder.mark_warm(R)
             # ladder warming is the one place every bucket compile is
@@ -472,10 +542,10 @@ class _ZeroPacked:
     positions: np.ndarray
 
 
-def _remote_backend(cfg: "EngineConfig"):
+def _remote_backend(cfg: "EngineConfig", mesh: Any = None):
     from .sidecar import RemoteBackend
 
-    return RemoteBackend(cfg)
+    return RemoteBackend(cfg)  # mesh lives sidecar-side for remote
 
 
 _BACKENDS = {
@@ -559,20 +629,48 @@ class ScoringEngine:
     >>> scores = eng.score_sync(batch, timeout_s=0.005)  # None on timeout
     """
 
+    # per-(model, mesh) learned adaptive-batching priors, shared across
+    # engine instances: a re-created engine on the same mesh shape (hot
+    # reload, blue/green swap) starts from the last learned device-step
+    # cost instead of assuming one chip. Only multi-chip engines consult
+    # this — the single-device path keeps its exact cold-start behavior.
+    _ADAPT_PRIORS: dict[tuple, tuple] = {}
+
     def __init__(self, config: Optional[EngineConfig] = None):
         self.cfg = config or EngineConfig()
         if self.cfg.quantized and self.cfg.model != "transformer":
-            # same refuse-don't-silently-serve stance as quantized+dp:
+            # same refuse-don't-silently-serve stance as quantized+mesh:
             # only the transformer has an int8 path
             raise ValueError(
                 f"quantized serving is only implemented for the "
                 f"transformer model, not {self.cfg.model!r}")
-        try:
-            self.backend = _BACKENDS[self.cfg.model](self.cfg)
-        except KeyError:
+        if self.cfg.model not in _BACKENDS:
             raise ValueError(
                 f"unknown scoring model {self.cfg.model!r} "
-                f"(known: {sorted(_BACKENDS)})") from None
+                f"(known: {sorted(_BACKENDS)})")
+        # the engine owns THE mesh (ISSUE 7: one mesh, one owner) —
+        # backends receive it, never build their own. Construction is
+        # gated to sequence models so mock/zscore engines stay jax-free,
+        # and jax.devices() honors the virtual-host-platform override
+        # (XLA_FLAGS --xla_force_host_platform_device_count) so the
+        # dp×tp path runs under tier-1 CPU without real TPUs.
+        self.mesh = None
+        if self.cfg.mesh is not None and self.cfg.model in (
+                "transformer", "autoencoder"):
+            from ..parallel import make_mesh
+
+            try:
+                self.mesh = make_mesh(self.cfg.mesh_shape())
+            except ValueError:
+                # a mesh the host cannot back (configs render per
+                # cluster, pods differ — a devices:4 gateway config can
+                # land on a 1-device pod): serve single-device LOUDLY
+                # instead of bricking the collector on upgrade. The
+                # pre-mesh code silently dropped the knob; the counter
+                # makes the degradation observable.
+                meter.add(labeled_key(MESH_UNAVAILABLE_METRIC,
+                                      model=self.cfg.model))
+        self.backend = _BACKENDS[self.cfg.model](self.cfg, mesh=self.mesh)
         # only backends with an async dispatch can overlap; everything else
         # (zscore's ordered online update, mock, the remote sidecar with its
         # own deadline discipline) keeps the exact serial depth-1 behavior
@@ -619,8 +717,38 @@ class ScoringEngine:
         self._ewma_spans_per_row: Optional[float] = None
         self._ewma_harvest_ms = 0.0
         self._last_adaptive_cap: Optional[int] = None
-        self._adaptive_gauge_key = labeled_key(
-            ADAPTIVE_CAP_GAUGE, model=self.cfg.model)
+        # per-mesh step-cost learning (ISSUE 7 tentpole d): the estimate
+        # is keyed by (model, mesh) so deadline-sized coalescing scales
+        # with device count instead of assuming one chip — an 8-device
+        # mesh retires spans ~8x cheaper and the cap grows to match; a
+        # fresh engine on a known mesh shape seeds from the registry.
+        # Keyed off the mesh the engine ACTUALLY built (self.mesh), not
+        # the configured spec — a host-unbackable mesh degraded to
+        # single-device and must not wear multi-chip labels or priors.
+        # The key includes the model GEOMETRY: a blue/green swap to a
+        # bigger model on the same mesh must not seed the small model's
+        # per-span cost and oversize its first deadline-bounded calls.
+        # An unhashable model_config opts out of the registry entirely.
+        self._mesh_label = _mesh_label(self.cfg.mesh) \
+            if self.mesh is not None else "single"
+        try:
+            self._adapt_key: Optional[tuple] = (
+                self.cfg.model, self.cfg.model_config, self.cfg.mesh)
+            hash(self._adapt_key)
+        except TypeError:
+            self._adapt_key = None
+        if self.mesh is not None and self._adapt_key is not None:
+            prior = ScoringEngine._ADAPT_PRIORS.get(self._adapt_key)
+            if prior is not None:
+                (self._ewma_call_ms, self._ewma_call_spans,
+                 self._ewma_spans_per_row, self._ewma_harvest_ms) = prior
+        if self.mesh is not None:
+            self._adaptive_gauge_key = labeled_key(
+                ADAPTIVE_CAP_GAUGE, model=self.cfg.model,
+                mesh=self._mesh_label)
+        else:
+            self._adaptive_gauge_key = labeled_key(
+                ADAPTIVE_CAP_GAUGE, model=self.cfg.model)
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "ScoringEngine":
@@ -753,6 +881,10 @@ class ScoringEngine:
             "device_busy_frac": round(min(self._busy_ns / wall, 1.0), 4)
             if wall else 0.0,
         }
+        if self.mesh is not None:
+            # padding_waste_frac / bucket_ladder_hit_rate become per-mesh
+            # gauges: the collector lifts this into a {mesh=} label
+            out["mesh"] = self._mesh_label
         waste = getattr(self.backend, "last_padding_waste", None)
         if waste is not None:
             out["padding_waste_frac"] = waste
@@ -793,7 +925,10 @@ class ScoringEngine:
             "spans_per_row": self._ewma_spans_per_row,
             "harvest_ms": round(self._ewma_harvest_ms, 4),
             "last_cap_spans": self._last_adaptive_cap,
+            "mesh": self._mesh_label,
         }
+        if self.mesh is not None:
+            out["mesh"] = dict(self.cfg.mesh)
         return out
 
     # -------------------------------------------------------------- worker
@@ -1038,6 +1173,13 @@ class ScoringEngine:
                     + _ADAPT_ALPHA * spr
         self._ewma_harvest_ms = (1 - _ADAPT_ALPHA) * self._ewma_harvest_ms \
             + _ADAPT_ALPHA * harvest_ms
+        if self.mesh is not None and self._adapt_key is not None:
+            # publish the learned per-mesh cost so the next engine on
+            # this (model geometry, mesh) starts informed (dict store is
+            # atomic; the worker is the only writer for this key)
+            ScoringEngine._ADAPT_PRIORS[self._adapt_key] = (
+                self._ewma_call_ms, self._ewma_call_spans,
+                self._ewma_spans_per_row, self._ewma_harvest_ms)
         self._stage_log.append({
             "pack_ms": pack_ms, "device_ms": device_ms,
             "harvest_ms": harvest_ms, "overlap_ms": grp.overlap_ms,

@@ -215,6 +215,83 @@ class TestJitShapeBucketing:
             "(unbounded-recompile hazard):\n  " + "\n  ".join(problems))
 
 
+class TestPartitionSpecHygiene:
+    """Every sharded jit/shard_map site in ``parallel/`` must declare its
+    partition spec (ISSUE 7 satellite): a new kernel placed under a mesh
+    without a declared spec silently runs replicated — dp-fold HBM and
+    zero speedup, invisible until someone profiles. The contract mirrors
+    SHAPE_BUCKETING: a module whose source shards (NamedSharding /
+    in_shardings / shard_map) exports a module-level ``PARTITION_SPECS``
+    dict, and every module-level function or class that itself contains
+    a sharding marker resolves to one of its keys (underscores and
+    ``_jit``/``_impl``/``_kernel`` suffixes stripped)."""
+
+    MARKER_CALLS = ("NamedSharding", "shard_map")
+    MARKER_KWARGS = ("in_shardings", "out_shardings")
+
+    @classmethod
+    def _has_marker(cls, node: ast.AST) -> bool:
+        """AST-level sharding detection: a call to NamedSharding/
+        shard_map, or a call carrying in_shardings/out_shardings —
+        never a plain-text scan (docstrings mention these words)."""
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            name = f.attr if isinstance(f, ast.Attribute) \
+                else getattr(f, "id", "")
+            if name in cls.MARKER_CALLS:
+                return True
+            if any(kw.arg in cls.MARKER_KWARGS for kw in n.keywords):
+                return True
+        return False
+
+    @classmethod
+    def _sharded_defs(cls, tree: ast.Module) -> list[tuple[int, str]]:
+        return [(node.lineno, node.name) for node in tree.body
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef))
+                and cls._has_marker(node)]
+
+    def test_every_sharded_site_declares_partition_spec(self):
+        root = os.path.join(PKG_ROOT, "parallel")
+        problems = []
+        for fn in sorted(os.listdir(root)):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, path)
+            if not self._has_marker(tree):
+                continue
+            declared = None
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "PARTITION_SPECS"
+                        for t in node.targets):
+                    declared = ast.literal_eval(node.value)
+            if declared is None:
+                problems.append(
+                    f"parallel/{fn}: shards but exports no "
+                    f"PARTITION_SPECS")
+                continue
+            assert all(isinstance(v, str) and v
+                       for v in declared.values()), \
+                f"parallel/{fn}: PARTITION_SPECS values must be non-empty"
+            norm = TestJitShapeBucketing._normalize
+            keys = {norm(k) for k in declared}
+            for lineno, name in self._sharded_defs(tree):
+                if norm(name) not in keys:
+                    problems.append(
+                        f"parallel/{fn}:{lineno}: sharded site {name!r} "
+                        f"has no PARTITION_SPECS entry")
+        assert not problems, (
+            "sharded sites without a declared partition spec (would "
+            "silently run replicated):\n  " + "\n  ".join(problems))
+
+
 class TestColumnarAttrsHygiene:
     """No hot-path module may fall back to per-span attribute Python
     (ISSUE 4 satellite): span attributes are canonically the columnar

@@ -156,13 +156,24 @@ def test_dp_packed_scoring_matches_single_device():
     np.testing.assert_allclose(s1, s8, atol=1e-5, rtol=1e-4)
 
 
-def test_dp_requires_divisible_bucket():
+def test_dp_aligns_bucket_ladder_to_mesh():
+    """An indivisible trace_bucket no longer refuses — the ladder lifts
+    every rung to lcm(bucket, dp) so packed row groups stay
+    shard-divisible by construction (ISSUE 7: dp-aligned packing)."""
     from odigos_tpu.serving import EngineConfig, ScoringEngine
-    import pytest
 
-    with pytest.raises(ValueError, match="multiple"):
-        ScoringEngine(EngineConfig(model="transformer", trace_bucket=100,
-                                   data_parallel=8))
+    from odigos_tpu.training import make_model_config
+
+    tiny = make_model_config("transformer", {
+        "d_model": 32, "n_layers": 1, "d_ff": 64, "n_heads": 2,
+        "max_len": 16, "dtype": "float32"})
+    eng = ScoringEngine(EngineConfig(model="transformer", trace_bucket=100,
+                                     model_config=tiny, max_len=16,
+                                     data_parallel=8))
+    lad = eng.backend.ladder
+    assert lad.base == 200  # lcm(100, 8)
+    assert all(b % 8 == 0 for b in lad.buckets)
+    assert lad.align == 8
 
 
 def test_dp_serving_flagship_geometry_under_load():

@@ -53,7 +53,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _parse_mesh(spec: str) -> dict:
+    """"4x2" -> {"data": 4, "model": 2} (the dp×tp serving mesh)."""
+    dp, _, tp = spec.lower().partition("x")
+    return {"data": int(dp), "model": int(tp or 1)}
+
+
 def run_soak(args, fast_path: bool) -> dict:
+    if args.mesh:
+        # multichip mode (ISSUE 7): the engine serves on a dp×tp mesh —
+        # virtual host devices stand in when no TPU is attached, the
+        # same CPU-fallback path tier-1 uses. Must precede backend init.
+        from odigos_tpu.parallel import ensure_host_devices
+
+        mesh = _parse_mesh(args.mesh)
+        ensure_host_devices(max(8, mesh["data"] * mesh["model"]))
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # the soak measures the wire
@@ -79,12 +93,30 @@ def run_soak(args, fast_path: bool) -> dict:
         pipeline_in["fast_path"] = {
             "deadline_ms": args.deadline_ms,
             "max_pending_spans": 128 * 1024}
+    # warm_ladder precompiles every scoring bucket at start: the
+    # adaptive coalescer's variable batch sizes must never pay a
+    # worker-stalling XLA compile mid-soak
+    tpu_cfg = {"model": args.model, "threshold": 0.6,
+               "timeout_ms": 30000, "shared_engine": False,
+               "warm_ladder": True}
+    if args.model == "transformer":
+        # multichip soak route: a small real transformer (wire soaks
+        # measure the path, not the model) with bounded coalescing so
+        # packed rows stay on warmed, mesh-aligned ladder rungs
+        tpu_cfg.update({
+            "model_config": {"d_model": 64, "n_layers": 2, "d_ff": 256,
+                             "n_heads": 4, "max_len": 32,
+                             "dtype": "float32"},
+            "trace_bucket": 64, "max_len": 32, "bucket_ladder": 4,
+            "max_batch": 4096})
+    if args.mesh:
+        tpu_cfg["mesh"] = _parse_mesh(args.mesh)
     cfg = {
         "receivers": {"otlpwire": {
             # watermark-driven admission: overload anywhere downstream
             # sheds at the socket, before decode — every rejection named
             "admission": {"watermarks": {
-                "engine/zscore": {"queue_depth": 48},
+                f"engine/{args.model}": {"queue_depth": 48},
                 "fastpath/traces/in": {"pending_ms": 250.0,
                                        "pending_spans": 96 * 1024},
                 "traces/in/memory_limiter": {"inflight_bytes": 400e6},
@@ -94,12 +126,7 @@ def run_soak(args, fast_path: bool) -> dict:
         "processors": {
             "memory_limiter": {"limit_mib": 512},
             "batch": {"send_batch_size": 8192, "timeout_s": 0.1},
-            # warm_ladder precompiles every zscore span bucket at start:
-            # the adaptive coalescer's variable batch sizes must never
-            # pay a worker-stalling XLA compile mid-soak
-            "tpuanomaly": {"model": "zscore", "threshold": 0.6,
-                           "timeout_ms": 30000, "shared_engine": False,
-                           "warm_ladder": True},
+            "tpuanomaly": tpu_cfg,
         },
         "connectors": {"anomalyrouter": {
             "anomaly_pipelines": ["traces/anomaly"],
@@ -306,6 +333,8 @@ def run_soak(args, fast_path: bool) -> dict:
         "elapsed_s": round(elapsed, 2),
         "senders": args.senders,
         "fast_path": fast_path,
+        "model": args.model,
+        "mesh": _parse_mesh(args.mesh) if args.mesh else None,
         "spans_sent": int(sent),
         "spans_received": int(received),
         "conservation": bool(conserved),
@@ -335,7 +364,7 @@ def run_soak(args, fast_path: bool) -> dict:
                            if len(lat_ms) else None),
         "latency_note": ("probe batches ride the same wire/pipeline as "
                          "the load; p* = send-to-export wall time under "
-                         "full multi-sender soak load, CPU zscore "
+                         f"full multi-sender soak load, CPU {args.model} "
                          "scoring path"
                          + (", ingest fast path + watermark admission"
                             if fast_path else ", componentwise chain")),
@@ -356,7 +385,19 @@ def main() -> None:
                          "embed the componentwise summary in the record")
     ap.add_argument("--deadline-ms", type=float, default=100.0,
                     help="fast-path admission deadline per frame")
+    ap.add_argument("--model", default="zscore",
+                    choices=["zscore", "transformer"],
+                    help="scoring backend for the soak route")
+    ap.add_argument("--mesh", default=None,
+                    help="multichip: dp×tp serving mesh, e.g. 4x2 "
+                         "(simulated host devices without a TPU); "
+                         "requires --model transformer")
     args = ap.parse_args()
+    if args.mesh and args.model != "transformer":
+        # zscore serves single-device and would silently ignore the
+        # mesh — a SOAK.json claiming a mesh that never ran is worse
+        # than refusing
+        ap.error("--mesh requires --model transformer")
 
     result = run_soak(args, fast_path=not args.no_fast_path)
     if args.ab and not args.no_fast_path:
