@@ -195,6 +195,14 @@ def main() -> None:
     print(json.dumps(result), flush=True)
 
     try:
+        result.update(latency_attribution_overhead_bench())
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"latency attribution bench failed: {type(e).__name__}: {e}")
+        result["latency_attribution_error"] = \
+            f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result), flush=True)
+
+    try:
         pipe = with_retry(lambda: pipeline_bench(on_tpu), "pipeline")
         result.update(pipe)
     except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
@@ -610,6 +618,102 @@ def ingest_path_bench() -> dict:
             "ends at bucket-padded PackedSequences where the shared "
             "pack kernel dominates both modes. gate_overhead = idle "
             "watermark-gate cost on the fast accept path"),
+    }
+
+
+def latency_attribution_overhead_bench() -> dict:
+    """Latency-attribution overhead A/B (ISSUE 8 acceptance: < 2%
+    spans/s, the flow/profiler-layer discipline): the SAME fast-path
+    route — IngestFastPath intake → engine submit/coalesce → forwarder
+    tag/forward — driven with the stage-clock layer enabled vs disabled
+    (``ODIGOS_LATENCY=0`` path), interleaved rounds on rotating inputs,
+    per-mode p50 spans/s. Per frame the enabled layer pays ~7 clock
+    stamps, the engine boundary merge, 12 histogram records with
+    exemplars, and the SLO tracker append."""
+    from odigos_tpu.pdata import synthesize_traces
+    from odigos_tpu.selftelemetry.latency import latency_ledger
+    from odigos_tpu.serving.engine import EngineConfig, ScoringEngine
+    from odigos_tpu.serving.fastpath import IngestFastPath
+
+    class Sink:
+        def consume(self, batch):
+            pass
+
+    def make_batch(seed):
+        return synthesize_traces(256, seed=seed)
+
+    N_VARIANTS = 8
+    PASSES = 6  # frames per timed round: amortizes drain-poll jitter
+    batches = [make_batch(99 + v) for v in range(N_VARIANTS)]
+    n_spans = PASSES * sum(len(b) for b in batches)
+    # the SOAK route's engine: real zscore scoring (warmed span-bucket
+    # kernels) — a mock backend would overstate the attribution
+    # fraction ~6x against device work no production frame skips
+    engine = ScoringEngine(EngineConfig(
+        model="zscore", max_queue=256, warm_ladder=True)).start()
+    fp = IngestFastPath("traces/bench-latency", engine, threshold=0.99,
+                        downstream=Sink(),
+                        config={"deadline_ms": 10_000.0})
+    fp.start()
+    # an SLO tracker in the loop: the enabled cost must include the
+    # burn-window append, exactly what a production SLO'd pipeline pays
+    latency_ledger.configure_slo("traces/bench-latency",
+                                 {"latency_p99_ms": 10_000.0,
+                                  "scored_fraction": 0.5})
+    prev_enabled = latency_ledger.enabled
+
+    def once(on: bool):
+        latency_ledger.enabled = on
+        for _ in range(PASSES):
+            for b in batches:
+                fp.consume(b)
+        if not fp.drain(timeout=30.0):
+            raise RuntimeError("fast path failed to drain")
+
+    try:
+        for _ in range(2):
+            for mode in (False, True):
+                once(mode)  # settle jit/caches outside the timed region
+        # PAIRED rounds: both modes run back to back inside each round
+        # and only the within-round ratio counts — the engine/forwarder
+        # threads share cores with everything else on a CI box, and
+        # machine-level drift between rounds would otherwise dwarf the
+        # sub-percent effect being measured (the median of paired
+        # ratios is the same discipline multichip_bench uses for its
+        # strong-scaling probe)
+        samples: dict[bool, list] = {True: [], False: []}
+        ratios = []
+        for r in range(12):
+            order = (False, True) if r % 2 == 0 else (True, False)
+            t_mode = {}
+            for mode in order:
+                t0 = time.perf_counter()
+                once(mode)
+                t_mode[mode] = time.perf_counter() - t0
+                samples[mode].append(t_mode[mode])
+            ratios.append(t_mode[True] / max(t_mode[False], 1e-9))
+    finally:
+        latency_ledger.enabled = prev_enabled
+        fp.shutdown()
+        engine.shutdown()
+    sps_off = n_spans / float(np.percentile(samples[False], 50))
+    sps_on = n_spans / float(np.percentile(samples[True], 50))
+    overhead = max(float(np.median(ratios)) - 1.0, 0.0)
+    log(f"latency_attribution_overhead: {overhead:.4f} "
+        f"({sps_on:,.0f} spans/s attributed vs {sps_off:,.0f} bare; "
+        f"bound < 2%)")
+    return {
+        "latency_attribution_overhead": round(float(overhead), 4),
+        "latency_attribution_spans_per_sec_on": round(sps_on, 1),
+        "latency_attribution_spans_per_sec_off": round(sps_off, 1),
+        "latency_attribution_note": (
+            "fraction of p50 spans/s lost to the stage-clock layer on "
+            "the fast-path SOAK route (intake featurize -> engine "
+            "coalesce -> warmed zscore scoring -> forwarder "
+            "tag/forward, 24 rotating 256-trace frames per round incl. "
+            "a live SLO tracker), interleaved off/on rounds; "
+            "acceptance bound < 0.02 — the ODIGOS_FLOW/profiler-layer "
+            "discipline"),
     }
 
 

@@ -165,9 +165,12 @@ def describe_workload(state: CliState, namespace: str, kind: str,
         if cond is not None:
             lines.append(_fmt_condition(_flow_condition(cond)))
     for p in sorted(placed_set):
-        cond = conditions.get(f"pipeline/{p}")
-        if cond is not None:
-            lines.append(_fmt_condition(_flow_condition(cond)))
+        # the conservation verdict and (when an SLO is declared) the
+        # burn-rate verdict, rendered with the same condition formatter
+        for node in (f"pipeline/{p}", f"slo/{p}"):
+            cond = conditions.get(node)
+            if cond is not None:
+                lines.append(_fmt_condition(_flow_condition(cond)))
     return "\n".join(lines)
 
 

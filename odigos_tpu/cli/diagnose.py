@@ -124,6 +124,14 @@ def collect_bundle(state: CliState, out_path: Optional[str] = None,
         flow_doc["conservation"] = flow_ledger.conservation()
         flow_doc["conditions"] = active_conditions()
         add("flow.json", json.dumps(flow_doc, indent=1, sort_keys=True))
+        # latency attribution (ISSUE 8): the per-pipeline stage
+        # waterfall, deadline-burn table with expiry blames, recent
+        # frame timelines, and SLO burn-rate status — "where did the
+        # time go", frozen at bundle time
+        from ..selftelemetry.latency import latency_ledger
+
+        add("latency.json", json.dumps(latency_ledger.snapshot(),
+                                       indent=1, sort_keys=True))
         # device-runtime snapshot, taken fresh at bundle time: engine
         # gauges + (when jax is loaded) live arrays, device memory, and
         # per-jit-site cache/compile accounting. Read-only: a one-shot

@@ -20,6 +20,12 @@ serves JSON (terminal-first operators curl it):
                            high-watermarks, the per-pipeline
                            conservation balance, and the component
                            condition rollup
+* ``/debug/latencyz``    — latency attribution (ISSUE 8): the per-
+                           pipeline stage waterfall (p50/p95/p99 per
+                           stage), the deadline-burn table (fraction of
+                           budget per stage + expiry blames), recent
+                           frame timelines, and the SLO burn-rate
+                           status
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
 """
@@ -115,12 +121,25 @@ class ZPagesExtension(HttpExtension):
             out["conditions"] = rollup.evaluate()
         return 200, out
 
+    def _latencyz(self, q: dict[str, str]) -> tuple[int, dict]:
+        from ...selftelemetry.latency import latency_ledger
+
+        out = latency_ledger.snapshot()
+        g = self._graph
+        rollup = getattr(g, "flow_health", None) if g is not None else None
+        if rollup is not None:
+            out["conditions"] = [
+                c for c in rollup.evaluate()
+                if c["component"].startswith("slo/")]
+        return 200, out
+
     def pages(self) -> dict[str, Page]:
         return {"/debug/pipelinez": self._pipelinez,
                 "/debug/servicez": self._servicez,
                 "/debug/extensionz": self._extensionz,
                 "/debug/tracez": self._tracez,
-                "/debug/flowz": self._flowz}
+                "/debug/flowz": self._flowz,
+                "/debug/latencyz": self._latencyz}
 
 
 register(Factory(

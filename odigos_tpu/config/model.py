@@ -98,6 +98,22 @@ class RolloutConfiguration:
 
 
 @dataclass
+class SloConfiguration:
+    """Declarative service-level objectives for the anomaly pipeline
+    (ISSUE 8): rendered by pipelinegen as the root traces pipeline's
+    ``slo:`` stanza and evaluated with Google-SRE-style fast/slow-window
+    burn rates (selftelemetry/latency.SloTracker). A p99 latency target
+    affords a 1 % error budget; a scored-fraction target Y affords 1−Y.
+    Both objectives optional — None renders nothing (byte-stable
+    configs for installs without SLOs)."""
+
+    latency_p99_ms: Optional[float] = None
+    scored_fraction: Optional[float] = None
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+
+
+@dataclass
 class AnomalyStageConfiguration:
     """First-class config for the TPU anomaly-detection stage (north star:
     tpuanomalyprocessor + anomalyrouter + TPU sidecar)."""
@@ -118,6 +134,9 @@ class AnomalyStageConfiguration:
     # coalescer, bypassing the componentwise batch/score seams; the
     # scoring timeout doubles as the per-frame admission deadline
     fast_path: bool = False
+    # declarative burn-rate SLOs for the root traces pipeline (ISSUE 8);
+    # None renders nothing — existing configs stay byte-identical
+    slo: Optional[SloConfiguration] = None
 
 
 @dataclass
@@ -217,7 +236,8 @@ class Configuration:
 
 # Optional nested-dataclass fields (default=None, so no default_factory to
 # infer the type from at runtime under `from __future__ import annotations`)
-_OPTIONAL_NESTED: dict[str, type] = {"oidc": OidcConfiguration}
+_OPTIONAL_NESTED: dict[str, type] = {"oidc": OidcConfiguration,
+                                     "slo": SloConfiguration}
 
 
 def _from_dict(cls, data):

@@ -383,6 +383,23 @@ class _Handler(BaseHTTPRequestHandler):
                     "watermarks": snap["watermarks"],
                     "conditions": active_conditions(),
                 })
+            if path == "/api/slo":
+                # latency attribution & SLO burn (ISSUE 8): per-pipeline
+                # burn-rate status over the declared objectives, the
+                # stage waterfall feeding it, and the slo/<pipeline>
+                # condition rows from the live rollups
+                from ..selftelemetry.flow import active_conditions
+                from ..selftelemetry.latency import latency_ledger
+
+                return self._json({
+                    "enabled": latency_ledger.enabled,
+                    "pipelines": latency_ledger.slo_status(),
+                    "waterfall": latency_ledger.waterfall(),
+                    "burn": latency_ledger.burn(),
+                    "conditions": [
+                        c for c in active_conditions()
+                        if c["component"].startswith("slo/")],
+                })
             if path == "/api/metrics":
                 out = fe.metrics.throughput()
                 # the server process's own meter complements the stream

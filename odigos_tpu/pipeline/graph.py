@@ -135,6 +135,64 @@ def validate_config(config: dict[str, Any]) -> list[str]:
         for eid in p.get("exporters", []):
             if eid not in declared[ComponentKind.EXPORTER] and eid not in conn_ids:
                 problems.append(f"pipeline {pname}: unknown exporter {eid}")
+        slo = p.get("slo")
+        if slo is not None:
+            # declarative SLOs (ISSUE 8): a malformed objective must die
+            # at validation, not silently evaluate to "never burning"
+            if not isinstance(slo, dict):
+                problems.append(f"pipeline {pname}: slo must be a mapping")
+            else:
+                unknown = set(slo) - {
+                    "latency_p99_ms", "scored_fraction", "fast_window_s",
+                    "slow_window_s", "fast_burn_threshold",
+                    "slow_burn_threshold"}
+                if unknown:
+                    problems.append(
+                        f"pipeline {pname}: unknown slo keys "
+                        f"{sorted(unknown)}")
+                if not slo.get("latency_p99_ms") \
+                        and not slo.get("scored_fraction"):
+                    problems.append(
+                        f"pipeline {pname}: slo declares no objective "
+                        f"(latency_p99_ms or scored_fraction)")
+
+                def _num(key):
+                    # a non-numeric objective must become a NAMED problem
+                    # in the aggregated list, never an exception that
+                    # masks every other config error
+                    v = slo.get(key)
+                    if v is None:
+                        return None
+                    try:
+                        return float(v)
+                    except (TypeError, ValueError):
+                        problems.append(
+                            f"pipeline {pname}: slo.{key} must be a "
+                            f"number, got {v!r}")
+                        return None
+
+                lat = _num("latency_p99_ms")
+                if lat is not None and lat <= 0:
+                    problems.append(
+                        f"pipeline {pname}: slo.latency_p99_ms must be "
+                        f"positive")
+                sf = _num("scored_fraction")
+                if sf is not None and not 0.0 < sf < 1.0:
+                    # a target of exactly 1.0 leaves a zero error budget
+                    # and every frame would page — refuse loudly
+                    problems.append(
+                        f"pipeline {pname}: slo.scored_fraction must be "
+                        f"in (0, 1)")
+                for key in ("fast_window_s", "slow_window_s",
+                            "fast_burn_threshold",
+                            "slow_burn_threshold"):
+                    v = _num(key)
+                    if v is not None and v <= 0:
+                        # a zero/negative window or threshold silently
+                        # evaluates to "never burning" — a dead SLO
+                        problems.append(
+                            f"pipeline {pname}: slo.{key} must be "
+                            f"positive")
         if p.get("fast_path"):
             pids = [pid.split("/", 1)[0] for pid in p.get("processors", [])]
             if "tpuanomaly" not in pids:
@@ -372,6 +430,18 @@ def build_graph(config: dict[str, Any],
                 (pname, fp.name, signal))
         flow_ledger.register_pipeline(pname, reg_procs, terminal_ids,
                                       signal)
+        from ..selftelemetry.latency import latency_ledger
+
+        slo_cfg = p.get("slo")
+        if slo_cfg:
+            # burn-rate SLO tracker (ISSUE 8): keyed by pipeline name,
+            # stable across hot reloads (get-or-create like flow edges)
+            # so burn history survives a graph swap mid-incident
+            latency_ledger.configure_slo(pname, dict(slo_cfg))
+        else:
+            # a reload that DELETES the stanza must also retire the
+            # tracker, or the stale objectives keep evaluating
+            latency_ledger.remove_slo(pname)
         # self-tracing weave: one pipeline/<name> span per batch at the
         # entry; receivers and connector outputs both route through the
         # entry map, so every ingress edge is covered. Free when the

@@ -318,6 +318,24 @@ def build_gateway_config(
             "exporters": exporters,
         }
         if sig == Signal.TRACES and anomaly_on \
+                and getattr(anomaly, "slo", None) is not None:
+            # declarative SLOs (ISSUE 8): the root traces pipeline gets
+            # an ``slo:`` stanza evaluated by the latency-attribution
+            # layer's fast/slow-window burn rates; objectives left None
+            # are omitted, and a fully-empty SloConfiguration renders
+            # nothing (byte-stable for installs without SLOs)
+            slo = anomaly.slo
+            spec: GenericMap = {}
+            if slo.latency_p99_ms:
+                spec["latency_p99_ms"] = slo.latency_p99_ms
+            if slo.scored_fraction:
+                spec["scored_fraction"] = slo.scored_fraction
+            if spec:
+                spec["fast_window_s"] = slo.fast_window_s
+                spec["slow_window_s"] = slo.slow_window_s
+                config["service"]["pipelines"][
+                    root_pipeline_name(sig)]["slo"] = spec
+        if sig == Signal.TRACES and anomaly_on \
                 and getattr(anomaly, "fast_path", False):
             # ingest fast path: decoded wire frames featurize once and
             # ride the engine's deadline-based adaptive coalescer; the

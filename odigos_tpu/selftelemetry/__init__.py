@@ -17,6 +17,19 @@ from .flow import (  # noqa: F401
     flow_ledger,
 )
 from .instrument import TracedEntry, trace_pipeline_entry  # noqa: F401
+from .latency import (  # noqa: F401
+    ENGINE_STAGES,
+    NULL_CLOCK,
+    SloTracker,
+    Stage,
+    StageClock,
+    claim_clock,
+    latency_enabled,
+    latency_ledger,
+    publish_clock,
+    start_clock,
+    unpublish_clock,
+)
 from .profiler import (  # noqa: F401
     ContinuousProfiler,
     DeviceRuntimeCollector,

@@ -271,7 +271,8 @@ class FlowLedger:
     # ------------------------------------------------------------ drops
 
     def record_drop(self, n: int, reason: str, pipeline: str,
-                    component: str, signal: str) -> None:
+                    component: str, signal: str,
+                    blame: Optional[str] = None) -> None:
         if n <= 0 or not self.enabled:
             return
         if reason not in DROP_REASONS:
@@ -288,12 +289,18 @@ class FlowLedger:
                 "unix_ts": time.time(),
                 "trace_id": f"{ctx[0]:032x}" if ctx else None,
                 "span_id": f"{ctx[1]:016x}" if ctx else None,
+                **({"blame": blame} if blame else {}),
             }
         # counters live-published (drops are rare — not hot-path cost);
         # the histogram carries the exemplar that links /metrics to the
         # self-trace active when the drop happened
         labels = {"pipeline": pipeline, "component": component,
                   "reason": reason}
+        if blame:
+            # deadline-burn blame (ISSUE 8): a latency-attribution
+            # DIMENSION on the closed taxonomy, never a new reason —
+            # unblamed drops keep their exact pre-existing metric keys
+            labels["blame"] = blame
         meter.add(labeled_key(DROPPED_METRIC, **labels), n)
         meter.record(labeled_key(DROP_SIZE_METRIC, **labels), float(n),
                      exemplar=(ctx[0], ctx[1]) if ctx else None)
@@ -483,14 +490,17 @@ class FlowContext:
     def drop(n: int, reason: str, component: Any = None,
              pipeline: Optional[str] = None,
              component_name: Optional[str] = None,
-             signal: Optional[str] = None, exc: Any = None) -> None:
+             signal: Optional[str] = None, exc: Any = None,
+             blame: Optional[str] = None) -> None:
         """Record ``n`` items intentionally shed for ``reason`` (one of
         :data:`DROP_REASONS`). Attribution order: explicit kwargs, the
         component's graph-stamped ``_flow_site``, then the calling
         edge's contextvar site (shared connectors). ``exc`` marks an
         about-to-be-raised exception as already accounted so the edge
         unwind does not double-count it as failed (memory_limiter's
-        reject-then-raise)."""
+        reject-then-raise). ``blame`` (ISSUE 8) optionally names the
+        latency stage that consumed the budget behind a deadline-driven
+        shed — a dimension on the taxonomy, not a new reason."""
         if n <= 0 or not flow_ledger.enabled:
             return
         site = getattr(component, "_flow_site", None) \
@@ -507,7 +517,7 @@ class FlowContext:
         if exc is not None:
             FlowContext.mark_counted(exc, pipeline)
         flow_ledger.record_drop(int(n), reason, pipeline, component_name,
-                                signal)
+                                signal, blame=blame)
 
     @staticmethod
     def mark_counted(exc: Any, pipeline: str) -> None:
@@ -786,6 +796,35 @@ class HealthRollup:
                     cond = self._upsert(
                         node, HEALTHY, "Conserved",
                         f"in={bal['items_in']} out={bal['items_out']}")
+                out.append(dict(cond))
+            # SLO burn conditions (ISSUE 8): one slo/<pipeline> row per
+            # configured SLO, scoped to this rollup's graph like the
+            # conservation rows. Fresh burn math per evaluation (the
+            # tracker's windows are time-pruned), so alternating pollers
+            # agree and a drained fast window clears the condition.
+            from .latency import latency_ledger
+
+            own_pipelines = set(graph.pipeline_processors) \
+                if graph is not None else None
+            for pname, slo in latency_ledger.slo_status().items():
+                if own_pipelines is not None \
+                        and pname not in own_pipelines:
+                    continue
+                node = f"slo/{pname}"
+                live.add(node)
+                if slo["burning"]:
+                    cond = self._upsert(
+                        node, DEGRADED, "SLOBurn",
+                        f"{slo['worst_objective']} burning at "
+                        f"{slo['fast']['burn']}x over "
+                        f"{slo['fast']['window_s']:g}s "
+                        f"(slow {slo['slow']['burn']}x over "
+                        f"{slo['slow']['window_s']:g}s)")
+                else:
+                    cond = self._upsert(
+                        node, HEALTHY, "WithinBudget",
+                        f"fast burn {slo['fast']['burn']}x / "
+                        f"slow {slo['slow']['burn']}x")
                 out.append(dict(cond))
             # prune components gone from the graph (reload removed them)
             for name in list(self._state):

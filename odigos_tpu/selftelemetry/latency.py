@@ -1,0 +1,593 @@
+"""Latency attribution: per-frame stage waterfall, deadline-burn blame,
+and multi-window burn-rate SLOs.
+
+The flow ledger (PR 5) proves *what* flows — conservation per edge,
+named drops — but not *where time goes*: SOAK.json records a 360 ms p99
+with zero attribution across
+wire→admission→decode→featurize→queue→pack→device→harvest→tag→forward.
+This module is that attribution layer, the signal the ROADMAP's
+auto-tuner item ("closes the loop from profiler/gauges back into batch
+sizes, ladder rungs, replica counts") is blocked on:
+
+* a :class:`StageClock` rides each wire frame through the ingest fast
+  path and the scoring engine — the wire receiver stamps the admission
+  verdict and decode, the fast path stamps featurize/enqueue/wait/tag/
+  forward, and the engine's per-call ``pack_ms``/``harvest_ms``/
+  ``overlap_ms`` accounting (PR 2) is merged in as the
+  queue/pack/device/harvest stages. Within ONE frame the stages tile
+  its wall end to end (queue→pack→device→harvest is that frame's own
+  serial critical path even under the depth-2 pipelined window; the
+  cross-call host/device overlap rides along as ``overlap_ms``), so
+  ``Σ stages ≈ wall`` per frame — the accounting
+  ``tests/test_latency.py`` pins within tolerance.
+* stage durations aggregate into
+  ``odigos_latency_stage_ms{pipeline=,stage=}`` histograms with
+  exemplars linking each tail sample to the self-trace that carried the
+  frame (resolve via ``/api/selftrace?trace_id=``), plus a per-pipeline
+  ``odigos_latency_e2e_ms`` end-to-end histogram.
+* deadline-carrying frames get **burn accounting**: the burn table
+  reports which stage consumed what fraction of the admission budget,
+  and every expired deadline names a **blamed stage** — ``device`` when
+  the request had been dispatched (the device call outran the budget),
+  ``queue`` when it never left the engine queue. Blame is a new
+  *dimension* on the existing drop taxonomy (``FlowContext.drop(...,
+  blame=)`` and ``odigos_latency_deadline_expired_spans_total
+  {pipeline=,blame=}``), never a new drop reason.
+* declarative SLOs (``slo: {latency_p99_ms, scored_fraction}`` per
+  pipeline, rendered by pipelinegen from ``anomaly.slo``) evaluate with
+  Google-SRE-style fast/slow-window burn rates: burn = observed
+  bad-fraction ÷ error budget (a p99 target affords a 1 % budget; a
+  scored-fraction target Y affords 1−Y). ``SLOBurn`` raises while the
+  fast window burns ≥ ``fast_burn_threshold`` (default 14.4, the SRE
+  page threshold) AND the slow window confirms budget is actually being
+  consumed (burn ≥ ``slow_burn_threshold``, default 1.0) — so a fault
+  flips the condition within the fast window and a recovery clears it
+  as soon as the fast window drains. Conditions surface through PR 5's
+  ``HealthRollup`` as ``slo/<pipeline>`` rows, on ``GET /api/slo``,
+  ``/debug/latencyz``, the dashboard, describe, and the diagnose
+  bundle's ``latency.json``.
+
+``ODIGOS_LATENCY=0`` disables the layer (clocks become no-ops, nothing
+records) — the same opt-out contract as ``ODIGOS_FLOW`` /
+``ODIGOS_SELFTRACE``. bench.py ``latency_attribution_overhead`` holds
+the enabled cost under 2 % on the fast-path soak route.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import enum
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..utils.telemetry import labeled_key, meter
+
+STAGE_METRIC = "odigos_latency_stage_ms"
+E2E_METRIC = "odigos_latency_e2e_ms"
+EXPIRED_METRIC = "odigos_latency_deadline_expired_spans_total"
+
+# SRE multi-window defaults: 14.4 is the classic page-threshold burn
+# rate (2 % of a 30-day budget in one hour); the slow window confirms
+# at >= 1.0 ("budget is actually being consumed"), so detection latency
+# is bounded by the FAST window while one tail blip cannot page alone.
+DEFAULT_FAST_WINDOW_S = 60.0
+DEFAULT_SLOW_WINDOW_S = 300.0
+DEFAULT_FAST_BURN = 14.4
+DEFAULT_SLOW_BURN = 1.0
+
+
+class Stage(enum.Enum):
+    """The closed stage taxonomy one frame traverses on the fast path.
+
+    Closed for the same reason DROP_REASONS is: free-form stage names
+    would rot into unaggregatable cardinality. The package-hygiene lint
+    (``TestLatencyStageHygiene``) asserts every member has exactly one
+    stamp site across the fast path — a stage stamped twice would
+    double-count its wall, a stage never stamped would silently vanish
+    from the waterfall.
+    """
+
+    ADMISSION = "admission"   # frame header read -> admission verdict
+    DECODE = "decode"         # verdict -> zero-copy decoded SpanBatch
+    FEATURIZE = "featurize"   # decode -> device-ready feature matrices
+    ENQUEUE = "enqueue"       # featurized -> engine queue accepted
+    QUEUE = "queue"           # engine queue wait (submit -> pack start)
+    PACK = "pack"             # host coalesce/pack (pack start -> dispatch)
+    DEVICE = "device"         # device execution (dispatch -> harvest start)
+    HARVEST = "harvest"       # result fetch + scatter (harvest -> scores)
+    WAIT = "wait"             # scores ready -> forwarder picks the frame up
+    TAG = "tag"               # anomaly attribute tagging
+    FORWARD = "forward"       # downstream consume (router/exporter edge)
+
+
+# the four stages the ENGINE accounts per coalesced call (PR 2's
+# pack/device/harvest split + per-request queue wait), merged into the
+# frame clock by ``StageClock.merge_engine`` — the lint counts this
+# tuple as those stages' single stamp site
+ENGINE_STAGES = (Stage.QUEUE, Stage.PACK, Stage.DEVICE, Stage.HARVEST)
+
+STAGES = tuple(s.value for s in Stage)
+
+
+class StageClock:
+    """Per-frame stage timeline: consecutive ``stamp()`` calls turn one
+    monotonic clock read each into the duration since the previous mark,
+    so the stages tile the frame's wall exactly (no gaps, no overlaps
+    within one frame). Threads hand the clock off FIFO with the frame
+    (receiver thread -> forwarder thread); the window queue is the
+    synchronization, the clock itself is never shared concurrently."""
+
+    __slots__ = ("t0", "_mark", "stages", "ctx", "overlap_ms")
+
+    def __init__(self, ctx: Optional[tuple[int, int]] = None):
+        self.t0 = self._mark = time.monotonic_ns()
+        # (stage label, duration_ms) in traversal order
+        self.stages: list[tuple[str, float]] = []
+        self.ctx = ctx  # (trace_id, span_id) exemplar link
+        self.overlap_ms = 0.0
+
+    def stamp(self, stage: Stage) -> None:
+        now = time.monotonic_ns()
+        self.stages.append((stage.value, (now - self._mark) / 1e6))
+        self._mark = now
+
+    def bind_trace(self, ctx: Optional[tuple]) -> None:
+        """Attach the self-trace context carrying this frame (the
+        pipeline/<name> span): every histogram sample this clock records
+        becomes an exemplar resolvable via /api/selftrace."""
+        if ctx is not None:
+            self.ctx = (ctx[0], ctx[1])
+
+    def merge_engine(self, info: dict[str, Any]) -> None:
+        """Fold one engine call's stage boundaries (monotonic ns, same
+        clock domain — ``ScoreRequest.stage_ns``) into the timeline as
+        the QUEUE/PACK/DEVICE/HARVEST stages. Boundaries are clamped
+        monotone non-decreasing from the current mark: the engine worker
+        can start packing BEFORE the intake thread stamps ENQUEUE (the
+        depth-2 window races submit), and a negative stage would corrupt
+        the tiling by more than the microseconds it saves."""
+        mark = self._mark
+        for stage, end in zip(ENGINE_STAGES,
+                              (info["pack0"], info["dispatch"],
+                               info["harvest0"], info["end"])):
+            end = max(int(end), mark)
+            self.stages.append((stage.value, (end - mark) / 1e6))
+            mark = end
+        self._mark = mark
+        self.overlap_ms = float(info.get("overlap_ms") or 0.0)
+
+    def wall_ms(self) -> float:
+        return (self._mark - self.t0) / 1e6
+
+    def sum_ms(self) -> float:
+        return sum(d for _, d in self.stages)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"stages": [{"stage": s, "ms": round(d, 4)}
+                           for s, d in self.stages],
+                "wall_ms": round(self.wall_ms(), 4),
+                "overlap_ms": round(self.overlap_ms, 4)}
+
+
+class _NullClock:
+    """Shared no-op clock when the layer is disabled (ODIGOS_LATENCY=0):
+    every stamp site pays one attribute load and a no-op call."""
+
+    __slots__ = ()
+    ctx = None
+    overlap_ms = 0.0
+    stages: list = []
+
+    def stamp(self, stage: Stage) -> None:
+        pass
+
+    def bind_trace(self, ctx) -> None:
+        pass
+
+    def merge_engine(self, info) -> None:
+        pass
+
+    def wall_ms(self) -> float:
+        return 0.0
+
+    def sum_ms(self) -> float:
+        return 0.0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"stages": [], "wall_ms": 0.0, "overlap_ms": 0.0}
+
+
+NULL_CLOCK = _NullClock()
+
+# hands the receiver-started clock to the fast path across the consume
+# seam (same thread, synchronous call chain — the receiver cannot pass
+# a parameter through the Consumer interface without breaking every
+# other consumer)
+_active_clock: contextvars.ContextVar[Optional[StageClock]] = \
+    contextvars.ContextVar("odigos_latency_clock", default=None)
+
+
+def start_clock() -> StageClock:
+    """A fresh frame clock, or the shared no-op when the layer is off."""
+    if not latency_ledger.enabled:
+        return NULL_CLOCK  # type: ignore[return-value]
+    return StageClock()
+
+
+def publish_clock(clock) -> contextvars.Token:
+    return _active_clock.set(clock if clock is not NULL_CLOCK else None)
+
+
+def unpublish_clock(token: contextvars.Token) -> None:
+    _active_clock.reset(token)
+
+
+def claim_clock():
+    """Take the receiver-published clock (one claimant per frame); a
+    directly-fed fast path (no wire hop) starts its own, so the
+    waterfall simply lacks the admission/decode stages."""
+    clock = _active_clock.get()
+    if clock is not None:
+        _active_clock.set(None)
+        return clock
+    return start_clock()
+
+
+def latency_enabled() -> bool:
+    return latency_ledger.enabled
+
+
+class _Recorder:
+    """Per-pipeline aggregation: stage/e2e histograms (meter-resident,
+    exemplar-carrying), per-stage running totals for the burn table, an
+    expiry-blame table, and a bounded ring of recent clocks (the
+    ``/debug/latencyz`` waterfall witnesses and the accounting tests'
+    evidence)."""
+
+    __slots__ = ("pipeline", "deadline_ms", "frames", "scored_frames",
+                 "overlap_ms_total", "_stage_keys", "_e2e_key", "_totals",
+                 "_expired", "recent", "_lock")
+
+    def __init__(self, pipeline: str):
+        self.pipeline = pipeline
+        self.deadline_ms: Optional[float] = None
+        self.frames = 0
+        self.scored_frames = 0
+        self.overlap_ms_total = 0.0
+        self._stage_keys = {
+            s: labeled_key(STAGE_METRIC, pipeline=pipeline, stage=s)
+            for s in STAGES}
+        self._e2e_key = labeled_key(E2E_METRIC, pipeline=pipeline)
+        self._totals: dict[str, list[float]] = {}  # stage -> [sum, count]
+        self._expired: dict[str, int] = {}         # blame -> spans
+        self.recent: deque[dict[str, Any]] = deque(maxlen=64)
+        self._lock = threading.Lock()
+
+    def observe(self, clock: StageClock, scored: bool) -> None:
+        wall = clock.wall_ms()
+        ex = clock.ctx
+        if scored:
+            # stage histograms carry scored frames only: an expired
+            # frame's engine stages are unknowable (the request never
+            # harvested), and recording its truncated partials would
+            # bias exactly the tails the waterfall exists to explain.
+            # One record_many = one meter lock hold for the whole
+            # waterfall; the exemplar reservoir stays populated from
+            # every 8th frame (algorithm-R does not need every sample
+            # to carry a witness — allocating 11 exemplars per frame
+            # would be the layer's own overhead bound violation)
+            keys = self._stage_keys
+            samples = [(keys[stage], d) for stage, d in clock.stages]
+            samples.append((self._e2e_key, wall))
+            stage_ex = ex if (self.frames & 7) == 0 else None
+            meter.record_many(samples, exemplar=stage_ex)
+        else:
+            meter.record(self._e2e_key, wall, exemplar=ex)
+        with self._lock:
+            self.frames += 1
+            if scored:
+                self.scored_frames += 1
+                self.overlap_ms_total += clock.overlap_ms
+                totals = self._totals
+                for stage, d in clock.stages:
+                    tot = totals.get(stage)
+                    if tot is None:
+                        tot = totals[stage] = [0.0, 0]
+                    tot[0] += d
+                    tot[1] += 1
+            # raw refs only — the clock is dead after retire, and
+            # rendering dicts per frame costs more than the rest of
+            # this method (snapshot() renders on demand)
+            self.recent.append(
+                (clock.stages, wall, clock.overlap_ms, scored))
+
+    def record_expiry(self, blame: str, n_spans: int) -> None:
+        with self._lock:
+            self._expired[blame] = self._expired.get(blame, 0) + n_spans
+
+    def waterfall(self) -> dict[str, dict[str, float]]:
+        """Per-stage p50/p95/p99/mean over the meter histograms, in
+        traversal order (stages with no samples are omitted)."""
+        out: dict[str, dict[str, float]] = {}
+        with self._lock:
+            totals = {s: (t[0], t[1]) for s, t in self._totals.items()}
+        for s in STAGES:
+            tot = totals.get(s)
+            if not tot or not tot[1]:
+                continue
+            key = self._stage_keys[s]
+            out[s] = {
+                "p50_ms": round(meter.quantile(key, 0.50), 4),
+                "p95_ms": round(meter.quantile(key, 0.95), 4),
+                "p99_ms": round(meter.quantile(key, 0.99), 4),
+                "mean_ms": round(tot[0] / tot[1], 4),
+                "count": tot[1],
+            }
+        return out
+
+    def burn(self) -> dict[str, Any]:
+        """The deadline-burn table: which stage consumed what fraction
+        of the admission budget (mean stage wall ÷ deadline), plus the
+        expiry-blame tally. Fractions are per-frame means, so a stage
+        holding steady at 0.6 of budget is the tuning target even while
+        nothing expires yet."""
+        with self._lock:
+            totals = {s: (t[0], t[1]) for s, t in self._totals.items()}
+            expired = dict(self._expired)
+            deadline = self.deadline_ms
+        by_stage = {}
+        for s in STAGES:
+            tot = totals.get(s)
+            if not tot or not tot[1]:
+                continue
+            mean = tot[0] / tot[1]
+            row = {"mean_ms": round(mean, 4)}
+            if deadline:
+                row["frac_of_budget"] = round(mean / deadline, 4)
+            by_stage[s] = row
+        return {"deadline_ms": deadline, "stages": by_stage,
+                "expired_spans_by_blame": expired}
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            recent = list(self.recent)[-8:]
+            frames, scored = self.frames, self.scored_frames
+            overlap = self.overlap_ms_total
+        return {
+            "frames": frames, "scored_frames": scored,
+            "overlap_ms_total": round(overlap, 3),
+            "waterfall": self.waterfall(), "burn": self.burn(),
+            "recent": [
+                {"stages": [{"stage": s, "ms": round(d, 4)}
+                            for s, d in stages],
+                 "wall_ms": round(wall, 4),
+                 "overlap_ms": round(ov, 4), "scored": sc}
+                for stages, wall, ov, sc in recent],
+        }
+
+
+class SloTracker:
+    """Multi-window burn-rate evaluation of one pipeline's declarative
+    SLO. Per-frame samples (timestamp, latency-violated, scored) live in
+    a time-pruned deque; ``status()`` computes the fast/slow-window
+    burns fresh on every call, so alternating pollers (healthcheck,
+    zpages, dashboard, tests with an injected clock) always agree."""
+
+    def __init__(self, pipeline: str, cfg: dict[str, Any],
+                 clock: Callable[[], float] = time.monotonic):
+        self.pipeline = pipeline
+        self.latency_p99_ms = (float(cfg["latency_p99_ms"])
+                               if cfg.get("latency_p99_ms") else None)
+        self.scored_fraction = (float(cfg["scored_fraction"])
+                                if cfg.get("scored_fraction") else None)
+        self.fast_window_s = float(cfg.get("fast_window_s",
+                                           DEFAULT_FAST_WINDOW_S))
+        self.slow_window_s = float(cfg.get("slow_window_s",
+                                           DEFAULT_SLOW_WINDOW_S))
+        self.fast_burn_threshold = float(cfg.get("fast_burn_threshold",
+                                                 DEFAULT_FAST_BURN))
+        self.slow_burn_threshold = float(cfg.get("slow_burn_threshold",
+                                                 DEFAULT_SLOW_BURN))
+        self._clock = clock
+        self._lock = threading.Lock()
+        # (t, n_spans, latency_violated, unscored)
+        self._samples: deque[tuple[float, int, bool, bool]] = deque()
+
+    def observe(self, wall_ms: float, scored: bool, n_spans: int) -> None:
+        now = self._clock()
+        violated = (self.latency_p99_ms is not None
+                    and wall_ms > self.latency_p99_ms)
+        with self._lock:
+            self._samples.append((now, n_spans, violated, not scored))
+            horizon = now - self.slow_window_s
+            while self._samples and self._samples[0][0] < horizon:
+                self._samples.popleft()
+
+    def _render(self, window_s: float,
+                counts: tuple[int, int, int]) -> dict[str, Any]:
+        total, lat_bad, unscored = counts
+        burns = {}
+        if self.latency_p99_ms is not None and total:
+            burns["latency_p99_ms"] = (lat_bad / total) / 0.01
+        if self.scored_fraction is not None and total:
+            budget = max(1.0 - self.scored_fraction, 1e-9)
+            burns["scored_fraction"] = (unscored / total) / budget
+        worst = max(burns, key=burns.get) if burns else None
+        return {"window_s": window_s, "spans": total,
+                "latency_violations": lat_bad, "unscored": unscored,
+                "burn": round(max(burns.values()), 4) if burns else 0.0,
+                "burn_by_objective": {k: round(v, 4)
+                                      for k, v in burns.items()},
+                "worst_objective": worst}
+
+    def status(self) -> dict[str, Any]:
+        now = self._clock()
+        fast_cut = now - self.fast_window_s
+        with self._lock:
+            horizon = now - self.slow_window_s
+            while self._samples and self._samples[0][0] < horizon:
+                self._samples.popleft()
+            # ONE pass over the (already slow-window-pruned) deque: the
+            # fast window is a subset of the slow one, and every poller
+            # (healthcheck, zpages, /api/slo, dashboard) holds the same
+            # lock the forwarder's observe() needs — two full scans per
+            # poll would stall the fast path exactly under load
+            f = [0, 0, 0]
+            s = [0, 0, 0]
+            for t, n, violated, not_scored in self._samples:
+                s[0] += n
+                if violated:
+                    s[1] += n
+                if not_scored:
+                    s[2] += n
+                if t >= fast_cut:
+                    f[0] += n
+                    if violated:
+                        f[1] += n
+                    if not_scored:
+                        f[2] += n
+        fast = self._render(self.fast_window_s, tuple(f))
+        slow = self._render(self.slow_window_s, tuple(s))
+        burning = (fast["burn"] >= self.fast_burn_threshold
+                   and slow["burn"] >= self.slow_burn_threshold)
+        objective = fast["worst_objective"] or slow["worst_objective"]
+        return {
+            "pipeline": self.pipeline,
+            "objectives": {
+                k: v for k, v in (
+                    ("latency_p99_ms", self.latency_p99_ms),
+                    ("scored_fraction", self.scored_fraction))
+                if v is not None},
+            "fast": fast, "slow": slow,
+            "fast_burn_threshold": self.fast_burn_threshold,
+            "slow_burn_threshold": self.slow_burn_threshold,
+            "burning": burning,
+            "worst_objective": objective,
+        }
+
+
+class LatencyLedger:
+    """Process-global latency-attribution registry (the flow_ledger /
+    meter / tracer sibling)."""
+
+    def __init__(self) -> None:
+        self.enabled = os.environ.get("ODIGOS_LATENCY", "1") != "0"
+        self._lock = threading.Lock()
+        self._recorders: dict[str, _Recorder] = {}
+        self._slos: dict[str, SloTracker] = {}
+        self._expired_keys: dict[tuple[str, str], str] = {}
+
+    # -------------------------------------------------------- recorders
+
+    def recorder(self, pipeline: str) -> _Recorder:
+        with self._lock:
+            rec = self._recorders.get(pipeline)
+            if rec is None:
+                rec = self._recorders[pipeline] = _Recorder(pipeline)
+            return rec
+
+    def set_deadline(self, pipeline: str, deadline_ms: float) -> None:
+        self.recorder(pipeline).deadline_ms = float(deadline_ms)
+
+    def configure_slo(self, pipeline: str, cfg: dict[str, Any],
+                      clock: Callable[[], float] = time.monotonic
+                      ) -> SloTracker:
+        """Get-or-create the pipeline's SLO tracker. Stable across hot
+        reloads (an identical config re-binds the same tracker, so burn
+        history survives the swap — the flow-edge discipline); ANY
+        changed setting re-creates it — windows and thresholds redefine
+        the burn math, so silently keeping the old ones would make a
+        reload mid-incident a no-op."""
+        candidate = SloTracker(pipeline, cfg, clock)
+        with self._lock:
+            tracker = self._slos.get(pipeline)
+            if tracker is not None and (
+                    tracker.latency_p99_ms, tracker.scored_fraction,
+                    tracker.fast_window_s, tracker.slow_window_s,
+                    tracker.fast_burn_threshold,
+                    tracker.slow_burn_threshold) == (
+                    candidate.latency_p99_ms, candidate.scored_fraction,
+                    candidate.fast_window_s, candidate.slow_window_s,
+                    candidate.fast_burn_threshold,
+                    candidate.slow_burn_threshold):
+                return tracker
+            self._slos[pipeline] = candidate
+            return candidate
+
+    def remove_slo(self, pipeline: str) -> None:
+        """Drop the pipeline's tracker. Called by graph build when a
+        (re)loaded config carries no ``slo:`` stanza for the pipeline —
+        without this, deleting the stanza mid-incident would leave the
+        old objectives evaluating (and paging) forever."""
+        with self._lock:
+            self._slos.pop(pipeline, None)
+
+    # ------------------------------------------------------- hot path
+
+    def observe(self, pipeline: str, clock, scored: bool,
+                n_spans: int) -> None:
+        """One frame retired by the fast path: aggregate its waterfall
+        and feed the pipeline's SLO tracker (if one is configured)."""
+        if not self.enabled or clock is NULL_CLOCK:
+            return
+        self.recorder(pipeline).observe(clock, scored)
+        tracker = self._slos.get(pipeline)
+        if tracker is not None:
+            tracker.observe(clock.wall_ms(), scored, n_spans)
+
+    def record_expiry(self, pipeline: str, blame: Stage,
+                      n_spans: int) -> None:
+        """An expired admission deadline, blamed on the stage that
+        consumed the budget (the burn dimension on the drop taxonomy)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            key = self._expired_keys.get((pipeline, blame.value))
+            if key is None:
+                key = self._expired_keys[(pipeline, blame.value)] = \
+                    labeled_key(EXPIRED_METRIC, pipeline=pipeline,
+                                blame=blame.value)
+        meter.add(key, n_spans)
+        self.recorder(pipeline).record_expiry(blame.value, n_spans)
+
+    # -------------------------------------------------------- surfaces
+
+    def waterfall(self) -> dict[str, dict[str, dict[str, float]]]:
+        with self._lock:
+            recs = list(self._recorders.values())
+        return {r.pipeline: r.waterfall() for r in recs}
+
+    def burn(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            recs = list(self._recorders.values())
+        return {r.pipeline: r.burn() for r in recs}
+
+    def slo_status(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            trackers = list(self._slos.values())
+        return {t.pipeline: t.status() for t in trackers}
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-able dump (``/debug/latencyz``, diagnose ``latency.json``)."""
+        with self._lock:
+            recs = list(self._recorders.values())
+        return {
+            "enabled": self.enabled,
+            "stages": list(STAGES),
+            "pipelines": {r.pipeline: r.snapshot() for r in recs},
+            "slo": self.slo_status(),
+        }
+
+    def reset(self) -> None:
+        """Test isolation: forget every recorder/tracker (live fast
+        paths lazily re-create theirs — the flow_ledger.reset contract)."""
+        with self._lock:
+            self._recorders.clear()
+            self._slos.clear()
+            self._expired_keys.clear()
+
+
+latency_ledger = LatencyLedger()

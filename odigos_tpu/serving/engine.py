@@ -56,6 +56,7 @@ from ..features.featurizer import (
     pack_sequences)
 from ..pdata.spans import SpanBatch
 from ..selftelemetry.flow import FlowContext
+from ..selftelemetry.latency import latency_enabled
 from ..selftelemetry.profiler import engines as _engine_registry
 from ..selftelemetry.tracer import (
     NULL_SPAN, is_selftelemetry_batch, tracer)
@@ -598,6 +599,15 @@ class ScoreRequest:
     # coalesced call so the harvest lands inside it (adaptive batching);
     # None = legacy fixed coalescing up to max_batch_spans
     deadline_ns: Optional[int] = None
+    # latency attribution (ISSUE 8): stage boundaries of the device call
+    # that scored this request — {pack0, dispatch, harvest0, end} in
+    # monotonic ns + overlap_ms — shared per coalesced group, assigned
+    # BEFORE done fires so a waiter never reads half-built state. None
+    # until retired (or forever, when the layer is off / the call
+    # failed); dispatched_ns marks pack-stage pickup so an expired
+    # deadline can be blamed on queue vs device even without a harvest.
+    stage_ns: Optional[dict] = None
+    dispatched_ns: int = 0
 
 
 @dataclass
@@ -829,10 +839,15 @@ class ScoringEngine:
             self._queue.put_nowait(req)
         except queue.Full:
             meter.add(QUEUE_FULL_METRIC)
+            # deadline-carrying requests died waiting for queue space:
+            # the burn blame dimension names the stage (never a new
+            # reason); legacy submits keep their exact metric key
             FlowContext.drop(len(batch), "queue_full",
                              pipeline="(engine)",
                              component_name=f"engine/{self.cfg.model}",
-                             signal="requests")
+                             signal="requests",
+                             blame="queue" if deadline_ns is not None
+                             else None)
             return None
         FlowContext.watermark(f"engine/{self.cfg.model}", "queue_depth",
                               self._queue.qsize())
@@ -1095,6 +1110,10 @@ class ScoringEngine:
             span.finish(error=True)
             return None
         t1 = time.monotonic_ns()
+        for r in reqs:
+            # expiry blame marker (ISSUE 8): a deadline that dies after
+            # this point blames the device, before it blames the queue
+            r.dispatched_ns = t1
         return _InflightGroup(
             reqs=reqs, handle=handle, span=span,
             n_spans=sum(len(r.batch) for r in reqs),
@@ -1120,6 +1139,17 @@ class ScoringEngine:
             grp.span.set_attr("error", True)
             grp.span.finish(error=True)
             return
+        if latency_enabled():
+            # one boundary dict per group, attached to every request
+            # BEFORE its done event fires: the fast-path forwarder reads
+            # stage_ns the instant the wait returns, and the frame's
+            # queue/pack/device/harvest stages are exactly these
+            # boundaries diffed (selftelemetry/latency.StageClock)
+            stage_ns = {"pack0": grp.t_pack0, "dispatch": grp.t_dispatch,
+                        "harvest0": t_h0, "end": time.monotonic_ns(),
+                        "overlap_ms": grp.overlap_ms}
+            for r in grp.reqs:
+                r.stage_ns = stage_ns
         try:
             if len(grp.reqs) == 1:
                 grp.reqs[0].scores = scores

@@ -51,8 +51,10 @@ import numpy as np
 # this module for the shared tagging helper, so depending on the
 # components package here would be a cycle whichever package loads first
 from ..features.featurizer import featurize
+from ..hooks.tracecontext import _active
 from ..pdata.spans import SpanBatch
 from ..selftelemetry.flow import FlowContext
+from ..selftelemetry.latency import Stage, claim_clock, latency_ledger
 from ..utils.telemetry import labeled_key, meter
 from .engine import PASSTHROUGH_METRIC, ScoringEngine
 
@@ -119,8 +121,11 @@ class IngestFastPath:
         self._feat_cfg = engine.cfg.featurizer
         self._needs_features = getattr(engine.backend, "needs_features",
                                        True)
-        # (batch, request, deadline_ns, enqueued_ns)
-        self._window: deque[tuple[SpanBatch, Any, int, int]] = deque()
+        # stage-waterfall aggregation rides per pipeline; the admission
+        # deadline is this route's burn budget (ISSUE 8)
+        latency_ledger.set_deadline(pipeline, self.deadline_ms)
+        # (batch, request, deadline_ns, enqueued_ns, stage clock)
+        self._window: deque[tuple[SpanBatch, Any, int, int, Any]] = deque()
         self._lock = threading.Lock()
         self._have = threading.Condition(self._lock)
         self._pending_spans = 0
@@ -141,6 +146,12 @@ class IngestFastPath:
         n = len(batch)
         if n == 0:
             return  # the componentwise path drops empties in batch concat
+        # latency attribution (ISSUE 8): adopt the receiver-started stage
+        # clock (admission/decode already stamped) or start one for a
+        # direct feed; the active self-trace (the pipeline/<name> span)
+        # becomes the exemplar every histogram sample of this frame links
+        clock = claim_clock()
+        clock.bind_trace(_active.get())
         with self._lock:
             if self._pending_spans + n > self.max_pending_spans:
                 meter.add(self._saturated_key)
@@ -162,12 +173,14 @@ class IngestFastPath:
         try:
             feats = featurize(batch, self._feat_cfg) \
                 if self._needs_features else None
+            clock.stamp(Stage.FEATURIZE)
             now = time.monotonic_ns()
             deadline = now + int(self.deadline_ms * 1e6)
             # req None = engine queue full / draining: the engine already
             # counted the shed request; the batch still forwards unscored
             # (lossless pass-through, exactly the tpuanomaly contract)
             req = self.engine.submit(batch, feats, deadline_ns=deadline)
+            clock.stamp(Stage.ENQUEUE)
         except BaseException:
             with self._lock:
                 self._pending_spans -= n  # release the reservation
@@ -177,7 +190,7 @@ class IngestFastPath:
             raise
         meter.add(self._spans_key, n)
         with self._have:
-            self._window.append((batch, req, deadline, now))
+            self._window.append((batch, req, deadline, now, clock))
             # pending_ms — age of the OLDEST pending frame — is the
             # throughput-invariant admission signal: a span-denominated
             # bound means N ms of queue on a slow box but over-sheds a
@@ -198,19 +211,49 @@ class IngestFastPath:
                     if self._stop.is_set():
                         return
                     self._have.wait(0.05)
-                batch, req, deadline, _t0 = self._window[0]
+                batch, req, deadline, _t0, clock = self._window[0]
             try:
                 scores = None
+                expired = False
                 if req is not None:
                     wait_s = max((deadline - time.monotonic_ns()) / 1e9,
                                  0.0)
                     if req.done.wait(wait_s):
                         scores = req.scores
                     else:
+                        expired = True
                         meter.add(PASSTHROUGH_METRIC, len(batch))
+                if scores is not None and req.stage_ns is not None:
+                    # fold the engine call's queue/pack/device/harvest
+                    # boundaries into this frame's timeline (same
+                    # monotonic clock domain); WAIT then measures the
+                    # head-of-line gap between scores landing and this
+                    # forwarder picking the frame up
+                    clock.merge_engine(req.stage_ns)
+                clock.stamp(Stage.WAIT)
                 out = batch if scores is None else \
                     tag_anomalies(batch, scores, self.threshold)
-                self.downstream.consume(out)
+                clock.stamp(Stage.TAG)
+                try:
+                    self.downstream.consume(out)
+                finally:
+                    # observed even when consume raises: a downstream
+                    # outage is exactly when the SLO tracker must keep
+                    # seeing frames (an unfed tracker reads burn 0.0
+                    # during the incident it exists to page on)
+                    clock.stamp(Stage.FORWARD)
+                    latency_ledger.observe(self.pipeline, clock,
+                                           scored=scores is not None,
+                                           n_spans=len(batch))
+                    if expired:
+                        # every expired deadline names a blamed stage:
+                        # the device call that outran the budget when
+                        # the request had been dispatched, the engine
+                        # queue when it never left it (ISSUE 8 blame)
+                        latency_ledger.record_expiry(
+                            self.pipeline,
+                            Stage.DEVICE if req.dispatched_ns
+                            else Stage.QUEUE, len(batch))
             except Exception:  # noqa: BLE001 — edge-accounted; keep serving
                 meter.add(self._errors_key)
             finally:
@@ -224,6 +267,11 @@ class IngestFastPath:
                         self._wm_component, "pending_ms",
                         (time.monotonic_ns() - self._window[0][3]) / 1e6
                         if self._window else 0.0)
+                    if not self._window:
+                        # wake drain() waiters the instant the window
+                        # empties (a polled drain quantizes shutdown
+                        # and every bench round to its sleep interval)
+                        self._have.notify_all()
 
     # ------------------------------------------------------------ ledger
     def flow_pending(self) -> int:
@@ -252,14 +300,17 @@ class IngestFastPath:
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Wait until the pending window empties (everything submitted
-        has been forwarded downstream)."""
+        has been forwarded downstream). Condition-signaled by the
+        forwarder's last retire — returns the instant the window
+        empties, never a poll interval later."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if not self._window:
-                    return True
-            time.sleep(0.002)
-        return False
+        with self._have:
+            while self._window:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._have.wait(min(remaining, 0.05))
+            return True
 
     def shutdown(self) -> None:
         # lossless drain: the engine keeps scoring until its own

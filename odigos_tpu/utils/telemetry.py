@@ -163,6 +163,21 @@ class Meter:
                 h = self._hists[name] = _Histogram()
             h.record(value, exemplar)
 
+    def record_many(self, samples: list[tuple[str, float]],
+                    exemplar: Optional[tuple[int, int]] = None) -> None:
+        """Record a correlated group of histogram samples under ONE lock
+        hold (the latency stage waterfall records ~11 per frame — taking
+        the registry lock per stage would make the lock the overhead the
+        attribution layer is bounded against). ``exemplar`` applies to
+        every sample: the group shares one frame, hence one witness."""
+        with self._lock:
+            hists = self._hists
+            for name, value in samples:
+                h = hists.get(name)
+                if h is None:
+                    h = hists[name] = _Histogram()
+                h.record(value, exemplar)
+
     def counter(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0.0)

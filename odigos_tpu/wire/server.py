@@ -23,6 +23,8 @@ from typing import Any, Callable, Optional
 from ..components.api import ComponentKind, Factory, Receiver, Signal, register
 from ..pdata.spans import SpanKind
 from ..selftelemetry.flow import FlowContext, flow_ledger
+from ..selftelemetry.latency import (
+    Stage, publish_clock, start_clock, unpublish_clock)
 from ..selftelemetry.tracer import is_selftelemetry_batch, tracer
 from ..utils.framing import recv_exact as _recv_exact
 from ..utils.telemetry import labeled_key, meter
@@ -239,7 +241,13 @@ class WireReceiver(Receiver):
                         except ValueError:
                             sock.sendall(MALFORMED)
                             return
+                        # latency attribution (ISSUE 8): the frame's
+                        # stage clock starts at its first touch; the
+                        # fast path adopts it across the consume seam
+                        # (no-op object when ODIGOS_LATENCY=0)
+                        clock = start_clock()
                         verdict = receiver.admission.admit(payload_len)
+                        clock.stamp(Stage.ADMISSION)
                         if verdict is not None:
                             # pre-decode rejection: drain the socket bytes,
                             # never allocate/decode, tell client to back off
@@ -272,6 +280,8 @@ class WireReceiver(Receiver):
                                     signal="frames")
                                 sock.sendall(MALFORMED)
                                 continue
+                            clock.stamp(Stage.DECODE)
+                            token = publish_clock(clock)
                             try:
                                 if is_selftelemetry_batch(batch):
                                     # forwarded self-spans must not mint
@@ -298,6 +308,11 @@ class WireReceiver(Receiver):
                                     f"{{receiver={receiver.name}}}")
                                 sock.sendall(REJECTED)
                                 continue
+                            finally:
+                                # an unclaimed clock (componentwise
+                                # chain) dies here; the fast path has
+                                # already taken ownership for the frame
+                                unpublish_clock(token)
                             sock.sendall(ACCEPTED)
                         except OSError:
                             return

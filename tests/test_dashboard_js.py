@@ -110,6 +110,11 @@ CONTRACT: dict[str, dict] = {
                       "pending", "leak"]},
     "fc": {"endpoint": "/api/flow", "at": ["conditions", "*"],
            "fields": ["component", "status", "reason"]},
+    # latency attribution & SLO burn panel (ISSUE 8): per-pipeline burn
+    # status + stage waterfall; per-pipeline rows are reached via locals
+    # (sp/stages), validated top-level here — the fixture runs no SLO'd
+    # fast-path pipeline, so the dicts are legitimately empty
+    "slo": {"endpoint": "/api/slo", "fields": ["pipelines", "waterfall"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

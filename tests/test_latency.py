@@ -1,0 +1,659 @@
+"""Latency attribution (ISSUE 8 tentpole): per-frame stage waterfall,
+deadline-burn blame, and multi-window burn-rate SLOs.
+
+The contracts pinned here:
+
+* the stage clock TILES a frame's wall: every ``Stage`` member appears
+  exactly once per scored frame and the stage durations sum to the
+  measured end-to-end wall within tolerance (the acceptance criterion's
+  >= 95 % attribution, overlap-corrected), under single frames, burst,
+  and a mid-stream hot reload;
+* every expired admission deadline carries a blamed stage (device when
+  the request was dispatched, queue when it never left the engine
+  queue) — and blame rides the drop taxonomy as a dimension, never a
+  new reason;
+* an injected latency fault flips the pipeline's ``SLOBurn`` condition
+  within the fast window and clears within the slow window, through
+  ``HealthRollup`` and visible on ``/api/slo`` and ``/debug/latencyz``;
+* stage histograms carry exemplars resolving through the existing
+  ``/api/selftrace`` loop (PR 3's acceptance discipline);
+* ``ODIGOS_LATENCY=0`` (ledger disabled) records nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline.service import Collector
+from odigos_tpu.selftelemetry.flow import HealthRollup, flow_ledger
+from odigos_tpu.selftelemetry.latency import (
+    ENGINE_STAGES, STAGES, Stage, StageClock, latency_ledger)
+from odigos_tpu.selftelemetry.tracer import tracer
+from odigos_tpu.serving.engine import EngineConfig, ScoringEngine
+from odigos_tpu.serving.fastpath import IngestFastPath
+from odigos_tpu.utils.telemetry import labeled_key, meter
+from odigos_tpu.wire.client import WireExporter
+
+from tests.test_ingest_fastpath import soak_config, wait_for
+
+E2E_KEY = labeled_key("odigos_latency_e2e_ms", pipeline="traces/in")
+
+
+@pytest.fixture(autouse=True)
+def _isolate_latency_ledger():
+    """SLO trackers are process-global and keyed by pipeline name: one
+    left behind for a common name (traces/in) would inject slo/ rows
+    into every later test's rollup evaluation."""
+    yield
+    latency_ledger.reset()
+
+
+def run_frames_attributed(cfg, batches):
+    """Wire-feed each batch as one frame (delivery-synchronized), return
+    (exporter batches, latency snapshot for traces/in)."""
+    flow_ledger.reset()
+    latency_ledger.reset()
+    collector = Collector(cfg).start()
+    try:
+        port = collector.graph.receivers["otlpwire"].port
+        exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}"})
+        exp.start()
+        sink = collector.graph.exporters["tracedb"]
+        want = 0
+        for b in batches:
+            exp.export(b)
+            want += len(b)
+            assert wait_for(lambda: sink.span_count == want), \
+                f"stuck at {sink.span_count}/{want}"
+        exp.shutdown()
+        collector.drain_receivers(20.0)
+        return list(sink._batches), \
+            latency_ledger.snapshot()["pipelines"]["traces/in"]
+    finally:
+        collector.shutdown()
+
+
+def assert_frame_accounts(frame, tol_frac=0.05, tol_ms=0.5):
+    """One recorded frame: every stage exactly once, in traversal order,
+    and the stage sum covers the measured wall (>= 95 %, the acceptance
+    criterion) without over-counting it."""
+    got = [s["stage"] for s in frame["stages"]]
+    assert got == list(STAGES), got
+    ssum = sum(s["ms"] for s in frame["stages"])
+    wall = frame["wall_ms"]
+    tol = max(wall * tol_frac, tol_ms)
+    assert abs(ssum - wall) <= tol, \
+        f"stage sum {ssum:.3f} vs wall {wall:.3f} (tol {tol:.3f})"
+    assert ssum >= 0.95 * wall
+
+
+# ------------------------------------------------------------ the clock
+
+class TestStageClock:
+    def test_stamps_tile_the_wall(self):
+        c = StageClock()
+        c.stamp(Stage.ADMISSION)
+        time.sleep(0.002)
+        c.stamp(Stage.DECODE)
+        assert [s for s, _ in c.stages] == ["admission", "decode"]
+        assert abs(c.sum_ms() - c.wall_ms()) < 1e-6
+        assert c.stages[1][1] >= 1.0  # the sleep landed in decode
+
+    def test_merge_engine_clamps_monotone(self):
+        c = StageClock()
+        c.stamp(Stage.ADMISSION)
+        now = time.monotonic_ns()
+        # pack0 BEFORE the current mark (the worker raced submit): the
+        # queue stage clamps to zero instead of going negative
+        c.merge_engine({"pack0": now - 10_000_000, "dispatch": now + 1_000,
+                       "harvest0": now + 2_000, "end": now + 3_000,
+                       "overlap_ms": 1.25})
+        stages = dict(c.stages)
+        assert stages["queue"] == 0.0
+        assert stages["pack"] >= 0.0 and stages["device"] >= 0.0
+        assert c.overlap_ms == 1.25
+        assert abs(c.sum_ms() - c.wall_ms()) < 1e-6
+
+    def test_engine_stages_constant_matches_enum(self):
+        assert [s.value for s in ENGINE_STAGES] == \
+            ["queue", "pack", "device", "harvest"]
+
+
+# ------------------------------------------------ end-to-end accounting
+
+class TestStageAccounting:
+    def test_wire_fed_frames_account_full_wall(self):
+        batches = [synthesize_traces(24, seed=s) for s in range(4)]
+        out, rec = run_frames_attributed(
+            soak_config(fast_path=True, deadline_ms=5000), batches)
+        assert rec["frames"] == 4 and rec["scored_frames"] == 4
+        for frame in rec["recent"]:
+            assert frame["scored"]
+            assert_frame_accounts(frame)
+        # the waterfall covers every stage with sane quantiles
+        wf = rec["waterfall"]
+        assert set(wf) == set(STAGES)
+        for stage, row in wf.items():
+            assert row["count"] == 4
+            assert row["p99_ms"] >= row["p50_ms"] >= 0.0
+        # burn table: budget registered from the fast path's deadline
+        assert rec["burn"]["deadline_ms"] == 5000.0
+        assert rec["burn"]["stages"]["device"]["frac_of_budget"] >= 0.0
+
+    def test_burst_keeps_accounting(self):
+        """A burst of unsynchronized frames (coalesced groups > 1
+        request, depth-2 overlap active) still tiles every frame."""
+        flow_ledger.reset()
+        latency_ledger.reset()
+        cfg = soak_config(fast_path=True, deadline_ms=10_000)
+        collector = Collector(cfg).start()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}",
+                                     "queue_size": 64})
+            exp.start()
+            batches = [synthesize_traces(16, seed=s) for s in range(4)]
+            want = 0
+            for k in range(24):
+                exp.export(batches[k % 4])
+                want += len(batches[k % 4])
+            assert exp.flush(30.0)
+            exp.shutdown()
+            collector.drain_receivers(30.0)
+            sink = collector.graph.exporters["tracedb"]
+            assert sink.span_count == want
+            rec = latency_ledger.snapshot()["pipelines"]["traces/in"]
+            assert rec["frames"] == 24 and rec["scored_frames"] == 24
+            for frame in rec["recent"]:
+                assert_frame_accounts(frame)
+            bal = flow_ledger.conservation()["traces/in"]
+            assert bal["leak"] == 0
+        finally:
+            collector.shutdown()
+
+    def test_reload_mid_stream_keeps_attributing(self):
+        flow_ledger.reset()
+        latency_ledger.reset()
+        cfg = soak_config(fast_path=True, deadline_ms=10_000)
+        collector = Collector(cfg).start()
+        stop = threading.Event()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}",
+                                     "max_elapsed_s": 30.0})
+            exp.start()
+            batches = [synthesize_traces(16, seed=s) for s in range(4)]
+
+            def sender():
+                k = 0
+                while not stop.is_set():
+                    exp.export(batches[k % 4])
+                    k += 1
+                    while exp.queued > 8 and not stop.is_set():
+                        time.sleep(0.001)
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=sender, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            before = latency_ledger.snapshot()[
+                "pipelines"]["traces/in"]["frames"]
+            assert before > 0
+            new_cfg = soak_config(fast_path=True, deadline_ms=10_000,
+                                  threshold=0.9)
+            new_cfg["receivers"]["otlpwire"] = {"port": port}
+            collector.reload(new_cfg)
+            time.sleep(0.2)
+            stop.set()
+            t.join(timeout=10)
+            assert exp.flush(30.0)
+            exp.shutdown()
+            collector.drain_receivers(30.0)
+            rec = latency_ledger.snapshot()["pipelines"]["traces/in"]
+            # the recorder survives the swap (same key, like flow edges)
+            assert rec["frames"] > before
+            for frame in rec["recent"]:
+                assert_frame_accounts(frame)
+        finally:
+            stop.set()
+            collector.shutdown()
+
+
+# -------------------------------------------------- deadline-burn blame
+
+class _SlowBackend:
+    """Mock-shaped backend whose score blocks: forces deadline expiry."""
+
+    def __init__(self, sleep_s: float):
+        self.sleep_s = sleep_s
+        self.release = threading.Event()
+
+    def score(self, batch, features):
+        import numpy as np
+
+        time.sleep(self.sleep_s)
+        return np.zeros(len(batch), np.float32)
+
+
+class TestDeadlineBlame:
+    def test_every_expiry_carries_a_blamed_stage(self):
+        latency_ledger.reset()
+        meter.reset()
+        engine = ScoringEngine(EngineConfig(model="mock", max_queue=64))
+        engine.backend = _SlowBackend(0.15)
+        engine._depth = 1
+        engine.start()
+        seen = []
+        fp = IngestFastPath(
+            "traces/blame", engine, threshold=0.9,
+            downstream=type("S", (), {
+                "consume": lambda self, b: seen.append(b)})(),
+            config={"deadline_ms": 20.0})
+        fp.start()
+        try:
+            fp.consume(synthesize_traces(8, seed=1))
+            assert fp.drain(20.0)
+            rec = latency_ledger.snapshot()["pipelines"]["traces/blame"]
+            blames = rec["burn"]["expired_spans_by_blame"]
+            n = sum(len(b) for b in seen)
+            assert n > 0, "frame never forwarded"
+            # every expired span is blamed, and on a real stage
+            assert sum(blames.values()) == n, blames
+            assert set(blames) <= {"queue", "device"}, blames
+            # the expiry counter carries the same blame dimension
+            total = sum(
+                v for k, v in meter.snapshot().items()
+                if k.startswith(
+                    "odigos_latency_deadline_expired_spans_total{"))
+            assert total == n
+            # expired frames forward unscored but still record e2e + SLO
+            assert rec["frames"] == 1 and rec["scored_frames"] == 0
+        finally:
+            fp.shutdown()
+            engine.shutdown()
+
+    def test_downstream_failure_still_observes_and_blames(self):
+        """A downstream outage is exactly when the SLO tracker must
+        keep seeing frames: consume() raising must not skip the e2e
+        observation or the expiry blame (regression: both sat after
+        consume inside the try, so a broken exporter made the SLO
+        layer read burn 0.0 during the incident)."""
+        latency_ledger.reset()
+        meter.reset()
+        engine = ScoringEngine(EngineConfig(model="mock", max_queue=64))
+        engine.backend = _SlowBackend(0.15)
+        engine._depth = 1
+        engine.start()
+        tracker = latency_ledger.configure_slo(
+            "traces/outage", {"latency_p99_ms": 1000.0})
+
+        class _Boom:
+            def consume(self, b):
+                raise RuntimeError("downstream outage")
+
+        fp = IngestFastPath(
+            "traces/outage", engine, threshold=0.9, downstream=_Boom(),
+            config={"deadline_ms": 20.0})
+        fp.start()
+        try:
+            batch = synthesize_traces(8, seed=3)
+            fp.consume(batch)
+            assert fp.drain(20.0)
+            rec = latency_ledger.snapshot()["pipelines"]["traces/outage"]
+            assert rec["frames"] == 1, "frame lost to the consume error"
+            blames = rec["burn"]["expired_spans_by_blame"]
+            assert sum(blames.values()) == len(batch), blames
+            assert tracker.status()["slow"]["spans"] == len(batch)
+        finally:
+            fp.shutdown()
+            engine.shutdown()
+
+    def test_engine_queue_full_drop_carries_queue_blame(self):
+        flow_ledger.reset()
+        engine = ScoringEngine(EngineConfig(model="mock", max_queue=1))
+        # never started: the queue fills and stays full
+        b = synthesize_traces(4, seed=0)
+        deadline = time.monotonic_ns() + int(1e9)
+        assert engine.submit(b, None, deadline_ns=deadline) is not None
+        assert engine.submit(b, None, deadline_ns=deadline) is None
+        witness = flow_ledger.snapshot()["drops"]
+        drop = next(d for d in witness if d["component"] == "engine/mock")
+        assert drop["reasons"]["queue_full"] == len(b)
+        assert drop["last"]["queue_full"]["blame"] == "queue"
+        engine.shutdown()
+
+
+# --------------------------------------------------- SLO burn-rate math
+
+class TestSloBurn:
+    def _tracker(self, **cfg):
+        latency_ledger.reset()
+        fake = [0.0]
+        base = {"latency_p99_ms": 100.0, "scored_fraction": 0.9,
+                "fast_window_s": 10.0, "slow_window_s": 60.0}
+        base.update(cfg)
+        tracker = latency_ledger.configure_slo(
+            "traces/slo-test", base, clock=lambda: fake[0])
+        return tracker, fake
+
+    def test_flips_within_fast_window_and_clears(self):
+        tracker, fake = self._tracker()
+        for _ in range(100):
+            tracker.observe(5.0, True, 10)
+        assert not tracker.status()["burning"]
+        # hard latency fault at t=5: every frame violates the target.
+        # Detection latency is bounded by the FAST window: at t=5 the
+        # fast window holds 50% bad -> burn 50x >= 14.4, and the slow
+        # window confirms budget consumption (>= 1x)
+        fake[0] = 5.0
+        for _ in range(100):
+            tracker.observe(500.0, True, 10)
+        st = tracker.status()
+        assert st["burning"]
+        assert st["worst_objective"] == "latency_p99_ms"
+        assert st["fast"]["burn"] >= 14.4 and st["slow"]["burn"] >= 1.0
+        # fault ends; good traffic resumes. Once the fast window drains
+        # past the fault (t=16 > 5+10), the condition clears — within
+        # the fast window of recovery, hence within the slow window
+        fake[0] = 8.0
+        for _ in range(100):
+            tracker.observe(5.0, True, 10)
+        fake[0] = 16.0
+        for _ in range(50):
+            tracker.observe(5.0, True, 10)
+        assert not tracker.status()["burning"]
+
+    def test_scored_fraction_objective_burns(self):
+        tracker, fake = self._tracker(latency_p99_ms=None)
+        # 40% unscored against a 0.9 target: burn = 0.4/0.1 = 4x on
+        # both windows -> fast 4 < 14.4 keeps it quiet (one tail blip
+        # must not page)...
+        for i in range(100):
+            tracker.observe(5.0, i % 5 != 0 and i % 2 == 0, 10)
+        st = tracker.status()
+        assert st["fast"]["burn"] >= 1.0
+        # ...but a total scoring outage (100% unscored, burn 10x)
+        # still needs the fast threshold; with threshold 2 it pages
+        tracker.fast_burn_threshold = 2.0
+        for _ in range(100):
+            tracker.observe(5.0, False, 10)
+        assert tracker.status()["burning"]
+
+    def test_reconfigure_reuses_identical_recreates_changed(self):
+        latency_ledger.reset()
+        cfg = {"latency_p99_ms": 100.0, "fast_window_s": 60.0}
+        t1 = latency_ledger.configure_slo("traces/x", cfg)
+        # identical reload: same tracker, burn history survives
+        assert latency_ledger.configure_slo("traces/x", dict(cfg)) is t1
+        # ANY changed setting (not just objectives) rebuilds: a reload
+        # that shrinks the fast window mid-incident must take effect
+        t2 = latency_ledger.configure_slo(
+            "traces/x", {"latency_p99_ms": 100.0, "fast_window_s": 10.0})
+        assert t2 is not t1 and t2.fast_window_s == 10.0
+
+    def test_reload_dropping_slo_stanza_retires_tracker(self):
+        """Deleting the slo: stanza on hot reload must retire the
+        tracker (regression: build_graph only had a create path, so the
+        stale objectives kept evaluating — and paging — forever)."""
+        flow_ledger.reset()
+        latency_ledger.reset()
+        cfg = soak_config(fast_path=True, deadline_ms=10_000)
+        cfg["service"]["pipelines"]["traces/in"]["slo"] = {
+            "latency_p99_ms": 1000.0}
+        collector = Collector(cfg).start()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            assert "traces/in" in latency_ledger.slo_status()
+            new_cfg = soak_config(fast_path=True, deadline_ms=10_000)
+            new_cfg["receivers"]["otlpwire"] = {"port": port}
+            collector.reload(new_cfg)
+            assert "traces/in" not in latency_ledger.slo_status()
+            assert all(c["component"] != "slo/traces/in"
+                       for c in collector.graph.flow_health.evaluate())
+        finally:
+            collector.shutdown()
+
+    def test_rollup_surfaces_slo_condition(self):
+        tracker, fake = self._tracker(fast_burn_threshold=2.0)
+        rollup = HealthRollup(None)
+        for _ in range(50):
+            tracker.observe(500.0, True, 10)
+        conds = {c["component"]: c for c in rollup.evaluate()}
+        cond = conds["slo/traces/slo-test"]
+        assert cond["status"] == "Degraded"
+        assert cond["reason"] == "SLOBurn"
+        assert "latency_p99_ms" in cond["message"]
+        # recovery: the fast window drains -> Healthy(WithinBudget)
+        fake[0] = 20.0
+        for _ in range(50):
+            tracker.observe(5.0, True, 10)
+        conds = {c["component"]: c for c in rollup.evaluate()}
+        assert conds["slo/traces/slo-test"]["status"] == "Healthy"
+        assert conds["slo/traces/slo-test"]["reason"] == "WithinBudget"
+
+
+# -------------------------------------------- fault -> surfaces, live
+
+class TestInjectedFaultEndToEnd:
+    def test_slowed_device_flips_slo_and_surfaces_show_it(self):
+        """Acceptance: an injected latency fault (slowed device step)
+        flips SLOBurn within the fast window, clears within the slow
+        window, and both /debug/latencyz and /api/slo show it."""
+        import json
+        import urllib.request
+
+        from odigos_tpu.api.store import Store
+        from odigos_tpu.components.extensions.zpages import (
+            ZPagesExtension)
+        from odigos_tpu.frontend import FrontendServer
+
+        flow_ledger.reset()
+        latency_ledger.reset()
+        cfg = soak_config(fast_path=True, deadline_ms=10_000)
+        cfg["service"]["pipelines"]["traces/in"]["slo"] = {
+            "latency_p99_ms": 40.0, "scored_fraction": 0.5,
+            "fast_window_s": 1.0, "slow_window_s": 4.0,
+            "fast_burn_threshold": 14.4}
+        collector = Collector(cfg).start()
+        fe = FrontendServer(Store(), metrics_port=None).start()
+        try:
+            fp = collector.graph.fastpaths["traces/in"]
+            engine = fp.engine
+            orig_score = engine.backend.score
+
+            def slowed(batch, features):
+                time.sleep(0.08)  # the injected device-step fault
+                return orig_score(batch, features)
+
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}"})
+            exp.start()
+            sink = collector.graph.exporters["tracedb"]
+            batches = [synthesize_traces(8, seed=s) for s in range(4)]
+
+            def pump(n):
+                want = sink.span_count
+                for k in range(n):
+                    exp.export(batches[k % 4])
+                    want += len(batches[k % 4])
+                    assert wait_for(
+                        lambda: sink.span_count == want), "stalled"
+
+            pump(4)  # healthy baseline
+            engine.backend.score = slowed
+            pump(10)  # every frame now walls ~80ms > 40ms target
+            rollup = collector.graph.flow_health
+            assert wait_for(lambda: any(
+                c["component"] == "slo/traces/in"
+                and c["reason"] == "SLOBurn"
+                for c in rollup.evaluate()), timeout=5.0), \
+                "SLOBurn never raised inside the fast window"
+            # visible on /debug/latencyz ...
+            zp = ZPagesExtension("zpages", {})
+            zp.set_graph(collector.graph)
+            status, doc = zp._latencyz({})
+            assert status == 200
+            assert doc["slo"]["traces/in"]["burning"]
+            assert doc["pipelines"]["traces/in"]["waterfall"]
+            assert any(c["reason"] == "SLOBurn"
+                       for c in doc["conditions"])
+            # ... and on /api/slo
+            with urllib.request.urlopen(f"{fe.url}/api/slo",
+                                        timeout=10) as r:
+                api = json.loads(r.read())
+            assert api["pipelines"]["traces/in"]["burning"]
+            assert "device" in api["waterfall"]["traces/in"]
+            assert any(c["component"] == "slo/traces/in"
+                       and c["reason"] == "SLOBurn"
+                       for c in api["conditions"])
+            # fault lifted: good frames refill the fast window and the
+            # condition clears well inside the slow window
+            engine.backend.score = orig_score
+            t_clear0 = time.monotonic()
+            pump(6)
+            assert wait_for(lambda: (pump(1) or True) and all(
+                c["reason"] != "SLOBurn"
+                for c in rollup.evaluate()
+                if c["component"] == "slo/traces/in"), timeout=4.0), \
+                "SLOBurn never cleared inside the slow window"
+            assert time.monotonic() - t_clear0 <= 4.0
+            exp.shutdown()
+        finally:
+            fe.shutdown()
+            collector.shutdown()
+
+
+# ------------------------------------------------- exemplars + switch
+
+class TestExemplarLoop:
+    def test_stage_histogram_exemplar_resolves_via_selftrace(self):
+        """PR 3's acceptance loop for the new histograms: a stage
+        sample's exemplar trace id resolves to a ring-resident
+        self-trace (the pipeline span that carried the frame)."""
+        meter.reset()
+        batches = [synthesize_traces(16, seed=s) for s in range(2)]
+        run_frames_attributed(
+            soak_config(fast_path=True, deadline_ms=5000), batches)
+        exs = meter.exemplars(E2E_KEY)
+        assert exs, "no exemplar on the e2e latency histogram"
+        stage_key = labeled_key("odigos_latency_stage_ms",
+                                pipeline="traces/in", stage="device")
+        stage_exs = meter.exemplars(stage_key)
+        assert stage_exs, "no exemplar on the device stage histogram"
+        for witness in (exs[E2E_KEY][0], stage_exs[stage_key][0]):
+            resolved = tracer.trace(witness["trace_id"])
+            assert resolved["found"], witness
+            names = {s["name"] for s in resolved["spans"]}
+            assert "pipeline/traces/in" in names, names
+
+    def test_kill_switch_records_nothing(self, monkeypatch):
+        latency_ledger.reset()
+        monkeypatch.setattr(latency_ledger, "enabled", False)
+        batches = [synthesize_traces(8, seed=0)]
+        out, _ = None, None
+        flow_ledger.reset()
+        collector = Collector(
+            soak_config(fast_path=True, deadline_ms=5000)).start()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}"})
+            exp.start()
+            sink = collector.graph.exporters["tracedb"]
+            exp.export(batches[0])
+            assert wait_for(lambda: sink.span_count == len(batches[0]))
+            exp.shutdown()
+            collector.drain_receivers(20.0)
+            snap = latency_ledger.snapshot()
+            assert not snap["enabled"]
+            assert snap["pipelines"].get("traces/in", {}).get(
+                "frames", 0) == 0
+        finally:
+            collector.shutdown()
+
+
+# -------------------------------------------------- config contracts
+
+class TestSloConfigContract:
+    def test_invalid_slo_rejected_at_validation(self):
+        from odigos_tpu.pipeline.graph import validate_config
+
+        def cfg_with(slo):
+            cfg = soak_config(fast_path=False)
+            cfg["service"]["pipelines"]["traces/in"]["slo"] = slo
+            return cfg
+
+        assert any("no objective" in p for p in
+                   validate_config(cfg_with({})))
+        assert any("scored_fraction" in p for p in
+                   validate_config(cfg_with({"scored_fraction": 1.0})))
+        assert any("latency_p99_ms" in p for p in
+                   validate_config(cfg_with({"latency_p99_ms": -5})))
+        assert any("unknown slo keys" in p for p in
+                   validate_config(cfg_with({"latency_p99_ms": 10,
+                                             "nope": 1})))
+        # a non-numeric objective is a NAMED problem, not a crash that
+        # masks the rest of the aggregated list
+        probs = validate_config(cfg_with({"latency_p99_ms": "abc",
+                                          "fast_window_s": [1]}))
+        assert any("latency_p99_ms must be a number" in p for p in probs)
+        assert any("fast_window_s must be a number" in p for p in probs)
+        # zero/negative windows or thresholds would silently evaluate
+        # to "never burning" — refused at validation
+        assert any("fast_window_s must be positive" in p for p in
+                   validate_config(cfg_with({"latency_p99_ms": 10,
+                                             "fast_window_s": 0})))
+        assert any("slow_burn_threshold must be positive" in p for p in
+                   validate_config(cfg_with({"latency_p99_ms": 10,
+                                             "slow_burn_threshold": -1})))
+        assert validate_config(
+            cfg_with({"latency_p99_ms": 10.0,
+                      "scored_fraction": 0.95})) == []
+
+    def test_pipelinegen_renders_slo_stanza_byte_stable_when_unset(self):
+        from odigos_tpu.config.model import (
+            AnomalyStageConfiguration, SloConfiguration)
+        from odigos_tpu.destinations import Destination
+        from odigos_tpu.pipelinegen import (
+            GatewayOptions, build_gateway_config)
+        from odigos_tpu.components.api import Signal
+
+        dests = [Destination(id="d1", dest_type="mock",
+                             signals=[Signal.TRACES], config={})]
+        base, _, _ = build_gateway_config(
+            dests, options=GatewayOptions(
+                anomaly=AnomalyStageConfiguration(enabled=True)))
+        # empty SloConfiguration renders byte-identically to None
+        empty, _, _ = build_gateway_config(
+            dests, options=GatewayOptions(
+                anomaly=AnomalyStageConfiguration(
+                    enabled=True, slo=SloConfiguration())))
+        assert empty == base
+        with_slo, _, _ = build_gateway_config(
+            dests, options=GatewayOptions(
+                anomaly=AnomalyStageConfiguration(
+                    enabled=True, slo=SloConfiguration(
+                        latency_p99_ms=25.0, scored_fraction=0.99))))
+        stanza = with_slo["service"]["pipelines"]["traces/in"]["slo"]
+        assert stanza == {"latency_p99_ms": 25.0,
+                          "scored_fraction": 0.99,
+                          "fast_window_s": 60.0, "slow_window_s": 300.0}
+        # and the rendered stanza passes graph validation
+        from odigos_tpu.pipeline.graph import validate_config
+        assert not [p for p in validate_config(with_slo)
+                    if "slo" in p]
+
+    def test_slo_config_round_trips_configuration(self):
+        from odigos_tpu.config.model import Configuration
+
+        conf = Configuration.from_dict({
+            "anomaly": {"enabled": True,
+                        "slo": {"latency_p99_ms": 12.5,
+                                "scored_fraction": 0.97}}})
+        assert conf.anomaly.slo.latency_p99_ms == 12.5
+        assert conf.anomaly.slo.fast_window_s == 60.0
+        again = Configuration.from_dict(conf.to_dict())
+        assert again.anomaly.slo == conf.anomaly.slo

@@ -413,6 +413,71 @@ class TestFastPathHygiene:
             "BucketLadder rungs — adaptive batches would pay recompiles")
 
 
+class TestLatencyStageHygiene:
+    """Latency-attribution lint (ISSUE 8 satellite): every ``Stage``
+    enum member is stamped exactly once per frame on the fast path.
+    A member with no stamp site silently vanishes from the waterfall (a
+    stage whose wall is attributed to its neighbor); a member stamped
+    at two sites double-counts its wall and breaks the Σstages == wall
+    accounting the acceptance criterion pins. Static AST scan over the
+    package: ``<clock>.stamp(Stage.X)`` call sites plus the
+    ``ENGINE_STAGES`` tuple (the four stages merged from the engine's
+    per-call boundary dict count as one site each)."""
+
+    def _stamp_sites(self) -> dict[str, list[str]]:
+        sites: dict[str, list[str]] = {}
+        for dirpath, _dirs, names in os.walk(PKG_ROOT):
+            for n in names:
+                if not n.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, n)
+                rel = os.path.relpath(path, PKG_ROOT)
+                with open(path) as f:
+                    tree = ast.parse(f.read(), path)
+                for node in ast.walk(tree):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "stamp"):
+                        continue
+                    for arg in node.args:
+                        if (isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "Stage"):
+                            sites.setdefault(arg.attr, []).append(
+                                f"{rel}:{node.lineno}")
+        return sites
+
+    def test_every_stage_member_stamped_exactly_once(self):
+        from odigos_tpu.selftelemetry.latency import ENGINE_STAGES, Stage
+
+        sites = self._stamp_sites()
+        for s in ENGINE_STAGES:
+            sites.setdefault(s.name, []).append(
+                "selftelemetry/latency.py:ENGINE_STAGES")
+        problems = []
+        for member in Stage:
+            where = sites.pop(member.name, [])
+            if len(where) != 1:
+                problems.append(
+                    f"Stage.{member.name}: {len(where)} stamp sites "
+                    f"{where} (must be exactly 1)")
+        for name, where in sites.items():
+            problems.append(
+                f"stamp of unknown Stage.{name} at {where}")
+        assert not problems, (
+            "stage-stamp coverage broken — the waterfall would "
+            "under- or double-count:\n  " + "\n  ".join(problems))
+
+    def test_stage_taxonomy_is_closed_and_labeled(self):
+        """Stage values are the metric label vocabulary: lowercase,
+        label-safe, and unique (the closed-taxonomy contract)."""
+        from odigos_tpu.selftelemetry.latency import STAGES, Stage
+
+        assert len(STAGES) == len(set(STAGES)) == len(list(Stage))
+        for v in STAGES:
+            assert re.fullmatch(r"[a-z_]+", v), v
+
+
 class TestFlowAccounting:
     """Flow-ledger lint (ISSUE 5 satellite): any processor/connector
     module whose ``process``/``consume``/``_emit`` method conditionally
