@@ -134,6 +134,14 @@ class AnomalyStageConfiguration:
     # coalescer, bypassing the componentwise batch/score seams; the
     # scoring timeout doubles as the per-frame admission deadline
     fast_path: bool = False
+    # completion-driven multi-lane retirement (ISSUE 9): number of
+    # retirement lanes overlapping tag/forward of independent frames
+    # (rendered as fast_path.lanes; only meaningful with fast_path)
+    fast_path_lanes: int = 4
+    # true = forward downstream in intake order (the single-forwarder
+    # FIFO contract, byte-identical output order) at the cost of
+    # serializing the forward leg; false = forward as completed
+    fast_path_ordered: bool = False
     # declarative burn-rate SLOs for the root traces pipeline (ISSUE 8);
     # None renders nothing — existing configs stay byte-identical
     slo: Optional[SloConfiguration] = None

@@ -220,6 +220,46 @@ def validate_config(config: dict[str, Any]) -> list[str]:
                         f"move them after the scorer (only "
                         f"memory_limiter/batch are replaced by the "
                         f"fast path)")
+            fp = p.get("fast_path")
+            if isinstance(fp, dict):
+                # retirement-lane knobs (ISSUE 9): a typo'd key or a
+                # zero-lane pool would silently fall back to defaults /
+                # never retire — refuse loudly at validation
+                known = {"deadline_ms", "max_pending_spans", "lanes",
+                         "submit_lanes", "ordered", "drain_timeout_s",
+                         "name"}
+                unknown = sorted(set(fp) - known)
+                if unknown:
+                    problems.append(
+                        f"pipeline {pname}: unknown fast_path keys "
+                        f"{unknown} (known: {sorted(known)})")
+                # max_pending_spans validates as an INTEGER with the
+                # lane counts: the fast path int()-truncates it, so a
+                # "valid" 0.9 would become a zero-span window rejecting
+                # every frame
+                for key in ("lanes", "submit_lanes",
+                            "max_pending_spans"):
+                    lanes = fp.get(key)
+                    if lanes is not None and (
+                            isinstance(lanes, bool)
+                            or not isinstance(lanes, int) or lanes < 1):
+                        problems.append(
+                            f"pipeline {pname}: fast_path.{key} must be "
+                            f"a positive integer")
+                if "ordered" in fp and not isinstance(fp["ordered"],
+                                                      bool):
+                    problems.append(
+                        f"pipeline {pname}: fast_path.ordered must be "
+                        f"a boolean")
+                for key in ("deadline_ms", "drain_timeout_s"):
+                    v = fp.get(key)
+                    if v is not None and (
+                            isinstance(v, bool)
+                            or not isinstance(v, (int, float))
+                            or v <= 0):
+                        problems.append(
+                            f"pipeline {pname}: fast_path.{key} must "
+                            f"be a positive number")
 
     # authenticator references must resolve to a defined+enabled extension
     # (the collector fails startup on a dangling authenticator; an auth'd

@@ -347,7 +347,14 @@ def build_gateway_config(
             # silently bypassed (graph.validate_config enforces this
             # ordering for every fast_path pipeline)
             root = config["service"]["pipelines"][root_pipeline_name(sig)]
-            root["fast_path"] = {"deadline_ms": anomaly.timeout_ms}
+            # lanes/ordered (ISSUE 9): completion-driven multi-lane
+            # retirement — N lanes overlap tag/forward of independent
+            # frames; ordered=true keeps the single-forwarder FIFO
+            # output order for consumers that need it
+            root["fast_path"] = {
+                "deadline_ms": anomaly.timeout_ms,
+                "lanes": anomaly.fast_path_lanes,
+                "ordered": anomaly.fast_path_ordered}
             root["processors"] = (
                 ["memory_limiter", "tpuanomaly"]
                 + [pid for pid in root["processors"]

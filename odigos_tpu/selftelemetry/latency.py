@@ -11,10 +11,13 @@ sizes, ladder rungs, replica counts") is blocked on:
 
 * a :class:`StageClock` rides each wire frame through the ingest fast
   path and the scoring engine — the wire receiver stamps the admission
-  verdict and decode, the fast path stamps featurize/enqueue/wait/tag/
-  forward, and the engine's per-call ``pack_ms``/``harvest_ms``/
-  ``overlap_ms`` accounting (PR 2) is merged in as the
-  queue/pack/device/harvest stages. Within ONE frame the stages tile
+  verdict and decode, the fast path stamps submit/featurize/enqueue/
+  wait/tag/forward (``wait`` is the completion-driven gap between the
+  scores landing and a retirement lane picking the frame up — ISSUE 9
+  redefined it from the old single-forwarder head-of-line wait), and
+  the engine's per-call ``pack_ms``/``harvest_ms``/``overlap_ms``
+  accounting (PR 2) is merged in as the queue/pack/device/harvest
+  stages. Within ONE frame the stages tile
   its wall end to end (queue→pack→device→harvest is that frame's own
   serial critical path even under the depth-2 pipelined window; the
   cross-call host/device overlap rides along as ``overlap_ms``), so
@@ -92,13 +95,14 @@ class Stage(enum.Enum):
 
     ADMISSION = "admission"   # frame header read -> admission verdict
     DECODE = "decode"         # verdict -> zero-copy decoded SpanBatch
+    SUBMIT = "submit"         # decode -> submit-lane pickup (intake handoff)
     FEATURIZE = "featurize"   # decode -> device-ready feature matrices
     ENQUEUE = "enqueue"       # featurized -> engine queue accepted
     QUEUE = "queue"           # engine queue wait (submit -> pack start)
     PACK = "pack"             # host coalesce/pack (pack start -> dispatch)
     DEVICE = "device"         # device execution (dispatch -> harvest start)
     HARVEST = "harvest"       # result fetch + scatter (harvest -> scores)
-    WAIT = "wait"             # scores ready -> forwarder picks the frame up
+    WAIT = "wait"             # scores landed -> retirement-lane pickup
     TAG = "tag"               # anomaly attribute tagging
     FORWARD = "forward"       # downstream consume (router/exporter edge)
 
@@ -277,7 +281,7 @@ class _Recorder:
             # One record_many = one meter lock hold for the whole
             # waterfall; the exemplar reservoir stays populated from
             # every 8th frame (algorithm-R does not need every sample
-            # to carry a witness — allocating 11 exemplars per frame
+            # to carry a witness — allocating 13 exemplars per frame
             # would be the layer's own overhead bound violation)
             keys = self._stage_keys
             samples = [(keys[stage], d) for stage, d in clock.stages]

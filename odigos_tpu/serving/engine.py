@@ -47,7 +47,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Any, Optional, Protocol
+from typing import Any, Callable, Optional, Protocol
 
 import numpy as np
 
@@ -608,6 +608,27 @@ class ScoreRequest:
     # deadline can be blamed on queue vs device even without a harvest.
     stage_ns: Optional[dict] = None
     dispatched_ns: int = 0
+    # completion-driven retirement (ISSUE 9): invoked exactly once, on
+    # the thread that completes the request (worker retire, dispatch
+    # failure, shutdown drain), strictly AFTER scores/stage_ns are
+    # assigned and done fires — the fast path's completion queue,
+    # replacing its done.wait() poll. Must be cheap; exceptions are
+    # counted, never propagated into the worker loop.
+    on_done: Optional[Callable[["ScoreRequest"], None]] = None
+
+    def signal_done(self) -> None:
+        """Fire the done event, then the completion callback (at most
+        once — re-signaling an already-done request is a no-op, so the
+        failure-backstop paths can call this unconditionally)."""
+        if self.done.is_set():
+            return
+        self.done.set()
+        cb = self.on_done
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — callback must not kill the worker
+                meter.add("odigos_anomaly_engine_errors_total")
 
 
 @dataclass
@@ -800,7 +821,7 @@ class ScoringEngine:
             except queue.Empty:
                 break
             req.scores = None
-            req.done.set()
+            req.signal_done()
             FlowContext.drop(len(req.batch), "shutdown_drain",
                              pipeline="(engine)",
                              component_name=f"engine/{self.cfg.model}",
@@ -809,12 +830,16 @@ class ScoringEngine:
     # ------------------------------------------------------------- scoring
     def submit(self, batch: SpanBatch,
                features: Optional[SpanFeatures] = None,
-               deadline_ns: Optional[int] = None) -> Optional[ScoreRequest]:
+               deadline_ns: Optional[int] = None,
+               on_done: Optional[Callable[[ScoreRequest], None]] = None,
+               ) -> Optional[ScoreRequest]:
         """Enqueue for scoring; returns None (and counts) if queue is full
         or the engine is draining for shutdown. ``deadline_ns`` (monotonic)
         opts the request into deadline-based adaptive batching: the pack
         stage caps the coalesced call so its harvest lands inside the
-        earliest deadline instead of letting batch growth blow p99."""
+        earliest deadline instead of letting batch growth blow p99.
+        ``on_done`` is the completion callback (see ScoreRequest): called
+        the instant the request resolves, so a caller never polls."""
         if self._stop.is_set():
             # shutting down: the worker is draining; new work would race
             # the lossless-drain guarantee
@@ -834,7 +859,7 @@ class ScoringEngine:
             features = featurize(batch, self.cfg.featurizer)
         req = ScoreRequest(batch=batch, features=features,
                            submitted_ns=time.monotonic_ns(),
-                           deadline_ns=deadline_ns)
+                           deadline_ns=deadline_ns, on_done=on_done)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -1105,7 +1130,7 @@ class ScoringEngine:
             meter.add("odigos_anomaly_engine_errors_total")
             for r in reqs:
                 r.scores = None
-                r.done.set()
+                r.signal_done()
             span.set_attr("error", True)
             span.finish(error=True)
             return None
@@ -1135,7 +1160,7 @@ class ScoringEngine:
             meter.add("odigos_anomaly_engine_errors_total")
             for r in grp.reqs:
                 r.scores = None
-                r.done.set()
+                r.signal_done()
             grp.span.set_attr("error", True)
             grp.span.finish(error=True)
             return
@@ -1153,20 +1178,20 @@ class ScoringEngine:
         try:
             if len(grp.reqs) == 1:
                 grp.reqs[0].scores = scores
-                grp.reqs[0].done.set()
+                grp.reqs[0].signal_done()
             else:
                 off = 0
                 for r in grp.reqs:
                     n_r = len(r.batch)
                     r.scores = scores[off:off + n_r]
                     off += n_r
-                    r.done.set()
+                    r.signal_done()
         finally:
             # no request may hang on a half-failed split: unset events fire
-            # with scores=None (caller passes through, counter fires)
+            # with scores=None (caller passes through, counter fires);
+            # signal_done is a no-op on requests already signaled above
             for r in grp.reqs:
-                if not r.done.is_set():
-                    r.done.set()
+                r.signal_done()
         t_end = time.monotonic_ns()
         # device-occupancy accounting: the union of [dispatch, harvest-end]
         # intervals is an upper bound on device busy time (it includes

@@ -38,6 +38,17 @@ class WireExporter(Exporter):
     queue_size:      max buffered frames (default 512; overflow drops oldest)
     retry_initial_s: first backoff (default 0.05)
     retry_max_s:     backoff cap (default 2.0)
+    retry_jitter:    randomize each sleep over [backoff*(1-j), backoff*(1+j)]
+                     (default 0.5, the OTel retry spec's randomization
+                     factor; 0 disables). Unjittered exponential backoff
+                     SYNCHRONIZES clients against a shed-based admission
+                     gate: every backed-off sender fires the instant the
+                     gate reopens, re-saturates it in one burst, and
+                     doubles again — measured on the soak box as
+                     multi-second latency oscillation at a 60 ms gate
+                     limit once fast-path intake became handoff-only
+                     (ISSUE 9) and REJECTED became the primary pacing
+                     signal rather than a rare overload answer.
     max_elapsed_s:   give up on a frame after this long (default 30)
     """
 
@@ -155,6 +166,14 @@ class WireExporter(Exporter):
         initial = float(self.config.get("retry_initial_s", 0.05))
         cap = float(self.config.get("retry_max_s", 2.0))
         max_elapsed = float(self.config.get("max_elapsed_s", 30.0))
+        # clamped: j >= 1 would yield zero/negative sleeps on the low
+        # side of the draw — immediate retries re-synchronize exactly
+        # the gate-open stampede the jitter exists to prevent
+        jitter = min(max(float(self.config.get("retry_jitter", 0.5)),
+                         0.0), 0.9)
+        # per-thread PRNG: the sender threads must not share one lock-
+        # guarded generator (the whole point is DE-correlating them)
+        rng = np.random.default_rng()
         backoff = initial
         frame_started = 0.0
         while not self._stop.is_set():
@@ -181,7 +200,11 @@ class WireExporter(Exporter):
                 meter.add(self._dropped_metric)
                 backoff = initial
             else:
-                self._stop.wait(backoff)
+                # randomized interval (OTel retry spec): without it,
+                # shed-paced senders synchronize into gate-open
+                # stampedes (see the retry_jitter config note)
+                self._stop.wait(backoff * (
+                    1.0 + jitter * float(rng.uniform(-1.0, 1.0))))
                 backoff = min(backoff * 2, cap)
 
 

@@ -1,0 +1,934 @@
+"""Completion-driven multi-lane retirement (ISSUE 9 tentpole).
+
+The contracts pinned here:
+
+* scores/attrs stay BIT-IDENTICAL to the componentwise path for both
+  ``ordered: true`` and unordered lanes (the engine semantics are
+  untouched — only retirement changed);
+* ``ordered: true`` forwards downstream in exact intake order (the
+  single-forwarder FIFO byte stream) even when lanes finish out of
+  order; unordered lanes deliver the same frames, any order;
+* conservation and ledger balance hold under concurrent retirement
+  with injected downstream failures, a deadline-expiry storm, and a
+  hot reload mid-stream;
+* the expiry timer runs OFF the retire loop: a frame whose deadline
+  passes is marked passed-through (and blamed) even while every lane
+  is busy;
+* the stage clock still tiles each frame's wall under N-lane
+  retirement (Σstages == wall, the ISSUE 8 acceptance bound), with
+  WAIT redefined as score-landing → lane-pickup;
+* the engine's done-callback (completion queue) fires exactly once per
+  request, after scores/stage_ns are final — including on failure and
+  shutdown-drain paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline.graph import validate_config
+from odigos_tpu.pipeline.service import Collector
+from odigos_tpu.selftelemetry.flow import flow_ledger
+from odigos_tpu.selftelemetry.latency import STAGES, latency_ledger
+from odigos_tpu.serving.engine import EngineConfig, ScoringEngine
+from odigos_tpu.serving.fastpath import IngestFastPath
+from odigos_tpu.serving.lanes import OrderedGate, RetirementLanes
+from odigos_tpu.utils.telemetry import meter
+from odigos_tpu.wire.client import WireExporter
+
+from tests.test_ingest_fastpath import run_frames, soak_config, wait_for
+from tests.test_latency import assert_frame_accounts
+
+
+@pytest.fixture(autouse=True)
+def _isolate_latency_ledger():
+    yield
+    latency_ledger.reset()
+
+
+def lane_config(lanes=4, ordered=False, deadline_ms=30_000, **kw):
+    cfg = soak_config(fast_path=True, **kw)
+    cfg["service"]["pipelines"]["traces/in"]["fast_path"] = {
+        "deadline_ms": deadline_ms, "lanes": lanes, "ordered": ordered}
+    return cfg
+
+
+# --------------------------------------------------------------- parity
+
+class TestLaneParity:
+    """Retirement changed; scoring did not: outputs stay bit-identical
+    to the componentwise chain at matched grouping, for both ordering
+    modes."""
+
+    def make_batches(self):
+        out = []
+        for s in range(4):
+            b = synthesize_traces(24, seed=s)
+            if s == 2:
+                mask = np.zeros(len(b), bool)
+                mask[:5] = True
+                b = b.with_span_attrs({"mock.anomaly": [True] * 5}, mask)
+            out.append(b)
+        return out
+
+    @pytest.mark.parametrize("ordered", [True, False])
+    def test_scores_and_attrs_bit_identical(self, ordered):
+        batches = self.make_batches()
+        got_fast = run_frames(lane_config(lanes=4, ordered=ordered),
+                              batches)
+        got_slow = run_frames(soak_config(fast_path=False), batches)
+        spans_fast = [d for b in got_fast for d in b.span_attrs]
+        spans_slow = [d for b in got_slow for d in b.span_attrs]
+        assert len(spans_fast) == len(spans_slow) \
+            == sum(len(b) for b in batches)
+        for a, b in zip(spans_fast, spans_slow):
+            assert dict(a) == dict(b)
+
+
+# ------------------------------------------------------------- ordering
+
+class _RecordingSink:
+    """Downstream that records frame identity (span count) in arrival
+    order; optionally stalls on the first frame to force lanes to race
+    past it."""
+
+    def __init__(self, stall_len=None, stall_s=0.0):
+        self.order = []
+        self.stall_len = stall_len
+        self.stall_s = stall_s
+        self._lock = threading.Lock()
+
+    def consume(self, b):
+        if self.stall_len is not None and len(b) == self.stall_len:
+            time.sleep(self.stall_s)
+        with self._lock:
+            self.order.append(len(b))
+
+
+def _distinct_batches():
+    """Frames with pairwise-distinct span counts (arrival-order ids)."""
+    sizes = []
+    out = []
+    for k in range(1, 7):
+        b = synthesize_traces(k, seed=k)
+        if len(b) in sizes:
+            continue
+        sizes.append(len(b))
+        out.append(b)
+    assert len(out) >= 4
+    return out
+
+
+def _drive(fp, batches):
+    for b in batches:
+        fp.consume(b)
+    assert fp.drain(30.0)
+
+
+class TestOrderingContract:
+    def _run(self, ordered):
+        latency_ledger.reset()
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        batches = _distinct_batches()
+        # the FIRST frame's forward stalls; later frames' lanes race it
+        sink = _RecordingSink(stall_len=len(batches[0]), stall_s=0.5)
+        fp = IngestFastPath(
+            f"traces/order-{ordered}", engine, threshold=0.99,
+            downstream=sink,
+            config={"deadline_ms": 30_000, "lanes": 4,
+                    "ordered": ordered})
+        fp.start()
+        try:
+            _drive(fp, batches)
+        finally:
+            fp.shutdown()
+            engine.shutdown()
+        return [len(b) for b in batches], sink.order
+
+    def test_ordered_output_is_intake_fifo(self):
+        """ordered: true — the single-forwarder FIFO contract survives
+        a stalled head: later lanes tag concurrently but forward waits
+        its turn."""
+        sent, got = self._run(ordered=True)
+        assert got == sent
+
+    def test_unordered_lanes_overtake_a_stalled_head(self):
+        """Unordered lanes exist to kill exactly this head-of-line:
+        every frame arrives, and the stalled head arrives LAST."""
+        sent, got = self._run(ordered=False)
+        assert sorted(got) == sorted(sent)
+        assert got[-1] == sent[0], \
+            f"stalled head was not overtaken: {got} vs {sent}"
+
+    def test_consume_before_start_renumbers_ordered_seqs(self):
+        """Regression: consume() has no started-guard, so frames
+        accepted before start() carried pre-epoch seqs that collided
+        with post-start frames' after start() reset the counter — the
+        ordered gate (keyed by seq) parked the duplicate at a slot it
+        had already advanced past, forever. start() now renumbers the
+        pending frames into the fresh epoch instead."""
+        latency_ledger.reset()
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        batches = _distinct_batches()[:4]
+        sink = _RecordingSink()
+        fp = IngestFastPath(
+            "traces/prestart", engine, threshold=0.99, downstream=sink,
+            config={"deadline_ms": 30_000, "lanes": 2, "ordered": True})
+        try:
+            for b in batches[:2]:
+                fp.consume(b)  # accepted before any epoch exists
+            fp.start()
+            for b in batches[2:]:
+                fp.consume(b)
+            assert fp.drain(30.0), \
+                "a seq collision parked a frame forever"
+        finally:
+            fp.shutdown()
+            engine.shutdown()
+        assert sink.order == [len(b) for b in batches]
+
+    def test_ordered_parks_count_once_in_retired_counter(self):
+        """A park at the ordered gate is not a retirement: each frame
+        lands in the odigos_fastpath_lane_retired_frames_total family
+        exactly once (on its forwarding invocation), so the per-lane
+        distribution stays a usable diagnostic."""
+        from odigos_tpu.serving.lanes import LANE_RETIRED_METRIC
+        latency_ledger.reset()
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        batches = _distinct_batches()
+        # the head stalls in the sink, so later frames offer out of
+        # turn and PARK — the double-count shape under the old code
+        sink = _RecordingSink(stall_len=len(batches[0]), stall_s=0.5)
+        fp = IngestFastPath(
+            "traces/retcount", engine, threshold=0.99, downstream=sink,
+            config={"deadline_ms": 30_000, "lanes": 4, "ordered": True})
+        fp.start()
+        try:
+            _drive(fp, batches)
+        finally:
+            fp.shutdown()
+            engine.shutdown()
+        retired = sum(
+            meter.counter(
+                f"{LANE_RETIRED_METRIC}"
+                f"{{pipeline=traces/retcount,lane={i}}}") or 0
+            for i in range(4))
+        assert retired == len(batches), \
+            f"each frame must count exactly once, got {retired}"
+
+    def test_ordered_head_completing_last_cannot_deadlock_the_pool(self):
+        """Regression: frames become ready OUT of intake order while
+        every lane is occupied. A blocking turnstile wedged here — the
+        lone lane held frame 1 waiting its turn while frame 0, ready in
+        the queue, had no lane left to run on (drain timed out at 30 s
+        under suite load). The parking gate frees the lane instead: the
+        tail parks, the head forwards the moment it completes, and the
+        parked frames drain in sequence."""
+        import odigos_tpu.serving.fastpath as fp_mod
+
+        latency_ledger.reset()
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        batches = _distinct_batches()[:4]
+        head_len = len(batches[0])
+        release_head = threading.Event()
+        orig_featurize = fp_mod.featurize
+
+        def gated(batch, cfg):
+            # the HEAD sticks in its submit lane until released, so
+            # every later frame completes (and must park) first
+            if len(batch) == head_len:
+                release_head.wait(10.0)
+            return orig_featurize(batch, cfg)
+
+        sink = _RecordingSink()
+        fp = IngestFastPath(
+            "traces/order-parked", engine, threshold=0.99,
+            downstream=sink,
+            config={"deadline_ms": 30_000, "lanes": 1,
+                    "submit_lanes": 2, "ordered": True})
+        fp_mod.featurize = gated
+        fp.start()
+        try:
+            for b in batches:
+                fp.consume(b)
+            # all three tail frames tagged and parked; the single lane
+            # is idle again (a turnstile would be blocking it here)
+            assert wait_for(lambda: len(fp._gate._parked) == 3), \
+                "tail frames never parked"
+            assert sink.order == []  # nothing forwarded ahead of turn
+            release_head.set()
+            assert fp.drain(30.0)
+        finally:
+            release_head.set()
+            fp_mod.featurize = orig_featurize
+            fp.shutdown()
+            engine.shutdown()
+        assert sink.order == [len(b) for b in batches]
+
+
+# ------------------------------------ conservation under concurrency
+
+class TestLaneConservation:
+    def test_burst_conserves_with_lanes(self):
+        flow_ledger.reset()
+        collector = Collector(lane_config(lanes=4)).start()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}",
+                                     "queue_size": 256,
+                                     "max_elapsed_s": 30.0})
+            exp.start()
+            total = 0
+            for s in range(16):
+                b = synthesize_traces(32, seed=s)
+                exp.export(b)
+                total += len(b)
+            assert exp.flush(30.0)
+            exp.shutdown()
+            collector.drain_receivers(30.0)
+            sink = collector.graph.exporters["tracedb"]
+            assert sink.span_count == total
+            bal = flow_ledger.conservation()["traces/in"]
+            assert bal["items_in"] == total
+            assert bal["leak"] == 0, bal
+        finally:
+            collector.shutdown()
+
+    def test_downstream_failures_stay_balanced(self):
+        """Every third frame's export raises mid-retirement: the edges
+        count the failures, the lanes keep serving, the reservation
+        releases exactly once — the balance names every span."""
+        flow_ledger.reset()
+        collector = Collector(lane_config(lanes=4)).start()
+        try:
+            sink = collector.graph.exporters["tracedb"]
+            orig = sink.consume
+            calls = [0]
+            lock = threading.Lock()
+
+            def flaky(b):
+                with lock:
+                    calls[0] += 1
+                    boom = calls[0] % 3 == 0
+                if boom:
+                    raise RuntimeError("injected exporter outage")
+                return orig(b)
+
+            sink.consume = flaky
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}",
+                                     "queue_size": 256,
+                                     "max_elapsed_s": 30.0})
+            exp.start()
+            total = 0
+            for s in range(12):
+                b = synthesize_traces(24, seed=s)
+                exp.export(b)
+                total += len(b)
+            assert exp.flush(30.0)
+            exp.shutdown()
+            collector.drain_receivers(30.0)
+            fp = collector.graph.fastpaths["traces/in"]
+            assert wait_for(lambda: fp.flow_pending() == 0)
+            bal = flow_ledger.conservation()["traces/in"]
+            assert sum(bal["failed"].values()) > 0, \
+                "no injected failure was counted"
+            assert bal["leak"] == 0, bal
+            assert bal["items_in"] == total
+            # the attribution layer saw every frame (downstream outage
+            # must not starve the SLO tracker)
+            rec = latency_ledger.snapshot()["pipelines"]["traces/in"]
+            assert rec["frames"] == 12
+        finally:
+            collector.shutdown()
+
+    def test_reload_mid_stream_with_lanes_conserved(self):
+        flow_ledger.reset()
+        cfg = lane_config(lanes=4)
+        collector = Collector(cfg).start()
+        stop = threading.Event()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}",
+                                     "max_elapsed_s": 30.0})
+            exp.start()
+            batches = [synthesize_traces(16, seed=s) for s in range(4)]
+
+            def sender():
+                k = 0
+                while not stop.is_set():
+                    exp.export(batches[k % 4])
+                    k += 1
+                    while exp.queued > 8 and not stop.is_set():
+                        time.sleep(0.001)
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=sender, daemon=True)
+            t.start()
+            time.sleep(0.25)
+            new_cfg = lane_config(lanes=2, ordered=True, threshold=0.9)
+            new_cfg["receivers"]["otlpwire"] = {"port": port}
+            collector.reload(new_cfg)
+            fp = collector.graph.fastpaths["traces/in"]
+            assert fp.lanes == 2 and fp.ordered
+            time.sleep(0.25)
+            stop.set()
+            t.join(timeout=10)
+            assert exp.flush(30.0)
+            exp.shutdown()
+            collector.drain_receivers(30.0)
+            bal = flow_ledger.conservation()["traces/in"]
+            assert bal["leak"] == 0, bal
+            assert collector.graph.exporters["tracedb"].span_count > 0
+        finally:
+            stop.set()
+            collector.shutdown()
+
+
+class TestTagFailure:
+    def test_tag_failure_frames_not_counted_scored(self):
+        """Regression: a frame whose tag_anomalies raised was observed
+        into the ledger scored=True — keeping the scored_fraction SLO
+        green during exactly the failure it should burn on. ``scored``
+        is now set only after tagging succeeds."""
+        import odigos_tpu.serving.fastpath as fp_mod
+
+        latency_ledger.reset()
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        sink = _RecordingSink()
+        orig = fp_mod.tag_anomalies
+
+        def boom(batch, scores, threshold):
+            raise RuntimeError("injected tag failure")
+
+        fp = IngestFastPath(
+            "traces/tagfail", engine, threshold=0.99, downstream=sink,
+            config={"deadline_ms": 30_000, "lanes": 2})
+        fp_mod.tag_anomalies = boom
+        fp.start()
+        try:
+            for s in range(3):
+                fp.consume(synthesize_traces(4, seed=s))
+            assert fp.drain(30.0)
+        finally:
+            fp_mod.tag_anomalies = orig
+            fp.shutdown()
+            engine.shutdown()
+        rec = latency_ledger.snapshot()["pipelines"]["traces/tagfail"]
+        assert rec["frames"] == 3
+        assert rec["scored_frames"] == 0, \
+            "tag-failed frames must not read as scored"
+        assert sink.order == []  # a tag-failed frame cannot forward
+        assert fp.flow_pending() == 0  # but its reservation released
+
+
+# ------------------------------------------------------- expiry timer
+
+class _StuckBackend:
+    """Backend whose score blocks until released: requests never
+    resolve on their own, so only the expiry timer can free frames."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def score(self, batch, features):
+        self.release.wait(10.0)
+        return np.zeros(len(batch), np.float32)
+
+
+class TestDeadlineAnchor:
+    def test_deadline_anchored_at_intake_not_post_featurize(self):
+        """Regression: the admission deadline was stamped AFTER
+        featurize in the submit lane, so time queued for (or inside)
+        featurize burned no budget — a featurize-bound overload could
+        hold frames for seconds and still 'meet' a 25 ms deadline with
+        zero expiries. The deadline now runs from frame acceptance."""
+        import odigos_tpu.serving.fastpath as fp_mod
+
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=8)).start()
+        captured = {}
+        orig_submit = engine.submit
+
+        def recording_submit(batch, features=None, deadline_ns=None,
+                             on_done=None):
+            captured["deadline_ns"] = deadline_ns
+            return orig_submit(batch, features, deadline_ns=deadline_ns,
+                               on_done=on_done)
+
+        engine.submit = recording_submit
+        orig_featurize = fp_mod.featurize
+
+        def slow_featurize(batch, cfg):
+            time.sleep(0.1)
+            return orig_featurize(batch, cfg)
+
+        fp_mod.featurize = slow_featurize
+
+        class Sink:
+            def consume(self, b):
+                pass
+
+        fp = IngestFastPath(
+            "traces/anchor", engine, threshold=0.9, downstream=Sink(),
+            config={"deadline_ms": 500.0, "lanes": 1})
+        fp.start()
+        try:
+            t0 = time.monotonic_ns()
+            fp.consume(synthesize_traces(4, seed=0))
+            assert fp.drain(10.0)
+            budget_ms = (captured["deadline_ns"] - t0) / 1e6
+            # intake-anchored: ~500 ms from consume; the old post-
+            # featurize anchor would read >= 600 ms (500 + the 100 ms
+            # featurize sleep)
+            assert budget_ms < 560.0, \
+                f"deadline anchored post-featurize: {budget_ms:.1f} ms"
+        finally:
+            fp_mod.featurize = orig_featurize
+            fp.shutdown()
+            engine.shutdown()
+
+
+class TestExpiryTimer:
+    def test_expiry_storm_blames_every_frame(self):
+        """Deadline storm: the device is stuck, every frame expires at
+        its deadline via the timer, retires unscored through the lanes,
+        and every expired span carries a blamed stage."""
+        latency_ledger.reset()
+        meter.reset()
+        engine = ScoringEngine(EngineConfig(model="mock", max_queue=64))
+        backend = _StuckBackend()
+        engine.backend = backend
+        engine._depth = 1
+        engine.start()
+        seen = []
+        lock = threading.Lock()
+
+        class Sink:
+            def consume(self, b):
+                with lock:
+                    seen.append(len(b))
+
+        fp = IngestFastPath(
+            "traces/storm", engine, threshold=0.9, downstream=Sink(),
+            config={"deadline_ms": 25.0, "lanes": 4})
+        fp.start()
+        try:
+            batches = [synthesize_traces(6, seed=s) for s in range(6)]
+            total = sum(len(b) for b in batches)
+            for b in batches:
+                fp.consume(b)
+            assert fp.drain(20.0)
+            assert sum(seen) == total, "a frame was lost in the storm"
+            rec = latency_ledger.snapshot()["pipelines"]["traces/storm"]
+            assert rec["frames"] == 6 and rec["scored_frames"] == 0
+            blames = rec["burn"]["expired_spans_by_blame"]
+            assert sum(blames.values()) == total, blames
+            assert set(blames) <= {"queue", "device"}, blames
+            assert fp.flow_pending() == 0
+        finally:
+            backend.release.set()
+            fp.shutdown()
+            engine.shutdown()
+
+    def test_expiry_fires_while_lanes_are_busy(self):
+        """The timer is OFF the retire loop: with the only lane stalled
+        in a slow downstream, a later frame's deadline still marks it
+        passed-through (counter fires before any lane frees)."""
+        latency_ledger.reset()
+        meter.reset()
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        gate = threading.Event()
+        in_sink = threading.Event()
+
+        class StallingSink:
+            def consume(self, b):
+                in_sink.set()
+                gate.wait(10.0)
+
+        fp = IngestFastPath(
+            "traces/busy-lanes", engine, threshold=0.9,
+            downstream=StallingSink(),
+            config={"deadline_ms": 150.0, "lanes": 1})
+        fp.start()
+        try:
+            # frame 1 scores fast and occupies THE lane (stalled sink)
+            fp.consume(synthesize_traces(4, seed=1))
+            assert in_sink.wait(10.0), "lane never reached the sink"
+            # frame 2's request never resolves (stuck device): with no
+            # free lane, only the earliest-deadline timer can mark it —
+            # the old retire-loop expiry would sit behind the stall
+            stuck = _StuckBackend()
+            engine.backend = stuck
+            b2 = synthesize_traces(4, seed=3)
+            fp.consume(b2)
+
+            def n_pass():
+                return meter.counter(
+                    "odigos_anomaly_passthrough_total") or 0
+
+            assert wait_for(lambda: n_pass() >= len(b2), timeout=10.0), \
+                "expiry never fired while the lane was busy"
+            assert not gate.is_set()  # the lane really was still stalled
+            stuck.release.set()
+            gate.set()
+            assert fp.drain(20.0)
+        finally:
+            gate.set()
+            fp.shutdown()
+            engine.shutdown()
+
+
+class TestEpochStraggler:
+    def test_straggler_lane_across_restart_cannot_park_forever(self):
+        """Regression: a lane stuck in tag across a shutdown→start
+        cycle read the NEW epoch's (unset) stop flag on resume and
+        offered into the ORPHANED old gate — whose head never advances
+        again — parking the frame and leaking its reservation forever.
+        The lane now aliases its epoch's stop flag alongside the gate,
+        sees it set, and gate-bypasses on resume."""
+        import odigos_tpu.serving.fastpath as fp_mod
+
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        batches = _distinct_batches()[:2]
+        head_len, stuck_len = len(batches[0]), len(batches[1])
+        in_sink = threading.Event()
+        sink_gate = threading.Event()
+        in_tag = threading.Event()
+        tag_gate = threading.Event()
+        orig_tag = fp_mod.tag_anomalies
+
+        def gated_tag(batch, scores, threshold):
+            if len(batch) == stuck_len:
+                in_tag.set()
+                tag_gate.wait(30.0)
+            return orig_tag(batch, scores, threshold)
+
+        class HeadStallSink:
+            def consume(self, b):
+                if len(b) == head_len:
+                    in_sink.set()
+                    sink_gate.wait(30.0)
+
+        fp = IngestFastPath(
+            "traces/epoch", engine, threshold=0.99,
+            downstream=HeadStallSink(),
+            config={"deadline_ms": 30_000, "lanes": 2, "ordered": True,
+                    "drain_timeout_s": 0.2})
+        fp_mod.tag_anomalies = gated_tag
+        fp.start()
+        try:
+            fp.consume(batches[0])  # seq 0: holds the gate, stalls in sink
+            assert in_sink.wait(10.0), "head never reached the sink"
+            fp.consume(batches[1])  # seq 1: its lane wedges in tag
+            assert in_tag.wait(10.0), "lane never reached tag"
+            fp.shutdown()  # drain times out; both lanes still stuck
+            fp.start()     # fresh epoch (new gate, new stop flag)
+            tag_gate.set()  # the tag-stuck lane resumes FIRST: the old
+            # gate's head (seq 0, still in the sink) has not advanced,
+            # so an offer into it would park forever — the resumed lane
+            # must bypass instead and release seq 1's reservation
+            assert wait_for(lambda: fp.flow_pending() == head_len,
+                            timeout=10.0), \
+                "straggler parked in the orphaned gate (leak)"
+            sink_gate.set()  # free the old head; it advances its own
+            assert wait_for(lambda: fp.flow_pending() == 0)  # old gate
+        finally:
+            tag_gate.set()
+            sink_gate.set()
+            fp_mod.tag_anomalies = orig_tag
+            fp.shutdown()
+            engine.shutdown()
+
+
+# ------------------------------------------------- bounded shutdown
+
+class TestBoundedShutdown:
+    def test_wedged_downstream_cannot_block_shutdown(self):
+        """A downstream that never returns must not wedge shutdown():
+        past drain_timeout_s the unretired frames are CLAIMED and shed
+        as named shutdown_drain drops (reservation released, balance
+        exact), while the frame a stuck lane still holds stays its
+        property — no double release when the lane finally finishes."""
+        flow_ledger.reset()
+        meter.reset()
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        gate = threading.Event()
+        in_sink = threading.Event()
+
+        class WedgedSink:
+            def consume(self, b):
+                in_sink.set()
+                gate.wait(30.0)
+
+        fp = IngestFastPath(
+            "traces/wedged", engine, threshold=0.9,
+            downstream=WedgedSink(),
+            config={"deadline_ms": 30_000, "lanes": 1,
+                    "drain_timeout_s": 0.3})
+        fp.start()
+        try:
+            a = synthesize_traces(4, seed=1)
+            b = synthesize_traces(6, seed=2)
+            fp.consume(a)
+            assert in_sink.wait(10.0), "lane never reached the sink"
+            fp.consume(b)  # scores land; no lane free to retire it
+            assert wait_for(lambda: fp._retire_lanes.depth() == 1)
+            t0 = time.monotonic()
+            fp.shutdown()
+            # bounded: drain timeout + thread joins, NOT the sink's 30 s
+            assert time.monotonic() - t0 < 15.0
+            # frame b was shed and named; frame a is still the stuck
+            # lane's property, its reservation held
+            assert fp.flow_pending() == len(a)
+            snap = flow_ledger.snapshot()
+            shed = sum(
+                d["reasons"].get("shutdown_drain", 0)
+                for d in snap["drops"]
+                if d["pipeline"] == "traces/wedged")
+            assert shed == len(b), snap["drops"]
+        finally:
+            gate.set()
+            # the released lane finishes frame a and releases exactly
+            # once — the pending window must fully empty
+            assert wait_for(lambda: fp.flow_pending() == 0)
+            engine.shutdown()
+
+
+class TestPayloadRelease:
+    def test_done_frames_behind_stalled_head_drop_payloads(self):
+        """Regression: _live prunes only its contiguous done prefix, so
+        a done frame can sit pinned behind a stalled (not-yet-done)
+        head indefinitely — with its reservation already released, the
+        max_pending_spans window no longer bounded what _live kept
+        alive. _release_frame now drops batch/out/req refs, so the
+        pinned shell is slim."""
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=64)).start()
+        gate = threading.Event()
+        in_sink = threading.Event()
+        batches = _distinct_batches()[:4]
+        head_len = len(batches[0])
+
+        class StallSink:
+            def consume(self, b):
+                if len(b) == head_len:
+                    in_sink.set()
+                    gate.wait(15.0)
+
+        fp = IngestFastPath(
+            "traces/pinned", engine, threshold=0.99,
+            downstream=StallSink(),
+            config={"deadline_ms": 30_000, "lanes": 2})
+        fp.start()
+        try:
+            fp.consume(batches[0])
+            assert in_sink.wait(10.0), "head never reached the sink"
+            for b in batches[1:]:
+                fp.consume(b)
+
+            def done_behind_head():
+                with fp._lock:
+                    return sum(1 for f in fp._live if f.done)
+
+            assert wait_for(lambda: done_behind_head() == 3)
+            with fp._lock:
+                pinned = [f for f in fp._live if f.done]
+                assert len(pinned) == 3  # head still stalls the prune
+                assert all(f.batch is None and f.out is None
+                           and f.req is None for f in pinned), \
+                    "done frames behind the head must not pin payloads"
+            gate.set()
+            assert fp.drain(20.0)
+        finally:
+            gate.set()
+            fp.shutdown()
+            engine.shutdown()
+
+
+# ------------------------------------------------ tiling under lanes
+
+class TestLaneTiling:
+    def test_stage_tiling_holds_under_multilane_burst(self):
+        """Σstages == wall per frame (the ISSUE 8 acceptance bound)
+        survives concurrent retirement — the clock handoff is sequenced
+        through the fast-path lock, never shared between lanes."""
+        flow_ledger.reset()
+        latency_ledger.reset()
+        collector = Collector(lane_config(lanes=4,
+                                          deadline_ms=10_000)).start()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}",
+                                     "queue_size": 64})
+            exp.start()
+            batches = [synthesize_traces(16, seed=s) for s in range(4)]
+            want = 0
+            for k in range(24):
+                exp.export(batches[k % 4])
+                want += len(batches[k % 4])
+            assert exp.flush(30.0)
+            exp.shutdown()
+            collector.drain_receivers(30.0)
+            sink = collector.graph.exporters["tracedb"]
+            assert sink.span_count == want
+            rec = latency_ledger.snapshot()["pipelines"]["traces/in"]
+            assert rec["frames"] == 24 and rec["scored_frames"] == 24
+            for frame in rec["recent"]:
+                assert_frame_accounts(frame)
+            wf = rec["waterfall"]
+            assert set(wf) == set(STAGES)
+        finally:
+            collector.shutdown()
+
+
+# --------------------------------------------------- completion queue
+
+class TestCompletionCallback:
+    def test_callback_fires_once_with_final_scores(self):
+        engine = ScoringEngine(EngineConfig(model="mock",
+                                            max_queue=8)).start()
+        fired = []
+        done = threading.Event()
+
+        def cb(req):
+            fired.append((req.scores is not None,
+                          req.done.is_set()))
+            done.set()
+
+        try:
+            b = synthesize_traces(4, seed=0)
+            req = engine.submit(b, None, on_done=cb)
+            assert req is not None
+            assert done.wait(10.0)
+            assert fired == [(True, True)]
+        finally:
+            engine.shutdown()
+        assert len(fired) == 1  # shutdown drain must not re-fire
+
+    def test_callback_fires_on_shutdown_drain(self):
+        engine = ScoringEngine(EngineConfig(model="mock", max_queue=8))
+        # never started: the queue drains at shutdown
+        fired = []
+        b = synthesize_traces(4, seed=0)
+        req = engine.submit(b, None, on_done=lambda r: fired.append(
+            r.scores is None))
+        assert req is not None
+        engine.shutdown()
+        assert fired == [True], \
+            "drained request must still signal its completion"
+
+
+# ------------------------------------------------------------- config
+
+class TestLaneConfigContract:
+    def _cfg(self, fp):
+        cfg = soak_config(fast_path=True)
+        cfg["service"]["pipelines"]["traces/in"]["fast_path"] = fp
+        return cfg
+
+    def test_bad_lane_configs_rejected(self):
+        assert any("fast_path.lanes" in p for p in validate_config(
+            self._cfg({"deadline_ms": 10, "lanes": 0})))
+        assert any("fast_path.lanes" in p for p in validate_config(
+            self._cfg({"deadline_ms": 10, "lanes": True})))
+        assert any("fast_path.ordered" in p for p in validate_config(
+            self._cfg({"deadline_ms": 10, "ordered": "yes"})))
+        assert any("unknown fast_path keys" in p for p in
+                   validate_config(self._cfg({"lane_count": 4})))
+        assert any("fast_path.deadline_ms" in p for p in validate_config(
+            self._cfg({"deadline_ms": -1})))
+        assert any("fast_path.submit_lanes" in p for p in validate_config(
+            self._cfg({"deadline_ms": 10, "submit_lanes": 0})))
+        # fractional max_pending_spans int()-truncates in the fast path
+        # (0.9 -> a zero-span window rejecting EVERY frame): integer-only
+        assert any("fast_path.max_pending_spans" in p for p in
+                   validate_config(self._cfg(
+                       {"deadline_ms": 10, "max_pending_spans": 0.9})))
+        assert validate_config(self._cfg(
+            {"deadline_ms": 10, "lanes": 4, "submit_lanes": 2,
+             "ordered": True})) == []
+
+    def test_submit_pool_sized_apart_from_retirement(self):
+        # the pools bound different legs (featurize+submit vs the
+        # downstream forward); submit_lanes defaults to lanes but may
+        # be set independently for host-contended boxes
+        engine = ScoringEngine(EngineConfig(model="mock")).start()
+        try:
+            fp = IngestFastPath(
+                "traces/pools", engine, 0.5, None,
+                {"deadline_ms": 10, "lanes": 3})
+            assert (fp.lanes, fp.submit_lanes) == (3, 3)
+            fp = IngestFastPath(
+                "traces/pools", engine, 0.5, None,
+                {"deadline_ms": 10, "lanes": 3, "submit_lanes": 1})
+            assert (fp.lanes, fp.submit_lanes) == (3, 1)
+            fp.start()
+            try:
+                assert len(fp._submit_threads) == 1
+                assert len(fp._retire_lanes._threads) == 3
+            finally:
+                fp.shutdown()
+        finally:
+            engine.shutdown()
+
+
+# ----------------------------------------------------- lane plumbing
+
+class TestLanePool:
+    def test_gate_parks_out_of_turn_and_surfaces_in_order(self):
+        """The ordered gate never blocks a caller: out-of-turn offers
+        park, and each advance() surfaces exactly the next parked
+        frame — seqs emerge 0,1,2,3 no matter the offer order."""
+        gate = OrderedGate()
+        # 3, 1, 2 arrive before the head: all park, no caller waits
+        assert not gate.offer(3, "f3")
+        assert not gate.offer(1, "f1")
+        assert not gate.offer(2, "f2")
+        assert gate.offer(0, "f0")  # the head holds the gate
+        assert gate.advance() == "f1"
+        assert gate.advance() == "f2"
+        assert gate.advance() == "f3"
+        assert gate.advance() is None  # seq 4 not offered yet
+        assert gate.offer(4, "f4")
+
+    def test_gate_flush_returns_parked_in_sequence_order(self):
+        gate = OrderedGate()
+        gate.offer(2, "f2")
+        gate.offer(5, "f5")
+        gate.offer(1, "f1")
+        assert gate.flush() == ["f1", "f2", "f5"]
+        assert gate.flush() == []
+
+    def test_lane_pool_survives_retire_errors(self):
+        retired = []
+
+        def retire(frame, lane):
+            if frame == "boom":
+                raise RuntimeError("frame error")
+            retired.append(frame)
+
+        lanes = RetirementLanes("traces/pool-test", 2, retire).start()
+        try:
+            lanes.push("boom")
+            lanes.push("a")
+            lanes.push("b")
+            assert wait_for(lambda: sorted(retired) == ["a", "b"])
+            assert meter.counter(
+                "odigos_fastpath_lane_errors_total"
+                "{pipeline=traces/pool-test}") >= 1
+        finally:
+            lanes.shutdown()

@@ -407,9 +407,19 @@ class TestConservation:
         age = flow_ledger.watermark_current("fastpath/traces/t",
                                             "pending_ms")
         assert age is not None and age >= 0.0
+        # backlog_ms (ISSUE 9): age of the oldest frame no submit lane
+        # has STARTED — with no lanes running, the just-appended frame
+        # IS the backlog head
+        backlog = flow_ledger.watermark_current("fastpath/traces/t",
+                                                "backlog_ms")
+        assert backlog is not None and backlog >= 0.0
         fp.start()
         assert wait_for(lambda: fp.flow_pending() == 0)
         assert flow_ledger.watermark_current(
             "fastpath/traces/t", "pending_ms") == 0.0
+        # every frame picked up: the gate's backlog reading must read
+        # EMPTY (a stale peak would shed with nothing left to drain)
+        assert flow_ledger.watermark_current(
+            "fastpath/traces/t", "backlog_ms") == 0.0
         fp.shutdown()
         eng.shutdown()

@@ -354,12 +354,14 @@ class TestFastPathHygiene:
     """The ingest fast path exists to remove per-span Python from the
     wire→device column (ISSUE 6 satellite), so the rule is stricter than
     the span_attrs lint: NO ``for``/comprehension in
-    ``serving/fastpath.py`` may iterate anything span- or batch-sized.
-    Iterating ``batch``/``spans``/``scores``/feature arrays re-introduces
-    O(n) interpreter work exactly where this PR bought it out. The
-    bounded-cardinality loops the module legitimately needs (flag lists
-    via list-multiply, window drains bounded by frame count) don't
-    iterate those names.
+    ``serving/fastpath.py`` — or the retirement-lane module it hands
+    frames to (``serving/lanes.py``, ISSUE 9) — may iterate anything
+    span- or batch-sized. Iterating ``batch``/``spans``/``scores``/
+    feature arrays re-introduces O(n) interpreter work exactly where
+    these PRs bought it out. The bounded-cardinality loops the modules
+    legitimately need (flag lists via list-multiply, lane pools bounded
+    by lane count, window drains bounded by frame count) don't iterate
+    those names.
 
     Also pins the adaptive-batching shape contract: the engine's
     deadline sizing must snap onto ``BucketLadder`` rungs (floor_rows),
@@ -367,7 +369,7 @@ class TestFastPathHygiene:
     SHAPE_BUCKETING for *bucketed* rows.
     """
 
-    FASTPATH = os.path.join(PKG_ROOT, "serving", "fastpath.py")
+    FASTPATH_MODULES = ("serving/fastpath.py", "serving/lanes.py")
     # identifiers whose iteration is per-span/per-batch-row work
     SPAN_SIZED = re.compile(
         r"\b(batch|spans|scores|span_attrs|categorical|continuous"
@@ -382,15 +384,18 @@ class TestFastPathHygiene:
                 for gen in node.generators:
                     yield node.lineno, ast.unparse(gen.iter)
 
-    def test_no_per_span_iteration_in_fastpath_module(self):
-        with open(self.FASTPATH) as f:
-            tree = ast.parse(f.read(), self.FASTPATH)
-        problems = [
-            f"serving/fastpath.py:{lineno}: iterates {expr!r}"
-            for lineno, expr in self._iter_exprs(tree)
-            if self.SPAN_SIZED.search(expr)]
+    def test_no_per_span_iteration_in_fastpath_modules(self):
+        problems = []
+        for rel in self.FASTPATH_MODULES:
+            path = os.path.join(PKG_ROOT, rel)
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+            problems.extend(
+                f"{rel}:{lineno}: iterates {expr!r}"
+                for lineno, expr in self._iter_exprs(tree)
+                if self.SPAN_SIZED.search(expr))
         assert not problems, (
-            "per-span Python iteration in the fast-path module — the "
+            "per-span Python iteration in a fast-path module — the "
             "whole point of this route is columnar flow:\n  "
             + "\n  ".join(problems))
 

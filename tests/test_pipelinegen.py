@@ -178,7 +178,10 @@ class TestAnomalyStage:
             [jaeger()], options=self.anomaly_opts(fast_path=True,
                                                   timeout_ms=25.0))
         root = cfg["service"]["pipelines"]["traces/in"]
-        assert root["fast_path"] == {"deadline_ms": 25.0}
+        # lanes/ordered (ISSUE 9): the completion-driven retirement
+        # knobs render alongside the deadline
+        assert root["fast_path"] == {"deadline_ms": 25.0, "lanes": 4,
+                                     "ordered": False}
         from odigos_tpu.pipeline.graph import build_graph
 
         g = build_graph(cfg)

@@ -28,11 +28,21 @@ per-span work on the hot path).
 
     python tools/e2e_soak.py [--seconds 20] [--senders 4]
                              [--no-fast-path] [--ab]
+                             [--pace-spans-per-sec 255000]
 
 ``--ab`` runs BOTH routes back to back (fast path first) and embeds the
 componentwise summary in the record as ``componentwise_baseline`` — the
 same-machine A/B the acceptance comparison needs (absolute spans/s are
 hardware-bound; see ``hardware_note``).
+
+``--pace-spans-per-sec`` switches the senders from closed-loop
+saturation to OPEN-LOOP pacing: a fixed offered load regardless of how
+fast the pipeline answers. For latency A/B this is the honest mode —
+saturating senders adapt to each arm's own backpressure (coordinated
+omission), so their probe compares the arms' admission policies (the
+fast path sheds at the socket; the componentwise chain buffers), not
+the paths. Paced below the knee, both arms carry the identical load
+losslessly and the probe measures pure path transit.
 
 Reference discipline: the hot-loop zero-alloc rule of
 collector/receivers/odigosebpfreceiver/traces.go:17, the configgrpc
@@ -91,9 +101,16 @@ def run_soak(args, fast_path: bool) -> dict:
     # is the old soak's 1.16 s p99 pathology — a 64-deep engine queue
     # of 8k-span batches).
     if fast_path:
+        # completion-driven multi-lane retirement (ISSUE 9): N lanes
+        # overlap tag/forward of independent frames; unordered by
+        # default (the soak's consumers are order-insensitive), so the
+        # old single-forwarder wait head-of-line is gone entirely
         pipeline_in["fast_path"] = {
             "deadline_ms": args.deadline_ms,
-            "max_pending_spans": 128 * 1024}
+            "max_pending_spans": args.max_pending_spans,
+            "lanes": args.lanes,
+            "submit_lanes": args.submit_lanes or args.lanes,
+            "ordered": bool(args.ordered)}
         # declarative SLO (ISSUE 8): evaluated live during the soak with
         # fast/slow-window burn rates; the verdict lands in SOAK.json so
         # every soak run is self-judging, not just self-attributing.
@@ -128,9 +145,22 @@ def run_soak(args, fast_path: bool) -> dict:
             # watermark-driven admission: overload anywhere downstream
             # sheds at the socket, before decode — every rejection named
             "admission": {"watermarks": {
-                f"engine/{args.model}": {"queue_depth": 48},
-                "fastpath/traces/in": {"pending_ms": 250.0,
-                                       "pending_spans": 96 * 1024},
+                # shallow (default 8, not the old 48): with multi-lane
+                # retirement the engine queue is the one place latency
+                # can still hide from the backlog_ms gate — 48
+                # deadline-coalesced requests is over a second of queue
+                # against a 100 ms admission deadline, i.e. mass expiry
+                # before scoring. A shallow gate converts that hidden
+                # queue into named REJECTEDs at the socket
+                f"engine/{args.model}": {
+                    "queue_depth": args.engine_queue_depth},
+                "fastpath/traces/in": {
+                    "backlog_ms": args.backlog_ms,
+                    # gate at 3/4 of the hard bound: the watermark sheds
+                    # at the socket BEFORE consume() hits the
+                    # FastPathSaturated wall (frame-size granularity
+                    # means the wall is crossed mid-burst otherwise)
+                    "pending_spans": args.max_pending_spans * 3 // 4},
                 "traces/in/memory_limiter": {"inflight_bytes": 400e6},
                 "traces/in/batch": {"pending_spans": 48 * 1024},
             }, "refresh_ms": 2.0},
@@ -191,12 +221,35 @@ def run_soak(args, fast_path: bool) -> dict:
     stop = threading.Event()
     exporter_names = [f"otlpwire/soak-{i}" for i in range(args.senders)]
 
+    # open-loop pacing (0 = closed-loop saturation): each sender holds
+    # a fixed spans/s share and sleeps between exports regardless of
+    # how fast the pipeline answers. A saturating closed-loop sender
+    # adapts to backpressure — the classic coordinated-omission trap —
+    # so its probe latency compares the two arms' ADMISSION POLICIES
+    # (the fast path sheds at the socket, the componentwise chain
+    # buffers), not the paths themselves. Paced below the knee, both
+    # arms carry the identical offered load losslessly and the probe
+    # measures pure path transit.
+    pace_interval_s = 0.0
+    if args.pace_spans_per_sec:
+        mean_batch = sum(batch_spans) / len(batch_spans)
+        pace_interval_s = mean_batch * args.senders \
+            / args.pace_spans_per_sec
+
     def sender(i: int) -> None:
+        # retry cap 0.05: against shed-paced admission (ISSUE 9) the
+        # REJECTED answer is the pacing signal, not an outage — the
+        # pending_ms gate drains a ~13 ms frame every service interval,
+        # so a sender sleeping 250 ms+ leaves reopened-gate capacity on
+        # the floor (the throughput hole IS the tail latency); jittered
+        # retries (wire/client.py) de-correlate the reopening stampede
         exp = WireExporter(exporter_names[i], {
             "endpoint": f"127.0.0.1:{port}", "queue_size": 64,
-            "retry_initial_s": 0.02, "max_elapsed_s": 60.0})
+            "retry_initial_s": 0.01, "retry_max_s": 0.05,
+            "max_elapsed_s": 60.0})
         exp.start()
         k = i
+        next_t = time.monotonic()
         while not stop.is_set():
             exp.export(batches[k % len(batches)])
             sent_spans[i] += batch_spans[k % len(batches)]
@@ -206,6 +259,14 @@ def run_soak(args, fast_path: bool) -> dict:
             # "sent" means accepted-by-socket, not buffered locally
             while exp.queued > 32 and not stop.is_set():
                 time.sleep(0.001)
+            if pace_interval_s:
+                # absolute-schedule pacing (no drift): a late export
+                # shortens the next sleep instead of stretching the
+                # whole schedule
+                next_t += pace_interval_s
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    stop.wait(delay)
         ok = exp.flush(timeout=60.0)
         if not ok:
             # the residual queue holds the most recently enqueued batches
@@ -253,9 +314,22 @@ def run_soak(args, fast_path: bool) -> dict:
     probe_spans_sent = [0]
 
     def prober() -> None:
+        # fast reprobe on REJECTED (3 ms initial backoff): the probe
+        # measures the ACCEPTED path's added latency under load; with
+        # shed-paced admission the gate flaps at its limit by design,
+        # and a 20 ms-doubling backoff on a 1-span probe would measure
+        # the probe client's own retry policy instead of the pipeline
+        # (rejected_backoffs still reports every REJECTED honestly)
+        # retry_max_s 0.012: gate-closed windows on this route are the
+        # backlog gate's drain interval (tens of ms); a probe sleeping
+        # past the reopening measures its own backoff ladder, not the
+        # shed-window length — the cap keeps the sample inside one
+        # reopening period while the workload senders keep their own
+        # coarser 0.05 cap
         exp = WireExporter("otlpwire/probe", {
             "endpoint": f"127.0.0.1:{port}", "queue_size": 8,
-            "retry_initial_s": 0.02, "max_elapsed_s": 30.0})
+            "retry_initial_s": 0.003, "retry_max_s": 0.012,
+            "max_elapsed_s": 30.0})
         exp.start()
         seq = 0
         while not stop.is_set():
@@ -354,7 +428,15 @@ def run_soak(args, fast_path: bool) -> dict:
         "unit": "spans/s",
         "elapsed_s": round(elapsed, 2),
         "senders": args.senders,
+        # open-loop offered load (None = closed-loop saturation): both
+        # A/B arms carry the same paced load, so the probe compares
+        # path transit, not admission policy
+        "offered_spans_per_sec": args.pace_spans_per_sec or None,
         "fast_path": fast_path,
+        "fast_path_lanes": args.lanes if fast_path else None,
+        "fast_path_submit_lanes": (args.submit_lanes or args.lanes)
+        if fast_path else None,
+        "fast_path_ordered": bool(args.ordered) if fast_path else None,
         "model": args.model,
         "mesh": _parse_mesh(args.mesh) if args.mesh else None,
         "spans_sent": int(sent),
@@ -416,6 +498,49 @@ def main() -> None:
                          "embed the componentwise summary in the record")
     ap.add_argument("--deadline-ms", type=float, default=100.0,
                     help="fast-path admission deadline per frame")
+    ap.add_argument("--lanes", type=int, default=4,
+                    help="fast-path retirement lanes (ISSUE 9): "
+                         "completion-driven tag/forward overlap")
+    ap.add_argument("--submit-lanes", type=int, default=0,
+                    help="fast-path submit-lane pool (featurize + "
+                         "engine submit); 0 = same as --lanes. The "
+                         "pools bound different legs, so a host-"
+                         "contended box may want them sized apart "
+                         "(more submit threads than cores just adds "
+                         "featurize contention)")
+    ap.add_argument("--backlog-ms", type=float, default=60.0,
+                    help="admission-gate limit on the fast path's "
+                         "backlog_ms watermark (age of the oldest frame "
+                         "no submit lane has started); now that intake "
+                         "is handoff-only the gate is the sole pacing "
+                         "signal, so this IS the standing-queue budget. "
+                         "Gating on pending_ms (head age of unretired "
+                         "frames) would shed on the frame's own "
+                         "processing wall — 2-3x throughput loss on a "
+                         "slow box")
+    ap.add_argument("--pace-spans-per-sec", type=float, default=0.0,
+                    help="open-loop offered load, spans/s across all "
+                         "senders (0 = closed-loop saturation). Paced "
+                         "below the knee both A/B arms carry IDENTICAL "
+                         "load losslessly, so the probe compares path "
+                         "transit instead of admission policy — the "
+                         "saturating mode's probe rides each arm's own "
+                         "backpressure (coordinated omission)")
+    ap.add_argument("--max-pending-spans", type=int, default=128 * 1024,
+                    help="fast path's hard pending-window bound; the "
+                         "pending_spans admission watermark gates at "
+                         "3/4 of it. Size it in FRAMES: large "
+                         "--traces-per-batch needs a wider window for "
+                         "the same in-flight frame count")
+    ap.add_argument("--engine-queue-depth", type=int, default=8,
+                    help="admission-gate limit on the engine's request-"
+                         "queue depth watermark (applies to both A/B "
+                         "arms; the engine queue is where latency hides "
+                         "from the backlog_ms gate)")
+    ap.add_argument("--ordered", action="store_true",
+                    help="forward downstream in intake order (single-"
+                         "forwarder FIFO contract) instead of "
+                         "as-completed")
     ap.add_argument("--slo-p99-ms", type=float, default=1000.0,
                     help="declared latency_p99_ms SLO objective for the "
                          "fast-path pipeline (burn verdict in SOAK.json)")
@@ -438,9 +563,9 @@ def main() -> None:
         base = run_soak(args, fast_path=False)
         result["componentwise_baseline"] = {
             k: base[k] for k in (
-                "value", "senders", "spans_sent", "spans_received",
-                "conservation", "latency_p50_ms", "latency_p95_ms",
-                "latency_p99_ms")}
+                "value", "senders", "offered_spans_per_sec",
+                "spans_sent", "spans_received", "conservation",
+                "latency_p50_ms", "latency_p95_ms", "latency_p99_ms")}
     import multiprocessing
 
     result["hardware_note"] = (
