@@ -209,6 +209,39 @@ def describe_install(state: CliState) -> str:
             lines.append("  " + _fmt_flow_row(e, dropped))
             if cond is not None:
                 lines.append("  " + _fmt_condition(_flow_condition(cond)))
+    # fleet plane (ISSUE 10): per-group worst-of rollup, per-collector
+    # health, firing alerts, and the observe-only sizing
+    # recommendations — live process state like the flow rows above
+    from ..selftelemetry.fleet import fleet_plane
+
+    fleet = fleet_plane.api_snapshot()
+    if fleet["collectors"]:
+        lines.append(f"  fleet: {len(fleet['collectors'])} collector(s)")
+        for g, grp in sorted(fleet["groups"].items()):
+            lines.append(
+                f"    group[{g}]: {grp['status']} ({grp['reason']}) — "
+                f"{grp['by_status'].get('Healthy', 0)} healthy / "
+                f"{grp['by_status'].get('Degraded', 0)} degraded / "
+                f"{grp['by_status'].get('Unhealthy', 0)} unhealthy")
+        for co in fleet["collectors"]:
+            lines.append(
+                f"    {co['collector']}[{co['group'] or '-'}]: "
+                f"{co['status']} {co['reason']}"
+                + (f" — {co['message']}" if co["message"] else ""))
+    rules = fleet["alerts"]["rules"]
+    if rules:
+        firing = [r for r in rules if r["firing"]]
+        lines.append(f"  alerts: {len(rules)} rule(s), "
+                     f"{len(firing)} firing")
+        for r in rules:
+            mark = "✕" if r["firing"] else "✓"
+            val = "-" if r["value"] is None else f"{r['value']:g}"
+            lines.append(f"    [{mark}] {r['name']} ({r['severity']}): "
+                         f"{r['expr']} — value {val}, "
+                         f"state {r['state']}")
+    for rec in fleet["recommendations"]:
+        lines.append(f"  recommend[{rec['knob']}] {rec['name']}: "
+                     f"{rec['recommendation']}")
     ics = state.store.list("InstrumentationConfig")
     lines.append(f"  instrumented workloads: {len(ics)}")
     for ic in ics:

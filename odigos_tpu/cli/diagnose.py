@@ -132,6 +132,15 @@ def collect_bundle(state: CliState, out_path: Optional[str] = None,
 
         add("latency.json", json.dumps(latency_ledger.snapshot(),
                                        indent=1, sort_keys=True))
+        # fleet plane (ISSUE 10): per-collector health rollups, worst-
+        # of per group, alert rule states + fired/cleared history, and
+        # the sizing recommendations scoped to this install's preset —
+        # "how is the fleet doing", frozen at bundle time
+        from ..selftelemetry.fleet import fleet_plane
+
+        add("fleet.json", json.dumps(
+            fleet_plane.api_snapshot(config=state.config),
+            indent=1, sort_keys=True))
         # device-runtime snapshot, taken fresh at bundle time: engine
         # gauges + (when jax is loaded) live arrays, device memory, and
         # per-jit-site cache/compile accounting. Read-only: a one-shot

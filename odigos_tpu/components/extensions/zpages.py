@@ -26,6 +26,10 @@ serves JSON (terminal-first operators curl it):
                            budget per stage + expiry blames), recent
                            frame timelines, and the SLO burn-rate
                            status
+* ``/debug/fleetz``      — the fleet plane (ISSUE 10): per-collector
+                           health rollups, worst-of per group, alert
+                           rule states with fired/cleared history, and
+                           the observe-only sizing recommendations
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
 """
@@ -133,13 +137,19 @@ class ZPagesExtension(HttpExtension):
                 if c["component"].startswith("slo/")]
         return 200, out
 
+    def _fleetz(self, q: dict[str, str]) -> tuple[int, dict]:
+        from ...selftelemetry.fleet import fleet_plane
+
+        return 200, fleet_plane.api_snapshot()
+
     def pages(self) -> dict[str, Page]:
         return {"/debug/pipelinez": self._pipelinez,
                 "/debug/servicez": self._servicez,
                 "/debug/extensionz": self._extensionz,
                 "/debug/tracez": self._tracez,
                 "/debug/flowz": self._flowz,
-                "/debug/latencyz": self._latencyz}
+                "/debug/latencyz": self._latencyz,
+                "/debug/fleetz": self._fleetz}
 
 
 register(Factory(

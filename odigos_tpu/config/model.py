@@ -114,6 +114,25 @@ class SloConfiguration:
 
 
 @dataclass
+class AlertRuleConfiguration:
+    """One declarative fleet alert rule (ISSUE 10): ``expr`` is a
+    window expression over the series store
+    (``fn(metric{k=v,...}[Ns]) <op> number``, grammar in
+    selftelemetry/fleet.parse_expr), ``for_s`` the hold duration a
+    breach must persist before the rule fires (recovery clears), and
+    ``severity`` maps to the HealthRollup condition raised while firing
+    (critical -> Unhealthy, else Degraded). Rendered by pipelinegen as
+    the gateway config's ``service.alerts`` stanza and validated by
+    graph.validate_config — a typo'd rule dies at load, never silently
+    sits dark."""
+
+    name: str = ""
+    expr: str = ""
+    for_s: float = 0.0
+    severity: str = "warning"
+
+
+@dataclass
 class AnomalyStageConfiguration:
     """First-class config for the TPU anomaly-detection stage (north star:
     tpuanomalyprocessor + anomalyrouter + TPU sidecar)."""
@@ -230,6 +249,10 @@ class Configuration:
         default_factory=AnomalyStageConfiguration)
     selftelemetry: SelfTelemetryConfiguration = field(
         default_factory=SelfTelemetryConfiguration)
+    # declarative fleet alert rules (ISSUE 10): rendered into the
+    # gateway config's service.alerts stanza; empty list renders
+    # nothing (byte-stable configs for installs without alerts)
+    alerts: list[AlertRuleConfiguration] = field(default_factory=list)
     # Free-form bag for profile-applied settings without a dedicated field
     # (reference profiles patch arbitrary config, e.g. disable-gin).
     extra: dict[str, Any] = field(default_factory=dict)
@@ -246,6 +269,10 @@ class Configuration:
 # infer the type from at runtime under `from __future__ import annotations`)
 _OPTIONAL_NESTED: dict[str, type] = {"oidc": OidcConfiguration,
                                      "slo": SloConfiguration}
+
+# list-of-dataclass fields (default_factory=list hides the element type
+# at runtime under deferred annotations, like _OPTIONAL_NESTED above)
+_LIST_NESTED: dict[str, type] = {"alerts": AlertRuleConfiguration}
 
 
 def _from_dict(cls, data):
@@ -266,6 +293,10 @@ def _from_dict(cls, data):
             kwargs[k] = _from_dict(f.default_factory, v)
         elif isinstance(v, dict) and k in _OPTIONAL_NESTED:
             kwargs[k] = _from_dict(_OPTIONAL_NESTED[k], v)
+        elif isinstance(v, list) and k in _LIST_NESTED:
+            kwargs[k] = [_from_dict(_LIST_NESTED[k], item)
+                         if isinstance(item, dict) else item
+                         for item in v]
         else:
             kwargs[k] = v
     obj = cls(**kwargs)

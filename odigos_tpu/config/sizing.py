@@ -39,6 +39,22 @@ class SizingPreset:
     node_limit_cpu_m: int
 
 
+# the sizing knobs the fleet recommender (selftelemetry/fleet.py) may
+# name in an observe-only recommendation: knob -> the config path an
+# operator (or, later, the ROADMAP auto-tuner) would turn. A closed
+# table for the same reason DROP_REASONS is — the package-hygiene lint
+# asserts every recommender rule's knob resolves here, so a
+# recommendation can never point at a knob that does not exist.
+TUNING_KNOBS: dict[str, str] = {
+    "max_batch": "anomaly.max_batch (device batch budget per call)",
+    "bucket_ladder": "anomaly trace_bucket / warm_ladder "
+                     "(precompiled row-bucket geometry)",
+    "replicas": "collector_gateway.min_replicas/max_replicas "
+                "(gateway replica count; bounded by the sizing preset)",
+    "submit_lanes": "anomaly fast_path.submit_lanes "
+                    "(featurize/submit thread pool width)",
+}
+
 # k8sutils/pkg/sizing/sizing.go presets (small/medium/large clusters)
 SIZING_PRESETS: dict[str, SizingPreset] = {
     "size_s": SizingPreset("size_s", 1, 5, 300, 150, 300, 150, 300, 150, 300),

@@ -245,6 +245,7 @@ class Autoscaler:
             anomaly=self.config.anomaly,
             ui_endpoint=self.config.ui_endpoint,
             telemetry_config=self.config.selftelemetry,
+            alerts=self.config.alerts,
         )
         with tracer.span("autoscaler/render-gateway-config") as sp:
             sp.set_attr("cr.kind", "ConfigMap")

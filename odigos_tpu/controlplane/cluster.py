@@ -72,6 +72,21 @@ class Workload:
     annotations: dict[str, str] = field(default_factory=dict)
 
 
+@dataclass
+class CollectorEndpoint:
+    """Fleet membership record of one collector (ISSUE 10): the cluster
+    model's analog of the reference's OpAMP-connected collector pod.
+    The fleet plane (selftelemetry/fleet.py) is the telemetry side;
+    this is the control-plane side — who is supposed to exist, on which
+    node, in which group — so churn (register/unregister) has one
+    source of truth the e2e environment and fleet simulations share."""
+
+    name: str
+    group: str = ""
+    node: Optional[str] = None
+    registered_at: float = field(default_factory=time.time)
+
+
 # admission webhook signature: mutate the pod in place before it "starts"
 AdmissionHook = Callable[[Pod], None]
 
@@ -86,6 +101,8 @@ class Cluster:
         self._node_rr = itertools.count()
         # fault injection: workload key -> phase new pods enter
         self._fail_next: dict[str, PodPhase] = {}
+        # fleet membership (ISSUE 10): collector name -> endpoint record
+        self.collector_endpoints: dict[str, CollectorEndpoint] = {}
 
     # ---------------------------------------------------------- workloads
 
@@ -145,6 +162,33 @@ class Cluster:
             del self.pods[pod.name]
         for _ in range(w.replicas - len(current)):
             self._spawn_pod(w)
+
+    # --------------------------------------------------------- collectors
+
+    def register_collector(self, name: str, group: str = "",
+                           node: Optional[str] = None
+                           ) -> CollectorEndpoint:
+        """Announce a collector to the fleet (idempotent; group/node
+        update in place). Simulated fleets register here and publish
+        telemetry through ``selftelemetry.fleet.fleet_plane`` — the two
+        registries stay in sync through these two methods."""
+        ep = self.collector_endpoints.get(name)
+        if ep is None:
+            ep = self.collector_endpoints[name] = CollectorEndpoint(
+                name, group=group, node=node)
+        else:
+            if group:
+                ep.group = group
+            if node is not None:
+                ep.node = node
+        return ep
+
+    def unregister_collector(self, name: str) -> None:
+        self.collector_endpoints.pop(name, None)
+
+    def collectors_in_group(self, group: str) -> list[CollectorEndpoint]:
+        return [ep for ep in self.collector_endpoints.values()
+                if ep.group == group]
 
     # ------------------------------------------------------------ rollout
 

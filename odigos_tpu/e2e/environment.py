@@ -115,6 +115,14 @@ class E2EEnvironment:
         raise RuntimeError(f"node {node} collector has no otlp receiver")
 
     def shutdown(self) -> None:
+        # fleet churn: departing collectors leave the plane (and their
+        # series leave the store) so aggregates stop answering for them
+        from ..selftelemetry.fleet import fleet_plane
+
+        for cid in (["gateway"]
+                    + [f"node/{n}" for n in self.node_collectors]):
+            fleet_plane.unregister(cid)
+            self.cluster.unregister_collector(cid)
         if self._wire_tap is not None:
             self._wire_tap.shutdown()
             self._wire_tap = None
@@ -151,17 +159,38 @@ class E2EEnvironment:
         self._refresh_gateway_service()
         self._publish_gateway_health()
 
+    # CollectorsGroup role -> fleet group name (one naming scheme for
+    # the plane, the worst-of rollup, and the FleetHealth condition)
+    GATEWAY_FLEET_GROUP = "cluster-gateway"
+    NODE_FLEET_GROUP = "node-collectors"
+
     def _publish_gateway_health(self) -> None:
         """Mirror the gateway collector's flow-ledger condition rollup
         into the CollectorsGroup status (the OpAMP status-reporting role:
         the control-plane store is a consumer of the rollup, so
         `describe`/the UI see collector health without reaching into the
-        collector process)."""
+        collector process) — and publish every running collector into
+        the fleet plane (ISSUE 10): its meter snapshot crosses the seam
+        delta-published under a ``{collector=}`` label, its rollup
+        becomes the per-collector fleet health, and the plane's worst-of
+        group rollup lands back on the CollectorsGroup as a
+        ``FleetHealth`` condition beside ``CollectorHealth``."""
         if self.gateway is None:
             return
         from ..api.resources import (
             CollectorsGroupRole, Condition, ConditionStatus)
+        from ..selftelemetry.fleet import fleet_plane
 
+        fleet_plane.publish_collector(
+            self.gateway, "gateway", group=self.GATEWAY_FLEET_GROUP)
+        self.cluster.register_collector(
+            "gateway", group=self.GATEWAY_FLEET_GROUP)
+        for node, collector in self.node_collectors.items():
+            cid = f"node/{node}"
+            fleet_plane.publish_collector(
+                collector, cid, group=self.NODE_FLEET_GROUP)
+            self.cluster.register_collector(
+                cid, group=self.NODE_FLEET_GROUP, node=node)
         group = next(
             (g for g in self.store.list("CollectorsGroup")
              if g.role == CollectorsGroupRole.CLUSTER_GATEWAY), None)
@@ -170,11 +199,23 @@ class E2EEnvironment:
         rollup = self.gateway.graph.flow_health
         rollup.evaluate()  # refresh conditions before summarizing
         status, reason, message = rollup.worst()
-        cond_status = {"Healthy": ConditionStatus.TRUE,
-                       "Degraded": ConditionStatus.UNKNOWN,
-                       "Unhealthy": ConditionStatus.FALSE}[status]
-        if group.set_condition(Condition(
-                "CollectorHealth", cond_status, reason, message)):
+        to_cond = {"Healthy": ConditionStatus.TRUE,
+                   "Degraded": ConditionStatus.UNKNOWN,
+                   "Unhealthy": ConditionStatus.FALSE}
+        changed = group.set_condition(Condition(
+            "CollectorHealth", to_cond[status], reason, message))
+        # the fleet plane's worst-of for this group (includes what the
+        # plane knows beyond this process: simulated/remote members)
+        fleet_groups = fleet_plane.group_rollup()
+        fg = fleet_groups.get(self.GATEWAY_FLEET_GROUP)
+        if fg is not None:
+            changed |= group.set_condition(Condition(
+                "FleetHealth", to_cond.get(fg["status"],
+                                           ConditionStatus.UNKNOWN),
+                fg["reason"],
+                f"{fg['collectors']} collector(s); worst: "
+                f"{fg['worst_collector'] or '-'}"))
+        if changed:
             self.store.update_status(group)
 
     def _refresh_gateway_service(self) -> None:

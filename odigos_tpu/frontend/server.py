@@ -383,6 +383,15 @@ class _Handler(BaseHTTPRequestHandler):
                     "watermarks": snap["watermarks"],
                     "conditions": active_conditions(),
                 })
+            if path == "/api/fleet":
+                # the fleet observability plane (ISSUE 10): per-
+                # collector health rollups (delta-published into the
+                # series store under {collector=}), worst-of per group,
+                # alert rule states + fired/cleared history, and the
+                # observe-only sizing recommendations
+                from ..selftelemetry.fleet import fleet_plane
+
+                return self._json(fleet_plane.api_snapshot())
             if path == "/api/slo":
                 # latency attribution & SLO burn (ISSUE 8): per-pipeline
                 # burn-rate status over the declared objectives, the

@@ -72,6 +72,11 @@ class Graph:
     # shared by healthcheck + zpages + the owning Collector so
     # last-transition times are one consistent history
     flow_health: Any = None
+    # fleet alert rules THIS config declared (service.alerts, ISSUE 10):
+    # the rollup scopes its alert/<name> rows to these, and
+    # Collector.reload diffs old vs new to retire rules a reload
+    # deleted (the remove_slo discipline, keyed by rule name)
+    alert_rule_names: set[str] = field(default_factory=set)
 
     def all_components(self) -> list[Component]:
         # extensions first: healthcheck must be able to answer before any
@@ -260,6 +265,15 @@ def validate_config(config: dict[str, Any]) -> list[str]:
                         problems.append(
                             f"pipeline {pname}: fast_path.{key} must "
                             f"be a positive number")
+
+    # fleet alert rules (ISSUE 10): a malformed rule must die at
+    # validation with every other config problem, never silently load
+    # as a rule that can't fire
+    alerts = config.get("service", {}).get("alerts")
+    if alerts is not None:
+        from ..selftelemetry.fleet import validate_alert_rules
+
+        problems.extend(validate_alert_rules(alerts))
 
     # authenticator references must resolve to a defined+enabled extension
     # (the collector fails startup on a dangling authenticator; an auth'd
@@ -508,6 +522,18 @@ def build_graph(config: dict[str, Any],
         recv = reg.get(ComponentKind.RECEIVER, rid).build(rid, rcfg)
         recv.set_consumer(feeds[0] if len(feeds) == 1 else FanoutConsumer(feeds))
         g.receivers[rid] = recv
+
+    # fleet alert rules (ISSUE 10): upsert every declared rule into the
+    # process-global engine — get-or-create stable on an identical spec
+    # so firing state survives a reload that didn't touch the rule —
+    # and stamp the declared names on the graph (the rollup scopes its
+    # alert/<name> rows to them; Collector.reload retires the diff)
+    if config.get("service", {}).get("alerts"):
+        from ..selftelemetry.fleet import alert_engine
+
+        for rule_cfg in config["service"]["alerts"]:
+            alert_engine.configure(dict(rule_cfg))
+            g.alert_rule_names.add(rule_cfg["name"])
 
     # condition rollup over the finished graph (flow ledger, ISSUE 5):
     # healthcheck/zpages/the Collector all read this one instance so

@@ -55,6 +55,14 @@ class Collector:
                 return
             self._stop_graph(self.graph)
             unregister_rollup(self.graph.flow_health)
+            if self.graph.alert_rule_names:
+                # the engine is process-global: a dead collector's rules
+                # must not keep evaluating (and firing) against the
+                # store forever — same lifetime as the rollup above
+                from ..selftelemetry.fleet import alert_engine
+
+                for name in self.graph.alert_rule_names:
+                    alert_engine.remove(name)
             stop_started(self._telemetry_started)
             self._telemetry_started = []
             self._running = False
@@ -141,6 +149,16 @@ class Collector:
                         comp.start()
                     meter.add("odigos_collector_reload_failures_total")
                     raise
+            # a reload that edited/deleted alert rules must retire the
+            # ones no longer declared (the remove_slo discipline): the
+            # new build upserted its own rules already, so the diff of
+            # graph-stamped names is exactly the deleted set
+            if old_graph.alert_rule_names - new_graph.alert_rule_names:
+                from ..selftelemetry.fleet import alert_engine
+
+                for name in (old_graph.alert_rule_names
+                             - new_graph.alert_rule_names):
+                    alert_engine.remove(name)
             # condition continuity across the swap: same-named components
             # keep their last-transition history (k8s lastTransitionTime
             # semantics survive a hot reload)

@@ -26,12 +26,14 @@ build without the TPU components registered.
 from __future__ import annotations
 
 import copy
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ..components.api import Signal
 from ..config.model import (
-    AnomalyStageConfiguration, SelfTelemetryConfiguration)
+    AlertRuleConfiguration, AnomalyStageConfiguration,
+    SelfTelemetryConfiguration)
 from ..destinations.configers import ConfigerError, modify_config
 from ..destinations.registry import Destination
 
@@ -89,6 +91,11 @@ class GatewayOptions:
     # silently toggle the wrong subsystem.
     telemetry_config: Optional[SelfTelemetryConfiguration] = None
     ui_endpoint: str = "ui.odigos-system:4317"  # otlp/ui stream target
+    # declarative fleet alert rules (ISSUE 10): AlertRuleConfiguration
+    # list rendered as the service.alerts stanza (empty/None renders
+    # nothing — existing configs stay byte-identical); evaluated by the
+    # fleet plane's alert engine, surfaced as alert/<name> conditions
+    alerts: Optional[list] = None
     # extra processor ids (already configured in `processors`) to run in the
     # root pipeline per signal, e.g. compiled Actions.
     root_processors: dict[Signal, list[str]] = field(default_factory=dict)
@@ -412,6 +419,21 @@ def build_gateway_config(
     # collector applies it via selftelemetry.start_from_config. Absent
     # when disabled — the generated config stays byte-stable for
     # existing installs.
+    # --- fleet alert rules (ISSUE 10): the service.alerts stanza the
+    # fleet plane's alert engine loads at graph build — rules evaluate
+    # window expressions over the series store and raise alert/<name>
+    # conditions while firing. Hot-reloadable: a re-render with edited/
+    # deleted rules reconfigures/retires them (Collector.reload diffs
+    # the graph-stamped rule names).
+    if options.alerts:
+        # normalize through the dataclass so its defaults are the ONE
+        # source of truth (raw dicts arrive from hand-built options;
+        # hydrated configs already carry dataclasses)
+        config["service"]["alerts"] = [
+            dataclasses.asdict(a if isinstance(a, AlertRuleConfiguration)
+                               else AlertRuleConfiguration(**a))
+            for a in options.alerts]
+
     st = options.telemetry_config
     if st is not None and (st.profiler_enabled or st.device_runtime_enabled):
         telemetry: GenericMap = {}

@@ -16,6 +16,18 @@ from .flow import (  # noqa: F401
     active_conditions,
     flow_ledger,
 )
+from .fleet import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    FleetPlane,
+    RECOMMENDER_RULES,
+    alert_engine,
+    fleet_plane,
+    parse_expr,
+    recommend,
+    referenced_metric,
+    validate_alert_rules,
+)
 from .instrument import TracedEntry, trace_pipeline_entry  # noqa: F401
 from .latency import (  # noqa: F401
     ENGINE_STAGES,
@@ -29,6 +41,12 @@ from .latency import (  # noqa: F401
     publish_clock,
     start_clock,
     unpublish_clock,
+)
+from .seriesstate import (  # noqa: F401
+    SeriesStore,
+    series_store,
+    split_key,
+    with_label,
 )
 from .profiler import (  # noqa: F401
     ContinuousProfiler,

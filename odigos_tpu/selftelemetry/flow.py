@@ -826,6 +826,40 @@ class HealthRollup:
                         f"fast burn {slo['fast']['burn']}x / "
                         f"slow {slo['slow']['burn']}x")
                 out.append(dict(cond))
+            # fleet alert conditions (ISSUE 10): one alert/<name> row
+            # per rule THIS graph's config declared (service.alerts),
+            # evaluated fresh against the series store like the SLO
+            # burn rows — firing critical maps to Unhealthy, firing
+            # warning/info to Degraded, pending/inactive stays Healthy
+            # (a pending rule has not confirmed its for: hold yet).
+            own_alerts = getattr(graph, "alert_rule_names", None) \
+                if graph is not None else None
+            if own_alerts:
+                from .fleet import alert_engine
+
+                for rule in alert_engine.evaluate():
+                    if rule["name"] not in own_alerts:
+                        continue
+                    node = f"alert/{rule['name']}"
+                    live.add(node)
+                    if rule["firing"]:
+                        status = UNHEALTHY \
+                            if rule["severity"] == "critical" else DEGRADED
+                        cond = self._upsert(
+                            node, status, "AlertFiring",
+                            f"{rule['expr']} (value "
+                            f"{rule['value']}, series "
+                            f"{rule['series'] or '-'})")
+                    elif rule["state"] == "pending":
+                        cond = self._upsert(
+                            node, HEALTHY, "AlertPending",
+                            f"breaching, holding for_s="
+                            f"{rule['for_s']:g}")
+                    else:
+                        cond = self._upsert(
+                            node, HEALTHY, "WithinThreshold",
+                            f"value {rule['value']}")
+                    out.append(dict(cond))
             # prune components gone from the graph (reload removed them)
             for name in list(self._state):
                 if name not in live:

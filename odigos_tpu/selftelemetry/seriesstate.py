@@ -1,0 +1,533 @@
+"""Series state: the bounded in-process time-series ring store.
+
+The flow ledger (PR 5) answers "what flowed", latency attribution
+(PR 8) answers "where time went" — both for the *current instant* plus
+a few hand-rolled windows. This module is the third leg the ROADMAP's
+fleet items are blocked on: **recent history as a queryable substrate**.
+The drift-detection item reads it ("detect feature drift via
+``seriesstate``"), the fleet rollup publishes per-collector snapshots
+into it, and the alert/recommendation engines (selftelemetry/fleet.py)
+evaluate window expressions over it.
+
+Model — deliberately much smaller than a TSDB:
+
+* one :class:`SeriesStore` holds many **series**, each keyed by the
+  meter's flat ``name{label=value,...}`` encoding (one convention for
+  the whole self-telemetry stack — ``utils.telemetry.labeled_key``).
+* a series is a **fixed-interval ring**: appends land in the slot for
+  ``tick = int(now / interval_s)``; re-appends within one tick
+  overwrite (last value wins — snapshots are level samples, not
+  events). Append is O(1): two array stores, no allocation, no
+  compaction, ever.
+* ticks are absolute, so a slot left over from a previous lap of the
+  ring simply fails the window filter at query time — there is no
+  expiry pass.
+* **counter-delta awareness**: a series created with
+  ``kind="counter"`` stores raw cumulative values; :meth:`rate` /
+  :meth:`delta` sum consecutive increases with Prometheus-style reset
+  handling (a decrease restarts accumulation at the new value instead
+  of producing a negative spike).
+* **hard memory bound**: at most ``max_series`` series ever exist
+  (each ``window`` slots of (tick int64, value float64) ≈ 16 bytes a
+  slot). Past the cap, NEW series are dropped and counted in
+  ``odigos_seriesstate_dropped_series_total{metric=}`` — the store
+  degrades by refusing cardinality, never by growing.
+* ``ODIGOS_SERIES=0`` kills the layer: ``observe`` returns before
+  touching the lock, queries answer empty — the same opt-out contract
+  as ``ODIGOS_FLOW`` / ``ODIGOS_LATENCY`` / ``ODIGOS_SELFTRACE``.
+
+Window queries (all ``O(window)`` per series, lock held only for the
+point gather): ``latest``, ``rate``, ``delta``, ``ewma``,
+``quantile_over_window``, ``avg/max/min/sum_over_window``. Selection:
+``select(metric, labels)`` matches series whose base name equals
+``metric`` and whose label set is a superset of ``labels`` — the
+cross-collector aggregation primitive ``fleet.py`` builds on.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from ..utils.telemetry import labeled_key, meter
+
+DROPPED_SERIES_METRIC = "odigos_seriesstate_dropped_series_total"
+
+GAUGE = "gauge"
+COUNTER = "counter"
+
+_EMPTY = np.empty(0, dtype=np.float64)
+
+
+def split_key(key: str) -> tuple[str, dict[str, str]]:
+    """Flat ``name{k=v,...}`` -> (base name, labels). The inverse of
+    ``labeled_key`` — values were sanitized at record time (structural
+    chars replaced), so the naive split round-trips by contract."""
+    if "{" not in key:
+        return key, {}
+    base, rest = key.split("{", 1)
+    labels: dict[str, str] = {}
+    for part in rest.rstrip("}").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels[k] = v
+    return base, labels
+
+
+def with_label(key: str, **extra: str) -> str:
+    """Merge labels into a flat key (the fleet publisher's
+    ``{collector=}`` stamp). Existing labels keep their values unless
+    overridden; label insertion order is existing-then-new, so repeated
+    stamping of the same snapshot yields identical keys (delta
+    publishing depends on key stability)."""
+    base, labels = split_key(key)
+    labels.update(extra)
+    return labeled_key(base, **labels)
+
+
+class _Series:
+    """One ring. Owned by the store; all access under the store lock.
+    Slot arrays are numpy so a window query is two vectorized masks,
+    not an O(window) Python scan — the alert engine evaluates every
+    matching series per tick, and a fleet of hundreds of collectors
+    makes the scan the layer's own overhead-bound violation (measured:
+    48k python iterations/tick before, microseconds after)."""
+
+    __slots__ = ("key", "base", "labels", "kind", "ticks", "values",
+                 "last_tick", "last_value")
+
+    def __init__(self, key: str, kind: str, window: int):
+        self.key = key
+        self.base, self.labels = split_key(key)
+        self.kind = kind
+        # absolute tick per slot (-1 = never written) + its value
+        self.ticks = np.full(window, -1, dtype=np.int64)
+        self.values = np.zeros(window, dtype=np.float64)
+        self.last_tick = -1
+        self.last_value = 0.0
+
+    def append(self, tick: int, value: float) -> None:
+        pos = tick % len(self.ticks)
+        self.ticks[pos] = tick
+        self.values[pos] = value
+        if tick >= self.last_tick:
+            self.last_tick = tick
+            self.last_value = value
+
+    def window_arrays(self, lo_tick: int, hi_tick: int
+                      ) -> tuple[np.ndarray, np.ndarray]:
+        """(ticks, values) within [lo_tick, hi_tick], UNSORTED (ring
+        order) — order-insensitive reductions (avg/max/min/sum/
+        quantile) use these directly. Stale slots from earlier laps
+        fail the absolute-tick filter."""
+        mask = (self.ticks >= lo_tick) & (self.ticks <= hi_tick)
+        return self.ticks[mask], self.values[mask]
+
+    def points(self, lo_tick: int, hi_tick: int) -> list[tuple[int, float]]:
+        """(tick, value) within [lo_tick, hi_tick], ascending."""
+        ticks, values = self.window_arrays(lo_tick, hi_tick)
+        order = np.argsort(ticks, kind="stable")
+        return list(zip(ticks[order].tolist(), values[order].tolist()))
+
+
+def _counter_increase(pts: list[tuple[int, float]]) -> float:
+    """Sum of positive deltas with reset handling: a decrease means the
+    source restarted, so the new value counts from zero (the Prometheus
+    rate() reset rule) instead of a negative spike."""
+    inc = 0.0
+    for (_, prev), (_, cur) in zip(pts, pts[1:]):
+        inc += (cur - prev) if cur >= prev else cur
+    return inc
+
+
+class SeriesStore:
+    """Bounded fixed-interval ring store (process-global instance:
+    :data:`series_store`). ``clock`` is injectable for tests; it must be
+    monotonic-ish (ticks derive from it)."""
+
+    def __init__(self, interval_s: float = 1.0, window: int = 240,
+                 max_series: int = 50_000,
+                 clock: Callable[[], float] = time.monotonic):
+        self.enabled = os.environ.get("ODIGOS_SERIES", "1") != "0"
+        self.interval_s = float(interval_s)
+        self.window = int(window)
+        self.max_series = int(max_series)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._series: dict[str, _Series] = {}
+        # base name -> {key: series}: select() is per-rule-per-tick and
+        # must not scan the whole store to answer for one metric
+        self._by_base: dict[str, dict[str, _Series]] = {}
+        self._dropped: dict[str, int] = {}  # base name -> dropped count
+
+    # ------------------------------------------------------------ append
+
+    def _tick(self, ts: Optional[float]) -> int:
+        return int((ts if ts is not None else self._clock())
+                   / self.interval_s)
+
+    def observe(self, key: str, value: float, kind: str = GAUGE,
+                ts: Optional[float] = None) -> bool:
+        """Append one sample; returns False when the sample was refused
+        (kill switch, cardinality cap, non-finite value)."""
+        if not self.enabled:
+            return False
+        v = float(value)
+        if not math.isfinite(v):
+            return False
+        tick = self._tick(ts)
+        with self._lock:
+            return self._observe_locked(key, v, kind, tick)
+
+    def observe_many(self, items: Iterable[tuple[str, float]],
+                     kind: str = GAUGE, ts: Optional[float] = None,
+                     refused: Optional[list] = None) -> int:
+        """Append a correlated batch under ONE lock hold (a collector
+        snapshot is hundreds of keys; per-key locking would make the
+        publish path the fleet layer's own overhead bound violation).
+        Returns the number of samples actually stored; ``refused``
+        (optional list) collects the keys that were NOT stored
+        (cardinality cap / non-finite) so publishers can un-mark them
+        in their delta base and retry on the next publish."""
+        if not self.enabled:
+            return 0
+        tick = self._tick(ts)
+        n = 0
+        with self._lock:
+            for key, value in items:
+                v = float(value)
+                if math.isfinite(v) and self._observe_locked(
+                        key, v, kind, tick):
+                    n += 1
+                elif refused is not None:
+                    refused.append(key)
+        return n
+
+    def _observe_locked(self, key: str, v: float, kind: str,
+                        tick: int) -> bool:
+        s = self._series.get(key)
+        if s is None:
+            if len(self._series) >= self.max_series:
+                base = key.split("{", 1)[0]
+                self._dropped[base] = self._dropped.get(base, 0) + 1
+                # the overflow evidence rides the METER (bounded: one
+                # counter per distinct base name), never this store —
+                # a store refusing cardinality must not consume it
+                meter.add(labeled_key(DROPPED_SERIES_METRIC, metric=base))
+                return False
+            s = self._series[key] = _Series(key, kind, self.window)
+            self._by_base.setdefault(s.base, {})[key] = s
+        s.append(tick, v)
+        return True
+
+    # --------------------------------------------------------- selection
+
+    def select(self, metric: str,
+               labels: Optional[dict[str, str]] = None) -> list[str]:
+        """Keys whose base name equals ``metric`` and whose labels are a
+        superset of ``labels`` (None/{} matches every label set)."""
+        with self._lock:
+            out = []
+            for key, s in self._by_base.get(metric, {}).items():
+                if labels and any(s.labels.get(k) != v
+                                  for k, v in labels.items()):
+                    continue
+                out.append(key)
+        return out
+
+    def drop_series(self, labels: dict[str, str]) -> int:
+        """Remove every series carrying ALL the given labels (fleet
+        churn: an unregistered collector's series must leave the
+        aggregates instead of answering queries for a full window).
+        Returns the number of series dropped; capacity is freed."""
+        with self._lock:
+            doomed = [s for s in self._series.values()
+                      if all(s.labels.get(lk) == lv
+                             for lk, lv in labels.items())]
+            for s in doomed:
+                del self._series[s.key]
+                base = self._by_base.get(s.base)
+                if base is not None:
+                    base.pop(s.key, None)
+                    if not base:
+                        del self._by_base[s.base]
+        return len(doomed)
+
+    # ----------------------------------------------------------- queries
+
+    def _bounds(self, window_s: Optional[float]) -> tuple[int, int]:
+        now_tick = self._tick(None)
+        span = self.window if window_s is None else max(
+            1, int(math.ceil(window_s / self.interval_s)))
+        return now_tick - min(span, self.window) + 1, now_tick
+
+    def _points(self, key: str,
+                window_s: Optional[float]) -> list[tuple[float, float]]:
+        """(unix-ish seconds, value) points of one series inside the
+        query window (None = the whole retained ring), time-ascending."""
+        return self._points_with_kind(key, window_s)[0]
+
+    def _points_with_kind(
+            self, key: str, window_s: Optional[float]
+    ) -> tuple[list[tuple[float, float]], str]:
+        """Points + the series' kind from ONE lock hold — rate()/delta()
+        need both, and re-reading the kind after the gather races a
+        concurrent drop_series into the GAUGE fallback (a counter reset
+        would then compute exactly the negative spike reset-awareness
+        exists to prevent)."""
+        lo, hi = self._bounds(window_s)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return [], GAUGE
+            pts = s.points(lo, hi)
+            kind = s.kind
+        return [(t * self.interval_s, v) for t, v in pts], kind
+
+    def _window_values(self, key: str,
+                       window_s: Optional[float]) -> np.ndarray:
+        """UNSORTED window values (order-insensitive reductions — the
+        hot query shape the alert engine drives per series per tick)."""
+        lo, hi = self._bounds(window_s)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return _EMPTY
+            return s.window_arrays(lo, hi)[1]
+
+    def points(self, key: str,
+               window_s: Optional[float] = None) -> list[tuple[float, float]]:
+        return self._points(key, window_s)
+
+    def latest(self, key: str,
+               window_s: Optional[float] = None) -> Optional[float]:
+        """Most recent value inside the window — O(1): the series
+        tracks its last (tick, value), and the window check is a
+        bounds compare (latest is the default alert-expression fn, so
+        it runs once per matching series per evaluation)."""
+        lo, hi = self._bounds(window_s)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None or not lo <= s.last_tick <= hi:
+                return None
+            return s.last_value
+
+    def rate(self, key: str, window_s: float) -> Optional[float]:
+        """Per-second increase over the window (counter-aware: resets
+        restart accumulation). None when fewer than two points exist —
+        a rate over one sample would be an invented number."""
+        pts, kind = self._points_with_kind(key, window_s)
+        if len(pts) < 2:
+            return None
+        elapsed = pts[-1][0] - pts[0][0]
+        if elapsed <= 0:
+            return None
+        if kind == COUNTER:
+            return _counter_increase(pts) / elapsed
+        return (pts[-1][1] - pts[0][1]) / elapsed
+
+    def delta(self, key: str, window_s: float) -> Optional[float]:
+        """Total change over the window (counter-aware like rate)."""
+        pts, kind = self._points_with_kind(key, window_s)
+        if len(pts) < 2:
+            return None
+        if kind == COUNTER:
+            return _counter_increase(pts)
+        return pts[-1][1] - pts[0][1]
+
+    def ewma(self, key: str, window_s: float,
+             alpha: Optional[float] = None) -> Optional[float]:
+        """Exponentially-weighted mean over the window's points, oldest
+        first (default alpha = 2/(n+1), the n-period EWMA convention)."""
+        pts = self._points(key, window_s)
+        if not pts:
+            return None
+        a = alpha if alpha is not None else 2.0 / (len(pts) + 1)
+        acc = pts[0][1]
+        for _, v in pts[1:]:
+            acc = a * v + (1.0 - a) * acc
+        return acc
+
+    def quantile_over_window(self, key: str, q: float,
+                             window_s: float) -> Optional[float]:
+        vals = self._window_values(key, window_s)
+        if not len(vals):
+            return None
+        vals = np.sort(vals)
+        return float(vals[min(int(q * len(vals)), len(vals) - 1)])
+
+    def avg_over_window(self, key: str, window_s: float) -> Optional[float]:
+        vals = self._window_values(key, window_s)
+        return float(vals.mean()) if len(vals) else None
+
+    def max_over_window(self, key: str, window_s: float) -> Optional[float]:
+        vals = self._window_values(key, window_s)
+        return float(vals.max()) if len(vals) else None
+
+    def min_over_window(self, key: str, window_s: float) -> Optional[float]:
+        vals = self._window_values(key, window_s)
+        return float(vals.min()) if len(vals) else None
+
+    def sum_over_window(self, key: str, window_s: float) -> Optional[float]:
+        vals = self._window_values(key, window_s)
+        return float(vals.sum()) if len(vals) else None
+
+    # the window-function vocabulary alert expressions / aggregation use
+    WINDOW_FNS = ("latest", "rate", "delta", "ewma", "avg", "max", "min",
+                  "sum", "p50", "p90", "p95", "p99")
+
+    def window_value(self, key: str, fn: str,
+                     window_s: float) -> Optional[float]:
+        """One windowed value of one series by function name (the alert
+        engine's evaluation primitive). Unknown fn raises ValueError —
+        callers validate at config time."""
+        if fn == "latest":
+            return self.latest(key, window_s)
+        if fn == "rate":
+            return self.rate(key, window_s)
+        if fn == "delta":
+            return self.delta(key, window_s)
+        if fn == "ewma":
+            return self.ewma(key, window_s)
+        if fn == "avg":
+            return self.avg_over_window(key, window_s)
+        if fn == "max":
+            return self.max_over_window(key, window_s)
+        if fn == "min":
+            return self.min_over_window(key, window_s)
+        if fn == "sum":
+            return self.sum_over_window(key, window_s)
+        if fn in ("p50", "p90", "p95", "p99"):
+            return self.quantile_over_window(
+                key, int(fn[1:]) / 100.0, window_s)
+        raise ValueError(f"unknown window function {fn!r} "
+                         f"(known: {self.WINDOW_FNS})")
+
+    # ------------------------------------------------------- aggregation
+
+    # reductions that vectorize across series in one stacked pass (the
+    # alert engine evaluates every matching series per tick — a fleet
+    # of hundreds of collectors × per-series numpy-call overhead was
+    # the measured cost center, not the ring math itself)
+    _BATCH_FNS = ("latest", "avg", "max", "min", "sum")
+
+    def series_values(self, metric: str, fn: str, window_s: float,
+                      labels: Optional[dict[str, str]] = None
+                      ) -> dict[str, float]:
+        """{series key: windowed value} over every matching series —
+        the per-series layer; series with no answer (empty window) are
+        omitted rather than invented as zero. Order-insensitive
+        reductions run as ONE (n_series, window) masked matrix op."""
+        if fn not in self._BATCH_FNS:
+            out: dict[str, float] = {}
+            for key in self.select(metric, labels):
+                v = self.window_value(key, fn, window_s)
+                if v is not None:
+                    out[key] = v
+            return out
+        lo, hi = self._bounds(window_s)
+        with self._lock:
+            sers = [s for s in self._by_base.get(metric, {}).values()
+                    if not labels or all(s.labels.get(k) == v
+                                         for k, v in labels.items())]
+            if not sers:
+                return {}
+            ticks = np.stack([s.ticks for s in sers])
+            values = np.stack([s.values for s in sers])
+            keys = [s.key for s in sers]
+        mask = (ticks >= lo) & (ticks <= hi)
+        alive = mask.any(axis=1)
+        if fn == "latest":
+            idx = np.where(mask, ticks, np.int64(-1)).argmax(axis=1)
+            vals = values[np.arange(len(keys)), idx]
+        elif fn == "avg":
+            cnt = mask.sum(axis=1)
+            vals = np.where(mask, values, 0.0).sum(axis=1) \
+                / np.maximum(cnt, 1)
+        elif fn == "sum":
+            vals = np.where(mask, values, 0.0).sum(axis=1)
+        elif fn == "max":
+            vals = np.where(mask, values, -np.inf).max(axis=1)
+        else:  # min
+            vals = np.where(mask, values, np.inf).min(axis=1)
+        return {k: float(v) for k, v, a in zip(keys, vals, alive) if a}
+
+    AGGREGATIONS = ("sum", "max", "min", "avg", "p50", "p95", "p99",
+                    "count")
+
+    def aggregate(self, metric: str, fn: str = "latest",
+                  window_s: float = 60.0, agg: str = "sum",
+                  labels: Optional[dict[str, str]] = None,
+                  by: Optional[str] = None) -> Any:
+        """Cross-series aggregation: per-series windowed value via
+        ``fn``, combined with ``agg``. ``by=<label>`` groups instead,
+        returning {label value: aggregate} (the per-CollectorsGroup
+        rollup shape); series missing the label group under ``""``."""
+        vals = self.series_values(metric, fn, window_s, labels)
+        if by is None:
+            return self._combine(list(vals.values()), agg)
+        groups: dict[str, list[float]] = {}
+        for key, v in vals.items():
+            _, lbls = split_key(key)
+            groups.setdefault(lbls.get(by, ""), []).append(v)
+        return {g: self._combine(vs, agg) for g, vs in groups.items()}
+
+    @staticmethod
+    def _combine(vals: list[float], agg: str) -> Optional[float]:
+        if agg == "count":
+            return float(len(vals))
+        if not vals:
+            return None
+        if agg == "sum":
+            return sum(vals)
+        if agg == "max":
+            return max(vals)
+        if agg == "min":
+            return min(vals)
+        if agg == "avg":
+            return sum(vals) / len(vals)
+        if agg in ("p50", "p95", "p99"):
+            vs = sorted(vals)
+            return vs[min(int(int(agg[1:]) / 100.0 * len(vs)),
+                          len(vs) - 1)]
+        raise ValueError(f"unknown aggregation {agg!r} "
+                         f"(known: {SeriesStore.AGGREGATIONS})")
+
+    # --------------------------------------------------------- inventory
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def stats(self) -> dict[str, Any]:
+        """JSON-able store inventory (the /api/fleet ``store`` block)."""
+        with self._lock:
+            by_metric: dict[str, int] = {}
+            for s in self._series.values():
+                by_metric[s.base] = by_metric.get(s.base, 0) + 1
+            return {
+                "enabled": self.enabled,
+                "series": len(self._series),
+                "max_series": self.max_series,
+                "interval_s": self.interval_s,
+                "window": self.window,
+                "bytes_bound": self.max_series * self.window * 16,
+                "metrics": len(by_metric),
+                "dropped_series": dict(self._dropped),
+            }
+
+    def reset(self) -> None:
+        """Test isolation (the meter.reset / flow_ledger.reset
+        contract)."""
+        with self._lock:
+            self._series.clear()
+            self._by_base.clear()
+            self._dropped.clear()
+
+
+series_store = SeriesStore()

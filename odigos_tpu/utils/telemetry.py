@@ -31,6 +31,15 @@ from typing import Optional
 # algorithm-R reservoir over every exemplar-carrying record
 EXEMPLAR_SLOTS = 8
 
+# series-cardinality guard (ISSUE 10 satellite): at most this many
+# DISTINCT label sets per metric name may register; overflow writes are
+# dropped and counted per metric instead of growing without bound (a
+# fleet of labeled publishers — or one bug interpolating span data into
+# a label — must not be able to explode the registry). Generous: the
+# busiest legitimate metric (per-edge flow counters) sits far below it.
+MAX_SERIES_PER_METRIC = 1024
+DROPPED_SERIES_METRIC = "odigos_selftelemetry_dropped_series_total"
+
 
 class _Exemplar:
     """One metric→trace witness; immutable once recorded."""
@@ -133,18 +142,53 @@ class Meter:
     """Thread-safe metrics registry. Labels are flattened into the name by the
     caller convention ``name{key=value}`` to keep the structure flat."""
 
-    def __init__(self) -> None:
+    def __init__(self,
+                 max_series_per_metric: int = MAX_SERIES_PER_METRIC) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
         self._hists: dict[str, _Histogram] = {}
+        self.max_series_per_metric = max_series_per_metric
+        # metric base name -> count of distinct label-carrying keys
+        # registered, plus the admitted-key set (a cleared-then-reset
+        # gauge must not count twice — profiler gauges recycle)
+        self._series_counts: dict[str, int] = {}
+        self._series_keys: set[str] = set()
+
+    def _admit(self, name: str) -> bool:
+        """Cardinality guard, called under the lock for a key NOT yet in
+        its instrument map. Unlabeled names always pass (one series by
+        construction); a labeled key past the per-metric cap is dropped
+        and counted in the per-metric overflow counter — the registry
+        degrades by refusing cardinality, never by growing without
+        bound (the seriesstate discipline)."""
+        if "{" not in name:
+            return True
+        if name in self._series_keys:
+            return True
+        base = name.split("{", 1)[0]
+        n = self._series_counts.get(base, 0)
+        if n >= self.max_series_per_metric:
+            # direct bump: the overflow counter is itself labeled (one
+            # series per distinct overflowing metric — bounded), and
+            # routing it through add() would re-enter the guard
+            self._counters[labeled_key(DROPPED_SERIES_METRIC,
+                                       metric=base)] += 1
+            return False
+        self._series_keys.add(name)
+        self._series_counts[base] = n + 1
+        return True
 
     def add(self, name: str, value: float = 1.0) -> None:
         with self._lock:
+            if name not in self._counters and not self._admit(name):
+                return
             self._counters[name] += value
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
+            if name not in self._gauges and not self._admit(name):
+                return
             self._gauges[name] = value
 
     def clear_gauge(self, name: str) -> None:
@@ -160,6 +204,8 @@ class Meter:
         with self._lock:
             h = self._hists.get(name)
             if h is None:
+                if not self._admit(name):
+                    return
                 h = self._hists[name] = _Histogram()
             h.record(value, exemplar)
 
@@ -175,6 +221,8 @@ class Meter:
             for name, value in samples:
                 h = hists.get(name)
                 if h is None:
+                    if not self._admit(name):
+                        continue
                     h = hists[name] = _Histogram()
                 h.record(value, exemplar)
 
@@ -233,6 +281,8 @@ class Meter:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._series_counts.clear()
+            self._series_keys.clear()
 
 
 def label_value(v: str) -> str:
@@ -248,11 +298,13 @@ def label_value(v: str) -> str:
              .replace("{", "_").replace("}", "_"))
 
 
-def labeled_key(metric: str, **labels: str) -> str:
+def labeled_key(metric: str, /, **labels: str) -> str:
     """Render a flat ``name{key=value}`` registry key, routing every
     label VALUE through ``label_value`` (see its contract). The flat
     encoding's one rule lives here; hot-path callers precompute the key
-    once at construction."""
+    once at construction. The metric name is positional-only so a label
+    may itself be called ``metric`` (the cardinality-overflow counter's
+    label)."""
     inner = ",".join(f"{k}={label_value(str(v))}"
                      for k, v in labels.items())
     return f"{metric}{{{inner}}}"

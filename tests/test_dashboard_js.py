@@ -115,6 +115,12 @@ CONTRACT: dict[str, dict] = {
     # (sp/stages), validated top-level here — the fixture runs no SLO'd
     # fast-path pipeline, so the dicts are legitimately empty
     "slo": {"endpoint": "/api/slo", "fields": ["pipelines", "waterfall"]},
+    # fleet plane panel (ISSUE 10): per-collector health, alert rule
+    # states, sizing recommendations; per-row objects are reached via
+    # locals (co/al/rec) — top-level containers validated here (always
+    # served, possibly empty)
+    "fleet": {"endpoint": "/api/fleet",
+              "fields": ["collectors", "alerts", "recommendations"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

@@ -1,0 +1,681 @@
+"""Fleet-plane tests (ISSUE 10): delta-publish equivalence vs full
+snapshots, rollup correctness under collector churn, the >=200-collector
+aggregation acceptance, alert fire-within-for-window / clear-after-
+recovery (incl. a real queue_full storm through a running Collector),
+hot reload editing/deleting the ``alerts:`` stanza, the recommender,
+and the surfaces (api snapshot, /api/fleet, describe lines)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from odigos_tpu.config.model import (
+    AlertRuleConfiguration, Configuration)
+from odigos_tpu.pipeline.service import Collector
+from odigos_tpu.selftelemetry.fleet import (
+    AlertEngine,
+    FleetPlane,
+    RECOMMENDER_RULES,
+    alert_engine,
+    fleet_plane,
+    parse_expr,
+    recommend,
+    validate_alert_rules,
+)
+from odigos_tpu.selftelemetry.flow import flow_ledger
+from odigos_tpu.selftelemetry.seriesstate import (
+    COUNTER, SeriesStore, series_store)
+from odigos_tpu.utils.telemetry import meter
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def clock():
+    return Clock()
+
+
+@pytest.fixture()
+def plane(clock):
+    store = SeriesStore(interval_s=1.0, window=120, max_series=10_000,
+                        clock=clock)
+    return FleetPlane(store=store, clock=clock)
+
+
+@pytest.fixture(autouse=True)
+def fresh_globals():
+    fleet_plane.reset()
+    flow_ledger.reset()
+    yield
+    fleet_plane.reset()
+    flow_ledger.reset()
+
+
+# ------------------------------------------------------ expression parse
+
+
+def test_parse_expr_grammar():
+    p = parse_expr(
+        "rate(odigos_flow_dropped_items_total{reason=queue_full}[30s])"
+        " > 500")
+    assert p == {"fn": "rate",
+                 "metric": "odigos_flow_dropped_items_total",
+                 "labels": {"reason": "queue_full"}, "window_s": 30.0,
+                 "cmp": ">", "threshold": 500.0}
+    assert parse_expr("latest(odigos_g) < 0.5")["window_s"] == 60.0
+
+
+@pytest.mark.parametrize("bad", [
+    "", "odigos_g > 5", "latest(odigos_g) >> 5",
+    "stddev(odigos_g[10s]) > 1",          # unknown fn
+    "rate(odigos_g) > 1",                 # rate needs explicit window
+    "latest(odigos_g[0s]) > 1",           # zero window
+    "latest(odigos_g{k}) > 1",            # bad matcher
+    "latest(odigos_g[10s]) > threshold",  # non-numeric threshold
+])
+def test_parse_expr_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_expr(bad)
+
+
+def test_validate_alert_rules_aggregates_problems():
+    problems = validate_alert_rules([
+        {"name": "ok", "expr": "latest(odigos_g[10s]) > 1"},
+        {"name": "ok", "expr": "latest(odigos_g[10s]) > 1"},   # dup
+        {"name": "bad", "expr": "nope", "for_s": -1,
+         "severity": "page", "bogus": 1},
+        "not-a-dict",
+    ])
+    text = "\n".join(problems)
+    assert "duplicate rule name" in text
+    assert "unparsable alert expression" in text
+    assert "for_s" in text and "severity" in text
+    assert "unknown keys" in text and "must be a mapping" in text
+    assert validate_alert_rules(
+        [{"name": "a", "expr": "latest(odigos_g[5s]) >= 0"}]) == []
+    assert validate_alert_rules({"a": 1}) \
+        == ["service.alerts must be a list, got dict"]
+
+
+# --------------------------------------------------- delta equivalence
+
+
+def test_delta_publish_equivalent_to_full_snapshots(clock):
+    """The equivalence oracle: the same snapshot sequence published
+    delta vs full must yield identical per-series points — delta
+    publishing is an optimization, never a semantic."""
+    s_delta = SeriesStore(interval_s=1.0, window=120, clock=clock)
+    s_full = SeriesStore(interval_s=1.0, window=120, clock=clock)
+    p_delta = FleetPlane(store=s_delta, clock=clock)
+    p_full = FleetPlane(store=s_full, clock=clock)
+    snapshots = [
+        {"odigos_g{model=z}": 1.0, "odigos_c_total": 10.0},
+        {"odigos_g{model=z}": 1.0, "odigos_c_total": 10.0},  # idle
+        {"odigos_g{model=z}": 2.0, "odigos_c_total": 10.0},
+        {"odigos_g{model=z}": 2.0, "odigos_c_total": 25.0},
+    ]
+    skipped = 0
+    for snap in snapshots:
+        r = p_delta.publish("c1", dict(snap), group="g")
+        skipped += r["skipped"]
+        p_full.publish("c1", dict(snap), group="g", delta=False)
+        clock.advance(2)
+    assert skipped > 0  # the idle snapshot was actually elided
+    for key in s_full.select("odigos_g") + s_full.select("odigos_c_total"):
+        # delta publishing writes CHANGED values only, so a repeated
+        # value leaves a gap in the delta store's ring — but every
+        # window query that matters must agree on the value landscape
+        assert s_delta.latest(key) == s_full.latest(key)
+        assert s_delta.delta(key, 60) == s_full.delta(key, 60)
+        assert s_delta.max_over_window(key, 60) == \
+            s_full.max_over_window(key, 60)
+
+
+def test_counter_kind_inferred_from_name(plane, clock):
+    plane.publish("c1", {"odigos_x_total": 10.0, "odigos_g": 1.0})
+    clock.advance(5)
+    plane.publish("c1", {"odigos_x_total": 4.0, "odigos_g": 5.0})
+    # reset-aware: the counter dropped 10 -> 4, so delta = +4, not -6
+    assert plane.store.delta("odigos_x_total{collector=c1}", 60) == 4.0
+    assert plane.store.delta("odigos_g{collector=c1}", 60) == 4.0
+
+
+def test_steady_value_survives_delta_elision(clock):
+    """Review regression: a gauge pinned at a constant, published every
+    tick, must stay visible to window queries indefinitely — the
+    heartbeat forces a full re-publish before the last written point
+    ages out of the window, so a sustained breach cannot self-clear
+    its own alert mid-incident."""
+    store = SeriesStore(interval_s=1.0, window=120, clock=clock)
+    plane = FleetPlane(store=store, clock=clock, heartbeat_s=10.0)
+    eng = AlertEngine(store=store, clock=clock)
+    eng.configure({"name": "sustained", "for_s": 0.0,
+                   "expr": "avg(odigos_g[30s]) > 5"})
+    for _ in range(120):  # 2 minutes of an unchanging 8.0
+        plane.publish("c1", {"odigos_g": 8.0})
+        clock.advance(1)
+    assert store.latest("odigos_g{collector=c1}", 30) == 8.0
+    assert store.avg_over_window("odigos_g{collector=c1}", 30) == 8.0
+    assert eng.evaluate()[0]["firing"]
+    # and the elision still did real work between heartbeats
+    snap = plane.api_snapshot()
+    assert snap["collectors"][0]["series_skipped"] > 50
+
+
+def test_refused_series_retries_after_capacity_frees(clock):
+    """Review regression: a series refused at the cardinality cap must
+    not be delta-elided forever — the delta base un-marks refused keys
+    so an identical next snapshot retries, and it lands once churn
+    frees capacity."""
+    store = SeriesStore(interval_s=1.0, window=60, max_series=2,
+                        clock=clock)
+    plane = FleetPlane(store=store, clock=clock)
+    plane.publish("old", {"odigos_g": 1.0})  # 2 series incl. health
+    r = plane.publish("new", {"odigos_g": 7.0})
+    # the new collector's series were refused at the cap...
+    assert store.select("odigos_g", {"collector": "new"}) == []
+    assert r["published"] < 2
+    plane.unregister("old")  # churn frees capacity
+    clock.advance(1)
+    r = plane.publish("new", {"odigos_g": 7.0})  # identical snapshot
+    assert store.latest("odigos_g{collector=new}") == 7.0
+
+
+# ---------------------------------------------------------- fleet scale
+
+
+def test_200_collector_aggregation_with_delta_publishing(plane, clock):
+    """The scale acceptance: >= 200 simulated collectors publish under
+    delta elision; aggregation answers across the whole fleet."""
+    N = 220
+    for tick in range(3):
+        for c in range(N):
+            plane.publish(
+                f"sim-{c:03d}",
+                {"odigos_engine_queue_depth{model=z}": float(c % 7),
+                 "odigos_spans_total": 100.0 * tick},
+                # c % 5 lands degraded members in every pool-(c % 4)
+                worst=("Degraded" if c % 5 == 0 else "Healthy",
+                       "QueueSaturation" if c % 5 == 0 else "Running",
+                       ""),
+                group=f"pool-{c % 4}")
+        clock.advance(2)
+    assert len(plane.collectors()) == N
+    agg = plane.aggregate("odigos_engine_queue_depth", fn="latest",
+                          agg="count")
+    assert agg == float(N)
+    total = plane.aggregate("odigos_engine_queue_depth", fn="latest",
+                            agg="sum")
+    assert total == float(sum(c % 7 for c in range(N)))
+    by = plane.aggregate("odigos_engine_queue_depth", fn="latest",
+                         agg="max", by="collector")
+    assert len(by) == N and by["sim-005"] == 5.0
+    # delta elision did real work: tick 2 re-published an unchanged
+    # queue_depth per collector
+    snap = plane.api_snapshot()
+    assert sum(c["series_skipped"] for c in snap["collectors"]) >= N
+    # worst-of per group: every pool holds some degraded members
+    groups = plane.group_rollup()
+    assert set(groups) == {f"pool-{i}" for i in range(4)}
+    for g in groups.values():
+        assert g["status"] == "Degraded"
+        assert g["reason"] == "QueueSaturation"
+        assert g["collectors"] == N // 4
+
+
+def test_churn_unregister_leaves_aggregates(plane, clock):
+    for c in ("a", "b", "c"):
+        plane.publish(c, {"odigos_g": 1.0}, group="g1")
+    assert plane.aggregate("odigos_g", agg="count") == 3.0
+    plane.unregister("b")
+    assert plane.collectors() == ["a", "c"]
+    # the departed collector's series left the store mid-window — the
+    # aggregate answers for live members only, no window coasting
+    assert plane.aggregate("odigos_g", agg="count") == 2.0
+    assert plane.group_rollup()["g1"]["collectors"] == 2
+    # re-registration starts a fresh delta base (full first publish)
+    r = plane.publish("b", {"odigos_g": 1.0}, group="g1")
+    assert r["published"] >= 1 and r["skipped"] == 0
+
+
+def test_mid_window_registration_joins_aggregates(plane, clock):
+    plane.publish("a", {"odigos_g": 1.0})
+    clock.advance(30)
+    plane.publish("late", {"odigos_g": 5.0})
+    assert plane.aggregate("odigos_g", fn="latest", window_s=60,
+                           agg="sum") == 6.0
+    # and the older member ages out once past the window
+    clock.advance(40)
+    assert plane.aggregate("odigos_g", fn="latest", window_s=60,
+                           agg="sum") == 5.0
+
+
+# -------------------------------------------------------------- alerts
+
+
+def _engine(plane, clock):
+    return AlertEngine(store=plane.store, clock=clock)
+
+
+def test_alert_fires_within_for_window_and_clears(plane, clock):
+    """The acceptance loop: a queue_full storm breaches, the rule holds
+    for for_s, fires, then clears after recovery — all on injected
+    clocks."""
+    eng = _engine(plane, clock)
+    eng.configure({
+        "name": "queue-full-storm",
+        "expr": "rate(odigos_flow_dropped_items_total"
+                "{reason=queue_full}[30s]) > 100",
+        "for_s": 5.0, "severity": "critical"})
+    key = ("odigos_flow_dropped_items_total"
+           "{reason=queue_full,collector=c1}")
+
+    def drop(total):
+        plane.store.observe(key, total, kind=COUNTER)
+
+    drop(0)
+    st = eng.evaluate()[0]
+    assert st["state"] == "inactive"
+    # storm: +1000 drops/s
+    for i in range(1, 4):
+        clock.advance(1)
+        drop(i * 1000.0)
+    st = eng.evaluate()[0]
+    assert st["state"] == "pending"  # breaching, inside the hold
+    clock.advance(5)
+    drop(8000.0)
+    st = eng.evaluate()[0]
+    assert st["state"] == "firing" and st["firing"]
+    assert st["series"] == key
+    fired = [e for e in eng.transitions() if e["event"] == "fired"]
+    assert len(fired) == 1 and fired[0]["rule"] == "queue-full-storm"
+    # recovery: the counter stops moving; once the storm leaves the
+    # window the rate drops under threshold and the rule clears
+    clock.advance(40)
+    drop(8000.0)
+    st = eng.evaluate()[0]
+    assert st["state"] == "inactive" and not st["firing"]
+    events = [e["event"] for e in eng.transitions()]
+    assert events == ["fired", "cleared"]
+
+
+def test_for_zero_fires_immediately(plane, clock):
+    eng = _engine(plane, clock)
+    eng.configure({"name": "now", "for_s": 0.0,
+                   "expr": "latest(odigos_g[30s]) > 5"})
+    plane.store.observe("odigos_g{collector=a}", 9.0)
+    assert eng.evaluate()[0]["firing"]
+
+
+def test_blip_shorter_than_for_never_fires(plane, clock):
+    eng = _engine(plane, clock)
+    eng.configure({"name": "held", "for_s": 10.0,
+                   "expr": "latest(odigos_g[5s]) > 5"})
+    plane.store.observe("odigos_g", 9.0)
+    assert eng.evaluate()[0]["state"] == "pending"
+    clock.advance(6)  # the blip ages out of the 5 s window
+    assert eng.evaluate()[0]["state"] == "inactive"
+    assert eng.transitions() == []
+
+
+def test_worst_series_semantics_lower_bound(plane, clock):
+    eng = _engine(plane, clock)
+    eng.configure({"name": "low", "for_s": 0.0,
+                   "expr": "latest(odigos_hit_rate[30s]) < 0.5"})
+    plane.store.observe("odigos_hit_rate{collector=a}", 0.9)
+    plane.store.observe("odigos_hit_rate{collector=b}", 0.2)
+    st = eng.evaluate()[0]
+    assert st["firing"]
+    assert st["series"] == "odigos_hit_rate{collector=b}"
+
+
+def test_no_matching_series_never_fires(plane, clock):
+    eng = _engine(plane, clock)
+    eng.configure({"name": "ghost", "for_s": 0.0,
+                   "expr": "latest(odigos_never[30s]) > 0"})
+    st = eng.evaluate()[0]
+    assert st["state"] == "inactive" and st["value"] is None
+
+
+def test_configure_identical_keeps_state_changed_recreates(plane, clock):
+    eng = _engine(plane, clock)
+    cfg = {"name": "r", "expr": "latest(odigos_g[30s]) > 5",
+           "for_s": 0.0, "severity": "warning"}
+    r1 = eng.configure(dict(cfg))
+    plane.store.observe("odigos_g", 9.0)
+    eng.evaluate()
+    assert r1.state == "firing"
+    # identical reload: same rule object, firing state survives
+    assert eng.configure(dict(cfg)) is r1
+    # any changed setting re-creates (threshold redefines the rule)
+    r2 = eng.configure(dict(cfg, expr="latest(odigos_g[30s]) > 99"))
+    assert r2 is not r1 and r2.state == "inactive"
+
+
+# ------------------------------------------- collector config lifecycle
+
+
+def _collector_cfg(alerts=None):
+    cfg = {
+        "receivers": {"synthetic": {"n_batches": 0}},
+        "processors": {"batch": {}},
+        "exporters": {"tracedb": {}},
+        "service": {"pipelines": {"traces/in": {
+            "receivers": ["synthetic"], "processors": ["batch"],
+            "exporters": ["tracedb"]}}},
+    }
+    if alerts is not None:
+        cfg["service"]["alerts"] = alerts
+    return cfg
+
+
+RULE = {"name": "qd", "expr": "latest(odigos_g[30s]) > 5",
+        "for_s": 0.0, "severity": "critical"}
+
+
+def test_collector_build_configures_and_scopes_conditions():
+    c = Collector(_collector_cfg([dict(RULE)])).start()
+    try:
+        assert alert_engine.rule_names() == {"qd"}
+        assert c.graph.alert_rule_names == {"qd"}
+        series_store.observe("odigos_g{collector=x}", 9.0)
+        conds = {x["component"]: x for x in c.health_conditions()}
+        cond = conds["alert/qd"]
+        # severity critical -> Unhealthy while firing
+        assert cond["status"] == "Unhealthy"
+        assert cond["reason"] == "AlertFiring"
+        assert c.graph.flow_health.worst()[0] == "Unhealthy"
+    finally:
+        c.shutdown()
+
+
+def test_rollup_without_alert_stanza_shows_no_alert_rows():
+    # another collector's rules must not leak into this graph's rollup
+    alert_engine.configure(dict(RULE))
+    c = Collector(_collector_cfg()).start()
+    try:
+        assert all(not x["component"].startswith("alert/")
+                   for x in c.health_conditions())
+    finally:
+        c.shutdown()
+
+
+def test_hot_reload_edits_and_deletes_alert_stanza():
+    c = Collector(_collector_cfg([dict(RULE)])).start()
+    try:
+        assert alert_engine.rule_names() == {"qd"}
+        # edit: changed expr re-creates; new rule appears
+        c.reload(_collector_cfg([
+            dict(RULE, expr="latest(odigos_g[30s]) > 50"),
+            {"name": "extra",
+             "expr": "avg(odigos_g[30s]) > 1e9"}]))
+        assert alert_engine.rule_names() == {"qd", "extra"}
+        assert c.graph.alert_rule_names == {"qd", "extra"}
+        [qd] = [r for r in alert_engine.status() if r["name"] == "qd"]
+        assert qd["threshold"] == 50.0
+        # delete the stanza entirely: every tracker retired (the
+        # remove_slo discipline) and the rollup rows disappear
+        c.reload(_collector_cfg())
+        assert alert_engine.rule_names() == set()
+        assert all(not x["component"].startswith("alert/")
+                   for x in c.health_conditions())
+    finally:
+        c.shutdown()
+
+
+def test_shutdown_retires_alert_rules():
+    """Review regression: a dead collector's rules must not keep
+    evaluating (and firing) against the store forever — shutdown
+    retires the graph-stamped names like it unregisters the rollup."""
+    c = Collector(_collector_cfg([dict(RULE)])).start()
+    assert alert_engine.rule_names() == {"qd"}
+    c.shutdown()
+    assert alert_engine.rule_names() == set()
+
+
+def test_invalid_alert_stanza_fails_build():
+    with pytest.raises(ValueError, match="unparsable alert expression"):
+        Collector(_collector_cfg([{"name": "x", "expr": "broken"}]))
+
+
+def test_queue_full_storm_fires_through_real_ledger():
+    """End-to-end regression injection: queue_full drops recorded
+    through the REAL flow ledger, published by the real publish path,
+    fire the storm rule; recovery (drops stop) clears it."""
+    from odigos_tpu.selftelemetry.flow import FlowContext
+
+    c = Collector(_collector_cfg([{
+        "name": "storm",
+        "expr": "delta(odigos_flow_dropped_items_total"
+                "{reason=queue_full}[20s]) > 500",
+        "for_s": 0.0, "severity": "critical"}])).start()
+    try:
+        meter.reset()
+        # counter-delta semantics: the first point is a LEVEL; the
+        # storm must rise between published points to register
+        FlowContext.drop(1, "queue_full", pipeline="traces/in",
+                         component_name="engine/z", signal="requests")
+        fleet_plane.publish_collector(c, "gw", group="g")
+        import time as _time
+        _time.sleep(1.1)  # the global store's 1 s tick interval
+        FlowContext.drop(2000, "queue_full", pipeline="traces/in",
+                         component_name="engine/z", signal="requests")
+        fleet_plane.publish_collector(c, "gw", group="g")
+        conds = {x["component"]: x for x in c.health_conditions()}
+        assert conds["alert/storm"]["status"] == "Unhealthy", conds
+        # recovery: the counter stops moving; once the storm ages out
+        # of the window the delta collapses and the rule clears
+        st = fleet_plane.store
+        key = ("odigos_flow_dropped_items_total{pipeline=traces/in,"
+               "component=engine/z,reason=queue_full,collector=gw}")
+        pts = st.points(key)
+        assert pts, st.select("odigos_flow_dropped_items_total")
+        # age the storm out by dropping the collector's series (the
+        # wall-clock global store cannot be time-travelled in a test)
+        st.drop_series({"collector": "gw"})
+        conds = {x["component"]: x for x in c.health_conditions()}
+        assert conds["alert/storm"]["status"] == "Healthy"
+        events = [e["event"] for e in alert_engine.transitions()]
+        assert events == ["fired", "cleared"]
+    finally:
+        c.shutdown()
+
+
+# --------------------------------------------------------- recommender
+
+
+def test_recommender_breach_names_knob_and_series(plane, clock):
+    for _ in range(3):
+        plane.publish("c1", {
+            "odigos_engine_padding_waste_frac{model=z}": 0.6,
+            "odigos_engine_bucket_ladder_hit_rate{model=z}": 0.99})
+        clock.advance(2)
+    recs = recommend(plane.store)
+    assert [r["name"] for r in recs] == ["padding-waste-high"]
+    rec = recs[0]
+    assert rec["knob"] == "max_batch"
+    assert rec["collector"] == "c1"
+    assert rec["observed"] == 0.6
+    assert "60%" in rec["recommendation"]
+
+
+def test_recommender_replica_bound_scopes_to_preset(plane, clock):
+    for _ in range(3):
+        plane.publish("c1", {"odigos_engine_queue_depth{model=z}": 50.0})
+        clock.advance(2)
+    cfg = Configuration(resource_size_preset="size_s")
+    recs = recommend(plane.store, config=cfg)
+    [rec] = [r for r in recs if r["name"] == "engine-queue-sustained"]
+    assert rec["knob"] == "replicas"
+    assert "1-5 replicas" in rec["recommendation"]  # size_s bounds
+
+
+def test_recommender_quiet_fleet_recommends_nothing(plane):
+    plane.publish("c1", {"odigos_engine_queue_depth{model=z}": 0.0})
+    assert recommend(plane.store) == []
+
+
+def test_recommender_rules_parse():
+    for rule in RECOMMENDER_RULES:
+        parse_expr(rule.expr)  # must not raise
+
+
+# ----------------------------------------------------------- surfaces
+
+
+def test_api_snapshot_shape(plane, clock):
+    plane.publish("c1", {"odigos_g": 1.0}, group="g1",
+                  conditions=[{"component": "pipeline/traces/in",
+                               "status": "Healthy",
+                               "reason": "Conserved", "message": ""}],
+                  worst=("Healthy", "AllHealthy", ""))
+    snap = plane.api_snapshot()
+    assert snap["enabled"]
+    [co] = snap["collectors"]
+    assert co["collector"] == "c1" and co["group"] == "g1"
+    assert co["status"] == "Healthy" and co["age_s"] is not None
+    assert co["conditions"][0]["component"] == "pipeline/traces/in"
+    assert snap["groups"]["g1"]["collectors"] == 1
+    assert snap["alerts"] == {"rules": [], "history": []}
+    assert snap["recommendations"] == []
+    assert snap["store"]["series"] == len(plane.store)
+    json.dumps(snap)  # JSON-able end to end
+
+
+def test_api_fleet_endpoint_and_fleetz():
+    from odigos_tpu.api.store import Store
+    from odigos_tpu.frontend import FrontendServer
+
+    fleet_plane.publish("gw", {"odigos_g": 2.0}, group="g")
+    alert_engine.configure(dict(RULE))
+    fe = FrontendServer(Store(), metrics_port=None).start()
+    try:
+        with urllib.request.urlopen(
+                f"{fe.url}/api/fleet", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert [c["collector"] for c in doc["collectors"]] == ["gw"]
+        assert [a["name"] for a in doc["alerts"]["rules"]] == ["qd"]
+    finally:
+        fe.shutdown()
+    # the zpage serves the same document
+    c = Collector({
+        "receivers": {"synthetic": {"n_batches": 0}},
+        "exporters": {"tracedb": {}},
+        "extensions": {"zpages": {"port": 0}},
+        "service": {"extensions": ["zpages"],
+                    "pipelines": {"traces/in": {
+                        "receivers": ["synthetic"], "processors": [],
+                        "exporters": ["tracedb"]}}},
+    }).start()
+    try:
+        port = c.graph.extensions["zpages"].port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/fleetz",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        assert [c_["collector"] for c_ in doc["collectors"]] == ["gw"]
+    finally:
+        c.shutdown()
+
+
+def test_describe_install_prints_fleet_and_alerts(tmp_path):
+    from odigos_tpu.cli.describe import describe_install
+    from odigos_tpu.cli.state import create_state
+
+    fleet_plane.publish(
+        "gw", {"odigos_g": 9.0}, group="cluster-gateway",
+        worst=("Degraded", "QueueSaturation", "queue backing up"))
+    alert_engine.configure(dict(RULE))
+    series_store.observe("odigos_g{collector=gw}", 9.0)
+    alert_engine.evaluate()
+    state = create_state(str(tmp_path / "install"))
+    text = describe_install(state)
+    assert "fleet: 1 collector(s)" in text
+    assert "group[cluster-gateway]: Degraded (QueueSaturation)" in text
+    assert "gw[cluster-gateway]: Degraded QueueSaturation" in text
+    assert "alerts: 1 rule(s), 1 firing" in text
+    assert "[✕] qd (critical)" in text
+
+
+def test_e2e_environment_publishes_fleet_and_group_condition():
+    from odigos_tpu.e2e.environment import E2EEnvironment
+
+    env = E2EEnvironment(nodes=1)
+    env.start()
+    try:
+        env.reconcile()
+        ids = fleet_plane.collectors()
+        assert "gateway" in ids
+        assert "gateway" in env.cluster.collector_endpoints
+        groups = fleet_plane.group_rollup()
+        assert env.GATEWAY_FLEET_GROUP in groups
+        group = next(g for g in env.store.list("CollectorsGroup")
+                     if g.role.value == "CLUSTER_GATEWAY"
+                     or "gateway" in g.role.value.lower())
+        types = {c.type for c in group.conditions}
+        assert "FleetHealth" in types and "CollectorHealth" in types
+        # churn: shutdown unregisters and drops the series
+        env.shutdown()
+        assert "gateway" not in fleet_plane.collectors()
+        assert series_store.select(
+            "odigos_collector_health_status",
+            {"collector": "gateway"}) == []
+    finally:
+        try:
+            env.shutdown()
+        except Exception:
+            pass
+
+
+def test_kill_switch_disables_plane(monkeypatch, clock):
+    store = SeriesStore(clock=clock)
+    store.enabled = False
+    plane = FleetPlane(store=store, clock=clock)
+    assert plane.publish("c1", {"odigos_g": 1.0}) \
+        == {"published": 0, "skipped": 0}
+    assert plane.api_snapshot()["enabled"] is False
+    eng = AlertEngine(store=store, clock=clock)
+    eng.configure(dict(RULE))
+    assert eng.evaluate() == []
+    assert recommend(store) == []
+
+
+def test_pipelinegen_renders_alert_stanza():
+    from odigos_tpu.pipelinegen.builder import (
+        GatewayOptions, build_gateway_config)
+    from odigos_tpu.destinations import Destination
+    from odigos_tpu.components.api import Signal
+
+    dests = [Destination(id="db", dest_type="tracedb",
+                         signals=[Signal.TRACES])]
+    base, _, _ = build_gateway_config(dests, options=GatewayOptions())
+    assert "alerts" not in base["service"]
+    opts = GatewayOptions(alerts=[AlertRuleConfiguration(
+        name="qd", expr="latest(odigos_g[30s]) > 5",
+        for_s=2.0, severity="critical")])
+    cfg, _, _ = build_gateway_config(dests, options=opts)
+    assert cfg["service"]["alerts"] == [
+        {"name": "qd", "expr": "latest(odigos_g[30s]) > 5",
+         "for_s": 2.0, "severity": "critical"}]
+    # empty list renders nothing — byte-stable configs
+    cfg2, _, _ = build_gateway_config(
+        dests, options=GatewayOptions(alerts=[]))
+    assert cfg2 == base
+
+
+def test_configuration_round_trips_alert_rules():
+    cfg = Configuration(alerts=[AlertRuleConfiguration(
+        name="qd", expr="latest(odigos_g[30s]) > 5")])
+    back = Configuration.from_dict(cfg.to_dict())
+    assert back.alerts == cfg.alerts
+    assert isinstance(back.alerts[0], AlertRuleConfiguration)

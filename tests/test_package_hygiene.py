@@ -483,6 +483,98 @@ class TestLatencyStageHygiene:
             assert re.fullmatch(r"[a-z_]+", v), v
 
 
+class TestFleetRuleHygiene:
+    """Fleet alert/recommender lint (ISSUE 10 satellite): every metric
+    name referenced in an in-repo alert expression or recommender rule
+    must resolve against the registered ``odigos_*`` metric names (the
+    ISSUE 3 name-lint registry: every odigos_* string literal in the
+    package) — a typo'd rule would otherwise match zero series and
+    silently never fire. Recommender knobs must resolve against
+    ``config.sizing.TUNING_KNOBS`` (a recommendation must never point
+    at a knob that does not exist)."""
+
+    # the flat snapshot also carries derived histogram-stat keys
+    # (Meter._stat_key) — an expression over a _p99 series is legal
+    STAT_SUFFIXES = ("_count", "_mean", "_p50", "_p90", "_p99", "_max")
+
+    @staticmethod
+    def _registered_metric_names() -> set:
+        """Every odigos_* string literal in odigos_tpu/ — metric name
+        constants, gauge-table values, f-string prefixes (the prefix of
+        a JoinedStr before its label block)."""
+        names = set()
+        name_re = re.compile(r"^odigos_[a-z0-9_]+$")
+        for dirpath, _dirs, files in os.walk(PKG_ROOT):
+            for n in files:
+                if not n.endswith(".py"):
+                    continue
+                with open(os.path.join(dirpath, n)) as f:
+                    tree = ast.parse(f.read())
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Constant) \
+                            and isinstance(node.value, str):
+                        v = node.value.split("{")[0]
+                        if name_re.fullmatch(v):
+                            names.add(v)
+        return names
+
+    def _resolves(self, metric: str, registry: set) -> bool:
+        if metric in registry:
+            return True
+        for suffix in self.STAT_SUFFIXES:
+            if metric.endswith(suffix) \
+                    and metric[: -len(suffix)] in registry:
+                return True
+        return False
+
+    def test_recommender_rules_resolve(self):
+        from odigos_tpu.config.sizing import TUNING_KNOBS
+        from odigos_tpu.selftelemetry.fleet import (
+            RECOMMENDER_RULES, referenced_metric)
+
+        registry = self._registered_metric_names()
+        problems = []
+        for rule in RECOMMENDER_RULES:
+            metric = referenced_metric(rule.expr)  # raises on bad expr
+            if not self._resolves(metric, registry):
+                problems.append(f"{rule.name}: metric {metric!r} is not "
+                                f"a registered odigos_* name")
+            if rule.knob not in TUNING_KNOBS:
+                problems.append(f"{rule.name}: knob {rule.knob!r} not "
+                                f"in sizing.TUNING_KNOBS")
+        assert not problems, "\n".join(problems)
+
+    def test_soak_alert_rules_resolve(self):
+        """The soak harness's shipped alert stanza must reference real
+        metrics — SOAK.json claiming an alert loop over series that can
+        never exist would be worse than no alert at all."""
+        import importlib.util
+
+        from odigos_tpu.selftelemetry.fleet import (
+            referenced_metric, validate_alert_rules)
+
+        spec = importlib.util.spec_from_file_location(
+            "e2e_soak_lint", os.path.join(REPO_ROOT, "tools",
+                                          "e2e_soak.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert validate_alert_rules(mod.SOAK_ALERTS) == []
+        registry = self._registered_metric_names()
+        for rule in mod.SOAK_ALERTS:
+            metric = referenced_metric(rule["expr"])
+            assert self._resolves(metric, registry), \
+                f"soak alert {rule['name']}: {metric!r} unregistered"
+
+    def test_typoed_metric_fails_resolution(self):
+        """The lint's own oracle: a plausible-but-wrong name must NOT
+        resolve (guards against the registry scan degenerating into
+        matching everything)."""
+        registry = self._registered_metric_names()
+        assert not self._resolves("odigos_engine_queue_dpeth", registry)
+        assert self._resolves("odigos_engine_queue_depth", registry)
+        assert self._resolves("odigos_latency_e2e_ms_p99", registry)
+
+
 class TestFlowAccounting:
     """Flow-ledger lint (ISSUE 5 satellite): any processor/connector
     module whose ``process``/``consume``/``_emit`` method conditionally

@@ -1,0 +1,304 @@
+"""Series-store unit tests (ISSUE 10): ring semantics under an
+injected clock, counter-delta/reset math, window queries, the hard
+memory bound, the kill switch, selection/aggregation, and the Meter's
+series-cardinality guard satellite."""
+
+import numpy as np
+import pytest
+
+from odigos_tpu.selftelemetry.seriesstate import (
+    COUNTER,
+    SeriesStore,
+    series_store,
+    split_key,
+    with_label,
+)
+from odigos_tpu.utils.telemetry import Meter, labeled_key, meter
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def clock():
+    return Clock()
+
+
+@pytest.fixture()
+def store(clock):
+    return SeriesStore(interval_s=1.0, window=60, max_series=100,
+                       clock=clock)
+
+
+# ------------------------------------------------------------- key codec
+
+
+def test_split_key_round_trips_labeled_key():
+    key = labeled_key("odigos_x_total", pipeline="traces/in", to="db")
+    base, labels = split_key(key)
+    assert base == "odigos_x_total"
+    assert labels == {"pipeline": "traces/in", "to": "db"}
+    assert split_key("odigos_plain") == ("odigos_plain", {})
+
+
+def test_with_label_merges_and_is_stable():
+    k1 = with_label("odigos_x{a=1}", collector="c1")
+    assert k1 == "odigos_x{a=1,collector=c1}"
+    # stamping an already-stamped key is idempotent (delta publishing
+    # depends on key stability across repeated publishes)
+    assert with_label(k1, collector="c1") == k1
+    assert with_label("odigos_x", collector="c1") == \
+        "odigos_x{collector=c1}"
+
+
+# ----------------------------------------------------------------- rings
+
+
+def test_append_within_tick_overwrites(store, clock):
+    store.observe("odigos_g", 1.0)
+    store.observe("odigos_g", 2.0)  # same tick: last value wins
+    assert store.latest("odigos_g") == 2.0
+    pts = store.points("odigos_g")
+    assert len(pts) == 1 and pts[0][1] == 2.0
+
+
+def test_window_filter_excludes_stale_laps(store, clock):
+    store.observe("odigos_g", 1.0)
+    clock.advance(200)  # far past the 60-slot ring
+    # the stale slot still holds tick data but fails the window filter
+    assert store.latest("odigos_g") is None
+    assert store.points("odigos_g") == []
+    store.observe("odigos_g", 5.0)
+    assert store.latest("odigos_g") == 5.0
+
+
+def test_ring_wraps_without_expiry_pass(store, clock):
+    for i in range(200):  # > 3 laps of the 60-slot ring
+        store.observe("odigos_g", float(i))
+        clock.advance(1)
+    pts = store.points("odigos_g")
+    # the window spans the most recent 60 ticks INCLUDING the current
+    # (still-empty) one, so 59 stored points answer
+    assert len(pts) == 59
+    assert [v for _, v in pts] == [float(i) for i in range(141, 200)]
+
+
+def test_counter_rate_and_delta_with_reset(store, clock):
+    for v in (0, 10, 20, 5, 15):  # reset between 20 and 5
+        store.observe("odigos_c_total", v, kind=COUNTER)
+        clock.advance(1)
+    # increases: 10 + 10 + (reset: +5) + 10 = 35 over 4 s
+    assert store.delta("odigos_c_total", 60) == 35.0
+    assert store.rate("odigos_c_total", 60) == pytest.approx(35.0 / 4)
+
+
+def test_gauge_rate_is_plain_slope(store, clock):
+    store.observe("odigos_g", 10.0)
+    clock.advance(4)
+    store.observe("odigos_g", 2.0)
+    assert store.rate("odigos_g", 60) == pytest.approx(-2.0)
+    assert store.delta("odigos_g", 60) == -8.0
+
+
+def test_rate_needs_two_points(store, clock):
+    store.observe("odigos_c_total", 5.0, kind=COUNTER)
+    assert store.rate("odigos_c_total", 60) is None
+    assert store.delta("odigos_c_total", 60) is None
+
+
+def test_ewma_and_quantile(store, clock):
+    for v in (1.0, 2.0, 3.0, 4.0):
+        store.observe("odigos_g", v)
+        clock.advance(1)
+    assert store.quantile_over_window("odigos_g", 0.5, 60) == 3.0
+    assert store.quantile_over_window("odigos_g", 0.99, 60) == 4.0
+    ew = store.ewma("odigos_g", 60)
+    assert 2.0 < ew < 4.0  # weighted toward the newest sample
+    assert store.avg_over_window("odigos_g", 60) == 2.5
+    assert store.max_over_window("odigos_g", 60) == 4.0
+    assert store.min_over_window("odigos_g", 60) == 1.0
+    assert store.sum_over_window("odigos_g", 60) == 10.0
+
+
+def test_window_narrows_queries(store, clock):
+    for v in range(10):
+        store.observe("odigos_g", float(v))
+        clock.advance(1)
+    # the last 3 ticks incl. the current empty one -> points 8 and 9
+    assert store.avg_over_window("odigos_g", 3.0) == pytest.approx(8.5)
+
+
+def test_non_finite_refused(store):
+    assert not store.observe("odigos_g", float("nan"))
+    assert not store.observe("odigos_g", float("inf"))
+    assert len(store) == 0
+
+
+# --------------------------------------------------------- memory bound
+
+
+def test_hard_series_cap_drops_new_series(clock):
+    meter.reset()
+    st = SeriesStore(interval_s=1.0, window=8, max_series=3, clock=clock)
+    for i in range(6):
+        st.observe(f"odigos_capped{{k=v{i}}}", 1.0)
+    assert len(st) == 3
+    assert st.stats()["dropped_series"] == {"odigos_capped": 3}
+    # the overflow evidence rides the meter, per metric (the store's
+    # own counter name — distinct from the Meter guard's
+    # odigos_selftelemetry_dropped_series_total)
+    assert meter.counter(
+        "odigos_seriesstate_dropped_series_total{metric=odigos_capped}"
+    ) == 3
+    # existing series still accept appends at the cap
+    assert st.observe("odigos_capped{k=v0}", 2.0)
+    meter.reset()
+
+
+def test_drop_series_frees_capacity(store):
+    store.observe("odigos_g{collector=a}", 1.0)
+    store.observe("odigos_g{collector=b}", 1.0)
+    assert store.drop_series({"collector": "a"}) == 1
+    assert store.select("odigos_g") == ["odigos_g{collector=b}"]
+    assert len(store) == 1
+
+
+# ----------------------------------------------------------- kill switch
+
+
+def test_kill_switch_noops_everything(monkeypatch, clock):
+    monkeypatch.setenv("ODIGOS_SERIES", "0")
+    st = SeriesStore(clock=clock)
+    assert not st.enabled
+    assert not st.observe("odigos_g", 1.0)
+    assert st.observe_many([("odigos_g", 1.0)]) == 0
+    assert len(st) == 0
+    assert st.latest("odigos_g") is None
+
+
+def test_global_store_exists_and_enabled_by_default():
+    assert series_store.enabled in (True, False)  # env-driven
+    assert series_store.stats()["max_series"] > 0
+
+
+# ------------------------------------------------- selection/aggregation
+
+
+def test_select_superset_label_matching(store):
+    store.observe("odigos_g{model=z,collector=a}", 1.0)
+    store.observe("odigos_g{model=z,collector=b}", 2.0)
+    store.observe("odigos_g{model=t,collector=a}", 3.0)
+    store.observe("odigos_other{model=z}", 9.0)
+    assert len(store.select("odigos_g")) == 3
+    assert store.select("odigos_g", {"collector": "a", "model": "z"}) \
+        == ["odigos_g{model=z,collector=a}"]
+    assert store.select("odigos_nope") == []
+
+
+def test_aggregate_and_group_by(store):
+    store.observe("odigos_g{collector=a}", 1.0)
+    store.observe("odigos_g{collector=b}", 3.0)
+    assert store.aggregate("odigos_g", fn="latest", agg="sum") == 4.0
+    assert store.aggregate("odigos_g", fn="latest", agg="max") == 3.0
+    assert store.aggregate("odigos_g", fn="latest", agg="count") == 2.0
+    by = store.aggregate("odigos_g", fn="latest", agg="sum",
+                         by="collector")
+    assert by == {"a": 1.0, "b": 3.0}
+
+
+def test_batched_series_values_match_per_series(store, clock):
+    rng = np.random.default_rng(7)
+    for c in range(20):
+        for _ in range(15):
+            store.observe(f"odigos_g{{collector=c{c}}}",
+                          float(rng.random()))
+            clock.advance(0.2)
+    for fn in ("latest", "avg", "max", "min", "sum"):
+        batched = store.series_values("odigos_g", fn, 30.0)
+        assert batched  # the fixture populated inside the window
+        for key, v in batched.items():
+            assert v == pytest.approx(
+                store.window_value(key, fn, 30.0)), (fn, key)
+
+
+def test_observe_many_one_lock_hold(store):
+    n = store.observe_many([("odigos_a", 1.0), ("odigos_b", 2.0),
+                            ("odigos_c", float("nan"))])
+    assert n == 2
+    assert store.latest("odigos_b") == 2.0
+
+
+def test_unknown_fn_and_agg_raise(store):
+    store.observe("odigos_g", 1.0)
+    with pytest.raises(ValueError):
+        store.window_value("odigos_g", "stddev", 60)
+    with pytest.raises(ValueError):
+        store.aggregate("odigos_g", agg="mode")
+
+
+# -------------------------------------- Meter cardinality guard satellite
+
+
+class TestMeterCardinalityGuard:
+    def test_cap_per_metric_with_overflow_counter(self):
+        m = Meter(max_series_per_metric=3)
+        for i in range(8):
+            m.add(labeled_key("odigos_t_total", k=str(i)))
+        snap = m.snapshot()
+        kept = [k for k in snap if k.startswith("odigos_t_total{")]
+        assert len(kept) == 3
+        assert snap[
+            "odigos_selftelemetry_dropped_series_total"
+            "{metric=odigos_t_total}"] == 5.0
+
+    def test_guard_covers_every_instrument_kind(self):
+        m = Meter(max_series_per_metric=1)
+        m.add("odigos_c_total{k=a}")
+        m.add("odigos_c_total{k=b}")        # dropped
+        m.set_gauge("odigos_g{k=a}", 1.0)
+        m.set_gauge("odigos_g{k=b}", 1.0)   # dropped
+        m.record("odigos_h_ms{k=a}", 1.0)
+        m.record("odigos_h_ms{k=b}", 1.0)   # dropped
+        m.record_many([("odigos_h2_ms{k=a}", 1.0),
+                       ("odigos_h2_ms{k=b}", 1.0)])  # second dropped
+        snap = m.snapshot()
+        for base in ("odigos_c_total", "odigos_g"):
+            assert f"{base}{{k=a}}" in snap
+            assert f"{base}{{k=b}}" not in snap
+        assert "odigos_h_ms_count{k=a}" in snap
+        assert "odigos_h_ms_count{k=b}" not in snap
+        assert "odigos_h2_ms_count{k=b}" not in snap
+        dropped = {k: v for k, v in snap.items()
+                   if k.startswith("odigos_selftelemetry_dropped")}
+        assert len(dropped) == 4  # one per overflowing metric
+
+    def test_unlabeled_names_never_capped(self):
+        m = Meter(max_series_per_metric=1)
+        for i in range(5):
+            m.add(f"odigos_plain_{i}_total")
+        assert len(m.snapshot()) == 5
+
+    def test_existing_series_keep_recording_at_cap(self):
+        m = Meter(max_series_per_metric=1)
+        m.add("odigos_t_total{k=a}", 1)
+        m.add("odigos_t_total{k=b}", 1)  # refused
+        m.add("odigos_t_total{k=a}", 2)  # still accepted
+        assert m.counter("odigos_t_total{k=a}") == 3.0
+
+    def test_cleared_gauge_does_not_recount(self):
+        m = Meter(max_series_per_metric=2)
+        m.set_gauge("odigos_g{k=a}", 1.0)
+        m.clear_gauge("odigos_g{k=a}")
+        m.set_gauge("odigos_g{k=a}", 2.0)  # same series, not a new one
+        m.set_gauge("odigos_g{k=b}", 1.0)  # second distinct: admitted
+        snap = m.snapshot()
+        assert snap["odigos_g{k=a}"] == 2.0
+        assert snap["odigos_g{k=b}"] == 1.0

@@ -69,6 +69,27 @@ def _parse_mesh(spec: str) -> dict:
     return {"data": int(dp), "model": int(tp or 1)}
 
 
+# fleet alert rules the soak runs under (ISSUE 10): rendered into the
+# collector config's service.alerts stanza, evaluated live while the
+# plane publishes the collector each tick, and embedded — rule states
+# plus every fired/cleared transition — into SOAK.json so a soak run
+# proves the alert loop end to end. Module-level so the package-hygiene
+# lint can resolve each expression's metric against the registered
+# odigos_* names (a typo'd rule must fail tests, not sit dark).
+SOAK_ALERTS = [
+    # a queue_full storm (the engine shedding under overload) must page
+    {"name": "queue-full-storm",
+     "expr": "rate(odigos_flow_dropped_items_total"
+             "{reason=queue_full}[10s]) > 5000",
+     "for_s": 2.0, "severity": "critical"},
+    # sustained pre-decode shedding at the socket: the admission gate
+    # doing its job, but worth a warning when it persists
+    {"name": "admission-shed-sustained",
+     "expr": "rate(odigos_admission_rejected_frames_total[10s]) > 100",
+     "for_s": 2.0, "severity": "warning"},
+]
+
+
 def run_soak(args, fast_path: bool) -> dict:
     if args.mesh:
         # multichip mode (ISSUE 7): the engine serves on a dp×tp mesh —
@@ -175,18 +196,23 @@ def run_soak(args, fast_path: bool) -> dict:
             "default_pipelines": ["traces/normal"],
             "mode": "trace"}},
         "exporters": {"tracedb/anomaly": {}, "tracedb/normal": {}},
-        "service": {"pipelines": {
-            "traces/in": pipeline_in,
-            "traces/anomaly": {"receivers": ["anomalyrouter"],
-                               "exporters": ["tracedb/anomaly"]},
-            "traces/normal": {"receivers": ["anomalyrouter"],
-                              "exporters": ["tracedb/normal"]},
-        }},
+        "service": {
+            "alerts": [dict(a) for a in SOAK_ALERTS],
+            "pipelines": {
+                "traces/in": pipeline_in,
+                "traces/anomaly": {"receivers": ["anomalyrouter"],
+                                   "exporters": ["tracedb/anomaly"]},
+                "traces/normal": {"receivers": ["anomalyrouter"],
+                                  "exporters": ["tracedb/normal"]},
+            }},
     }
+
+    from odigos_tpu.selftelemetry.fleet import fleet_plane
 
     flow_ledger.reset()
     meter.reset()
     latency_ledger.reset()
+    fleet_plane.reset()
     collector = Collector(cfg).start()
     port = collector.graph.receivers["otlpwire"].port
 
@@ -355,7 +381,17 @@ def run_soak(args, fast_path: bool) -> dict:
     for t in threads:
         t.start()
     probe_thread.start()
-    time.sleep(args.seconds)
+    # fleet publish/evaluate cadence (ISSUE 10): the soak's main wait
+    # doubles as the plane timer — each tick delta-publishes the
+    # collector's snapshot + rollup under {collector=} and advances the
+    # alert engine, so SOAK.json's alert states/history come from the
+    # loop running live under load, not a post-hoc evaluation
+    t_end = time.monotonic() + args.seconds
+    while time.monotonic() < t_end:
+        fleet_plane.publish_collector(collector, "soak-gateway",
+                                      group="soak")
+        fleet_plane.tick()
+        time.sleep(min(0.5, max(t_end - time.monotonic(), 0.0)))
     stop.set()
     for t in threads:
         t.join(timeout=90)
@@ -414,6 +450,26 @@ def run_soak(args, fast_path: bool) -> dict:
     slo_conditions = [c for c in collector.health_conditions()
                      if c["component"].startswith("slo/")]
 
+    # fleet rollup + alert loop evidence (ISSUE 10), read BEFORE
+    # shutdown: per-collector health, worst-of per group, every rule's
+    # final state, the full fired/cleared transition history, and any
+    # sizing recommendations the run's gauges triggered
+    fleet_snap = fleet_plane.api_snapshot()
+    fleet_summary = {
+        "collectors": [
+            {k: co[k] for k in ("collector", "group", "status",
+                                "reason", "series_published",
+                                "series_skipped")}
+            for co in fleet_snap["collectors"]],
+        "groups": fleet_snap["groups"],
+        "alert_rules": fleet_snap["alerts"]["rules"],
+        "alert_transitions": fleet_snap["alerts"]["history"],
+        "recommendations": fleet_snap["recommendations"],
+        "series_store": {k: fleet_snap["store"][k]
+                         for k in ("series", "metrics",
+                                   "dropped_series")},
+    }
+
     collector.shutdown()
 
     import numpy as np
@@ -464,6 +520,10 @@ def run_soak(args, fast_path: bool) -> dict:
         "deadline_burn": burn_tables,
         "slo": slo_verdicts,
         "slo_conditions": slo_conditions,
+        # the fleet plane's view of the run (ISSUE 10): collector
+        # rollup, alert rule states + fired/cleared transitions, and
+        # sizing recommendations — the soak proves the alert loop e2e
+        "fleet": fleet_summary,
         # added latency through the LOADED pipeline (probe stream,
         # send -> terminal exporter; includes wire, admission, adaptive
         # batching, zscore scoring, routing)
