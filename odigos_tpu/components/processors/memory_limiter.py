@@ -9,18 +9,21 @@ metric odigos_gateway_memory_limiter_rejections_total
 Ours tracks an estimated in-flight byte budget (columnar batches make the
 estimate cheap: sum of column nbytes) and refuses batches above the hard
 limit, incrementing the same-named rejection counter that our autoscaler's
-HPA math consumes. Soft limit triggers aggressive downstream flushing via
-gc, mirroring spike-limit headroom (resource_config.go:22-32).
+HPA math consumes. Soft limit hints the paced GC janitor
+(serving/gcisolation.py) to collect off the data path, mirroring
+spike-limit headroom (resource_config.go:22-32) without the inline
+stop-the-world pause the old ``gc.collect(0)`` put on every crossing
+frame (ISSUE 12).
 """
 
 from __future__ import annotations
 
-import gc
 import threading
 from typing import Any
 
 from ...pdata.spans import SpanBatch
 from ...selftelemetry.flow import FlowContext
+from ...serving.gcisolation import gc_plane
 from ...utils.telemetry import labeled_key, meter
 from ..api import ComponentKind, Factory, Processor, register
 
@@ -96,7 +99,13 @@ class MemoryLimiterProcessor(Processor):
             FlowContext.watermark(self._watermark_name(),
                                   "inflight_bytes", self._inflight)
         if soft_exceeded:
-            gc.collect(0)
+            # soft pressure flushes via the PACED GC JANITOR (ISSUE 12):
+            # the old inline gc.collect(0) here put a stop-the-world
+            # pause on the data path of every frame that crossed the
+            # soft line — exactly the saturated-tail stage the waterfall
+            # blamed. hint() is one event set; the collect runs on the
+            # janitor thread within its pacing interval.
+            gc_plane.hint()
         try:
             self.next_consumer.consume(batch)
         finally:

@@ -161,6 +161,11 @@ class AnomalyStageConfiguration:
     # FIFO contract, byte-identical output order) at the cost of
     # serializing the forward leg; false = forward as completed
     fast_path_ordered: bool = False
+    # predictive deadline-burn shed (ISSUE 12): frames the burn table
+    # prices past the admission deadline are REJECTED before featurize
+    # spends host time on them (blame=predicted); rendered as
+    # fast_path.predictive
+    fast_path_predictive: bool = True
     # declarative burn-rate SLOs for the root traces pipeline (ISSUE 8);
     # None renders nothing — existing configs stay byte-identical
     slo: Optional[SloConfiguration] = None

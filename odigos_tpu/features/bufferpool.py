@@ -1,0 +1,340 @@
+"""Pinned reusable buffers for the steady-state featurize/pack tensors.
+
+The fast path's host cost below the knee is featurize + pack; AT the
+knee the remaining tail comes from what those kernels do per frame:
+``np.zeros``/``np.empty``/``np.full`` on every call (~25 sites across
+``features/featurizer.py``), i.e. a malloc storm that (a) burns
+allocator time exactly when every core is busy and (b) feeds the GC/
+allocator churn behind the multi-hundred-ms saturated tails PR 9
+recorded. This module is the host-side extension of PR 7's
+``donate_argnums`` discipline: buffers are OWNED BY A LEASE, checked
+out, fully initialized, handed to the engine, and returned to the pool
+only when every holder is done with them — steady state allocates
+nothing per frame.
+
+Design:
+
+* :class:`BufferPool` keeps freed backing buffers on a power-of-two
+  byte-bucket ladder (the same bounded-shape-set idea as the engine's
+  ``BucketLadder``): a request for any (shape, dtype) takes the
+  smallest free bucket that holds it and returns an exact-shape view
+  over its head. A bounded ``max_bytes`` of freed capacity is retained;
+  beyond it, returns are dropped to the allocator (a size storm cannot
+  pin unbounded memory).
+* :class:`Lease` scopes a checkout group (one frame's featurize, one
+  engine call's pack) and is REFCOUNTED: the fast path holds one
+  reference for the frame and one for the engine request, so buffers
+  return only after both the retirement lane released the frame AND the
+  engine's done-callback confirmed the device call consumed them —
+  exactly the donate-after-last-use contract, host-side. Releasing is
+  idempotent-by-construction (each holder releases its own reference
+  exactly once).
+* ``alloc(shape, dtype, fill)`` is the one allocation helper the
+  featurize/pack kernels call: inside a ``lease_scope`` it checks out
+  from the active lease's pool; outside any scope (training, tools,
+  cold paths) it falls back to plain numpy — callers never thread pool
+  objects through kernel signatures.
+
+Safety contract (pinned by ``tests/test_bufferpool.py``):
+
+* every ``take`` is **fully initialized** (``fill=`` or a complete
+  overwrite by the caller — the ``np.empty`` discipline), so recycled
+  content can never leak between frames;
+* two live leases never share backing memory (no cross-frame
+  aliasing); holding a checked-out array past its lease's final
+  release is a contract violation — ``poison=True`` (tests) overwrites
+  returned buffers so such a bug is deterministic, not heisenbergian;
+* pooled-vs-unpooled outputs are **bitwise identical** (the kernels
+  only ever get exact-shape, initialized views).
+
+``ODIGOS_POOL=0`` (or :func:`set_pools_enabled`) disables the layer:
+leases become plain allocations and ``alloc`` always falls back —
+the bench's ``steady_state_allocs`` off/on A/B toggle.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+from ..utils.telemetry import labeled_key, meter
+
+POOL_BYTES_GAUGE = "odigos_bufferpool_bytes_held"
+POOL_FREE_GAUGE = "odigos_bufferpool_free_buffers"
+POOL_OUTSTANDING_GAUGE = "odigos_bufferpool_outstanding_leases"
+POOL_HIT_RATE_GAUGE = "odigos_bufferpool_hit_rate"
+POOL_MISSES_METRIC = "odigos_bufferpool_misses_total"
+POOL_HITS_METRIC = "odigos_bufferpool_hits_total"
+POOL_DROPPED_METRIC = "odigos_bufferpool_dropped_buffers_total"
+
+# smallest backing bucket: below this every request shares one rung, so
+# tiny scratch vectors (run starts, per-trace offsets) don't fragment
+# the ladder into hundreds of micro-buckets
+MIN_BUCKET_BYTES = 4096
+# freed capacity retained per pool; beyond it returns go back to the
+# allocator. Sized for the fast path's worst frame (a few padded
+# (R, L, C) tensors) times a handful of rungs.
+DEFAULT_MAX_BYTES = 128 << 20
+
+# gauge publish throttle: steady state must not pay a meter lock per
+# checkout, so the hot take() path publishes at most once a second
+_PUBLISH_INTERVAL_S = 1.0
+
+_enabled = os.environ.get("ODIGOS_POOL", "1") != "0"
+
+# process-wide count of alloc() calls that fell back to plain numpy —
+# the bench's "allocations per frame with pools off" numerator (and,
+# with pools on, the proof that no steady-state site bypassed a lease)
+_fallback_allocs = 0
+
+
+def pools_enabled() -> bool:
+    return _enabled
+
+
+def set_pools_enabled(on: bool) -> None:
+    """Flip the layer globally (the bench A/B + kill-switch hook).
+    Leases already outstanding keep their buffers and still return them
+    — disabling mid-flight only stops NEW checkouts from pooling."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def fallback_allocs() -> int:
+    return _fallback_allocs
+
+
+# the lease the current frame's kernels check out from; None = plain
+# numpy (cold paths, training, tools). Context-local like the stage
+# clock: each submit lane / engine worker scopes its own frame.
+_active_lease: contextvars.ContextVar[Optional["Lease"]] = \
+    contextvars.ContextVar("odigos_buffer_lease", default=None)
+
+
+@contextmanager
+def lease_scope(lease: Optional["Lease"]) -> Iterator[Optional["Lease"]]:
+    """Make ``lease`` the allocation target for ``alloc`` calls in this
+    context (None = explicit plain-numpy scope, used by the parity
+    oracle)."""
+    token = _active_lease.set(lease)
+    try:
+        yield lease
+    finally:
+        _active_lease.reset(token)
+
+
+def _plain(shape, dtype, fill) -> np.ndarray:
+    if fill is None:
+        return np.empty(shape, dtype)
+    if isinstance(fill, (int, float)) and fill == 0:
+        return np.zeros(shape, dtype)
+    return np.full(shape, fill, dtype)
+
+
+def alloc(shape, dtype, fill=None) -> np.ndarray:
+    """The featurize/pack kernels' one allocation site: a pooled,
+    exact-shape array when a lease is active, plain numpy otherwise.
+    ``fill=None`` is the ``np.empty`` contract — the CALLER fully
+    overwrites every element (pinned by the parity tests: recycled
+    content must never be observable)."""
+    lease = _active_lease.get()
+    if lease is None:
+        global _fallback_allocs
+        _fallback_allocs += 1
+        return _plain(shape, dtype, fill)
+    return lease.take(shape, dtype, fill)
+
+
+class Lease:
+    """One checkout scope's buffers, refcounted across holders.
+
+    ``retain()`` before handing the buffers to another owner (the
+    engine request); each owner calls ``release()`` exactly once; at
+    zero the backing buffers go back to the pool. A lease is single-
+    checkout-threaded (one submit lane / one engine worker) but
+    released from arbitrary threads — the count is lock-protected.
+    """
+
+    __slots__ = ("pool", "_bufs", "_refs", "_lock")
+
+    def __init__(self, pool: "BufferPool"):
+        self.pool = pool
+        self._bufs: list[np.ndarray] = []
+        self._refs = 1
+        self._lock = threading.Lock()
+
+    def take(self, shape, dtype, fill=None) -> np.ndarray:
+        return self.pool._take(self, shape, dtype, fill)
+
+    def retain(self) -> "Lease":
+        with self._lock:
+            self._refs += 1
+        return self
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs -= 1
+            if self._refs != 0:
+                return
+            bufs, self._bufs = self._bufs, []
+        self.pool._give_back(bufs)
+
+
+class BufferPool:
+    """Power-of-two-bucketed reusable backing store (see module doc).
+
+    One pool per hot-path lane (fast-path submit lanes, the engine
+    worker): checkouts are effectively uncontended; the lock only
+    serializes the cross-thread give-back at frame retirement.
+    """
+
+    def __init__(self, name: str, max_bytes: int = DEFAULT_MAX_BYTES,
+                 poison: bool = False):
+        self.name = name
+        self.max_bytes = int(max_bytes)
+        self.poison = bool(poison)
+        self._lock = threading.Lock()
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._bytes_held = 0
+        self._hits = 0
+        self._misses = 0
+        self._dropped = 0
+        self._leases = 0
+        self._outstanding = 0
+        # deltas since the last throttled publish
+        self._pub_hits = 0
+        self._pub_misses = 0
+        self._pub_dropped = 0
+        self._next_publish = 0.0
+        self._keys = {
+            "bytes": labeled_key(POOL_BYTES_GAUGE, pool=name),
+            "free": labeled_key(POOL_FREE_GAUGE, pool=name),
+            "out": labeled_key(POOL_OUTSTANDING_GAUGE, pool=name),
+            "rate": labeled_key(POOL_HIT_RATE_GAUGE, pool=name),
+            "hits": labeled_key(POOL_HITS_METRIC, pool=name),
+            "misses": labeled_key(POOL_MISSES_METRIC, pool=name),
+            "dropped": labeled_key(POOL_DROPPED_METRIC, pool=name),
+        }
+
+    # ------------------------------------------------------------ leases
+    def lease(self) -> Lease:
+        with self._lock:
+            self._leases += 1
+            self._outstanding += 1
+        return Lease(self)
+
+    @staticmethod
+    def _bucket(nbytes: int) -> int:
+        b = MIN_BUCKET_BYTES
+        while b < nbytes:
+            b <<= 1
+        return b
+
+    def _take(self, lease: Lease, shape, dtype, fill) -> np.ndarray:
+        dt = np.dtype(dtype)
+        # math.prod over the 1-3 small ints: np.prod's array round trip
+        # costs ~10x on the exact path this module exists to make cheap
+        nbytes = math.prod(shape) * dt.itemsize
+        bucket = self._bucket(nbytes)
+        now = time.monotonic()
+        publish = False
+        with self._lock:
+            stack = self._free.get(bucket)
+            buf = stack.pop() if stack else None
+            if buf is None:
+                # a LARGER idle buffer beats a fresh allocation: shape
+                # jitter (varying coalesce widths, in-flight depth
+                # wobble) then rides existing capacity instead of
+                # minting a new rung. Two rungs up keeps worst-case
+                # slack at 4x, same as the bucket ladder's geometry.
+                for bigger in (bucket << 1, bucket << 2):
+                    stack = self._free.get(bigger)
+                    if stack:
+                        buf = stack.pop()
+                        break
+            if buf is not None:
+                self._bytes_held -= buf.nbytes
+                self._hits += 1
+                self._pub_hits += 1
+            if now >= self._next_publish:
+                self._next_publish = now + _PUBLISH_INTERVAL_S
+                publish = True
+        if buf is None:
+            # the pool's ONE fresh-allocation site (lint-allowlisted):
+            # a miss here is exactly what steady_state_allocs counts
+            buf = self._fresh(bucket)
+        arr = buf[:nbytes].view(dt).reshape(shape)
+        if fill is not None:
+            arr.fill(fill)
+        lease._bufs.append(buf)
+        if publish:
+            self._publish()
+        return arr
+
+    def _fresh(self, bucket: int) -> np.ndarray:
+        with self._lock:
+            self._misses += 1
+            self._pub_misses += 1
+        return np.empty(bucket, np.uint8)
+
+    def _give_back(self, bufs: list[np.ndarray]) -> None:
+        with self._lock:
+            self._outstanding -= 1
+            for buf in bufs:
+                n = buf.nbytes
+                if self._bytes_held + n > self.max_bytes:
+                    # over the retention cap: back to the allocator —
+                    # a one-off giant frame must not pin its high-water
+                    # footprint forever
+                    self._dropped += 1
+                    self._pub_dropped += 1
+                    continue
+                if self.poison:
+                    buf.fill(0xAB)  # use-after-release turns deterministic
+                self._free.setdefault(n, []).append(buf)
+                self._bytes_held += n
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "pool": self.name,
+                "hits": self._hits,
+                "misses": self._misses,
+                "hit_rate": round(self._hits / total, 4) if total else 0.0,
+                "dropped": self._dropped,
+                "leases": self._leases,
+                "outstanding_leases": self._outstanding,
+                "bytes_held": self._bytes_held,
+                "free_buffers": sum(len(s) for s in self._free.values()),
+            }
+
+    def _publish(self) -> None:
+        """Throttled gauge/counter publish (called off the lock)."""
+        with self._lock:
+            total = self._hits + self._misses
+            rate = self._hits / total if total else 0.0
+            bytes_held = self._bytes_held
+            free = sum(len(s) for s in self._free.values())
+            out = self._outstanding
+            d_hits, self._pub_hits = self._pub_hits, 0
+            d_miss, self._pub_misses = self._pub_misses, 0
+            d_drop, self._pub_dropped = self._pub_dropped, 0
+        meter.set_gauge(self._keys["bytes"], bytes_held)
+        meter.set_gauge(self._keys["free"], free)
+        meter.set_gauge(self._keys["out"], out)
+        meter.set_gauge(self._keys["rate"], round(rate, 4))
+        if d_hits:
+            meter.add(self._keys["hits"], d_hits)
+        if d_miss:
+            meter.add(self._keys["misses"], d_miss)
+        if d_drop:
+            meter.add(self._keys["dropped"], d_drop)

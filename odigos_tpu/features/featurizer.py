@@ -19,6 +19,17 @@ path is pure columnar:
 Hashes are stable across processes (blake2b), so vocab ids are reproducible
 between training and serving — the property the reference gets from its
 YAML-pinned registries.
+
+Steady-state memory discipline (ISSUE 12): every per-frame tensor these
+kernels build goes through :func:`bufferpool.alloc` — inside a buffer-
+pool lease (the fast path's submit lanes, the engine's pack stage) the
+arrays are recycled views over pinned backing buffers and a warmed
+frame allocates NOTHING; outside any lease the helper falls back to
+plain numpy, so training/tools/cold paths are unchanged. The memoized
+hash/slot tables (``_hash_table``, ``_attr_slot_matrix``) deliberately
+keep direct allocation: their arrays outlive any one frame by design
+(value-keyed LRU / per-store memo), which is exactly what a lease must
+never own — the package-hygiene lint allowlists them as setup paths.
 """
 
 from __future__ import annotations
@@ -31,6 +42,7 @@ from typing import Callable, Optional, Union
 import numpy as np
 
 from ..pdata.spans import SpanBatch
+from .bufferpool import alloc as _alloc
 
 # categorical feature columns, in order
 CAT_FIELDS = ("service", "name", "kind", "status", "parent_service")
@@ -206,8 +218,8 @@ def featurize(batch: SpanBatch,
     config = config or FeaturizerConfig()
     n = len(batch)
     if n == 0:
-        return SpanFeatures(np.zeros((0, config.cat_width), np.int32),
-                            np.zeros((0, config.cont_width), np.float32))
+        return SpanFeatures(_alloc((0, config.cat_width), np.int32, 0),
+                            _alloc((0, config.cont_width), np.float32, 0))
 
     service_h = _hash_table(batch.strings, config.service_vocab)
     name_h = _hash_table(batch.strings, config.name_vocab)
@@ -229,14 +241,17 @@ def featurize(batch: SpanBatch,
     parent_rows = order[pos]
     parent_service = np.where(found, service_ids[parent_rows], 0).astype(np.int32)
 
-    cols = [service_ids, name_ids, kind, status, parent_service]
+    cols = (service_ids, name_ids, kind, status, parent_service)
 
+    # output matrices come from the buffer pool (a column_stack here was
+    # the frame's largest steady-state allocation); column writes into
+    # an exact-shape C-order view are bitwise what column_stack built
+    categorical = _alloc((n, config.cat_width), np.int32)
+    for i, c in enumerate(cols):
+        categorical[:, i] = c
     if config.attr_slots:
-        slots = _attr_slot_matrix(batch, config.attr_slots,
-                                  config.attr_vocab)
-        categorical = np.column_stack(cols + [slots])
-    else:
-        categorical = np.column_stack(cols)
+        categorical[:, len(cols):] = _attr_slot_matrix(
+            batch, config.attr_slots, config.attr_vocab)
 
     dur_us = batch.duration_ns.astype(np.float64) / 1_000.0
     log_dur = np.log1p(dur_us).astype(np.float32)
@@ -245,10 +260,12 @@ def featurize(batch: SpanBatch,
     # cheap proxy = 0 for roots, 1 for spans with in-batch parent, 0.5 orphan
     depth_hint = np.where(parent_ids == 0, 0.0,
                           np.where(found, 1.0, 0.5)).astype(np.float32)
-    continuous = np.column_stack([log_dur, is_root, depth_hint])
+    continuous = _alloc((n, config.cont_width), np.float32)
+    continuous[:, 0] = log_dur
+    continuous[:, 1] = is_root
+    continuous[:, 2] = depth_hint
 
-    return SpanFeatures(categorical.astype(np.int32, copy=False),
-                        continuous.astype(np.float32, copy=False))
+    return SpanFeatures(categorical, continuous)
 
 
 # shape-bucket spec for the leading (trace/row) axis of assembled tensors:
@@ -315,10 +332,10 @@ def assemble_sequences(batch: SpanBatch,
         T = _bucket_rows(0, pad_traces_to) if callable(pad_traces_to) \
             else (pad_traces_to or 0)
         return TraceSequences(
-            np.zeros((T, max_len, C), np.int32),
-            np.zeros((T, max_len, D), np.float32),
-            np.zeros((T, max_len), bool),
-            np.full((T, max_len), -1, np.int32), 0)
+            _alloc((T, max_len, C), np.int32, 0),
+            _alloc((T, max_len, D), np.float32, 0),
+            _alloc((T, max_len), bool, False),
+            _alloc((T, max_len), np.int32, -1), 0)
 
     from ..pdata.traces import trace_keys
 
@@ -329,7 +346,7 @@ def assemble_sequences(batch: SpanBatch,
     order = np.lexsort((start, inverse))  # trace-major, time-minor
     inv_sorted = inverse[order]
     # position of each span within its trace (cumcount over sorted runs)
-    first_of_run = np.empty(n, dtype=bool)
+    first_of_run = _alloc((n,), bool)
     first_of_run[0] = True
     first_of_run[1:] = inv_sorted[1:] != inv_sorted[:-1]
     run_starts = np.nonzero(first_of_run)[0]
@@ -347,10 +364,10 @@ def assemble_sequences(batch: SpanBatch,
     T = _bucket_rows(T_real, pad_traces_to)
     C = features.categorical.shape[1]
     D = features.continuous.shape[1]
-    cat = np.zeros((T, max_len, C), np.int32)
-    cont = np.zeros((T, max_len, D), np.float32)
-    mask = np.zeros((T, max_len), bool)
-    span_index = np.full((T, max_len), -1, np.int32)
+    cat = _alloc((T, max_len, C), np.int32, 0)
+    cont = _alloc((T, max_len, D), np.float32, 0)
+    mask = _alloc((T, max_len), bool, False)
+    span_index = _alloc((T, max_len), np.int32, -1)
 
     cat[t_idx, l_idx] = features.categorical[rows]
     cont[t_idx, l_idx] = features.continuous[rows]
@@ -436,11 +453,11 @@ def pack_arrays(trace_id_hi: np.ndarray, trace_id_lo: np.ndarray,
         R = _bucket_rows(0, pad_rows_to) if callable(pad_rows_to) \
             else (pad_rows_to or 0)
         return PackedSequences(
-            np.zeros((R, max_len, C), np.int32),
-            np.zeros((R, max_len, D), np.float32),
-            np.zeros((R, max_len), np.int32),
-            np.zeros((R, max_len), np.int32),
-            np.full((R, max_len), -1, np.int32))
+            _alloc((R, max_len, C), np.int32, 0),
+            _alloc((R, max_len, D), np.float32, 0),
+            _alloc((R, max_len), np.int32, 0),
+            _alloc((R, max_len), np.int32, 0),
+            _alloc((R, max_len), np.int32, -1))
 
     # one integer lexsort groups spans by trace and time-orders them; a
     # structured-dtype np.unique here costs ~3 ms at 8k spans (generic
@@ -450,7 +467,7 @@ def pack_arrays(trace_id_hi: np.ndarray, trace_id_lo: np.ndarray,
     order = np.lexsort((start_unix_nano, lo, hi))
     hi_s = hi[order]
     lo_s = lo[order]
-    new_trace = np.empty(n, bool)
+    new_trace = _alloc((n,), bool)
     new_trace[0] = True
     np.logical_or(hi_s[1:] != hi_s[:-1], lo_s[1:] != lo_s[:-1],
                   out=new_trace[1:])
@@ -463,18 +480,18 @@ def pack_arrays(trace_id_hi: np.ndarray, trace_id_lo: np.ndarray,
     # the <5 ms serving budget, so per-trace array allocation is banned.
     T = int(inv_sorted[-1]) + 1 if n else 0
     counts = np.bincount(inv_sorted, minlength=T)
-    first_idx = np.zeros(T, np.int64)
+    first_idx = _alloc((T,), np.int64, 0)
     np.cumsum(counts[:-1], out=first_idx[1:])
     pos_in_trace = np.arange(n, dtype=np.int64) - first_idx[inv_sorted]
     chunk_of_span = pos_in_trace // max_len
     pos_in_chunk = (pos_in_trace % max_len).astype(np.int32)
 
     n_chunks = (counts + max_len - 1) // max_len  # per trace
-    seg_first = np.zeros(T, np.int64)
+    seg_first = _alloc((T,), np.int64, 0)
     np.cumsum(n_chunks[:-1], out=seg_first[1:])
     total_segs = int(seg_first[-1] + n_chunks[-1]) if T else 0
     # segment lengths: max_len everywhere, remainder on each trace's last
-    seg_len = np.full(total_segs, max_len, np.int64)
+    seg_len = _alloc((total_segs,), np.int64, max_len)
     last_seg = seg_first + n_chunks - 1
     seg_len[last_seg] = counts - (n_chunks - 1) * max_len
     span_seg = seg_first[inv_sorted] + chunk_of_span
@@ -507,18 +524,18 @@ def pack_arrays(trace_id_hi: np.ndarray, trace_id_lo: np.ndarray,
     seg_idx = np.arange(total_segs, dtype=np.int64)
     seg_row = np.searchsorted(row_starts, seg_idx, side="right") - 1
     # cumulative length at each row's first segment = row-local offset base
-    row_cum0 = np.zeros(R_real, np.int64)
+    row_cum0 = _alloc((R_real,), np.int64, 0)
     if R_real > 1:
         row_cum0[1:] = cum[row_starts[1:] - 1]
     seg_off = (cum - seg_len) - row_cum0[seg_row]
     seg_slot = seg_idx - row_starts[seg_row] + 1  # 1-based id within row
 
     R = _bucket_rows(R_real, pad_rows_to)
-    cat = np.zeros((R, max_len, C), np.int32)
-    cont = np.zeros((R, max_len, D), np.float32)
-    segments = np.zeros((R, max_len), np.int32)
-    positions = np.zeros((R, max_len), np.int32)
-    span_index = np.full((R, max_len), -1, np.int32)
+    cat = _alloc((R, max_len, C), np.int32, 0)
+    cont = _alloc((R, max_len, D), np.float32, 0)
+    segments = _alloc((R, max_len), np.int32, 0)
+    positions = _alloc((R, max_len), np.int32, 0)
+    span_index = _alloc((R, max_len), np.int32, -1)
 
     span_row = seg_row[span_seg]
     span_col = seg_off[span_seg] + pos_in_chunk

@@ -209,8 +209,12 @@ class ZScoreDetector:
         log_dur = features.continuous[:, 0]
         n = cat.shape[0]
         if not self.span_bucket or n == 0:
-            self.state = self.update_fn(self.state, jnp.asarray(cat),
-                                        jnp.asarray(log_dur))
+            # same input-ownership rule as the bucketed branch below:
+            # this update is async and the contiguous categorical view
+            # may be pool-backed — copy before the zero-copy device_put
+            self.state = self.update_fn(
+                self.state, jnp.asarray(cat.copy() if n else cat),
+                jnp.asarray(log_dur))
             return
         b = self._bucket_rows(n)
         pad = b - n
@@ -219,6 +223,13 @@ class ZScoreDetector:
                 [cat, np.zeros((pad, cat.shape[1]), cat.dtype)])
             log_dur = np.concatenate(
                 [log_dur, np.zeros(pad, log_dur.dtype)])
+        else:
+            # own the categorical input: this update is dispatched async
+            # and never blocked on, and jax's CPU client zero-copies
+            # contiguous host arrays — a pool-backed features matrix
+            # (ISSUE 12) could recycle mid-kernel otherwise. Exact-bucket
+            # frames are the rare case; padded ones copied above anyway.
+            cat = cat.copy()
         weights = np.zeros(b, np.float32)
         weights[:n] = 1.0
         self.state = _update_masked_kernel(

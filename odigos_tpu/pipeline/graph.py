@@ -232,7 +232,8 @@ def validate_config(config: dict[str, Any]) -> list[str]:
                 # never retire — refuse loudly at validation
                 known = {"deadline_ms", "max_pending_spans", "lanes",
                          "submit_lanes", "ordered", "drain_timeout_s",
-                         "name"}
+                         "name", "predictive", "predictive_margin",
+                         "predictive_min_frames", "pooled"}
                 unknown = sorted(set(fp) - known)
                 if unknown:
                     problems.append(
@@ -243,7 +244,8 @@ def validate_config(config: dict[str, Any]) -> list[str]:
                 # "valid" 0.9 would become a zero-span window rejecting
                 # every frame
                 for key in ("lanes", "submit_lanes",
-                            "max_pending_spans"):
+                            "max_pending_spans",
+                            "predictive_min_frames"):
                     lanes = fp.get(key)
                     if lanes is not None and (
                             isinstance(lanes, bool)
@@ -251,12 +253,13 @@ def validate_config(config: dict[str, Any]) -> list[str]:
                         problems.append(
                             f"pipeline {pname}: fast_path.{key} must be "
                             f"a positive integer")
-                if "ordered" in fp and not isinstance(fp["ordered"],
-                                                      bool):
-                    problems.append(
-                        f"pipeline {pname}: fast_path.ordered must be "
-                        f"a boolean")
-                for key in ("deadline_ms", "drain_timeout_s"):
+                for key in ("ordered", "predictive", "pooled"):
+                    if key in fp and not isinstance(fp[key], bool):
+                        problems.append(
+                            f"pipeline {pname}: fast_path.{key} must be "
+                            f"a boolean")
+                for key in ("deadline_ms", "drain_timeout_s",
+                            "predictive_margin"):
                     v = fp.get(key)
                     if v is not None and (
                             isinstance(v, bool)
@@ -274,6 +277,15 @@ def validate_config(config: dict[str, Any]) -> list[str]:
         from ..selftelemetry.fleet import validate_alert_rules
 
         problems.extend(validate_alert_rules(alerts))
+
+    # GC isolation stanza (ISSUE 12): a typo'd janitor knob must die at
+    # load — a collector silently running default GC posture under a
+    # config that believes it froze is a tail-latency heisenbug
+    gc_cfg = config.get("service", {}).get("gc")
+    if gc_cfg is not None:
+        from ..serving.gcisolation import validate_gc_config
+
+        problems.extend(validate_gc_config(gc_cfg))
 
     # authenticator references must resolve to a defined+enabled extension
     # (the collector fails startup on a dangling authenticator; an auth'd

@@ -17,6 +17,7 @@ import odigos_tpu.components  # noqa: F401  (registers builtin factories)
 
 from ..selftelemetry.flow import register_rollup, unregister_rollup
 from ..selftelemetry.profiler import start_from_config, stop_started
+from ..serving.gcisolation import gc_plane
 from ..utils.telemetry import meter
 from .graph import Graph, build_graph
 
@@ -32,6 +33,7 @@ class Collector:
         # device-runtime collector) THIS collector's config started — only
         # those are stopped on shutdown (another owner's stay running)
         self._telemetry_started: list[str] = []
+        self._gc_started = False
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Collector":
@@ -46,6 +48,14 @@ class Collector:
             register_rollup(self.graph.flow_health)
             self._telemetry_started = start_from_config(
                 self.config.get("service", {}).get("telemetry"))
+            # GC isolation (ISSUE 12), AFTER components started: engine
+            # warmup / ladder compiles have happened, so a configured
+            # freeze pins the built object graph out of every future
+            # collection's scan set. The janitor itself always runs
+            # while a collector does (refcounted) — memory_limiter's
+            # soft-pressure hints need a thread to land on.
+            gc_plane.start(self.config.get("service", {}).get("gc"))
+            self._gc_started = True
         meter.add("odigos_collector_starts_total")
         return self
 
@@ -65,6 +75,9 @@ class Collector:
                     alert_engine.remove(name)
             stop_started(self._telemetry_started)
             self._telemetry_started = []
+            if self._gc_started:
+                gc_plane.stop()
+                self._gc_started = False
             self._running = False
 
     def __enter__(self) -> "Collector":
@@ -127,6 +140,7 @@ class Collector:
         allow_reuse_address makes the same-port rebind immediate."""
         if new_config == self.config:
             return  # a no-op reload must not bounce intake
+        old_config = self.config
         new_graph = build_graph(new_config, self._registry)
         with self._lock:
             old_graph, old_running = self.graph, self._running
@@ -172,4 +186,16 @@ class Collector:
                 stop_started(self._telemetry_started)
                 self._telemetry_started = start_from_config(
                     new_config.get("service", {}).get("telemetry"))
+                # same for the GC plane — but only when the stanza
+                # actually changed: a bounce costs unfreeze + a full
+                # stop-the-world collect + refreeze (tens of ms of
+                # GIL hold landing in live lane frames), which an
+                # unrelated-config reload must not pay
+                old_gc = old_config.get("service", {}).get("gc")
+                new_gc = new_config.get("service", {}).get("gc")
+                if old_gc != new_gc or not self._gc_started:
+                    if self._gc_started:
+                        gc_plane.stop()
+                    gc_plane.start(new_gc)
+                    self._gc_started = True
         meter.add("odigos_collector_reloads_total")

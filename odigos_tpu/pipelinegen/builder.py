@@ -361,7 +361,11 @@ def build_gateway_config(
             root["fast_path"] = {
                 "deadline_ms": anomaly.timeout_ms,
                 "lanes": anomaly.fast_path_lanes,
-                "ordered": anomaly.fast_path_ordered}
+                "ordered": anomaly.fast_path_ordered,
+                # predictive deadline-burn admission (ISSUE 12): shed
+                # frames priced past the deadline before featurize
+                # touches them, named blame=predicted
+                "predictive": anomaly.fast_path_predictive}
             root["processors"] = (
                 ["memory_limiter", "tpuanomaly"]
                 + [pid for pid in root["processors"]

@@ -115,6 +115,19 @@ ENGINE_STAGES = (Stage.QUEUE, Stage.PACK, Stage.DEVICE, Stage.HARVEST)
 
 STAGES = tuple(s.value for s in Stage)
 
+# blame value for PREDICTIVE admission sheds (ISSUE 12): a frame the
+# fast path rejected because the priced burn table said it would expire
+# before scoring. Not a Stage — no wall was ever spent — but it rides
+# the same blame dimension (odigos_latency_deadline_expired_spans_total
+# {blame=predicted} + the drop taxonomy's blame label) so every
+# deadline-driven loss, realized or predicted, is countable in one place.
+PREDICTED_BLAME = "predicted"
+
+# bounded ring of recent frame clocks per recorder: the latencyz
+# waterfall witnesses AND the window the predictive gate's stage means
+# are computed over (consumers clamping thresholds key off this)
+RECENT_WINDOW = 64
+
 
 class StageClock:
     """Per-frame stage timeline: consecutive ``stamp()`` calls turn one
@@ -267,7 +280,7 @@ class _Recorder:
         self._e2e_key = labeled_key(E2E_METRIC, pipeline=pipeline)
         self._totals: dict[str, list[float]] = {}  # stage -> [sum, count]
         self._expired: dict[str, int] = {}         # blame -> spans
-        self.recent: deque[dict[str, Any]] = deque(maxlen=64)
+        self.recent: deque[dict[str, Any]] = deque(maxlen=RECENT_WINDOW)
         self._lock = threading.Lock()
 
     def observe(self, clock: StageClock, scored: bool) -> None:
@@ -311,6 +324,30 @@ class _Recorder:
     def record_expiry(self, blame: str, n_spans: int) -> None:
         with self._lock:
             self._expired[blame] = self._expired.get(blame, 0) + n_spans
+
+    def stage_means(self) -> tuple[int, dict[str, float]]:
+        """(scored frames in window, per-stage mean ms over the RECENT
+        ring) — the predictive admission gate's burn pricing input
+        (ISSUE 12). Windowed on purpose: the lifetime ``_totals`` means
+        never decay, so an overload that pushed them past the deadline
+        would keep pricing frames as doomed long after the incident —
+        with the gate then shedding the very traffic that could refresh
+        the estimate (a permanent full-shed latch). The bounded recent
+        ring (last 64 scored frames) forgets the incident as fast as
+        healthy frames flow again. One lock hold, ≤64×12 adds; the fast
+        path calls this throttled (~10 Hz), never per frame."""
+        with self._lock:
+            sums: dict[str, float] = {}
+            counts: dict[str, int] = {}
+            n = 0
+            for stages, _wall, _ov, scored in self.recent:
+                if not scored:
+                    continue
+                n += 1
+                for s, d in stages:
+                    sums[s] = sums.get(s, 0.0) + d
+                    counts[s] = counts.get(s, 0) + 1
+            return n, {s: sums[s] / counts[s] for s in sums}
 
     def waterfall(self) -> dict[str, dict[str, float]]:
         """Per-stage p50/p95/p99/mean over the meter histograms, in
@@ -542,20 +579,24 @@ class LatencyLedger:
         if tracker is not None:
             tracker.observe(clock.wall_ms(), scored, n_spans)
 
-    def record_expiry(self, pipeline: str, blame: Stage,
+    def record_expiry(self, pipeline: str, blame,
                       n_spans: int) -> None:
         """An expired admission deadline, blamed on the stage that
-        consumed the budget (the burn dimension on the drop taxonomy)."""
+        consumed the budget (the burn dimension on the drop taxonomy).
+        ``blame`` is a :class:`Stage` for realized expiries, or
+        :data:`PREDICTED_BLAME` for frames the predictive gate shed
+        before any budget was spent (ISSUE 12)."""
         if not self.enabled:
             return
+        bval = blame.value if isinstance(blame, Stage) else str(blame)
         with self._lock:
-            key = self._expired_keys.get((pipeline, blame.value))
+            key = self._expired_keys.get((pipeline, bval))
             if key is None:
-                key = self._expired_keys[(pipeline, blame.value)] = \
+                key = self._expired_keys[(pipeline, bval)] = \
                     labeled_key(EXPIRED_METRIC, pipeline=pipeline,
-                                blame=blame.value)
+                                blame=bval)
         meter.add(key, n_spans)
-        self.recorder(pipeline).record_expiry(blame.value, n_spans)
+        self.recorder(pipeline).record_expiry(bval, n_spans)
 
     # -------------------------------------------------------- surfaces
 

@@ -51,6 +51,8 @@ from typing import Any, Callable, Optional, Protocol
 
 import numpy as np
 
+from ..features.bufferpool import (
+    BufferPool, alloc as _pool_alloc, lease_scope, pools_enabled)
 from ..features.featurizer import (
     FeaturizerConfig, SpanFeatures, assemble_sequences, featurize,
     pack_sequences)
@@ -615,6 +617,23 @@ class ScoreRequest:
     # replacing its done.wait() poll. Must be cheap; exceptions are
     # counted, never propagated into the worker loop.
     on_done: Optional[Callable[["ScoreRequest"], None]] = None
+    # buffer-pool hook (ISSUE 12): invoked exactly once, the moment the
+    # engine no longer reads ``features`` — after the pack stage's
+    # coalesce/score call consumed them (success or failure), or at
+    # shutdown fail-fast for never-dispatched requests. Every backend
+    # consumes features synchronously inside its dispatch/score call
+    # (zscore's async online update copies its inputs for exactly this
+    # reason), so the caller's featurize buffers can recycle while the
+    # scores are still in flight.
+    on_features_consumed: Optional[Callable[[], None]] = None
+
+    def release_features(self) -> None:
+        cb, self.on_features_consumed = self.on_features_consumed, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — never kills the worker
+                meter.add("odigos_anomaly_engine_errors_total")
 
     def signal_done(self) -> None:
         """Fire the done event, then the completion callback (at most
@@ -651,6 +670,12 @@ class _InflightGroup:
     # the NEXT call by the time this group retires under depth > 1
     shape: Optional[list[int]]
     padding_waste: Optional[float]
+    # buffer-pool lease backing this call's coalesced/packed tensors
+    # (ISSUE 12): released at the END of _retire — after the blocking
+    # harvest fetch, so the device call has fully consumed its inputs
+    # before the backing buffers recycle (the donate-after-last-use
+    # contract, host-side). None when pooling is off.
+    lease: Any = None
 
 
 class ScoringEngine:
@@ -780,6 +805,10 @@ class ScoringEngine:
         else:
             self._adaptive_gauge_key = labeled_key(
                 ADAPTIVE_CAP_GAUGE, model=self.cfg.model)
+        # pack-stage buffer pool (ISSUE 12): the worker's coalesce/pack
+        # tensors recycle call to call instead of re-allocating — one
+        # pool, one worker thread, so checkouts never contend
+        self._pack_pool = BufferPool(f"engine/{self.cfg.model}")
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "ScoringEngine":
@@ -820,6 +849,7 @@ class ScoringEngine:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
+            req.release_features()  # never dispatched: nothing read them
             req.scores = None
             req.signal_done()
             FlowContext.drop(len(req.batch), "shutdown_drain",
@@ -832,6 +862,7 @@ class ScoringEngine:
                features: Optional[SpanFeatures] = None,
                deadline_ns: Optional[int] = None,
                on_done: Optional[Callable[[ScoreRequest], None]] = None,
+               on_features_consumed: Optional[Callable[[], None]] = None,
                ) -> Optional[ScoreRequest]:
         """Enqueue for scoring; returns None (and counts) if queue is full
         or the engine is draining for shutdown. ``deadline_ns`` (monotonic)
@@ -859,7 +890,8 @@ class ScoringEngine:
             features = featurize(batch, self.cfg.featurizer)
         req = ScoreRequest(batch=batch, features=features,
                            submitted_ns=time.monotonic_ns(),
-                           deadline_ns=deadline_ns, on_done=on_done)
+                           deadline_ns=deadline_ns, on_done=on_done,
+                           on_features_consumed=on_features_consumed)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -902,6 +934,11 @@ class ScoringEngine:
                 w(batch)
             feats = featurize(batch, self.cfg.featurizer)
             self.backend.score(batch, feats)
+
+    def pack_pool_stats(self) -> dict[str, Any]:
+        """The pack-stage buffer pool's counters (ISSUE 12) — the
+        public surface the soak/bench allocation evidence reads."""
+        return self._pack_pool.stats()
 
     def runtime_gauges(self) -> dict[str, Any]:
         """Instantaneous engine state for the device-runtime collector
@@ -1089,51 +1126,76 @@ class ScoringEngine:
         span = (NULL_SPAN
                 if any(is_selftelemetry_batch(r.batch) for r in reqs)
                 else tracer.span("tpu/score")).begin()
+        # every tensor the pack stage builds (feature concat, packed/
+        # assembled sequences inside backend.dispatch) checks out of the
+        # worker's buffer pool; the lease rides the in-flight group and
+        # releases after harvest — steady state packs allocation-free
+        lease = self._pack_pool.lease() if pools_enabled() else None
         try:
-            if len(reqs) == 1:
-                merged, feats = reqs[0].batch, reqs[0].features
-            else:
-                feats = None
-                if all(r.features is not None for r in reqs):
-                    feats = SpanFeatures(
-                        np.concatenate([r.features.categorical
-                                        for r in reqs]),
-                        np.concatenate([r.features.continuous
-                                        for r in reqs]))
-                if feats is not None and getattr(
-                        self.backend, "coalesce_columns", None) is not None:
-                    # every request pre-featurized + a backend that only
-                    # reads id/time columns: skip the merged batch — the
-                    # ingest fast path's zero-rematerialization seam
-                    merged: Any = _ColumnBatch([r.batch for r in reqs])
+            with lease_scope(lease):
+                if len(reqs) == 1:
+                    merged, feats = reqs[0].batch, reqs[0].features
                 else:
-                    from ..pdata.spans import concat_batches
+                    feats = None
+                    if all(r.features is not None for r in reqs):
+                        cats = [r.features.categorical for r in reqs]
+                        conts = [r.features.continuous for r in reqs]
+                        rows = sum(c.shape[0] for c in cats)
+                        feats = SpanFeatures(
+                            np.concatenate(cats, out=_pool_alloc(
+                                (rows, cats[0].shape[1]), cats[0].dtype)),
+                            np.concatenate(conts, out=_pool_alloc(
+                                (rows, conts[0].shape[1]),
+                                conts[0].dtype)))
+                    if feats is not None and getattr(
+                            self.backend, "coalesce_columns",
+                            None) is not None:
+                        # every request pre-featurized + a backend that
+                        # only reads id/time columns: skip the merged
+                        # batch — the ingest fast path's
+                        # zero-rematerialization seam
+                        merged: Any = _ColumnBatch(
+                            [r.batch for r in reqs])
+                    else:
+                        from ..pdata.spans import concat_batches
 
-                    merged = concat_batches([r.batch for r in reqs])
-            dispatch = getattr(self.backend, "dispatch", None)
-            with self._backend_lock:
-                if dispatch is not None:
-                    handle = dispatch(merged, feats)
-                else:
-                    # depth-1 backend: the whole call happens here, eagerly
-                    # — identical to the serial engine (ordering guarantees
-                    # for zscore online updates and the remote sidecar
-                    # deadline)
-                    handle = self.backend.score(merged, feats)
-                # snapshot while still holding the lock: a concurrent
-                # warmup() score would overwrite the last_* fields with
-                # the warmup call's shape before we read them
-                bucket_hit = getattr(self.backend, "last_bucket_hit", None)
-                shape = getattr(self.backend, "last_shape", None)
-                waste = getattr(self.backend, "last_padding_waste", None)
+                        merged = concat_batches([r.batch for r in reqs])
+                dispatch = getattr(self.backend, "dispatch", None)
+                with self._backend_lock:
+                    if dispatch is not None:
+                        handle = dispatch(merged, feats)
+                    else:
+                        # depth-1 backend: the whole call happens here,
+                        # eagerly — identical to the serial engine
+                        # (ordering guarantees for zscore online updates
+                        # and the remote sidecar deadline)
+                        handle = self.backend.score(merged, feats)
+                    # snapshot while still holding the lock: a concurrent
+                    # warmup() score would overwrite the last_* fields
+                    # with the warmup call's shape before we read them
+                    bucket_hit = getattr(self.backend, "last_bucket_hit",
+                                         None)
+                    shape = getattr(self.backend, "last_shape", None)
+                    waste = getattr(self.backend, "last_padding_waste",
+                                    None)
         except Exception:
             meter.add("odigos_anomaly_engine_errors_total")
+            if lease is not None:
+                lease.release()
             for r in reqs:
+                r.release_features()
                 r.scores = None
                 r.signal_done()
             span.set_attr("error", True)
             span.finish(error=True)
             return None
+        # the pack/score call has consumed every request's features
+        # (copied into packed/coalesced tensors or scored outright):
+        # release the callers' featurize buffers NOW, while the scores
+        # are still in flight — holding them to retirement was measured
+        # as the pool's residual steady-state misses (depth jitter)
+        for r in reqs:
+            r.release_features()
         t1 = time.monotonic_ns()
         for r in reqs:
             # expiry blame marker (ISSUE 8): a deadline that dies after
@@ -1144,12 +1206,24 @@ class ScoringEngine:
             n_spans=sum(len(r.batch) for r in reqs),
             t_pack0=t0, t_dispatch=t1,
             overlap_ms=(t1 - t0) / 1e6 if overlapped else 0.0,
-            bucket_hit=bucket_hit, shape=shape, padding_waste=waste)
+            bucket_hit=bucket_hit, shape=shape, padding_waste=waste,
+            lease=lease)
 
     def _retire(self, grp: _InflightGroup) -> None:
         """Harvest stage: block on the oldest in-flight device call, split
         scores per request (FIFO — byte-identical to the serial path), set
         events, and account stage timings."""
+        try:
+            self._retire_inner(grp)
+        finally:
+            # pack buffers recycle only AFTER the blocking harvest fetch
+            # (or its failure path): the device call has fully consumed
+            # its inputs by then, and the harvested scores were scattered
+            # into fresh arrays — nothing pooled escapes the group
+            if grp.lease is not None:
+                grp.lease.release()
+
+    def _retire_inner(self, grp: _InflightGroup) -> None:
         t_h0 = time.monotonic_ns()
         try:
             harvest = getattr(self.backend, "harvest", None)

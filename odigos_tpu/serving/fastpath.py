@@ -42,6 +42,23 @@ shortcut the ROADMAP's "kill the soak tail" item asks for:
   published here and by the engine feed the receiver's pre-decode
   admission gate (wire/server.py) so a storm is shed before decode.
 
+Steady-state zero-allocation + predictive shed (ISSUE 12):
+
+* each submit lane featurizes into its own :class:`BufferPool` lease
+  (features/bufferpool.py) — warmed traffic allocates nothing per
+  frame; the lease is refcounted between the lane and the engine
+  (released via ``on_features_consumed`` the instant the pack/score
+  call copied the tensors out), so buffers recycle while the scores
+  are still in flight;
+* admission consults the PR 8 burn table: an arriving frame is priced
+  (oldest in-flight frame's age + observed stage means through
+  harvest) and one predicted to expire is REJECTED before featurize
+  spends host time on it — named ``queue_full`` with the
+  ``blame=predicted`` dimension, so
+  predictive sheds count beside realized expiries and conservation
+  stays exact. The same prediction publishes as the
+  ``predicted_burn_ms`` watermark for the pre-decode admission gate.
+
 Conservation stays exact under concurrent retirement: spans are
 reserved at intake and released exactly once — in the forwarding
 lane's ``finally``, or as a named ``shutdown_drain`` shed when a
@@ -68,11 +85,13 @@ import numpy as np
 # deliberately no components.api import: the tpuanomaly processor imports
 # this module for the shared tagging helper, so depending on the
 # components package here would be a cycle whichever package loads first
+from ..features.bufferpool import BufferPool, lease_scope, pools_enabled
 from ..features.featurizer import featurize
 from ..hooks.tracecontext import _active
 from ..pdata.spans import SpanBatch
 from ..selftelemetry.flow import FlowContext
-from ..selftelemetry.latency import Stage, claim_clock, latency_ledger
+from ..selftelemetry.latency import (
+    PREDICTED_BLAME, RECENT_WINDOW, Stage, claim_clock, latency_ledger)
 from ..utils.telemetry import labeled_key, meter
 from .engine import PASSTHROUGH_METRIC, ScoringEngine
 from .lanes import SHUTDOWN_BACKSTOP_S, OrderedGate, RetirementLanes
@@ -85,8 +104,24 @@ SPANS_METRIC = "odigos_fastpath_spans_total"
 SATURATED_METRIC = "odigos_fastpath_saturated_total"
 FORWARD_ERRORS_METRIC = "odigos_fastpath_forward_errors_total"
 SUBMIT_ERRORS_METRIC = "odigos_fastpath_submit_errors_total"
+PREDICTED_SHED_METRIC = "odigos_fastpath_predicted_shed_total"
 
 DEFAULT_LANES = 4
+
+# predictive shed (ISSUE 12): the SERVICE stages whose observed means
+# price an arriving frame's marginal cost — featurize through the
+# scores landing (expiry is beaten the instant the engine completes
+# the request, so wait/tag/forward are outside the horizon). The WAIT
+# stages (submit-lane pickup, engine queue) are deliberately absent:
+# the head-age load term already carries the queueing the pipeline is
+# experiencing, and adding the wait means on top double-counts it —
+# measured as shedding deliverable traffic well below the deadline
+PREDICT_STAGES = (Stage.FEATURIZE.value, Stage.ENQUEUE.value,
+                  Stage.PACK.value, Stage.DEVICE.value,
+                  Stage.HARVEST.value)
+# stage-cost recompute throttle: the burn table moves at EWMA speed,
+# the admission decision happens per frame — pricing reads a cached sum
+PREDICT_REFRESH_NS = 100_000_000
 
 # flow-ledger watermark identity prefix: each instance reports as
 # "fastpath/<pipeline>" — two fast-path pipelines must never clobber
@@ -164,6 +199,24 @@ class IngestFastPath:
                        30); past it, unretired frames are shed as
                        named ``shutdown_drain`` drops instead of
                        blocking shutdown on a wedged downstream
+    predictive:        shed frames the burn table predicts will expire
+                       BEFORE featurize spends host time on them
+                       (default true; ISSUE 12). The prediction is the
+                       age of the oldest in-flight frame (the latency
+                       the route is carrying now) plus the observed
+                       per-stage means through harvest; a frame priced
+                       past the deadline is REJECTED at intake with
+                       blame=predicted — the client backs off instead
+                       of the frame expiring inside the pipeline
+    predictive_margin: multiple of the deadline the prediction must
+                       exceed to shed (default 1.0; < 1 sheds earlier)
+    predictive_min_frames: scored frames required before the means are
+                       trusted (default 32 — a cold route never
+                       predicts)
+    pooled:            per-lane buffer pools for the featurize tensors
+                       (default true; the steady state then allocates
+                       nothing per frame). Also globally killable via
+                       ODIGOS_POOL=0
 
     Duck-types the Component lifecycle (name/start/shutdown/health) so
     the graph can manage it, without importing components.api (see the
@@ -189,12 +242,39 @@ class IngestFastPath:
                                                   self.lanes)))
         self.ordered = bool(config.get("ordered", False))
         self.drain_timeout_s = float(config.get("drain_timeout_s", 30.0))
+        self.predictive = bool(config.get("predictive", True))
+        self.predictive_margin = float(config.get("predictive_margin",
+                                                  1.0))
+        # clamped to the recorder's recent-ring capacity: the means are
+        # windowed over the last RECENT_WINDOW scored frames, so a
+        # larger threshold could never be met and would silently
+        # disable the gate a config believes is on
+        self.predictive_min_frames = min(
+            int(config.get("predictive_min_frames", 32)),
+            RECENT_WINDOW)
+        self.pooled = bool(config.get("pooled", True))
         self._feat_cfg = engine.cfg.featurizer
         self._needs_features = getattr(engine.backend, "needs_features",
                                        True)
+        # per-lane buffer pools (ISSUE 12): each submit lane featurizes
+        # into its own pool's recycled buffers — checkouts uncontended,
+        # returns (frame release + engine done, other threads) locked
+        self._pools: Optional[list[BufferPool]] = None
+        if self.pooled and self._needs_features:
+            self._pools = [
+                BufferPool(f"{WATERMARK_PREFIX}/{pipeline}/lane{i}")
+                for i in range(self.submit_lanes)]
         # stage-waterfall aggregation rides per pipeline; the admission
         # deadline is this route's burn budget (ISSUE 8)
         latency_ledger.set_deadline(pipeline, self.deadline_ms)
+        # predictive-shed pricing cache: Σ(observed stage means through
+        # harvest), recomputed at most every PREDICT_REFRESH_NS from the
+        # recorder's burn totals; None until predictive_min_frames
+        # scored frames exist (or when ODIGOS_LATENCY=0 starves the
+        # means — no data, no prediction)
+        self._recorder = latency_ledger.recorder(pipeline)
+        self._stage_cost_ms: Optional[float] = None
+        self._stage_cost_next_ns = 0
         self._lock = threading.Lock()
         # receiver → submit-lane handoff (featurize moves OFF the wire
         # intake thread: ISSUE 9)
@@ -227,6 +307,8 @@ class IngestFastPath:
                                        pipeline=pipeline)
         self._submit_errors_key = labeled_key(SUBMIT_ERRORS_METRIC,
                                               pipeline=pipeline)
+        self._predicted_key = labeled_key(PREDICTED_SHED_METRIC,
+                                          pipeline=pipeline)
 
     # ------------------------------------------------------------ intake
     def consume(self, batch: SpanBatch) -> None:
@@ -258,6 +340,54 @@ class IngestFastPath:
                 # count the unwind as failed (memory_limiter discipline)
                 FlowContext.drop(n, "queue_full", component=self, exc=err)
                 raise err
+            if self.predictive and self._stage_cost_ms is not None \
+                    and self._live:
+                # the in-flight guard (with the windowed means in
+                # stage_means) breaks the starvation latch: an IDLE
+                # route always admits — a shed-everything posture
+                # would otherwise never score another frame, so the
+                # estimate that caused it could never recover
+                # PREDICTIVE shed (ISSUE 12): price this frame's burn
+                # as the age of the OLDEST UNRETIRED frame (the latency
+                # the pipeline is carrying right now — it includes the
+                # engine-side queue that backlog_ms cannot see, and it
+                # saturates at ~deadline exactly when frames start
+                # expiring) plus the observed per-stage means through
+                # harvest. The means alone are survivorship-biased
+                # (only scored frames feed the waterfall), so the head
+                # age is the load term and the means are the marginal
+                # cost. A frame predicted to expire is cheapest to shed
+                # NOW — before featurize spends host time on data the
+                # deadline timer would pass through unscored anyway.
+                # Unlike PR 9's admission gate (where thresholding raw
+                # head age shed while merely WORKING), the comparison
+                # here is against the deadline, which by definition
+                # includes the frame's own processing wall. The shed is
+                # named (queue_full) and blamed (predicted), so
+                # conservation stays exact and the loss is countable
+                # beside realized expiries.
+                now_ns = time.monotonic_ns()
+                head_ms = ((now_ns - self._live[0].t_in_ns) / 1e6
+                           if self._live else 0.0)
+                predicted_ms = head_ms + self._stage_cost_ms
+                if predicted_ms > self.deadline_ms \
+                        * self.predictive_margin:
+                    claim_clock()  # a shed frame's timeline dies here
+                    meter.add(self._predicted_key)
+                    self._refresh_watermarks_locked(now_ns)
+                    err = FastPathSaturated(
+                        f"{self.name}: predicted deadline burn "
+                        f"{predicted_ms:.1f} ms exceeds the "
+                        f"{self.deadline_ms:g} ms budget "
+                        f"(oldest in-flight {head_ms:.1f} ms + "
+                        f"expected stage cost "
+                        f"{self._stage_cost_ms:.1f} ms); receiver "
+                        f"should answer REJECTED")
+                    FlowContext.drop(n, "queue_full", component=self,
+                                     exc=err, blame=PREDICTED_BLAME)
+                    latency_ledger.record_expiry(
+                        self.pipeline, PREDICTED_BLAME, n)
+                    raise err
             # RESERVE inside the check's lock hold: concurrent receiver
             # threads must not all pass the bound at once — the pending
             # window IS the latency budget, so an N-thread overshoot is
@@ -296,20 +426,49 @@ class IngestFastPath:
         WORKING, not backlogged — measured as a 2-3x throughput loss
         exactly when the box slows down. Backlog age is the queue the
         gate can actually drain by shedding. pending_spans remains the
-        memory backstop."""
+        memory backstop. predicted_burn_ms (ISSUE 12) — oldest
+        in-flight age plus the priced stage cost — lets the PRE-DECODE
+        admission gate shed by prediction too: bound it at the
+        deadline in the receiver's ``admission.watermarks`` and a
+        frame that would expire is refused before decode spends a
+        byte on it."""
         FlowContext.watermark(self._wm_component, "pending_spans",
                               self._pending_spans)
-        FlowContext.watermark(
-            self._wm_component, "pending_ms",
-            (now_ns - self._live[0].t_in_ns) / 1e6
-            if self._live else 0.0)
+        pending_ms = ((now_ns - self._live[0].t_in_ns) / 1e6
+                      if self._live else 0.0)
+        FlowContext.watermark(self._wm_component, "pending_ms",
+                              pending_ms)
         FlowContext.watermark(
             self._wm_component, "backlog_ms",
             (now_ns - self._submit_q[0].t_in_ns) / 1e6
             if self._submit_q else 0.0)
+        if self.predictive:
+            self._refresh_stage_cost(now_ns)
+            FlowContext.watermark(
+                self._wm_component, "predicted_burn_ms",
+                pending_ms + (self._stage_cost_ms or 0.0))
+
+    def _refresh_stage_cost(self, now_ns: int) -> None:
+        """Re-price the expected per-frame stage cost from the burn
+        table's means, at most every PREDICT_REFRESH_NS (the means move
+        at EWMA speed; the admission decision reads a cached sum)."""
+        if now_ns < self._stage_cost_next_ns:
+            return
+        self._stage_cost_next_ns = now_ns + PREDICT_REFRESH_NS
+        frames, means = self._recorder.stage_means()
+        if frames < self.predictive_min_frames:
+            # not enough SCORED frames in the window — keep the last
+            # known price rather than going dark: an unscored-heavy
+            # overload (expiry storm) floods the ring with frames the
+            # means skip, and dropping to None would switch the gate
+            # off in exactly the regime it was built for. A never-
+            # priced (cold) route stays None until real data exists.
+            return
+        self._stage_cost_ms = sum(
+            means.get(s, 0.0) for s in PREDICT_STAGES)
 
     # ------------------------------------------------------- submit lane
-    def _submit_run(self, stop: threading.Event) -> None:
+    def _submit_run(self, stop: threading.Event, lane: int = 0) -> None:
         """Featurize + engine submit, off the receiver threads (ISSUE 9:
         featurize was the second-largest deadline burn and serial on
         wire intake — a rejected sender now gets its REJECTED at wire
@@ -322,6 +481,7 @@ class IngestFastPath:
         ``self._stop``): a lane surviving a shutdown→start cycle must
         keep seeing its epoch's SET flag, not run on as an extra
         uncounted lane the operator never sized for."""
+        pool = self._pools[lane] if self._pools is not None else None
         while True:
             with self._lock:
                 if stop.is_set():
@@ -354,10 +514,34 @@ class IngestFastPath:
             # featurize would let frames sit unbounded in _submit_q
             # and still "meet" their deadline
             deadline = frame.t_in_ns + self._deadline_ns
+            # featurize into this lane's buffer pool (ISSUE 12): the
+            # lease holds the frame's feature tensors, refcounted TWICE
+            # when an engine request exists — this lane releases its
+            # own reference the moment submit resolves (nothing on the
+            # retirement side reads features), and the ENGINE releases
+            # the other via on_features_consumed the instant its pack/
+            # score call copied them out. Buffers therefore recycle
+            # while the scores are still in flight — the lifetime that
+            # makes steady-state misses actually reach zero.
+            lease = None
+            if pool is not None and self._needs_features \
+                    and pools_enabled():
+                lease = pool.lease()
+            retained = False
             try:
-                feats = featurize(frame.batch, self._feat_cfg) \
-                    if self._needs_features else None
+                feats = None
+                if self._needs_features:
+                    # lease_scope(None) is an explicit plain-numpy
+                    # scope, so one call site covers pooled and not
+                    with lease_scope(lease):
+                        feats = featurize(frame.batch, self._feat_cfg)
                 clock.stamp(Stage.FEATURIZE)
+                if lease is not None:
+                    # the engine's reference, taken BEFORE submit: the
+                    # worker can consume the request (and fire the
+                    # hook) before submit even returns
+                    lease.retain()
+                    retained = True
                 # req None = engine queue full / draining: the engine
                 # already counted the shed request; the frame still
                 # forwards unscored (lossless pass-through, exactly the
@@ -366,7 +550,14 @@ class IngestFastPath:
                 # scores land, replacing the old done.wait() poll.
                 req = self.engine.submit(
                     frame.batch, feats, deadline_ns=deadline,
-                    on_done=lambda r, f=frame: self._completed(f, r))
+                    on_done=lambda r, f=frame: self._completed(f, r),
+                    on_features_consumed=lease.release
+                    if lease is not None else None)
+                if req is None and lease is not None:
+                    # no request was enqueued: the engine will never
+                    # fire the features-consumed hook
+                    lease.release()
+                    retained = False
                 clock.stamp(Stage.ENQUEUE)
             except Exception:  # noqa: BLE001 — a frame must never kill the lane
                 # featurize/submit failure: lossless unscored
@@ -374,6 +565,16 @@ class IngestFastPath:
                 # wire; dropping it here would leak conservation)
                 meter.add(self._submit_errors_key)
                 req = None
+                if retained:
+                    # submit raised before enqueueing: the engine
+                    # contract (hooks fire iff submit returned a
+                    # request) says nobody else will release this
+                    lease.release()
+            finally:
+                if lease is not None:
+                    # the lane's own reference: featurize is done and
+                    # the retirement side never touches features
+                    lease.release()
             with self._lock:
                 if frame.req is None:
                     # the early-completion callback may have attached
@@ -623,6 +824,25 @@ class IngestFastPath:
         with self._lock:
             return self._pending_spans
 
+    def pool_stats(self) -> Optional[dict[str, Any]]:
+        """Aggregated buffer-pool evidence (soak/bench records): total
+        checkouts, misses (fresh allocations — the steady-state ≈0
+        claim), and retained bytes across the submit-lane pools."""
+        if self._pools is None:
+            return None
+        agg = {"pools": len(self._pools), "hits": 0, "misses": 0,
+               "dropped": 0, "leases": 0, "outstanding_leases": 0,
+               "bytes_held": 0, "free_buffers": 0}
+        for p in self._pools:
+            s = p.stats()
+            for k in ("hits", "misses", "dropped", "leases",
+                      "outstanding_leases", "bytes_held",
+                      "free_buffers"):
+                agg[k] += s[k]
+        total = agg["hits"] + agg["misses"]
+        agg["hit_rate"] = round(agg["hits"] / total, 4) if total else 0.0
+        return agg
+
     # --------------------------------------------------------- lifecycle
     def healthy(self) -> bool:
         return True
@@ -663,7 +883,7 @@ class IngestFastPath:
             self._retire_lanes.start()
             self._submit_threads = [
                 threading.Thread(
-                    target=self._submit_run, args=(self._stop,),
+                    target=self._submit_run, args=(self._stop, i),
                     daemon=True,
                     name=f"fastpath-submit-{self.pipeline}-{i}")
                 for i in range(self.submit_lanes)]

@@ -212,9 +212,15 @@ class WireReceiver(Receiver):
         meter.add(keys[1], nbytes)
         # pre-decode shed: the span count is unknowable (nothing was
         # decoded), so the ledger names the loss in FRAMES — same
-        # discipline as malformed-frame accounting
+        # discipline as malformed-frame accounting. A shed steered by
+        # the fast path's predicted_burn_ms watermark carries the
+        # blame=predicted dimension (ISSUE 12): the frame was refused
+        # because it was PRICED to expire, not because a queue was full
         FlowContext.drop(1, reason, pipeline="(ingress)",
-                         component_name=self.name, signal="frames")
+                         component_name=self.name, signal="frames",
+                         blame="predicted"
+                         if detail.endswith(":predicted_burn_ms")
+                         else None)
 
     def start(self) -> None:
         super().start()

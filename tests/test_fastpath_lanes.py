@@ -461,10 +461,10 @@ class TestDeadlineAnchor:
         orig_submit = engine.submit
 
         def recording_submit(batch, features=None, deadline_ns=None,
-                             on_done=None):
+                             on_done=None, **kw):
             captured["deadline_ns"] = deadline_ns
             return orig_submit(batch, features, deadline_ns=deadline_ns,
-                               on_done=on_done)
+                               on_done=on_done, **kw)
 
         engine.submit = recording_submit
         orig_featurize = fp_mod.featurize

@@ -290,6 +290,82 @@ class TestAdmission:
         finally:
             collector.shutdown()
 
+    def test_predicted_burn_watermark_sheds_predecode_with_blame(self):
+        """Predictive shed at the SOCKET (ISSUE 12): bound the fast
+        path's predicted_burn_ms watermark at the deadline and a frame
+        priced to expire is REJECTED before decode — the ledger names
+        it with the blame=predicted dimension."""
+        flow_ledger.reset()
+        meter.reset()
+        recv_cfg = {"admission": {
+            "watermarks": {"fastpath/traces/in":
+                           {"predicted_burn_ms": 25.0}},
+            "refresh_ms": 0.0}}
+        collector = Collector(
+            soak_config(fast_path=True, receiver_cfg=recv_cfg)).start()
+        try:
+            port = collector.graph.receivers["otlpwire"].port
+            b = synthesize_traces(4, seed=3)
+            sink = collector.graph.exporters["tracedb"]
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            # healthy prediction: admitted
+            flow_ledger.watermark("fastpath/traces/in",
+                                  "predicted_burn_ms", 3.0)
+            s.sendall(frame(b))
+            assert s.recv(1) == b"\x00"
+            assert wait_for(lambda: sink.span_count == len(b))
+            # priced past the budget: REJECTED pre-decode
+            flow_ledger.watermark("fastpath/traces/in",
+                                  "predicted_burn_ms", 80.0)
+            s.sendall(frame(b))
+            assert s.recv(1) == REJECTED
+            s.close()
+            key = ("odigos_admission_rejected_frames_total"
+                   "{receiver=otlpwire,"
+                   "reason=fastpath/traces/in:predicted_burn_ms}")
+            assert meter.counter(key) == 1
+            blamed = [k for k in meter.snapshot()
+                      if k.startswith("odigos_flow_dropped_items_total")
+                      and "blame=predicted" in k]
+            assert blamed, "pre-decode predictive shed lost its blame"
+        finally:
+            collector.shutdown()
+
+    def test_fastpath_publishes_predicted_burn_watermark(self):
+        """A live fast path keeps the predicted_burn_ms watermark
+        current (backlog + priced stage cost) once means exist."""
+        flow_ledger.reset()
+        latency_ledger = __import__(
+            "odigos_tpu.selftelemetry.latency",
+            fromlist=["latency_ledger"]).latency_ledger
+        latency_ledger.reset()
+        eng = ScoringEngine(EngineConfig(model="mock")).start()
+
+        class Sink:
+            def consume(self, b):
+                pass
+
+        fp = IngestFastPath("traces/pb", eng, 0.6, Sink(),
+                            {"deadline_ms": 100.0,
+                             "predictive_min_frames": 1})
+        fp.start()
+        try:
+            for s in range(3):
+                fp.consume(synthesize_traces(4, seed=s))
+            assert wait_for(lambda: fp.flow_pending() == 0)
+            # force a re-price on the next refresh, then traffic
+            fp._stage_cost_next_ns = 0
+            fp.consume(synthesize_traces(4, seed=9))
+            assert wait_for(lambda: fp.flow_pending() == 0)
+            wm = flow_ledger.watermark_current("fastpath/traces/pb",
+                                               "predicted_burn_ms")
+            assert wm is not None and wm >= 0.0
+            assert fp._stage_cost_ms is not None and \
+                fp._stage_cost_ms > 0.0
+        finally:
+            fp.shutdown()
+            eng.shutdown()
+
     def test_gate_maps_byte_watermarks_to_memory_limited(self):
         flow_ledger.reset()
         gate = WatermarkGate({"memory_limiter": {"inflight_bytes": 100}},

@@ -418,6 +418,97 @@ class TestFastPathHygiene:
             "BucketLadder rungs — adaptive batches would pay recompiles")
 
 
+class TestSteadyStateAllocHygiene:
+    """Zero-allocation steady state (ISSUE 12): the featurize/pack
+    kernels and the fast path may not call ``np.zeros``/``np.empty``/
+    ``np.full`` directly — every per-frame tensor goes through
+    ``bufferpool.alloc`` so a leased frame recycles pinned buffers
+    instead of paying the allocator. Cold/setup paths that OUTLIVE a
+    frame (memoized hash/slot tables, the pool's own backing
+    allocation) are allowlisted with a justification: a lease must
+    never own an array that survives it.
+    """
+
+    MODULES = ("features/featurizer.py", "features/bufferpool.py",
+               "serving/fastpath.py", "serving/lanes.py")
+    ALLOC_FNS = {"zeros", "empty", "full"}
+    ALLOWLIST = {
+        ("features/featurizer.py", "_hash_table"):
+            "value-keyed LRU memo: the frozen table outlives any frame",
+        ("features/featurizer.py", "_attr_slot_matrix"):
+            "memoized on the immutable attr store (lives with the "
+            "batch, not the lease); frozen before caching",
+        ("features/bufferpool.py", "_fresh"):
+            "the pool's ONE backing allocation site (a counted miss)",
+        ("features/bufferpool.py", "_plain"):
+            "the explicit no-lease fallback (training/tools/cold "
+            "paths; counted as fallback_allocs)",
+    }
+
+    def _direct_allocs(self, tree):
+        """(enclosing function name, lineno) of every direct np
+        zeros/empty/full call, tracked via a function-def stack."""
+        out = []
+
+        def walk(node, fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = node.name
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.ALLOC_FNS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "np"):
+                out.append((fn, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, fn)
+
+        walk(tree, "<module>")
+        return out
+
+    def test_no_direct_np_alloc_in_steady_state_kernels(self):
+        problems = []
+        for rel in self.MODULES:
+            path = os.path.join(PKG_ROOT, rel)
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+            for fn, lineno in self._direct_allocs(tree):
+                if (rel, fn) in self.ALLOWLIST:
+                    continue
+                problems.append(
+                    f"{rel}:{lineno}: np.{{zeros,empty,full}} in "
+                    f"{fn}() — route it through bufferpool.alloc or "
+                    f"allowlist with a justification")
+        assert not problems, (
+            "direct numpy allocation on a steady-state kernel — the "
+            "zero-allocation hot path (ISSUE 12) leaks per-frame "
+            "mallocs:\n  " + "\n  ".join(problems))
+
+    def test_allowlisted_sites_still_allocate(self):
+        """Stale-allowlist oracle: every allowlisted function still
+        exists AND still contains a direct allocation — a rewritten
+        kernel must shed its stale exemption."""
+        by_file: dict = {}
+        for (rel, fn), _why in self.ALLOWLIST.items():
+            by_file.setdefault(rel, set()).add(fn)
+        for rel, fns in by_file.items():
+            path = os.path.join(PKG_ROOT, rel)
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+            present = {fn for fn, _ in self._direct_allocs(tree)}
+            stale = fns - present
+            assert not stale, (
+                f"{rel}: allowlisted functions {sorted(stale)} no "
+                f"longer allocate directly — drop the exemption")
+
+    def test_kernels_import_the_pool_allocator(self):
+        """featurizer.py must actually route through bufferpool.alloc
+        (the lint above only proves absence; this proves presence)."""
+        path = os.path.join(PKG_ROOT, "features", "featurizer.py")
+        with open(path) as f:
+            src = f.read()
+        assert "from .bufferpool import alloc" in src
+
+
 class TestLatencyStageHygiene:
     """Latency-attribution lint (ISSUE 8 satellite): every ``Stage``
     enum member is stamped exactly once per frame on the fast path.

@@ -178,10 +178,12 @@ class TestAnomalyStage:
             [jaeger()], options=self.anomaly_opts(fast_path=True,
                                                   timeout_ms=25.0))
         root = cfg["service"]["pipelines"]["traces/in"]
-        # lanes/ordered (ISSUE 9): the completion-driven retirement
-        # knobs render alongside the deadline
+        # lanes/ordered (ISSUE 9) + predictive (ISSUE 12): the
+        # retirement and predictive-shed knobs render alongside the
+        # deadline
         assert root["fast_path"] == {"deadline_ms": 25.0, "lanes": 4,
-                                     "ordered": False}
+                                     "ordered": False,
+                                     "predictive": True}
         from odigos_tpu.pipeline.graph import build_graph
 
         g = build_graph(cfg)

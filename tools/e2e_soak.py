@@ -29,6 +29,17 @@ per-span work on the hot path).
     python tools/e2e_soak.py [--seconds 20] [--senders 4]
                              [--no-fast-path] [--ab]
                              [--pace-spans-per-sec 255000]
+                             [--find-knee]
+
+``--find-knee`` (ISSUE 12) sweeps offered load with short paced probes
+to locate the throughput knee (highest level carried essentially
+losslessly — delivered ≥ ``--knee-delivery``, default 98%, of
+offered), then records the full run AT the knee — "saturated" becomes
+a measured operating point, not an arbitrary number. SOAK.json embeds
+``knee_spans_per_sec``, the sweep table, ``p99_over_p50`` (acceptance:
+≤ 3 for the fast path at the knee), and a ``steady_state`` section
+(buffer-pool miss rate ≈ 0 allocs/frame, GC pause accounting,
+predictive-shed tally).
 
 ``--ab`` runs BOTH routes back to back (fast path first) and embeds the
 componentwise summary in the record as ``componentwise_baseline`` — the
@@ -125,13 +136,16 @@ def run_soak(args, fast_path: bool) -> dict:
         # completion-driven multi-lane retirement (ISSUE 9): N lanes
         # overlap tag/forward of independent frames; unordered by
         # default (the soak's consumers are order-insensitive), so the
-        # old single-forwarder wait head-of-line is gone entirely
+        # old single-forwarder wait head-of-line is gone entirely.
+        # predictive (ISSUE 12): frames priced past the deadline are
+        # shed at intake (blame=predicted) instead of expiring inside
         pipeline_in["fast_path"] = {
             "deadline_ms": args.deadline_ms,
             "max_pending_spans": args.max_pending_spans,
             "lanes": args.lanes,
             "submit_lanes": args.submit_lanes or args.lanes,
-            "ordered": bool(args.ordered)}
+            "ordered": bool(args.ordered),
+            "predictive": not args.no_predictive}
         # declarative SLO (ISSUE 8): evaluated live during the soak with
         # fast/slow-window burn rates; the verdict lands in SOAK.json so
         # every soak run is self-judging, not just self-attributing.
@@ -175,13 +189,18 @@ def run_soak(args, fast_path: bool) -> dict:
                 # queue into named REJECTEDs at the socket
                 f"engine/{args.model}": {
                     "queue_depth": args.engine_queue_depth},
-                "fastpath/traces/in": {
-                    "backlog_ms": args.backlog_ms,
-                    # gate at 3/4 of the hard bound: the watermark sheds
-                    # at the socket BEFORE consume() hits the
-                    # FastPathSaturated wall (frame-size granularity
-                    # means the wall is crossed mid-burst otherwise)
-                    "pending_spans": args.max_pending_spans * 3 // 4},
+                "fastpath/traces/in": dict(
+                    {"backlog_ms": args.backlog_ms,
+                     # gate at 3/4 of the hard bound: the watermark
+                     # sheds at the socket BEFORE consume() hits the
+                     # FastPathSaturated wall (frame-size granularity
+                     # means the wall is crossed mid-burst otherwise)
+                     "pending_spans": args.max_pending_spans * 3 // 4},
+                    # predictive shed pre-decode (ISSUE 12): a frame
+                    # the burn table prices past the deadline is
+                    # REJECTED before decode spends a byte on it
+                    **({} if args.no_predictive else
+                       {"predicted_burn_ms": args.deadline_ms})),
                 "traces/in/memory_limiter": {"inflight_bytes": 400e6},
                 "traces/in/batch": {"pending_spans": 48 * 1024},
             }, "refresh_ms": 2.0},
@@ -198,6 +217,13 @@ def run_soak(args, fast_path: bool) -> dict:
         "exporters": {"tracedb/anomaly": {}, "tracedb/normal": {}},
         "service": {
             "alerts": [dict(a) for a in SOAK_ALERTS],
+            # GC isolation (ISSUE 12), BOTH arms (the A/B compares the
+            # paths, not the GC posture): the paced janitor owns gen-0/1
+            # sweeps, thresholds absorb per-frame churn, and freeze
+            # pins the engine/ladder graph after warmup so collections
+            # never rescan the model
+            "gc": {"janitor_interval_s": 0.2, "freeze": True,
+                   "thresholds": [150_000, 30, 30]},
             "pipelines": {
                 "traces/in": pipeline_in,
                 "traces/anomaly": {"receivers": ["anomalyrouter"],
@@ -208,11 +234,13 @@ def run_soak(args, fast_path: bool) -> dict:
     }
 
     from odigos_tpu.selftelemetry.fleet import fleet_plane
+    from odigos_tpu.serving.gcisolation import gc_plane
 
     flow_ledger.reset()
     meter.reset()
     latency_ledger.reset()
     fleet_plane.reset()
+    gc_plane.reset_stats()
     collector = Collector(cfg).start()
     port = collector.graph.receivers["otlpwire"].port
 
@@ -446,6 +474,25 @@ def run_soak(args, fast_path: bool) -> dict:
     # live graph) so every soak run is self-attributing
     stage_waterfall = latency_ledger.waterfall()
     burn_tables = latency_ledger.burn()
+    # frame-weighted IN-PIPELINE e2e percentiles (acceptance→forward,
+    # every frame, thousands of samples) beside the probe's wire-level
+    # view: the ~200-sample probe p99 on a shared CI box is decided by
+    # 2-3 scheduler-stall/retry-ladder outliers, while this histogram
+    # measures exactly the path the steady-state work changed
+    pipeline_e2e = None
+    if fast_path:
+        e2e_key = labeled_key("odigos_latency_e2e_ms",
+                              pipeline="traces/in")
+        p50 = meter.quantile(e2e_key, 0.50)
+        if p50:
+            p99 = meter.quantile(e2e_key, 0.99)
+            pipeline_e2e = {
+                "p50_ms": round(p50, 2),
+                "p95_ms": round(meter.quantile(e2e_key, 0.95), 2),
+                "p99_ms": round(p99, 2),
+                "frames": latency_ledger.recorder("traces/in").frames,
+                "p99_over_p50": round(p99 / p50, 2),
+            }
     slo_verdicts = latency_ledger.slo_status()
     slo_conditions = [c for c in collector.health_conditions()
                      if c["component"].startswith("slo/")]
@@ -454,6 +501,39 @@ def run_soak(args, fast_path: bool) -> dict:
     # shutdown: per-collector health, worst-of per group, every rule's
     # final state, the full fired/cleared transition history, and any
     # sizing recommendations the run's gauges triggered
+    # steady-state memory evidence (ISSUE 12), read BEFORE shutdown:
+    # buffer-pool miss rate (the allocations-per-frame ≈ 0 claim under
+    # real wire load), GC pause accounting (the "pauses left the
+    # waterfall" claim), and the predictive-shed tally
+    pool_agg = None
+    engine_pool = None
+    if fast_path:
+        fp_route = collector.graph.fastpaths.get("traces/in")
+        if fp_route is not None:
+            pool_agg = fp_route.pool_stats()
+            # the engine's pack-stage pool misses count toward the same
+            # allocs-per-frame claim (bench.py's steady_state_allocs
+            # sums both) — omitting them would let a pack-pool
+            # regression hide behind a clean lane-pool number
+            engine_pool = fp_route.engine.pack_pool_stats()
+    gc_stats = gc_plane.stats()
+    predicted_spans = sum(
+        int(v) for k, v in meter.snapshot().items()
+        if k.startswith("odigos_latency_deadline_expired_spans_total")
+        and "blame=predicted" in k)
+    steady_state = {
+        "gc": gc_stats,
+        "predicted_shed_spans": predicted_spans,
+    }
+    if pool_agg is not None:
+        steady_state["buffer_pools"] = pool_agg
+        steady_state["engine_pack_pool"] = engine_pool
+        steady_state["allocs_per_frame"] = round(
+            (pool_agg["misses"]
+             + (engine_pool["misses"] if engine_pool else 0))
+            / pool_agg["leases"], 4) \
+            if pool_agg["leases"] else None
+
     fleet_snap = fleet_plane.api_snapshot()
     fleet_summary = {
         "collectors": [
@@ -535,6 +615,17 @@ def run_soak(args, fast_path: bool) -> dict:
                            if len(lat_ms) else None),
         "latency_p99_ms": (round(float(np.percentile(lat_ms, 99)), 2)
                            if len(lat_ms) else None),
+        # the tail-vs-median verdict (ISSUE 12 acceptance: ≤ 3 at the
+        # measured knee for the fast path; evaluate on pipeline_e2e —
+        # frame-weighted over every frame — with the probe ratio as
+        # the wire-level witness)
+        "p99_over_p50": (round(
+            float(np.percentile(lat_ms, 99))
+            / max(float(np.percentile(lat_ms, 50)), 1e-9), 2)
+            if len(lat_ms) else None),
+        "pipeline_e2e_ms": pipeline_e2e,
+        # zero-allocation + GC-isolation evidence (ISSUE 12)
+        "steady_state": steady_state,
         "latency_note": ("probe batches ride the same wire/pipeline as "
                          "the load; p* = send-to-export wall time under "
                          f"full multi-sender soak load, CPU {args.model} "
@@ -604,6 +695,34 @@ def main() -> None:
     ap.add_argument("--slo-p99-ms", type=float, default=1000.0,
                     help="declared latency_p99_ms SLO objective for the "
                          "fast-path pipeline (burn verdict in SOAK.json)")
+    ap.add_argument("--no-predictive", action="store_true",
+                    help="disable predictive deadline-burn shed "
+                         "(ISSUE 12): frames priced past the deadline "
+                         "are otherwise REJECTED at intake/pre-decode "
+                         "with blame=predicted")
+    ap.add_argument("--find-knee", action="store_true",
+                    help="sweep offered load (short paced probes) to "
+                         "locate the throughput knee, then record the "
+                         "full run AT the knee (sets "
+                         "--pace-spans-per-sec); SOAK.json embeds "
+                         "knee_spans_per_sec + the sweep table")
+    ap.add_argument("--knee-start", type=float, default=60_000.0,
+                    help="first offered load of the knee sweep")
+    ap.add_argument("--knee-factor", type=float, default=1.3,
+                    help="geometric step between sweep levels")
+    ap.add_argument("--knee-max", type=float, default=600_000.0,
+                    help="sweep ceiling")
+    ap.add_argument("--knee-seconds", type=float, default=5.0,
+                    help="probe duration per sweep level")
+    ap.add_argument("--knee-delivery", type=float, default=0.98,
+                    help="min delivered/offered fraction that still "
+                         "counts as below the knee; the knee is the "
+                         "highest level the pipeline carries "
+                         "essentially losslessly (2% shed = the knee "
+                         "is behind you — a looser bound lands the "
+                         "'knee' deep in the overload regime where "
+                         "tails are governed by shed policy, not by "
+                         "the path)")
     ap.add_argument("--model", default="zscore",
                     choices=["zscore", "transformer"],
                     help="scoring backend for the soak route")
@@ -618,14 +737,78 @@ def main() -> None:
         # than refusing
         ap.error("--mesh requires --model transformer")
 
+    knee = None
+    knee_sweep = []
+    if args.find_knee:
+        # sweep offered load upward with short paced probes until
+        # delivery degrades: the knee is the highest level the fast
+        # path still carries at >= knee_delivery of offered. The full
+        # (A/B) record then runs AT that level — "saturated" means the
+        # measured knee, not an arbitrary big number.
+        import copy
+
+        level = args.knee_start
+        bend = None  # first level where delivery measurably degrades
+        while level <= args.knee_max:
+            probe_args = copy.copy(args)
+            probe_args.seconds = args.knee_seconds
+            probe_args.pace_spans_per_sec = level
+            probe = run_soak(probe_args,
+                             fast_path=not args.no_fast_path)
+            ratio = probe["value"] / level
+            knee_sweep.append({
+                "offered_spans_per_sec": level,
+                "delivered_spans_per_sec": probe["value"],
+                "delivery_ratio": round(ratio, 4),
+                "latency_p50_ms": probe["latency_p50_ms"],
+                "latency_p99_ms": probe["latency_p99_ms"],
+                "p99_over_p50": probe["p99_over_p50"],
+            })
+            print(f"knee probe: {level:,.0f} offered -> "
+                  f"{probe['value']:,.0f} delivered "
+                  f"(ratio {ratio:.3f}, p99/p50 "
+                  f"{probe['p99_over_p50']})", file=sys.stderr)
+            if ratio < args.knee_delivery:
+                bend = level
+                break
+            knee = level
+            level = level * args.knee_factor
+        if knee is None:
+            # even the first level shed: record there anyway — the
+            # sweep table says so honestly
+            knee = args.knee_start
+        # the saturated record runs AT THE BEND — between the last
+        # lossless level and the first degraded one (geometric
+        # midpoint). Recording at the last lossless level measures the
+        # below-knee regime (tiny standing queue, transit-dominated
+        # p50), which says nothing about saturation tails; recording
+        # at the first degraded level overshoots into deep overload
+        # where the probe measures its own REJECTED-retry ladder. The
+        # midpoint is mild saturation — the operating point "at the
+        # knee" — by construction.
+        args.pace_spans_per_sec = (knee * bend) ** 0.5 \
+            if bend is not None else knee
+
     result = run_soak(args, fast_path=not args.no_fast_path)
+    if knee is not None:
+        result["knee_spans_per_sec"] = knee
+        result["knee_sweep"] = knee_sweep
+        result["knee_note"] = (
+            "knee = highest offered load the fast path delivered at "
+            f">= {args.knee_delivery:.0%} (geometric sweep, "
+            f"{args.knee_seconds:.0f}s paced probes); the main record "
+            "ran at the BEND — the geometric midpoint of the last "
+            "lossless and first degraded sweep levels — because "
+            "saturation tails only exist on the saturated side, while "
+            "deep overload would measure the probe's own retry ladder")
     if args.ab and not args.no_fast_path:
         base = run_soak(args, fast_path=False)
         result["componentwise_baseline"] = {
             k: base[k] for k in (
                 "value", "senders", "offered_spans_per_sec",
                 "spans_sent", "spans_received", "conservation",
-                "latency_p50_ms", "latency_p95_ms", "latency_p99_ms")}
+                "latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+                "p99_over_p50")}
     import multiprocessing
 
     result["hardware_note"] = (
