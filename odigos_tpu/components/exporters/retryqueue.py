@@ -1,0 +1,402 @@
+"""Export retry/spill queue — bounded jittered-backoff around exporters.
+
+A destination outage before this module was a raise per batch: the
+branch/``__output__`` edges counted the failure and the spans were gone
+— no retry, no buffer, no degradation rung between "destination
+hiccuped" and "data lost" (the reference ships sending-queue +
+retry-on-failure on every exporter; SURVEY §2.3). :class:`RetryQueue`
+is that rung, built to this repo's accounting discipline:
+
+* a **direct fast path**: while the spill queue is empty the batch goes
+  straight through — the wrapper adds one lock acquisition to a healthy
+  exporter;
+* on failure the batch **spills** into a bounded FIFO queue (bounded in
+  SPANS — the latency/memory budget, same denomination as every other
+  queue here) and a retry thread replays it with **jittered exponential
+  backoff** (full-jitter over [1-jitter, 1]× the ladder, the PR 9
+  stampede lesson: deterministic backoff re-synchronizes recovery
+  storms). Arrivals while the queue is non-empty enqueue behind it, so
+  the destination sees the original byte order;
+* every terminal loss is a **named drop from the closed taxonomy** —
+  an arrival overflowing the bound is ``queue_full``, a shutdown that
+  cannot flush in ``drain_timeout_s`` sheds the leftovers as
+  ``shutdown_drain`` — recorded via ``FlowContext.drop`` under the
+  ``retry/<exporter>`` component, so the chaos oracle's "no silent
+  loss" assertion covers the export edge too. (The queue sits OUTSIDE
+  the pipeline conservation boundary: a spilled batch already crossed
+  ``__output__``; the wrapper's own ledger is
+  sent == delivered + dropped(named) + pending.)
+* the queue depth is **watermarked into admission** like every other
+  queue: ``retry/<exporter>:pending_spans`` via ``FlowContext.
+  watermark``, so a receiver's ``admission.watermarks`` stanza can shed
+  at the socket while a destination is down instead of spilling without
+  bound;
+* while the queue is non-empty the wrapper's condition is
+  ``Degraded(ExportRetrying)`` through the standard ``health()`` hook —
+  it clears the moment the backlog drains (the chaos round-trip
+  oracle), and ``healthy()`` stays True so the healthcheck contract
+  (200 unless Unhealthy) is untouched.
+
+Wiring: ``pipeline/graph.build_graph`` wraps any exporter whose config
+carries a ``retry:`` mapping (validated by ``graph.validate_config``);
+pipelinegen renders it onto every destination exporter when
+``collector_gateway.export_retry`` is set. The wrapper duck-types the
+Exporter surface and delegates unknown attributes to the wrapped
+exporter, so queryable test doubles (tracedb) keep their query API.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from ...selftelemetry.flow import FlowContext
+from ...utils.telemetry import labeled_key, meter
+
+RETRY_ATTEMPTS_METRIC = "odigos_export_retry_attempts_total"
+RETRY_SPILLED_METRIC = "odigos_export_retry_spilled_spans_total"
+RETRY_DELIVERED_METRIC = "odigos_export_retry_delivered_spans_total"
+RETRY_DROPPED_METRIC = "odigos_export_retry_dropped_spans_total"
+RETRY_QUEUE_GAUGE = "odigos_export_retry_queue_spans"
+
+# config keys + defaults (validated in graph.validate_config: a typo'd
+# retry stanza dies at load, never silently ships without its queue)
+DEFAULTS = {
+    "initial_backoff_ms": 50.0,
+    "max_backoff_ms": 5000.0,
+    "jitter": 0.5,            # full-jitter fraction, clamped [0, 0.9]
+    "max_queue_spans": 65536,  # spill bound (spans)
+    "drain_timeout_s": 5.0,    # shutdown flush budget
+}
+KNOWN_KEYS = frozenset(DEFAULTS) | {"enabled", "seed"}
+
+# watermark identity prefix — the admission-gate key is
+# "retry/<exporter>" with queue "pending_spans"
+WATERMARK_PREFIX = "retry"
+
+
+def validate_retry_config(eid: str, spec: Any) -> list[str]:
+    """Static validation of one exporter's ``retry:`` stanza (the
+    graph.validate_config contract; empty list = valid). ``true`` and
+    ``{}`` are both the all-defaults spelling."""
+    if spec is True:
+        return []
+    if not isinstance(spec, dict):
+        return [f"exporter {eid}: retry must be a mapping or true, "
+                f"got {type(spec).__name__}"]
+    problems = []
+    unknown = sorted(set(spec) - KNOWN_KEYS)
+    if unknown:
+        problems.append(f"exporter {eid}: unknown retry keys {unknown} "
+                        f"(known: {sorted(KNOWN_KEYS)})")
+    for key in ("initial_backoff_ms", "max_backoff_ms",
+                "drain_timeout_s"):
+        v = spec.get(key)
+        if v is not None and (isinstance(v, bool)
+                              or not isinstance(v, (int, float))
+                              or v <= 0):
+            problems.append(
+                f"exporter {eid}: retry.{key} must be a positive number")
+    j = spec.get("jitter")
+    if j is not None and (isinstance(j, bool)
+                          or not isinstance(j, (int, float))
+                          or not 0.0 <= j <= 0.9):
+        # >= 1.0 would draw zero sleeps — the re-synchronized stampede
+        # the jitter exists to prevent (wire/client.py lesson)
+        problems.append(f"exporter {eid}: retry.jitter must be in "
+                        f"[0, 0.9]")
+    q = spec.get("max_queue_spans")
+    if q is not None and (isinstance(q, bool) or not isinstance(q, int)
+                          or q < 1):
+        problems.append(f"exporter {eid}: retry.max_queue_spans must "
+                        f"be a positive integer")
+    return problems
+
+
+class RetryQueue:
+    """Exporter wrapper: direct export while healthy, bounded spill +
+    jittered-backoff replay while the destination is down. Duck-types
+    the Exporter lifecycle; unknown attributes delegate to ``inner``."""
+
+    def __init__(self, inner: Any, config: Any = None):
+        spec = dict(config) if isinstance(config, dict) else {}
+        self.inner = inner
+        self.name = inner.name
+        self.initial_backoff_s = float(
+            spec.get("initial_backoff_ms",
+                     DEFAULTS["initial_backoff_ms"])) / 1e3
+        self.max_backoff_s = float(
+            spec.get("max_backoff_ms", DEFAULTS["max_backoff_ms"])) / 1e3
+        self.jitter = min(max(float(spec.get("jitter",
+                                             DEFAULTS["jitter"])), 0.0),
+                          0.9)
+        self.max_queue_spans = int(spec.get("max_queue_spans",
+                                            DEFAULTS["max_queue_spans"]))
+        self.drain_timeout_s = float(
+            spec.get("drain_timeout_s", DEFAULTS["drain_timeout_s"]))
+        # seedable jitter: chaos scenarios run deterministic injections
+        # (--chaos-seed), so the backoff draw must be seedable too
+        self._rng = random.Random(spec.get("seed"))
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._drained = threading.Condition(self._lock)
+        self._q: deque = deque()
+        self._pending_spans = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serializes inner.consume between the direct path and the
+        # retry thread — destination order is part of the contract
+        self._export_lock = threading.Lock()
+        self.spilled_spans = 0
+        self.delivered_spans = 0
+        self.dropped_spans = 0
+        self.retries = 0
+        self._wm = f"{WATERMARK_PREFIX}/{self.name}"
+        self._attempts_key = labeled_key(RETRY_ATTEMPTS_METRIC,
+                                         exporter=self.name)
+        self._spilled_key = labeled_key(RETRY_SPILLED_METRIC,
+                                        exporter=self.name)
+        self._delivered_key = labeled_key(RETRY_DELIVERED_METRIC,
+                                          exporter=self.name)
+        self._gauge_key = labeled_key(RETRY_QUEUE_GAUGE,
+                                      exporter=self.name)
+
+    # ----------------------------------------------------------- pipeline
+
+    def consume(self, batch: Any) -> None:
+        n = len(batch)
+        with self._lock:
+            queued = bool(self._q)
+        if not queued:
+            with self._export_lock:
+                meter.add(self._attempts_key)
+                try:
+                    self.inner.consume(batch)
+                except Exception:  # noqa: BLE001 — spill, never propagate
+                    pass
+                else:
+                    # counters mutate under _lock EVERYWHERE (direct
+                    # path, retry thread, shutdown drain): += is not
+                    # atomic, and a lost update here skews the exact
+                    # sent == delivered + dropped + pending ledger the
+                    # chaos verdict reads
+                    with self._lock:
+                        self.delivered_spans += n
+                    meter.add(self._delivered_key, n)
+                    return
+        self._enqueue(batch, n)
+
+    # Exporter protocol symmetry (direct export() callers in tests)
+    export = consume
+
+    def _enqueue(self, batch: Any, n: int) -> None:
+        with self._lock:
+            if self._pending_spans + n > self.max_queue_spans:
+                # terminal, NAMED: the spill queue is full — the closed
+                # taxonomy's queue_full, attributed to retry/<exporter>
+                # outside the pipeline conservation boundary (the batch
+                # already crossed __output__)
+                self.dropped_spans += n
+                meter.add(labeled_key(RETRY_DROPPED_METRIC,
+                                      exporter=self.name,
+                                      reason="queue_full"), n)
+                FlowContext.drop(n, "queue_full", pipeline="(export)",
+                                 component_name=self._wm)
+                return
+            self._q.append(batch)
+            self._pending_spans += n
+            self.spilled_spans += n
+            meter.add(self._spilled_key, n)
+            self._publish_depth_locked()
+            self._work.notify()
+
+    def _publish_depth_locked(self) -> None:
+        meter.set_gauge(self._gauge_key, float(self._pending_spans))
+        # the admission-gate watermark: a receiver bounding
+        # retry/<exporter>:pending_spans sheds at the socket while the
+        # destination is down, instead of spilling without bound
+        FlowContext.watermark(self._wm, "pending_spans",
+                              self._pending_spans)
+
+    # -------------------------------------------------------- retry thread
+
+    def _retry_run(self, stop: threading.Event) -> None:
+        """``stop`` is THIS epoch's flag (the engine/lane-thread
+        discipline): a thread wedged in a hanging export across a
+        shutdown→start cycle must keep seeing its epoch's SET flag when
+        it unwedges — reading ``self._stop`` dynamically would hand it
+        the fresh epoch's unset event and leave two replayers racing
+        the same queue head."""
+        backoff = self.initial_backoff_s
+        while True:
+            with self._lock:
+                while not self._q:
+                    if stop.is_set():
+                        return
+                    backoff = self.initial_backoff_s  # queue drained
+                    self._work.wait(1.0)
+                if stop.is_set():
+                    # shutdown owns the leftovers (final flush + named
+                    # shutdown_drain) — racing it batch by batch here
+                    # would double-deliver or double-drop
+                    return
+                batch = self._q[0]  # peek: the head stays queued (and
+                #                     arrivals keep enqueuing behind it)
+                n = len(batch)
+            meter.add(self._attempts_key)
+            with self._export_lock:
+                try:
+                    self.inner.consume(batch)
+                    ok = True
+                except Exception:  # noqa: BLE001
+                    ok = False
+            with self._lock:
+                if ok:
+                    if self._q and self._q[0] is batch:
+                        self._q.popleft()
+                        self._pending_spans -= n
+                        self.delivered_spans += n
+                        meter.add(self._delivered_key, n)
+                        self._publish_depth_locked()
+                        if not self._q:
+                            self._drained.notify_all()
+                    # else: a timed-out shutdown join already claimed
+                    # the head — ITS flush loop owns the accounting
+                    # (delivered or named drop); double-counting here
+                    # would break sent == delivered + dropped + pending.
+                    # At-least-once delivery is the queue's contract.
+                    backoff = self.initial_backoff_s
+                    continue
+                self.retries += 1
+                # full jitter over [1-j, 1]: deterministic exponential
+                # backoff re-synchronizes every retrier in the fleet
+                # against the destination's recovery instant
+                delay = backoff * (1.0 - self.jitter * self._rng.random())
+                backoff = min(backoff * 2.0, self.max_backoff_s)
+            # the backoff sleeps on the STOP event, outside the lock:
+            # waiting on _work here would let every arriving batch
+            # (which notifies _work) wake the thread and re-hammer the
+            # dead destination at the arrival rate — the exact
+            # re-synchronized storm the jitter exists to prevent
+            if stop.wait(delay):
+                return
+
+    # ------------------------------------------------------------- queries
+
+    def pending_spans(self) -> int:
+        with self._lock:
+            return self._pending_spans
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait until the spill queue drains (True) or timeout."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._q:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drained.wait(remaining)
+            return True
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "exporter": self.name,
+                "pending_spans": self._pending_spans,
+                "queued_batches": len(self._q),
+                "spilled_spans": self.spilled_spans,
+                "delivered_spans": self.delivered_spans,
+                "dropped_spans": self.dropped_spans,
+                "retries": self.retries,
+            }
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.inner.start()
+        if self._thread is None or not self._thread.is_alive():
+            stop = threading.Event()
+            self._stop = stop
+            self._thread = threading.Thread(
+                target=self._retry_run, args=(stop,), daemon=True,
+                name=f"export-retry-{self.name}")
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=self.drain_timeout_s + 5.0)
+            self._thread = None
+        # final flush: one direct attempt per queued batch inside the
+        # drain budget; what cannot land is shed NAMED — conservation
+        # says shutdown_drain, never a silent vanish
+        deadline = time.monotonic() + self.drain_timeout_s
+        while True:
+            with self._lock:
+                if not self._q:
+                    break
+                batch = self._q.popleft()
+                n = len(batch)
+                self._pending_spans -= n
+                self._publish_depth_locked()
+            delivered = False
+            remaining = deadline - time.monotonic()
+            # the drain budget bounds LOCK ACQUISITION too: a hanging
+            # (not raising) destination leaves a timed-out retry thread
+            # wedged inside inner.consume holding _export_lock — an
+            # unbounded acquire here would hang collector shutdown on
+            # the very outage drain_timeout_s exists to bound
+            if remaining > 0 and self._export_lock.acquire(
+                    timeout=remaining):
+                try:
+                    self.inner.consume(batch)
+                    delivered = True
+                except Exception:  # noqa: BLE001
+                    pass
+                finally:
+                    self._export_lock.release()
+            if delivered:
+                with self._lock:
+                    self.delivered_spans += n
+                meter.add(self._delivered_key, n)
+            else:
+                with self._lock:
+                    self.dropped_spans += n
+                meter.add(labeled_key(RETRY_DROPPED_METRIC,
+                                      exporter=self.name,
+                                      reason="shutdown_drain"), n)
+                FlowContext.drop(n, "shutdown_drain",
+                                 pipeline="(export)",
+                                 component_name=self._wm)
+        with self._lock:
+            self._drained.notify_all()
+        self.inner.shutdown()
+
+    # --------------------------------------------------------- conditions
+
+    def healthy(self) -> bool:
+        return self.inner.healthy()
+
+    def health(self) -> tuple[str, str, str]:
+        if not self.healthy():
+            return ("Unhealthy", "ReportedUnhealthy",
+                    f"{self.name} reports unhealthy")
+        with self._lock:
+            pending, batches = self._pending_spans, len(self._q)
+        if pending > 0:
+            return ("Degraded", "ExportRetrying",
+                    f"{pending} spans ({batches} batches) spilled, "
+                    f"retrying {self.name}")
+        return self.inner.health()
+
+    # ------------------------------------------------------------ plumbing
+
+    def __getattr__(self, item: str) -> Any:
+        # queryable inner exporters (tracedb span_count / wait_for_spans,
+        # mockdestination counters) keep their API through the wrapper
+        return getattr(self.inner, item)

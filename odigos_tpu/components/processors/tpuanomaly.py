@@ -87,6 +87,11 @@ class TpuAnomalyProcessor(Processor):
     pipeline_depth / bucket_ladder / warm_ladder:
         forwarded to EngineConfig (pipeline_depth 2 = double-buffered
         scoring: host packing overlaps device execution)
+    failover: circuit-broken CPU fallback (ISSUE 13) — ``true`` or a
+        {window_s, trip_errors, probe_interval_s, recovery_successes,
+        fallback_model} mapping; a persistent device fault hot-swaps
+        scoring to the zscore CPU route, raises ModelFailover, and
+        half-open probes the primary back (serving/failover.py)
     shared_engine: reuse one engine across processor instances (default True)
     """
 
@@ -125,6 +130,7 @@ class TpuAnomalyProcessor(Processor):
             pipeline_depth=int(config.get("pipeline_depth", 2)),
             bucket_ladder=int(config.get("bucket_ladder", 4)),
             warm_ladder=bool(config.get("warm_ladder", False)),
+            failover=config.get("failover"),
         )
         self.engine = _engine_for(self.engine_cfg,
                                   bool(config.get("shared_engine", True)))

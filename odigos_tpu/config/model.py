@@ -68,6 +68,15 @@ class CollectorGatewayConfiguration:
     # whole slice of anomaly.devices × anomaly.tensor_parallel chips (the
     # engine's dp×tp mesh); None = as many as the device pools can back.
     mesh_slices: Optional[int] = None
+    # export retry/spill (ISSUE 13): a mapping ({} = defaults) stamps a
+    # ``retry:`` stanza onto every destination exporter the gateway
+    # config renders — bounded jittered-backoff + spill queue around a
+    # destination outage, terminal drops named queue_full/
+    # shutdown_drain (components/exporters/retryqueue.py). None renders
+    # nothing (existing configs stay byte-identical). Keys:
+    # initial_backoff_ms / max_backoff_ms / jitter / max_queue_spans /
+    # drain_timeout_s.
+    export_retry: Optional[dict] = None
 
 
 @dataclass
@@ -169,6 +178,15 @@ class AnomalyStageConfiguration:
     # declarative burn-rate SLOs for the root traces pipeline (ISSUE 8);
     # None renders nothing — existing configs stay byte-identical
     slo: Optional[SloConfiguration] = None
+    # failover breaker for the scoring engine (ISSUE 13): a mapping
+    # ({} = defaults; keys per serving/failover.FailoverConfig —
+    # window_s, trip_errors, probe_interval_s, recovery_successes,
+    # fallback_model) rendered as the tpuanomaly processor's
+    # ``failover:`` knob. A persistent device fault then hot-swaps
+    # scoring to the zscore CPU route (ModelFailover condition,
+    # odigos_failover_* metrics) and half-open probes the primary back.
+    # None renders nothing — existing configs stay byte-identical.
+    failover: Optional[dict] = None
 
 
 @dataclass

@@ -246,6 +246,7 @@ class Autoscaler:
             ui_endpoint=self.config.ui_endpoint,
             telemetry_config=self.config.selftelemetry,
             alerts=self.config.alerts,
+            export_retry=self.config.collector_gateway.export_retry,
         )
         with tracer.span("autoscaler/render-gateway-config") as sp:
             sp.set_attr("cr.kind", "ConfigMap")

@@ -11,7 +11,22 @@ components (the chaos-mesh network-latency analog).
 from .environment import E2EEnvironment  # noqa: F401
 from .scenario import Scenario, Step  # noqa: F401
 from .chaos import (  # noqa: F401
+    INJECTORS,
+    clear_all,
+    clear_clock_skew,
+    clear_destination_outage,
+    clear_device_fault,
     clear_exporter_chaos,
+    clear_hot_reload,
+    clear_malformed_frame_storm,
+    clear_memory_pressure,
+    clear_reconnect_stampede,
+    inject_clock_skew,
+    inject_destination_outage,
+    inject_device_fault,
     inject_exporter_chaos,
+    inject_hot_reload,
+    inject_malformed_frame_storm,
     inject_memory_pressure,
+    inject_reconnect_stampede,
 )

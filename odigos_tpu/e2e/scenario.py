@@ -3,7 +3,10 @@
 A scenario is an ordered list of steps, each an apply / assert / script
 (tests/e2e/trace-collection/chainsaw-test.yaml:1-40 shape). ``assert``
 steps poll a predicate with a timeout — the level-triggered analog of
-chainsaw's assert resources.
+chainsaw's assert resources. ``finally_steps`` (the chainsaw ``finally``
+block, ISSUE 13) ALWAYS run — pass, fail, or raise — so a chaos
+scenario that dies mid-fault can never leak its injection into the next
+test.
 """
 
 from __future__ import annotations
@@ -39,34 +42,64 @@ class StepResult:
 class Scenario:
     name: str
     steps: list[Step] = field(default_factory=list)
+    # always-run cleanup (chaos clear_* calls, drains): every entry runs
+    # even when the main steps failed — and every entry runs even when
+    # an EARLIER finally step failed (errors are collected, not raced)
+    finally_steps: list[Step] = field(default_factory=list)
 
     def run(self, env: E2EEnvironment) -> list[StepResult]:
-        """Run all steps; stops at the first failure (chainsaw semantics).
-        Raises AssertionError with the failing step's name."""
+        """Run all steps; stops at the first failure (chainsaw
+        semantics), then runs every ``finally_steps`` entry regardless.
+        Raises AssertionError naming the failing step — a main-step
+        failure outranks a finally failure in the message, but a
+        finally failure alone still fails the scenario (a cleanup that
+        cannot restore the environment is itself a bug)."""
         results: list[StepResult] = []
+        failed: Optional[StepResult] = None
         for step in self.steps:
-            t0 = time.monotonic()
-            error = ""
-            ok = True
-            try:
-                if step.apply is not None:
-                    step.apply(env)
-                    env.reconcile()
-                if step.script is not None:
-                    step.script(env)
-                if step.assert_fn is not None:
-                    ok = self._poll(env, step)
-                    if not ok:
-                        error = "assert timed out"
-            except Exception as e:  # surfaced with step context below
-                ok, error = False, f"{type(e).__name__}: {e}"
-            results.append(StepResult(step.name, ok,
-                                      time.monotonic() - t0, error))
-            if not ok:
-                raise AssertionError(
-                    f"scenario {self.name!r} failed at step {step.name!r}: "
-                    f"{error}\ncompleted: {[r.step for r in results if r.ok]}")
+            res = self._run_step(env, step)
+            results.append(res)
+            if not res.ok:
+                failed = res
+                break
+        finally_failed: Optional[StepResult] = None
+        for step in self.finally_steps:
+            res = self._run_step(env, step)
+            results.append(res)
+            if not res.ok and finally_failed is None:
+                finally_failed = res
+        if failed is not None:
+            raise AssertionError(
+                f"scenario {self.name!r} failed at step "
+                f"{failed.step!r}: {failed.error}\ncompleted: "
+                f"{[r.step for r in results if r.ok]}"
+                + (f"\n(finally step {finally_failed.step!r} also "
+                   f"failed: {finally_failed.error})"
+                   if finally_failed is not None else ""))
+        if finally_failed is not None:
+            raise AssertionError(
+                f"scenario {self.name!r} passed but finally step "
+                f"{finally_failed.step!r} failed: "
+                f"{finally_failed.error}")
         return results
+
+    def _run_step(self, env: E2EEnvironment, step: Step) -> StepResult:
+        t0 = time.monotonic()
+        error = ""
+        ok = True
+        try:
+            if step.apply is not None:
+                step.apply(env)
+                env.reconcile()
+            if step.script is not None:
+                step.script(env)
+            if step.assert_fn is not None:
+                ok = self._poll(env, step)
+                if not ok:
+                    error = "assert timed out"
+        except Exception as e:  # surfaced with step context by run()
+            ok, error = False, f"{type(e).__name__}: {e}"
+        return StepResult(step.name, ok, time.monotonic() - t0, error)
 
     @staticmethod
     def _poll(env: E2EEnvironment, step: Step) -> bool:

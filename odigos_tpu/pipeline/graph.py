@@ -309,6 +309,16 @@ def validate_config(config: dict[str, Any]) -> list[str]:
         elif ref and ref not in enabled_ext:
             problems.append(f"exporter {eid}: authenticator {ref!r} "
                             f"defined but not listed in service.extensions")
+        retry_spec = (ecfg or {}).get("retry")
+        if retry_spec not in (None, False):
+            # export retry/spill (ISSUE 13): a typo'd stanza must die
+            # at load — an exporter silently shipping WITHOUT its spill
+            # queue loses data in exactly the outage it was configured
+            # to survive. {} is the all-defaults spelling, not "off".
+            from ..components.exporters.retryqueue import (
+                validate_retry_config)
+
+            problems.extend(validate_retry_config(eid, retry_spec))
 
     # connector DAG check: edge pipeline_A -> pipeline_B when a connector is
     # exporter in A and receiver in B
@@ -411,7 +421,26 @@ def build_graph(config: dict[str, Any],
             # oauth2client vs googleclientauth)
             ecfg = {**ecfg, "auth_resolved": {
                 "_type": ref.split("/", 1)[0], **extensions[ref]}}
-        g.exporters[eid] = reg.get(ComponentKind.EXPORTER, eid).build(eid, ecfg)
+        exp = reg.get(ComponentKind.EXPORTER, eid).build(eid, ecfg)
+        retry_spec = (ecfg or {}).get("retry")
+        if isinstance(retry_spec, dict) \
+                and not retry_spec.get("enabled", True):
+            # {"enabled": false} is an explicit opt-out — wrapping
+            # anyway would silently swallow the destination's failures
+            # the operator just asked to see
+            retry_spec = None
+        if retry_spec not in (None, False):  # {} = all defaults
+            # export retry/spill (ISSUE 13): wrap the destination in a
+            # bounded jittered-backoff spill queue — a destination
+            # outage degrades to Degraded(ExportRetrying) + a
+            # watermarked queue instead of per-batch failures, and
+            # every terminal loss is a named queue_full/shutdown_drain
+            # drop (components/exporters/retryqueue.py)
+            from ..components.exporters.retryqueue import RetryQueue
+
+            exp = RetryQueue(
+                exp, retry_spec if isinstance(retry_spec, dict) else {})
+        g.exporters[eid] = exp
     for cid, ccfg in conn_cfgs.items():
         g.connectors[cid] = reg.get(ComponentKind.CONNECTOR, cid).build(cid, ccfg)
 

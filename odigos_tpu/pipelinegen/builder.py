@@ -96,6 +96,11 @@ class GatewayOptions:
     # nothing — existing configs stay byte-identical); evaluated by the
     # fleet plane's alert engine, surfaced as alert/<name> conditions
     alerts: Optional[list] = None
+    # export retry/spill (ISSUE 13): a mapping ({} = defaults) stamped
+    # as the ``retry:`` stanza of every destination exporter —
+    # build_graph wraps those in the bounded jittered-backoff
+    # RetryQueue. None renders nothing (byte-stable configs).
+    export_retry: Optional[dict] = None
     # extra processor ids (already configured in `processors`) to run in the
     # root pipeline per signal, e.g. compiled Actions.
     root_processors: dict[Signal, list[str]] = field(default_factory=dict)
@@ -229,6 +234,17 @@ def build_gateway_config(
             enabled.add(sig)
         status.destination[dest.id] = None
 
+    # --- export retry/spill (ISSUE 13): stamp the retry stanza onto the
+    # destination exporters rendered so far (the internal otlp/ui and
+    # servicegraph exporters are added later and stay unwrapped — their
+    # loss modes are self-telemetry, not customer data)
+    if options.export_retry is not None:
+        retry_spec = dict(options.export_retry)
+        for eid, ecfg in config["exporters"].items():
+            cfg_e = dict(ecfg or {})
+            cfg_e.setdefault("retry", retry_spec)
+            config["exporters"][eid] = cfg_e
+
     enabled_signals = [s for s in SIGNALS if s in enabled]
 
     # --- data-stream pipelines: router connector -> forward connectors
@@ -309,6 +325,12 @@ def build_gateway_config(
                 "timeout_ms": anomaly.timeout_ms,
                 "devices": anomaly.devices,
             }
+            if getattr(anomaly, "failover", None) is not None:
+                # failover breaker (ISSUE 13): the engine arms a
+                # circuit breaker with a CPU fallback route; None
+                # renders nothing (byte-stable configs)
+                config["processors"]["tpuanomaly"]["failover"] = dict(
+                    anomaly.failover)
             tp = getattr(anomaly, "tensor_parallel", 1) or 1
             if anomaly.devices > 1 or tp > 1:
                 # multi-chip sharded serving (ISSUE 7): render the full

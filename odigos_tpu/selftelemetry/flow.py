@@ -764,16 +764,33 @@ class HealthRollup:
             # scoring engines are process-scoped, not graph components:
             # their queue_full drops (recorded as engine/<model> on the
             # "requests" signal) surface as pseudo-components so a
-            # saturated queue actually reaches Degraded(QueueSaturation)
-            for name in sorted(totals):
-                if not name.startswith("engine/"):
-                    continue
+            # saturated queue actually reaches Degraded(QueueSaturation).
+            # Failover supervisors (ISSUE 13) surface on the same rows:
+            # Degraded(ModelFailover) while a breaker serves its CPU
+            # fallback, back to an explicit Healthy on recovery — the
+            # chaos oracle asserts that round trip. Lazy import: the
+            # serving package imports this module at load.
+            try:
+                from ..serving.failover import failover_conditions
+
+                fo_rows = failover_conditions()
+            except ImportError:  # pragma: no cover — serving not loaded
+                fo_rows = {}
+            engine_rows = {n for n in totals if n.startswith("engine/")}
+            engine_rows.update(fo_rows)
+            for name in sorted(engine_rows):
                 live.add(name)
-                deg = self._degradation(name, totals, now)
-                if deg is not None:
-                    status, (reason, message) = DEGRADED, deg
+                fo = fo_rows.get(name)
+                if fo is not None and fo[0] != HEALTHY:
+                    # an active failover outranks ledger evidence: the
+                    # breaker names the exact failure mode
+                    status, reason, message = fo
                 else:
-                    status, reason, message = HEALTHY, "Running", ""
+                    deg = self._degradation(name, totals, now)
+                    if deg is not None:
+                        status, (reason, message) = DEGRADED, deg
+                    else:
+                        status, reason, message = HEALTHY, "Running", ""
                 out.append(dict(self._upsert(name, status, reason,
                                              message)))
             for pname, bal in balances.items():

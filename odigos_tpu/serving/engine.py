@@ -141,6 +141,15 @@ class EngineConfig:
     pipeline_depth: int = 2
     bucket_ladder: int = 4      # geometric row buckets above trace_bucket
     warm_ladder: bool = False   # compile the whole ladder at start()
+    # failover supervisor (ISSUE 13): a circuit breaker over the
+    # dispatch/harvest error path that hot-swaps scoring to a CPU
+    # fallback route on a persistent device fault and half-open probes
+    # the primary back (serving/failover.py). Accepts True (defaults)
+    # or a {window_s, trip_errors, probe_interval_s,
+    # recovery_successes, fallback_model} mapping; normalized hashable
+    # in __post_init__ (shared-engine keying hashes the config); None/
+    # False = no breaker (the pre-ISSUE-13 behavior, byte-identical).
+    failover: Any = None
 
     def __post_init__(self) -> None:
         m = self.mesh
@@ -158,10 +167,35 @@ class EngineConfig:
         if m is not None and math.prod(s for _, s in m) <= 1:
             m = None  # a 1x1 mesh is the single-device path
         object.__setattr__(self, "mesh", m)
+        f = self.failover
+        if f is False or f is None:
+            f = None
+        elif f is True:
+            f = ()  # all-defaults breaker
+        else:
+            items = dict(f.items() if isinstance(f, dict) else tuple(f))
+            # {"enabled": false} is an explicit OPT-OUT, not a tuning
+            # knob: popping the key unconditionally would arm a default
+            # breaker the config just turned off
+            if not items.pop("enabled", True):
+                f = None
+            else:
+                f = tuple(sorted((str(k), v) for k, v in items.items()))
+        object.__setattr__(self, "failover", f)
+
+    def failover_spec(self) -> Optional[dict[str, Any]]:
+        """Normalized failover mapping (None = breaker disabled)."""
+        return dict(self.failover) if self.failover is not None else None
 
     def mesh_shape(self) -> Optional[dict[str, int]]:
         """Normalized mesh spec as the dict parallel.make_mesh takes."""
         return dict(self.mesh) if self.mesh else None
+
+
+class DeviceFaultInjected(RuntimeError):
+    """Raised by the chaos device-fault hook (``inject_device_fault``):
+    the deterministic stand-in for a dead/wedged device on the primary
+    scoring route."""
 
 
 class ModelBackend(Protocol):
@@ -676,6 +710,15 @@ class _InflightGroup:
     # before the backing buffers recycle (the donate-after-last-use
     # contract, host-side). None when pooling is off.
     lease: Any = None
+    # the backend that served this call (ISSUE 13): under failover the
+    # worker selects a backend PER GROUP, so a group dispatched through
+    # the primary before a trip must still harvest against the primary
+    # (a fallback harvest on a primary handle would mis-scatter), and
+    # its final result is attributed to the right side of the breaker.
+    # ``probe`` echoes the supervisor's select() flag: only the probe
+    # group's result may resolve the half-open probe slot.
+    backend: Any = None
+    probe: bool = False
 
 
 class ScoringEngine:
@@ -727,6 +770,41 @@ class ScoringEngine:
                 meter.add(labeled_key(MESH_UNAVAILABLE_METRIC,
                                       model=self.cfg.model))
         self.backend = _BACKENDS[self.cfg.model](self.cfg, mesh=self.mesh)
+        # failover supervisor (ISSUE 13): circuit breaker over the
+        # dispatch/harvest error path with a CPU fallback backend — a
+        # persistent device fault degrades to zscore scoring instead of
+        # forwarding every frame unscored forever. The supervisor never
+        # imports this module; the engine constructs the fallback and
+        # hands both backends in.
+        self.failover = None
+        # chaos hook (e2e/chaos.py inject_device_fault): a non-None
+        # message makes every PRIMARY-backend dispatch raise — the
+        # deterministic stand-in for a dead device that the failover
+        # breaker (and the sustained-failure tests) exercise
+        self._device_fault: Optional[str] = None
+        if self.cfg.failover is not None:
+            from .failover import FailoverConfig, FailoverSupervisor
+
+            if self.cfg.model == "remote":
+                # the sidecar featurizes server-side (needs_features is
+                # False), so submit never builds the features a local
+                # fallback would score — and the sidecar carries its
+                # own deadline discipline anyway
+                raise ValueError(
+                    "failover does not compose with the remote sidecar "
+                    "backend")
+            fo_cfg = FailoverConfig.from_spec(self.cfg.failover_spec())
+            fb_cfg = EngineConfig(
+                model=fo_cfg.fallback_model,
+                max_batch_spans=self.cfg.max_batch_spans,
+                max_len=self.cfg.max_len,
+                trace_bucket=self.cfg.trace_bucket,
+                online_update=self.cfg.online_update,
+                featurizer=self.cfg.featurizer,
+                seed=self.cfg.seed)
+            fallback = _BACKENDS[fo_cfg.fallback_model](fb_cfg, mesh=None)
+            self.failover = FailoverSupervisor(
+                self.cfg.model, self.backend, fallback, fo_cfg)
         # only backends with an async dispatch can overlap; everything else
         # (zscore's ordered online update, mock, the remote sidecar with its
         # own deadline discipline) keeps the exact serial depth-1 behavior
@@ -817,6 +895,14 @@ class ScoringEngine:
                 w = getattr(self.backend, "warm", None)
                 if w is not None:
                     w()  # blocking by design: caller opted into warm start
+                if self.failover is not None:
+                    # the fallback must be warm BEFORE it is needed: its
+                    # first groups otherwise pay per-shape XLA compiles
+                    # in the middle of the device-loss incident the
+                    # breaker exists to smooth over
+                    fw = getattr(self.failover.fallback, "warm", None)
+                    if fw is not None:
+                        fw()
             # per-run stop event: a worker that outlived a timed-out
             # shutdown() join (hung device call) keeps ITS event set and
             # exits when the call unwedges — clearing a shared event
@@ -940,6 +1026,26 @@ class ScoringEngine:
         public surface the soak/bench allocation evidence reads."""
         return self._pack_pool.stats()
 
+    # ------------------------------------------------------- chaos hooks
+    def inject_device_fault(
+            self, message: str = "injected device fault") -> None:
+        """Chaos hook (e2e/chaos.py, ISSUE 13): every subsequent
+        PRIMARY-backend dispatch raises :class:`DeviceFaultInjected`
+        until cleared — the deterministic device-loss injection the
+        failover breaker and the sustained-failure tests drive. The
+        fallback route (when a breaker is configured) is untouched."""
+        self._device_fault = str(message)
+
+    def clear_device_fault(self) -> None:
+        """Lift the injected device fault (idempotent)."""
+        self._device_fault = None
+
+    def failover_status(self) -> Optional[dict[str, Any]]:
+        """The breaker's state snapshot (None = no breaker configured)
+        — surfaced in pipeline_stats and the chaos soak's CHAOS.json."""
+        return self.failover.status() if self.failover is not None \
+            else None
+
     def runtime_gauges(self) -> dict[str, Any]:
         """Instantaneous engine state for the device-runtime collector
         (ISSUE 3): the gauges the pipeline always computed but never
@@ -1006,6 +1112,8 @@ class ScoringEngine:
         }
         if self.mesh is not None:
             out["mesh"] = dict(self.cfg.mesh)
+        if self.failover is not None:
+            out["failover"] = self.failover.status()
         return out
 
     # -------------------------------------------------------------- worker
@@ -1119,6 +1227,13 @@ class ScoringEngine:
         t0 = time.monotonic_ns()
         if self._t_run0 is None:
             self._t_run0 = t0
+        # failover (ISSUE 13): the breaker picks the backend PER GROUP —
+        # primary while closed, the CPU fallback while tripped, and one
+        # half-open probe group per interval while recovering
+        if self.failover is not None:
+            backend, probe = self.failover.select()
+        else:
+            backend, probe = self.backend, False
         # scoring exported self-spans (a pipeline dogfooding anomaly
         # detection on internal traces) must not mint new spans about
         # them — the worker thread is outside the suppressed() scope,
@@ -1148,7 +1263,7 @@ class ScoringEngine:
                                 (rows, conts[0].shape[1]),
                                 conts[0].dtype)))
                     if feats is not None and getattr(
-                            self.backend, "coalesce_columns",
+                            backend, "coalesce_columns",
                             None) is not None:
                         # every request pre-featurized + a backend that
                         # only reads id/time columns: skip the merged
@@ -1160,7 +1275,13 @@ class ScoringEngine:
                         from ..pdata.spans import concat_batches
 
                         merged = concat_batches([r.batch for r in reqs])
-                dispatch = getattr(self.backend, "dispatch", None)
+                if self._device_fault is not None \
+                        and backend is self.backend:
+                    # injected device loss (chaos hook): only the
+                    # PRIMARY route faults — the fallback must keep
+                    # scoring or there is nothing to fail over TO
+                    raise DeviceFaultInjected(self._device_fault)
+                dispatch = getattr(backend, "dispatch", None)
                 with self._backend_lock:
                     if dispatch is not None:
                         handle = dispatch(merged, feats)
@@ -1169,17 +1290,22 @@ class ScoringEngine:
                         # eagerly — identical to the serial engine
                         # (ordering guarantees for zscore online updates
                         # and the remote sidecar deadline)
-                        handle = self.backend.score(merged, feats)
+                        handle = backend.score(merged, feats)
                     # snapshot while still holding the lock: a concurrent
                     # warmup() score would overwrite the last_* fields
                     # with the warmup call's shape before we read them
-                    bucket_hit = getattr(self.backend, "last_bucket_hit",
+                    bucket_hit = getattr(backend, "last_bucket_hit",
                                          None)
-                    shape = getattr(self.backend, "last_shape", None)
-                    waste = getattr(self.backend, "last_padding_waste",
+                    shape = getattr(backend, "last_shape", None)
+                    waste = getattr(backend, "last_padding_waste",
                                     None)
-        except Exception:
+        except Exception as e:
             meter.add("odigos_anomaly_engine_errors_total")
+            if self.failover is not None:
+                self.failover.observe(
+                    backend, ok=False,
+                    n_spans=sum(len(r.batch) for r in reqs),
+                    error=f"{type(e).__name__}: {e}", probe=probe)
             if lease is not None:
                 lease.release()
             for r in reqs:
@@ -1207,7 +1333,7 @@ class ScoringEngine:
             t_pack0=t0, t_dispatch=t1,
             overlap_ms=(t1 - t0) / 1e6 if overlapped else 0.0,
             bucket_hit=bucket_hit, shape=shape, padding_waste=waste,
-            lease=lease)
+            lease=lease, backend=backend, probe=probe)
 
     def _retire(self, grp: _InflightGroup) -> None:
         """Harvest stage: block on the oldest in-flight device call, split
@@ -1225,19 +1351,34 @@ class ScoringEngine:
 
     def _retire_inner(self, grp: _InflightGroup) -> None:
         t_h0 = time.monotonic_ns()
+        # harvest against the backend that DISPATCHED this group (see
+        # _InflightGroup.backend): a failover trip between dispatch and
+        # harvest must not hand a primary handle to the fallback
+        backend = grp.backend if grp.backend is not None else self.backend
         try:
-            harvest = getattr(self.backend, "harvest", None)
+            harvest = getattr(backend, "harvest", None)
             with self._backend_lock:
                 scores = harvest(grp.handle) if harvest is not None \
                     else grp.handle
-        except Exception:
+        except Exception as e:
             meter.add("odigos_anomaly_engine_errors_total")
+            if self.failover is not None:
+                self.failover.observe(backend, ok=False,
+                                      n_spans=grp.n_spans,
+                                      error=f"{type(e).__name__}: {e}",
+                                      probe=grp.probe)
             for r in grp.reqs:
                 r.scores = None
                 r.signal_done()
             grp.span.set_attr("error", True)
             grp.span.finish(error=True)
             return
+        if self.failover is not None:
+            # the group's FINAL success: harvest landed (or the eager
+            # fallback call already had) — breaker evidence, and the
+            # fallback's scored-span volume when it served
+            self.failover.observe(backend, ok=True, n_spans=grp.n_spans,
+                                  probe=grp.probe)
         if latency_enabled():
             # one boundary dict per group, attached to every request
             # BEFORE its done event fires: the fast-path forwarder reads

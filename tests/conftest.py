@@ -26,6 +26,33 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # registered markers: tier-1 runs `-m 'not slow'`, so `chaos`
+    # (the fault-injection scenario matrix, ISSUE 13) is IN tier-1 by
+    # default — robustness regressions fail CI, not a nightly
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection scenario matrix (deterministic "
+        "injections, seeded via --chaos-seed)")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--chaos-seed", type=int, default=0,
+        help="seed for every randomized choice inside chaos scenarios "
+             "(jittered backoffs, storm payloads) — the same seed "
+             "replays the same fault schedule")
+
+
+@pytest.fixture
+def chaos_seed(request):
+    """The deterministic seed chaos scenarios thread through every
+    randomized injection (ISSUE 13)."""
+    return int(request.config.getoption("--chaos-seed"))
+
+
 @pytest.fixture(scope="session")
 def demo_batch():
     """A medium synthetic batch shared across tests (session-scoped: cheap)."""

@@ -1,0 +1,786 @@
+"""Chaos scenario matrix (ISSUE 13): fault injection with conservation,
+blame, condition transitions, and alerting as the machine-checked oracle.
+
+Every scenario runs against the full in-process stack (E2EEnvironment:
+control plane + live gateway collector) through the chainsaw-style
+runner, injects a fault from the paired registry in ``e2e/chaos.py``,
+and asserts the FOUR-part oracle — "no silent loss, no unexplained
+latency" as assertions, not a slogan:
+
+1. **ledger balance exact** — every registered pipeline's flow-ledger
+   conservation closes to leak == 0;
+2. **every drop named** — each loss carries a reason from the closed
+   taxonomy (and the scenario's expected reasons actually appear);
+3. **condition transitions** — the expected ``HealthRollup`` condition
+   raises during the fault and round-trips back to Healthy on recovery
+   (ModelFailover, ExportRetrying, MemoryPressure...);
+4. **the right alert fired** — the PR 10 rule the scenario declares in
+   its ``service.alerts`` stanza transitions to firing (and quiet
+   scenarios assert that NO alert fired).
+
+Injections are deterministic; anything randomized threads the
+``--chaos-seed`` pytest option (the ``chaos_seed`` fixture). Scenario
+``finally_steps`` clear every injected fault even on failure — a dead
+scenario can never leak a fault into the next test (the
+``test_finally_steps_always_run`` contract below).
+"""
+
+import threading
+import time
+
+import pytest
+
+from odigos_tpu.components.api import Signal
+from odigos_tpu.config.model import (
+    AlertRuleConfiguration,
+    AnomalyStageConfiguration,
+    CollectorGatewayConfiguration,
+    Configuration,
+    RolloutConfiguration,
+)
+from odigos_tpu.destinations import Destination
+from odigos_tpu.e2e import (
+    E2EEnvironment,
+    Scenario,
+    Step,
+    clear_all,
+    clear_clock_skew,
+    clear_destination_outage,
+    clear_device_fault,
+    clear_exporter_chaos,
+    clear_hot_reload,
+    clear_malformed_frame_storm,
+    clear_memory_pressure,
+    clear_reconnect_stampede,
+    inject_clock_skew,
+    inject_destination_outage,
+    inject_device_fault,
+    inject_exporter_chaos,
+    inject_hot_reload,
+    inject_malformed_frame_storm,
+    inject_memory_pressure,
+    inject_reconnect_stampede,
+)
+from odigos_tpu.e2e.chaos import _gateway_engines
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.selftelemetry.fleet import alert_engine, fleet_plane
+from odigos_tpu.selftelemetry.flow import (
+    DROP_REASONS, HealthRollup, flow_ledger)
+from odigos_tpu.selftelemetry.latency import latency_ledger
+from odigos_tpu.utils.telemetry import meter
+
+pytestmark = pytest.mark.chaos
+
+T = Signal.TRACES
+
+
+@pytest.fixture(autouse=True)
+def fresh_planes():
+    """Process-global telemetry planes reset around every scenario —
+    a prior scenario's series/rules/drops must never decide this one's
+    oracle."""
+    meter.reset()
+    flow_ledger.reset()
+    flow_ledger.enabled = True
+    latency_ledger.reset()
+    fleet_plane.reset()
+    yield
+    fleet_plane.reset()
+    latency_ledger.reset()
+    flow_ledger.reset()
+    meter.reset()
+
+
+# --------------------------------------------------------------- fixtures
+
+
+def tracedb_dest(id="db1"):
+    return Destination(id=id, dest_type="tracedb", signals=[T])
+
+
+def env_config(*, anomaly=None, alerts=(), export_retry=None
+               ) -> Configuration:
+    return Configuration(
+        rollout=RolloutConfiguration(rollback_grace_time_s=0.0),
+        anomaly=anomaly or AnomalyStageConfiguration(),
+        alerts=list(alerts),
+        collector_gateway=CollectorGatewayConfiguration(
+            export_retry=export_retry))
+
+
+def anomaly_cfg(failover=None) -> AnomalyStageConfiguration:
+    # timeout_ms 5000: the oracle is about degradation, not the 5 ms
+    # budget — a CPU fallback's first (jit-compiling) call must not
+    # read as an unscored pass-through
+    return AnomalyStageConfiguration(enabled=True, model="zscore",
+                                     timeout_ms=5000.0,
+                                     failover=failover)
+
+
+def _db(env, id="db1"):
+    return env.gateway_component(f"tracedb/tracedb-{id}")
+
+
+def _engine(env):
+    engines = _gateway_engines(env)
+    assert engines, "gateway has no scoring engine"
+    return engines[0]
+
+
+# ----------------------------------------------------------------- oracle
+
+
+def assert_conserved(timeout: float = 8.0) -> dict:
+    """Oracle part 1+2: every pipeline balances to leak == 0 (polling
+    through in-flight flushes) and every drop anywhere is NAMED from
+    the closed taxonomy."""
+    deadline = time.monotonic() + timeout
+    while True:
+        balances = flow_ledger.conservation()
+        if all(b["leak"] == 0 for b in balances.values()) \
+                or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    for pname, b in balances.items():
+        assert b["leak"] == 0, \
+            f"pipeline {pname} leaks {b['leak']} items: {b}"
+    for d in flow_ledger.snapshot()["drops"]:
+        for reason in d["reasons"]:
+            assert reason in DROP_REASONS, \
+                f"unnamed drop reason {reason!r} at {d}"
+    return balances
+
+
+def drop_total(reason: str, component: str = "") -> int:
+    total = 0
+    for d in flow_ledger.snapshot()["drops"]:
+        if component and d["component"] != component:
+            continue
+        total += d["reasons"].get(reason, 0)
+    return total
+
+
+def alert_fired(rule: str) -> bool:
+    return any(t["rule"] == rule and t["event"] == "fired"
+               for t in alert_engine.transitions())
+
+
+def no_alert_fired() -> bool:
+    return not any(t["event"] == "fired"
+                   for t in alert_engine.transitions())
+
+
+def condition(env, component: str):
+    for c in env.gateway.health_conditions():
+        if c["component"] == component:
+            return c
+    return None
+
+
+def expect_condition(env, component: str, status: str,
+                     reason: str = "") -> bool:
+    c = condition(env, component)
+    return (c is not None and c["status"] == status
+            and (not reason or c["reason"] == reason))
+
+
+# ------------------------------------------------------------- scenarios
+
+
+class TestDeviceLossFailover:
+    """ISSUE 13 acceptance: an injected persistent device fault trips
+    failover to CPU scoring (ModelFailover raised, scoring recovers on
+    the fallback) and clears on recovery — conservation exact and the
+    failover alert fired along the way."""
+
+    ALERT = AlertRuleConfiguration(
+        name="failover-active",
+        expr="max(odigos_failover_state[30s]) >= 1",
+        for_s=0.0, severity="warning")
+
+    def test_failover_round_trip(self):
+        cfg = env_config(
+            anomaly=anomaly_cfg(failover={
+                "window_s": 10.0, "trip_errors": 3,
+                "probe_interval_s": 0.2, "recovery_successes": 2}),
+            alerts=[self.ALERT])
+        scored = meter.counter("odigos_anomaly_scored_spans_total")
+        state = {}
+
+        def send(e, n=4, seed=0):
+            e.send_traces(synthesize_traces(n, seed=seed))
+
+        def send_until_scored(e):
+            send(e, seed=1)
+            return meter.counter(
+                "odigos_anomaly_scored_spans_total") > scored
+
+        def fault_traffic(e):
+            # >= trip_errors batches under the fault: the first few
+            # forward unscored (degradation), then the breaker trips
+            for i in range(5):
+                send(e, n=2, seed=10 + i)
+                time.sleep(0.05)
+
+        def fallback_scoring(e):
+            state.setdefault("scored_at_trip", meter.counter(
+                "odigos_anomaly_scored_spans_total"))
+            send(e, n=2, seed=50)
+            return (meter.counter("odigos_anomaly_scored_spans_total")
+                    > state["scored_at_trip"]
+                    and _engine(e).failover.active)
+
+        def recovered(e):
+            send(e, n=1, seed=99)  # probes ride traffic
+            return (not _engine(e).failover.active
+                    and expect_condition(e, "engine/zscore", "Healthy"))
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("device-loss-failover", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("baseline traffic scored",
+                     assert_fn=send_until_scored, timeout_s=20.0),
+                Step("inject persistent device fault",
+                     script=lambda e: inject_device_fault(e)),
+                Step("sustained failures trip the breaker",
+                     script=fault_traffic,
+                     assert_fn=lambda e: _engine(e).failover.trips >= 1,
+                     timeout_s=10.0),
+                Step("fallback serves: scoring continues on CPU",
+                     assert_fn=fallback_scoring, timeout_s=10.0),
+                Step("ModelFailover condition raised",
+                     assert_fn=lambda e: expect_condition(
+                         e, "engine/zscore", "Degraded",
+                         "ModelFailover")),
+                Step("failover alert fired",
+                     assert_fn=lambda e: alert_fired("failover-active"),
+                     timeout_s=10.0),
+                Step("clear fault",
+                     script=lambda e: clear_device_fault(e)),
+                Step("half-open probes recover the primary",
+                     assert_fn=recovered, timeout_s=15.0),
+            ], finally_steps=[
+                # the belt-and-braces sweep (every no-target clear),
+                # exercised here so the sweep itself stays proven
+                Step("clear all faults",
+                     script=lambda e: clear_all(e)),
+            ]).run(env)
+            sup = _engine(env).failover
+            assert sup.trips >= 1 and sup.recoveries >= 1
+            assert sup.fallback_spans > 0
+            assert_conserved()
+
+
+class TestDeviceLossNoFailover:
+    """The same persistent fault WITHOUT a breaker (the satellite's
+    sustained-failure contract at e2e level): every frame still forwards
+    — unscored — with the error counted; nothing is lost."""
+
+    ALERT = AlertRuleConfiguration(
+        name="engine-errors",
+        expr="max(odigos_anomaly_engine_errors_total[30s]) > 0",
+        for_s=0.0, severity="warning")
+
+    def test_unscored_passthrough_conserved(self):
+        cfg = env_config(anomaly=anomaly_cfg(), alerts=[self.ALERT])
+        sent = {"spans": 0}
+
+        def send_faulted(e):
+            for i in range(4):
+                b = synthesize_traces(3, seed=20 + i)
+                sent["spans"] += len(b)
+                e.send_traces(b)
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            errors0 = meter.counter("odigos_anomaly_engine_errors_total")
+            Scenario("device-loss-no-failover", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("inject device fault",
+                     script=lambda e: inject_device_fault(e)),
+                Step("traffic under sustained failure",
+                     script=send_faulted),
+                Step("all spans forward unscored",
+                     assert_fn=lambda e: _db(e).span_count
+                     >= sent["spans"], timeout_s=15.0),
+                Step("errors counted",
+                     assert_fn=lambda e: meter.counter(
+                         "odigos_anomaly_engine_errors_total") > errors0),
+                Step("engine-error alert fired",
+                     assert_fn=lambda e: alert_fired("engine-errors"),
+                     timeout_s=10.0),
+            ], finally_steps=[
+                Step("clear device fault",
+                     script=lambda e: clear_device_fault(e)),
+            ]).run(env)
+            assert_conserved()
+
+
+class TestDestinationOutageRetrySpill:
+    """Destination outage with the export retry/spill queue: spans
+    spill (Degraded ExportRetrying + backlog alert) and deliver after
+    recovery — zero loss end to end."""
+
+    ALERT = AlertRuleConfiguration(
+        name="export-retry-backlog",
+        expr="max(odigos_export_retry_queue_spans[30s]) > 0",
+        for_s=0.0, severity="warning")
+
+    DB = "tracedb/tracedb-db1"
+
+    def test_spill_and_recover(self, chaos_seed):
+        cfg = env_config(alerts=[self.ALERT], export_retry={
+            "initial_backoff_ms": 10, "max_backoff_ms": 60,
+            "max_queue_spans": 200_000, "seed": chaos_seed})
+        sent = {"spans": 0}
+
+        def send(e, seed):
+            b = synthesize_traces(4, seed=seed)
+            sent["spans"] += len(b)
+            e.send_traces(b)
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("destination-outage-retry", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("baseline delivery",
+                     script=lambda e: send(e, 0),
+                     assert_fn=lambda e: _db(e).span_count > 0,
+                     timeout_s=10.0),
+                Step("inject destination outage",
+                     script=lambda e: inject_destination_outage(
+                         e, self.DB)),
+                Step("traffic spills into the retry queue",
+                     script=lambda e: [send(e, s) for s in (1, 2, 3)],
+                     assert_fn=lambda e: e.gateway_component(
+                         self.DB).pending_spans() > 0,
+                     timeout_s=10.0),
+                Step("ExportRetrying condition raised",
+                     assert_fn=lambda e: expect_condition(
+                         e, self.DB, "Degraded", "ExportRetrying"),
+                     timeout_s=10.0),
+                Step("retry-backlog alert fired",
+                     assert_fn=lambda e: alert_fired(
+                         "export-retry-backlog"), timeout_s=10.0),
+                Step("destination recovers",
+                     script=lambda e: clear_destination_outage(
+                         e, self.DB)),
+                Step("queue drains: every span delivered",
+                     assert_fn=lambda e: (
+                         e.gateway_component(self.DB).pending_spans()
+                         == 0 and _db(e).span_count == sent["spans"]),
+                     timeout_s=15.0),
+                Step("condition clears",
+                     assert_fn=lambda e: expect_condition(
+                         e, self.DB, "Healthy"), timeout_s=10.0),
+            ], finally_steps=[
+                Step("clear outage",
+                     script=lambda e: clear_destination_outage(e)),
+            ]).run(env)
+            stats = env.gateway_component(self.DB).stats()
+            assert stats["dropped_spans"] == 0
+            assert stats["delivered_spans"] == sent["spans"]
+            assert_conserved()
+
+
+class TestDestinationOutageQueueOverflow:
+    """A too-small spill queue under outage: the overflow is a NAMED
+    ``queue_full`` terminal drop — sent == delivered + dropped exactly,
+    nothing silent."""
+
+    ALERT = AlertRuleConfiguration(
+        name="export-retry-drops",
+        expr="max(odigos_export_retry_dropped_spans_total[30s]) > 0",
+        for_s=0.0, severity="critical")
+
+    DB = "tracedb/tracedb-db1"
+
+    def test_overflow_named(self, chaos_seed):
+        cfg = env_config(alerts=[self.ALERT], export_retry={
+            "initial_backoff_ms": 10, "max_backoff_ms": 60,
+            "max_queue_spans": 120, "seed": chaos_seed})
+        sent = {"spans": 0}
+
+        def flood(e):
+            for s in range(6):
+                b = synthesize_traces(4, seed=30 + s)
+                sent["spans"] += len(b)
+                e.send_traces(b)
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("destination-outage-overflow", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("inject destination outage",
+                     script=lambda e: inject_destination_outage(
+                         e, self.DB)),
+                Step("flood past the spill bound", script=flood),
+                Step("overflow drops are named queue_full",
+                     assert_fn=lambda e: drop_total(
+                         "queue_full",
+                         f"retry/{self.DB}") > 0, timeout_s=10.0),
+                Step("drop alert fired",
+                     assert_fn=lambda e: alert_fired(
+                         "export-retry-drops"), timeout_s=10.0),
+                Step("destination recovers",
+                     script=lambda e: clear_destination_outage(
+                         e, self.DB)),
+                Step("survivors deliver",
+                     assert_fn=lambda e: e.gateway_component(
+                         self.DB).pending_spans() == 0,
+                     timeout_s=15.0),
+            ], finally_steps=[
+                Step("clear outage",
+                     script=lambda e: clear_destination_outage(e)),
+            ]).run(env)
+            stats = env.gateway_component(self.DB).stats()
+            assert stats["dropped_spans"] > 0
+            assert stats["dropped_spans"] == drop_total(
+                "queue_full", f"retry/{self.DB}")
+            # the export ledger closes exactly: nothing silent
+            assert stats["delivered_spans"] + stats["dropped_spans"] \
+                == sent["spans"]
+            assert _db(env).span_count == stats["delivered_spans"]
+            assert_conserved()
+
+
+class TestMemoryPressureBackpressure:
+    """Gateway memory pressure: pre-decode REJECTED at the wire (named
+    memory_limited on the ingress book), MemoryPressure degradation
+    round-trips, and the held frame delivers after the pressure lifts."""
+
+    ALERT = AlertRuleConfiguration(
+        name="admission-rejections",
+        expr="max(odigos_gateway_memory_limiter_rejections_total[30s])"
+             " > 0",
+        for_s=0.0, severity="warning")
+
+    def test_pressure_round_trip(self):
+        cfg = env_config(alerts=[self.ALERT])
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            env.add_destination(tracedb_dest())
+            assert env.send_traces_wire(synthesize_traces(5, seed=0))
+            assert _db(env).wait_for_spans(1, timeout=10)
+            stored = _db(env).span_count
+            # short-window rollup: ledger-evidence degradations hold
+            # for degrade_window_s, so the round trip needs its own
+            # clock horizon (the production default is 60 s)
+            rollup = HealthRollup(env.gateway.graph,
+                                  degrade_window_s=1.0)
+            rollup.evaluate()
+
+            Scenario("memory-pressure", [
+                Step("inject memory pressure",
+                     script=lambda e: inject_memory_pressure(e)),
+                Step("wire frame rejected pre-decode",
+                     script=lambda e: e.send_traces_wire(
+                         synthesize_traces(5, seed=1), timeout=1.0)
+                     and None,
+                     assert_fn=lambda e: drop_total(
+                         "memory_limited") > 0, timeout_s=10.0),
+                Step("MemoryPressure degradation raised",
+                     assert_fn=lambda e: any(
+                         c["reason"] == "MemoryPressure"
+                         for c in rollup.evaluate()), timeout_s=5.0),
+                Step("rejection alert fired",
+                     assert_fn=lambda e: alert_fired(
+                         "admission-rejections"), timeout_s=10.0),
+                Step("pressure lifts",
+                     script=lambda e: clear_memory_pressure(e)),
+                Step("held frame retried and delivered",
+                     assert_fn=lambda e: e._wire_tap.flush(timeout=1.0)
+                     and _db(e).span_count > stored, timeout_s=15.0),
+                Step("degradation clears after the window",
+                     assert_fn=lambda e: not any(
+                         c["reason"] == "MemoryPressure"
+                         for c in rollup.evaluate()), timeout_s=10.0),
+            ], finally_steps=[
+                Step("clear memory pressure",
+                     script=lambda e: clear_memory_pressure(e)),
+            ]).run(env)
+            assert_conserved()
+
+
+class TestClockSkewStorm:
+    """A producer fleet six hours in the future: the pipeline must
+    carry the traffic untouched — conserved, healthy, no alert, no
+    drop — skew is not an error, just weather."""
+
+    def test_skewed_traffic_conserved(self):
+        cfg = env_config()
+        sent = {"spans": 0}
+
+        def send_skewed(e):
+            for s in (1, 2, 3):
+                b = synthesize_traces(4, seed=40 + s)
+                sent["spans"] += len(b)
+                assert e.send_traces_wire(b)
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("clock-skew-storm", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("inject six-hour clock skew",
+                     script=lambda e: inject_clock_skew(e, 6 * 3600.0)),
+                Step("skewed traffic flows", script=send_skewed),
+                Step("every span delivered",
+                     assert_fn=lambda e: _db(e).span_count
+                     == sent["spans"], timeout_s=15.0),
+                # synthetic traces anchor at a fixed 1.7e18 ns epoch —
+                # the stored minimum must sit a full skew beyond it
+                Step("timestamps actually skewed",
+                     assert_fn=lambda e: int(
+                         _db(e).all_spans().col("start_unix_nano")
+                         .astype("int64").min())
+                     > 1_700_000_000 * 10**9 + 5 * 3600 * 10**9),
+                Step("no alert fired",
+                     assert_fn=lambda e: no_alert_fired()),
+            ], finally_steps=[
+                Step("clear clock skew",
+                     script=lambda e: clear_clock_skew(e)),
+            ]).run(env)
+            assert drop_total("invalid") == 0
+            assert_conserved()
+
+
+class TestMalformedFrameStorm:
+    """A storm of well-framed-but-undecodable payloads: every frame is
+    answered MALFORMED, named ``invalid`` on the ingress book, the
+    malformed alert fires, and real traffic keeps flowing."""
+
+    ALERT = AlertRuleConfiguration(
+        name="malformed-frames",
+        expr="max(odigos_receiver_malformed_frames_total[30s]) > 0",
+        for_s=0.0, severity="warning")
+
+    def test_storm_named_invalid(self):
+        cfg = env_config(alerts=[self.ALERT])
+        state = {}
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("malformed-frame-storm", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("storm of undecodable frames",
+                     script=lambda e: state.update(
+                         answered=inject_malformed_frame_storm(
+                             e, frames=12))),
+                Step("every frame answered MALFORMED",
+                     assert_fn=lambda e: state.get("answered") == 12),
+                Step("every frame a named invalid drop",
+                     assert_fn=lambda e: drop_total("invalid") == 12,
+                     timeout_s=5.0),
+                Step("malformed alert fired",
+                     assert_fn=lambda e: alert_fired(
+                         "malformed-frames"), timeout_s=10.0),
+                Step("real traffic still flows",
+                     script=lambda e: e.send_traces_wire(
+                         synthesize_traces(4, seed=7)),
+                     assert_fn=lambda e: _db(e).span_count > 0,
+                     timeout_s=10.0),
+            ], finally_steps=[
+                Step("clear (no-op)",
+                     script=lambda e: clear_malformed_frame_storm(e)),
+            ]).run(env)
+            assert_conserved()
+
+
+class TestReconnectStampede:
+    """Abrupt half-frame connect/disconnect storms (the PR 9 stampede
+    class): nothing is accepted so nothing can leak, the dead handlers
+    are shed, and the very next real frame lands."""
+
+    def test_stampede_survived(self):
+        cfg = env_config()
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("reconnect-stampede", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("stampede of truncated connections",
+                     script=lambda e: inject_reconnect_stampede(
+                         e, clients=12, rounds=2)),
+                Step("gateway still serves",
+                     script=lambda e: e.send_traces_wire(
+                         synthesize_traces(4, seed=3)),
+                     assert_fn=lambda e: _db(e).span_count > 0,
+                     timeout_s=15.0),
+                Step("no alert fired",
+                     assert_fn=lambda e: no_alert_fired()),
+            ], finally_steps=[
+                Step("clear (no-op)",
+                     script=lambda e: clear_reconnect_stampede(e)),
+            ]).run(env)
+            assert_conserved()
+
+
+class TestHotReloadUnderLoad:
+    """Config regeneration + graph hot swap while traffic flows: the
+    wire clients ride the REJECTED/retry contract across the swap, both
+    destinations serve afterwards, and conservation is exact across the
+    reload."""
+
+    def test_reload_under_load(self):
+        cfg = env_config()
+        stop = threading.Event()
+        delivered = {"n": 0}
+
+        def sender(env):
+            s = 0
+            while not stop.is_set():
+                b = synthesize_traces(2, seed=60 + (s % 8))
+                if env.send_traces_wire(b, timeout=10.0):
+                    delivered["n"] += 1
+                s += 1
+                time.sleep(0.02)
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            env.add_destination(tracedb_dest("db1"))
+            thread = threading.Thread(target=sender, args=(env,),
+                                      daemon=True)
+
+            def stop_sender(e):
+                stop.set()
+                if thread.ident is not None:
+                    thread.join(timeout=30)
+                    assert not thread.is_alive()
+
+            # NOTE: per-exporter span counts cannot be compared across
+            # the swap — the reload builds FRESH tracedb instances, so
+            # pre-reload deliveries live in discarded exporters. The
+            # cross-reload "nothing lost" claim is the LEDGER's (edge
+            # stats survive reloads keyed by pipeline), asserted by
+            # assert_conserved below; the per-db assertions only cover
+            # post-reload traffic.
+            def confirmed_send(e, n, seed):
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    if e.send_traces_wire(synthesize_traces(n, seed=seed),
+                                          timeout=5.0):
+                        return True
+                return False
+
+            Scenario("hot-reload-under-load", [
+                Step("start load",
+                     script=lambda e: (thread.start(),
+                                       time.sleep(0.3))[0]),
+                Step("hot reload mid-stream",
+                     script=lambda e: inject_hot_reload(e)),
+                Step("more load across the swap",
+                     script=lambda e: time.sleep(0.5)),
+                Step("stop load", script=stop_sender),
+                Step("clients delivered through the window",
+                     assert_fn=lambda e: delivered["n"] > 0),
+                Step("reloaded graph serves both destinations",
+                     script=lambda e: confirmed_send(e, 3, 77) or None,
+                     assert_fn=lambda e: _db(e, "db1").span_count > 0
+                     and _db(e, "chaos-reload").span_count > 0,
+                     timeout_s=20.0),
+            ], finally_steps=[
+                Step("stop load (idempotent)", script=stop_sender),
+                Step("remove reload destination",
+                     script=lambda e: clear_hot_reload(e)),
+            ]).run(env)
+            assert_conserved()
+
+
+class TestRejectingDestinationIsolation:
+    """A mockdestination rejecting 100% must not stall the healthy
+    destination beside it (the original chaos test, now with the full
+    oracle: failures are NAMED error classes, balance exact)."""
+
+    def test_rejecting_destination_isolated(self):
+        cfg = env_config()
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            env.add_destination(tracedb_dest("good"))
+            env.add_destination(Destination(
+                id="bad", dest_type="mock", signals=[T],
+                config={"MOCK_REJECT_FRACTION": "0",
+                        "MOCK_RESPONSE_DURATION": "0"}))
+
+            def wait_rejected(e):
+                mock = e.gateway_component("mockdestination/bad")
+                return mock.rejected_batches > 0
+
+            Scenario("rejecting-destination-isolation", [
+                Step("baseline both destinations",
+                     script=lambda e: e.send_traces_wire(
+                         synthesize_traces(5, seed=0)),
+                     assert_fn=lambda e: _db(e, "good").span_count > 0,
+                     timeout_s=10.0),
+                Step("inject 100% rejection",
+                     script=lambda e: inject_exporter_chaos(
+                         e, "mockdestination/bad",
+                         reject_fraction=1.0)),
+                Step("healthy destination keeps flowing",
+                     script=lambda e: e.send_traces_wire(
+                         synthesize_traces(5, seed=1)),
+                     assert_fn=lambda e: _db(e, "good").span_count
+                     > len(synthesize_traces(5, seed=0)),
+                     timeout_s=10.0),
+                Step("rejections observed",
+                     assert_fn=wait_rejected, timeout_s=10.0),
+            ], finally_steps=[
+                Step("clear exporter chaos",
+                     script=lambda e: clear_exporter_chaos(
+                         e, "mockdestination/bad")),
+            ]).run(env)
+            balances = assert_conserved()
+            # the rejection is a NAMED failure class on the bad branch,
+            # never a silent vanish
+            snap = flow_ledger.snapshot()
+            failed_classes = {
+                cls for e in snap["edges"]
+                if e["to"] == "mockdestination/bad"
+                for cls in e["failed"]}
+            assert "MockDestinationError" in failed_classes, snap["edges"]
+            assert balances  # at least one pipeline was registered
+
+
+# ------------------------------------------------------ runner contract
+
+
+class TestFinallySteps:
+    """The scenario runner's always-run cleanup contract (ISSUE 13
+    satellite): a failed chaos scenario can never leak its fault."""
+
+    def test_finally_steps_always_run(self):
+        ran = []
+        cfg = env_config()
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            sc = Scenario("fails-midway", [
+                Step("boom", script=lambda e: 1 / 0),
+                Step("never reached",
+                     script=lambda e: ran.append("main2")),
+            ], finally_steps=[
+                Step("cleanup-1", script=lambda e: ran.append("f1")),
+                Step("cleanup-2-fails", script=lambda e: 1 / 0),
+                Step("cleanup-3", script=lambda e: ran.append("f3")),
+            ])
+            with pytest.raises(AssertionError, match="boom"):
+                sc.run(env)
+        # every finally step ran, even past the failing one
+        assert ran == ["f1", "f3"]
+
+    def test_finally_failure_alone_fails_scenario(self):
+        cfg = env_config()
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            sc = Scenario("clean-but-dirty-finally", [
+                Step("fine", script=lambda e: None),
+            ], finally_steps=[
+                Step("cleanup-fails", script=lambda e: 1 / 0),
+            ])
+            with pytest.raises(AssertionError, match="cleanup-fails"):
+                sc.run(env)
+
+    def test_passing_scenario_returns_all_results(self):
+        cfg = env_config()
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            sc = Scenario("clean", [
+                Step("a", script=lambda e: None),
+            ], finally_steps=[
+                Step("b", script=lambda e: None),
+            ])
+            results = sc.run(env)
+            assert [r.step for r in results] == ["a", "b"]
+            assert all(r.ok for r in results)

@@ -650,8 +650,9 @@ class TestFleetRuleHygiene:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         assert validate_alert_rules(mod.SOAK_ALERTS) == []
+        assert validate_alert_rules(mod.CHAOS_ALERTS) == []
         registry = self._registered_metric_names()
-        for rule in mod.SOAK_ALERTS:
+        for rule in mod.SOAK_ALERTS + mod.CHAOS_ALERTS:
             metric = referenced_metric(rule["expr"])
             assert self._resolves(metric, registry), \
                 f"soak alert {rule['name']}: {metric!r} unregistered"
@@ -664,6 +665,96 @@ class TestFleetRuleHygiene:
         assert not self._resolves("odigos_engine_queue_dpeth", registry)
         assert self._resolves("odigos_engine_queue_depth", registry)
         assert self._resolves("odigos_latency_e2e_ms_p99", registry)
+
+
+class TestChaosInjectorHygiene:
+    """Chaos injector lint (ISSUE 13 satellite): every ``inject_*`` in
+    ``e2e/chaos.py`` must have a paired ``clear_*`` (a fault someone
+    can inject but nobody can lift WILL leak into the next test the
+    first time a scenario dies mid-fault) and must appear in at least
+    one scenario of ``tests/test_chaos_matrix.py`` (an injector nobody
+    exercises is a fault mode nobody has proven the pipeline degrades
+    through)."""
+
+    CHAOS_PATH = os.path.join(PKG_ROOT, "e2e", "chaos.py")
+    MATRIX_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "test_chaos_matrix.py")
+
+    @staticmethod
+    def _toplevel_defs(source: str) -> set:
+        tree = ast.parse(source)
+        return {node.name for node in tree.body
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+
+    @staticmethod
+    def _unpaired(defs: set) -> list:
+        return sorted(
+            name for name in defs
+            if name.startswith("inject_")
+            and f"clear_{name[len('inject_'):]}" not in defs)
+
+    def test_every_injector_has_a_paired_clear(self):
+        with open(self.CHAOS_PATH) as f:
+            defs = self._toplevel_defs(f.read())
+        assert {n for n in defs if n.startswith("inject_")}, \
+            "chaos.py lost its injectors?"
+        assert self._unpaired(defs) == []
+
+    def test_pairing_check_catches_an_unpaired_injector(self):
+        """The lint's own oracle: an injector without a clear must be
+        flagged (guards against the scan degenerating into a no-op)."""
+        defs = self._toplevel_defs(
+            "def inject_gremlins(env):\n    pass\n"
+            "def clear_goblins(env):\n    pass\n")
+        assert self._unpaired(defs) == ["inject_gremlins"]
+
+    def test_registry_covers_every_pair(self):
+        from odigos_tpu.e2e.chaos import INJECTORS
+
+        with open(self.CHAOS_PATH) as f:
+            defs = self._toplevel_defs(f.read())
+        expected = {n[len("inject_"):] for n in defs
+                    if n.startswith("inject_")}
+        assert set(INJECTORS) == expected
+        for name, (inject, clear) in INJECTORS.items():
+            assert inject.__name__ == f"inject_{name}"
+            assert clear.__name__ == f"clear_{name}"
+
+    @staticmethod
+    def _names_used_outside_imports(source: str) -> set:
+        """Name references in the module's NON-import statements — an
+        injector that only appears in the import block is imported,
+        not exercised, and must not satisfy the coverage lint."""
+        used = set()
+        for node in ast.parse(source).body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    used.add(sub.id)
+        return used
+
+    def test_every_injector_appears_in_a_scenario(self):
+        with open(self.CHAOS_PATH) as f:
+            defs = self._toplevel_defs(f.read())
+        with open(self.MATRIX_PATH) as f:
+            used = self._names_used_outside_imports(f.read())
+        missing = sorted(
+            name for name in defs
+            if name.startswith("inject_") and name not in used)
+        assert not missing, (
+            f"chaos injectors never exercised by any scenario in "
+            f"tests/test_chaos_matrix.py: {missing}")
+
+    def test_import_only_reference_does_not_count(self):
+        """The coverage lint's own oracle: an injector that is merely
+        IMPORTED by the matrix module must still read as missing."""
+        used = self._names_used_outside_imports(
+            "from odigos_tpu.e2e import inject_gremlins\n"
+            "def test_x():\n    other_fn()\n")
+        assert "inject_gremlins" not in used
+        assert "other_fn" in used
 
 
 class TestFlowAccounting:

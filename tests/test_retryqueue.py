@@ -1,0 +1,376 @@
+"""Export retry/spill queue tests (ISSUE 13).
+
+Contracts pinned:
+
+* direct pass-through while healthy (one lock acquisition of overhead);
+* a failing export SPILLS instead of raising, the retry thread replays
+  FIFO with jittered exponential backoff, and recovery delivers every
+  batch in the original order;
+* the spill bound is enforced in spans and the overflow is a NAMED
+  ``queue_full`` drop; a shutdown that cannot flush sheds leftovers as
+  named ``shutdown_drain`` — sent == delivered + dropped exactly;
+* queue depth publishes as the ``retry/<exporter>:pending_spans``
+  admission watermark and the ``odigos_export_retry_queue_spans``
+  gauge;
+* ``health()`` round-trips Degraded(ExportRetrying) → Healthy;
+* graph wiring: a ``retry:`` stanza wraps the exporter at build, typo'd
+  stanzas die in validate_config, pipelinegen stamps the stanza from
+  ``collector_gateway.export_retry``;
+* jitter draws are seedable (the --chaos-seed determinism contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from odigos_tpu.components.exporters.retryqueue import (
+    DEFAULTS,
+    RetryQueue,
+    validate_retry_config,
+)
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline.graph import build_graph, validate_config
+from odigos_tpu.selftelemetry.flow import flow_ledger
+from odigos_tpu.utils.telemetry import meter
+
+
+@pytest.fixture(autouse=True)
+def fresh_ledger():
+    flow_ledger.reset()
+    yield
+    flow_ledger.reset()
+
+
+class FlakyExporter:
+    """Test double: fails while ``down`` is set, records delivery order."""
+
+    def __init__(self, name="tracedb/dest"):
+        self.name = name
+        self.config = {}
+        self.down = False
+        self.batches = []
+        self.started = False
+        self.stopped = False
+        self._lock = threading.Lock()
+
+    def consume(self, batch):
+        with self._lock:
+            if self.down:
+                raise RuntimeError("destination down")
+            self.batches.append(batch)
+
+    def start(self):
+        self.started = True
+
+    def shutdown(self):
+        self.stopped = True
+
+    def healthy(self):
+        return True
+
+    def health(self):
+        return ("Healthy", "Running", "")
+
+    @property
+    def span_count(self):
+        with self._lock:
+            return sum(len(b) for b in self.batches)
+
+
+def make_rq(inner=None, **spec) -> tuple[RetryQueue, FlakyExporter]:
+    inner = inner or FlakyExporter()
+    cfg = dict({"initial_backoff_ms": 5, "max_backoff_ms": 20,
+                "seed": 0}, **spec)
+    rq = RetryQueue(inner, cfg)
+    rq.start()
+    return rq, inner
+
+
+def batches(n, spans=4):
+    return [synthesize_traces(spans, seed=s) for s in range(n)]
+
+
+class TestRetryDelivery:
+    def test_direct_path_while_healthy(self):
+        rq, inner = make_rq()
+        try:
+            b = synthesize_traces(3, seed=0)
+            rq.consume(b)
+            assert inner.span_count == len(b)
+            assert rq.pending_spans() == 0
+            assert rq.stats()["spilled_spans"] == 0
+        finally:
+            rq.shutdown()
+
+    def test_spill_and_fifo_redelivery(self):
+        rq, inner = make_rq()
+        try:
+            inner.down = True
+            sent = batches(4, spans=2)
+            for b in sent:
+                rq.consume(b)
+            assert rq.pending_spans() == sum(len(b) for b in sent)
+            assert rq.health()[0:2] == ("Degraded", "ExportRetrying")
+            time.sleep(0.1)  # let the retry thread fail at least once
+            inner.down = False
+            assert rq.flush(timeout=10.0)
+            # FIFO: the destination sees the original byte order
+            assert [id(b) for b in inner.batches] \
+                == [id(b) for b in sent]
+            assert rq.health()[0] == "Healthy"
+            st = rq.stats()
+            assert st["delivered_spans"] == sum(len(b) for b in sent)
+            assert st["dropped_spans"] == 0
+            assert st["retries"] > 0
+        finally:
+            rq.shutdown()
+
+    def test_arrivals_behind_nonempty_queue_keep_order(self):
+        rq, inner = make_rq()
+        try:
+            inner.down = True
+            first = synthesize_traces(2, seed=0)
+            rq.consume(first)
+            inner.down = False
+            # destination is healthy again, but the queue is non-empty:
+            # a new arrival must enqueue BEHIND the head, not overtake
+            second = synthesize_traces(2, seed=1)
+            rq.consume(second)
+            assert rq.flush(timeout=10.0)
+            assert [id(b) for b in inner.batches] == [id(first),
+                                                      id(second)]
+        finally:
+            rq.shutdown()
+
+
+class TestNamedTerminalDrops:
+    def test_overflow_named_queue_full(self):
+        rq, inner = make_rq(max_queue_spans=10)
+        try:
+            inner.down = True
+            sent = batches(5, spans=4)  # 20 spans into a 10-span bound
+            for b in sent:
+                rq.consume(b)
+            st = rq.stats()
+            assert st["dropped_spans"] > 0
+            assert st["pending_spans"] <= 10
+            drops = {
+                (d["component"], r): n
+                for d in flow_ledger.snapshot()["drops"]
+                for r, n in d["reasons"].items()}
+            assert drops.get(("retry/tracedb/dest", "queue_full")) \
+                == st["dropped_spans"]
+            # the export ledger closes: sent == pending + dropped
+            assert st["pending_spans"] + st["dropped_spans"] \
+                == sum(len(b) for b in sent)
+        finally:
+            rq.shutdown()
+
+    def test_shutdown_flushes_then_names_the_rest(self):
+        rq, inner = make_rq(drain_timeout_s=0.2)
+        inner.down = True
+        sent = batches(3, spans=2)
+        for b in sent:
+            rq.consume(b)
+        rq.shutdown()  # destination still down: bounded flush fails
+        st = rq.stats()
+        assert st["pending_spans"] == 0
+        assert st["dropped_spans"] == sum(len(b) for b in sent)
+        drops = {
+            (d["component"], r): n
+            for d in flow_ledger.snapshot()["drops"]
+            for r, n in d["reasons"].items()}
+        assert drops.get(("retry/tracedb/dest", "shutdown_drain")) \
+            == st["dropped_spans"]
+        assert inner.stopped
+
+    def test_shutdown_bounded_even_when_export_hangs(self):
+        # a destination that HANGS (not raises) wedges the retry thread
+        # inside inner.consume holding the export lock — shutdown must
+        # still return inside the drain budget, naming the leftovers
+        release = threading.Event()
+        hung = threading.Event()
+        inner = FlakyExporter()
+        orig = inner.consume
+
+        def hanging(batch):
+            hung.set()
+            release.wait(30.0)
+            orig(batch)
+
+        rq, _ = make_rq(inner, drain_timeout_s=0.3)
+        inner.down = True
+        rq.consume(synthesize_traces(2, seed=0))  # raises -> spills
+        inner.down = False
+        inner.consume = hanging  # the RETRY thread now wedges on it
+        assert hung.wait(5.0), "retry thread never attempted the head"
+        rq.consume(synthesize_traces(2, seed=1))  # queued behind it
+        t0 = time.monotonic()
+        rq.shutdown()
+        assert time.monotonic() - t0 < 10.0, "shutdown wedged"
+        assert rq.stats()["dropped_spans"] > 0  # named, not silent
+        release.set()  # unwedge the leaked daemon thread
+        rq, inner = make_rq(drain_timeout_s=5.0)
+        inner.down = True
+        sent = batches(2, spans=2)
+        for b in sent:
+            rq.consume(b)
+        # stop the retry thread from winning the race deterministically:
+        # recover the destination only at shutdown time
+        inner.down = False
+        rq.shutdown()
+        assert inner.span_count == sum(len(b) for b in sent)
+        assert rq.stats()["dropped_spans"] == 0
+
+
+class TestObservability:
+    def test_watermark_and_gauge_published(self):
+        rq, inner = make_rq()
+        try:
+            inner.down = True
+            b = synthesize_traces(3, seed=0)
+            rq.consume(b)
+            assert flow_ledger.watermark_current(
+                "retry/tracedb/dest", "pending_spans") == len(b)
+            key = ("odigos_export_retry_queue_spans"
+                   "{exporter=tracedb/dest}")
+            assert meter.snapshot().get(key) == float(len(b))
+            inner.down = False
+            assert rq.flush(10.0)
+            assert flow_ledger.watermark_current(
+                "retry/tracedb/dest", "pending_spans") == 0
+        finally:
+            rq.shutdown()
+
+    def test_arrivals_do_not_defeat_the_backoff(self):
+        # regression: the backoff sleep must NOT wake on every arriving
+        # batch — sustained traffic during an outage would otherwise
+        # hammer the dead destination at the arrival rate, the exact
+        # re-synchronized storm the jitter exists to prevent
+        inner = FlakyExporter()
+        attempts = {"n": 0}
+        orig = inner.consume
+
+        def counting(batch):
+            attempts["n"] += 1
+            orig(batch)
+
+        inner.consume = counting
+        rq, _ = make_rq(inner, initial_backoff_ms=300,
+                        max_backoff_ms=600, jitter=0.0)
+        try:
+            inner.down = True
+            for b in batches(6, spans=2):
+                rq.consume(b)
+                time.sleep(0.01)
+            time.sleep(0.15)
+            # inside one 300 ms backoff window: at most the direct
+            # attempt + the retry thread's first try — never one
+            # attempt per arrival
+            assert attempts["n"] <= 3, attempts["n"]
+        finally:
+            inner.down = False
+            rq.shutdown()
+
+    def test_jitter_is_seeded(self):
+        import random
+
+        ref = random.Random(7)
+        draws_a = [ref.random() for _ in range(4)]
+        rq, _ = make_rq(seed=7)
+        try:
+            assert [rq._rng.random() for _ in range(4)] == draws_a
+        finally:
+            rq.shutdown()
+
+    def test_inner_query_api_delegates(self):
+        rq, inner = make_rq()
+        try:
+            b = synthesize_traces(2, seed=0)
+            rq.consume(b)
+            assert rq.span_count == inner.span_count  # __getattr__
+        finally:
+            rq.shutdown()
+
+
+class TestGraphWiring:
+    def base_cfg(self, retry):
+        return {
+            "receivers": {"synthetic": {"n_batches": 0}},
+            "processors": {},
+            "exporters": {"tracedb/out": {"retry": retry}},
+            "service": {"pipelines": {"traces/in": {
+                "receivers": ["synthetic"], "processors": [],
+                "exporters": ["tracedb/out"]}}},
+        }
+
+    def test_retry_stanza_wraps_exporter(self):
+        g = build_graph(self.base_cfg({"max_queue_spans": 64}))
+        exp = g.exporters["tracedb/out"]
+        assert isinstance(exp, RetryQueue)
+        assert exp.max_queue_spans == 64
+        assert g.component("tracedb/out") is exp
+
+    def test_retry_true_uses_defaults(self):
+        g = build_graph(self.base_cfg(True))
+        exp = g.exporters["tracedb/out"]
+        assert isinstance(exp, RetryQueue)
+        assert exp.max_queue_spans == DEFAULTS["max_queue_spans"]
+
+    def test_retry_empty_mapping_also_means_defaults(self):
+        # {} is the all-defaults spelling (what pipelinegen's
+        # export_retry={} renders) — it must wrap, not silently skip
+        g = build_graph(self.base_cfg({}))
+        assert isinstance(g.exporters["tracedb/out"], RetryQueue)
+
+    def test_retry_enabled_false_is_an_opt_out(self):
+        # {"enabled": false} must leave the exporter UNWRAPPED — its
+        # failures surface per batch, exactly what the opt-out asked for
+        g = build_graph(self.base_cfg({"enabled": False}))
+        assert not isinstance(g.exporters["tracedb/out"], RetryQueue)
+        g2 = build_graph(self.base_cfg({"enabled": True}))
+        assert isinstance(g2.exporters["tracedb/out"], RetryQueue)
+
+    def test_no_stanza_no_wrapper(self):
+        cfg = self.base_cfg(True)
+        del cfg["exporters"]["tracedb/out"]["retry"]
+        g = build_graph(cfg)
+        assert not isinstance(g.exporters["tracedb/out"], RetryQueue)
+
+    def test_validation_refuses_typos(self):
+        assert validate_retry_config("e", {"max_queue_spnas": 1})
+        assert validate_retry_config("e", {"jitter": 1.5})
+        assert validate_retry_config("e", {"initial_backoff_ms": 0})
+        assert validate_retry_config("e", {"max_queue_spans": 0.5})
+        assert validate_retry_config("e", "yes")
+        assert validate_retry_config("e", True) == []
+        assert validate_retry_config("e", {"jitter": 0.3}) == []
+        problems = validate_config(self.base_cfg({"bogus_key": 1}))
+        assert any("unknown retry keys" in p for p in problems)
+
+    def test_pipelinegen_stamps_destination_exporters(self):
+        from odigos_tpu.components.api import Signal
+        from odigos_tpu.destinations import Destination
+        from odigos_tpu.pipelinegen import GatewayOptions
+        from odigos_tpu.pipelinegen.builder import build_gateway_config
+
+        dests = [Destination(id="db1", dest_type="tracedb",
+                             signals=[Signal.TRACES])]
+        spec = {"max_queue_spans": 128}
+        cfg, _, _ = build_gateway_config(
+            dests, options=GatewayOptions(export_retry=spec))
+        dest_exporters = [e for e in cfg["exporters"]
+                          if e.startswith("tracedb/")]
+        assert dest_exporters
+        for eid in dest_exporters:
+            assert cfg["exporters"][eid]["retry"] == spec
+        # internal self-telemetry exporters stay unwrapped
+        assert "retry" not in cfg["exporters"].get("otlp/ui", {})
+        # None renders byte-identically to the pre-ISSUE-13 shape
+        cfg2, _, _ = build_gateway_config(dests,
+                                          options=GatewayOptions())
+        assert all("retry" not in (e or {})
+                   for e in cfg2["exporters"].values())

@@ -100,6 +100,18 @@ SOAK_ALERTS = [
      "for_s": 2.0, "severity": "warning"},
 ]
 
+# extra rules the --chaos run loads (ISSUE 13): the injected faults
+# must fire exactly these — a failover trip and a retry backlog are the
+# alerts the chaos record asserts on
+CHAOS_ALERTS = [
+    {"name": "failover-active",
+     "expr": "max(odigos_failover_state[30s]) >= 1",
+     "for_s": 0.0, "severity": "warning"},
+    {"name": "export-retry-backlog",
+     "expr": "max(odigos_export_retry_queue_spans[30s]) > 0",
+     "for_s": 0.0, "severity": "warning"},
+]
+
 
 def run_soak(args, fast_path: bool) -> dict:
     if args.mesh:
@@ -163,6 +175,12 @@ def run_soak(args, fast_path: bool) -> dict:
     tpu_cfg = {"model": args.model, "threshold": 0.6,
                "timeout_ms": 30000, "shared_engine": False,
                "warm_ladder": True}
+    if args.chaos:
+        # chaos soak (ISSUE 13): arm the failover breaker so the
+        # injected device loss trips to the CPU fallback mid-window
+        tpu_cfg["failover"] = {
+            "trip_errors": 3, "window_s": 5.0,
+            "probe_interval_s": 0.5, "recovery_successes": 2}
     if args.model == "transformer":
         # multichip soak route: a small real transformer (wire soaks
         # measure the path, not the model) with bounded coalescing so
@@ -214,9 +232,21 @@ def run_soak(args, fast_path: bool) -> dict:
             "anomaly_pipelines": ["traces/anomaly"],
             "default_pipelines": ["traces/normal"],
             "mode": "trace"}},
-        "exporters": {"tracedb/anomaly": {}, "tracedb/normal": {}},
+        # chaos soak: destinations ride the retry/spill queue so the
+        # injected outage spills + recovers instead of failing batches.
+        # ONE spec for both exporters — the chaos verdict sums both
+        # spill queues, so their bounds must never silently diverge
+        "exporters": {
+            eid: ({"retry": {"initial_backoff_ms": 20,
+                             "max_backoff_ms": 200,
+                             "max_queue_spans": 4 << 20,
+                             "seed": args.chaos_seed}}
+                  if args.chaos else {})
+            for eid in ("tracedb/anomaly", "tracedb/normal")
+        },
         "service": {
-            "alerts": [dict(a) for a in SOAK_ALERTS],
+            "alerts": [dict(a) for a in SOAK_ALERTS]
+            + ([dict(a) for a in CHAOS_ALERTS] if args.chaos else []),
             # GC isolation (ISSUE 12), BOTH arms (the A/B compares the
             # paths, not the GC posture): the paced janitor owns gen-0/1
             # sweeps, thresholds absorb per-frame churn, and freeze
@@ -402,6 +432,44 @@ def run_soak(args, fast_path: bool) -> dict:
         exp.flush(timeout=30.0)
         exp.shutdown()
 
+    # ---- chaos schedule (ISSUE 13): faults injected MID-WINDOW on the
+    # live pipeline — device loss at 20% (failover trips to the CPU
+    # fallback), cleared at 45% (half-open probes recover); destination
+    # outage on tracedb/normal at 55% (spans spill into the retry
+    # queue), restored at 80% (backlog drains). Every event is
+    # timestamped into the record; the oracle at the end is the same
+    # as the scenario matrix: zero unexplained loss.
+    chaos_events: list = []
+
+    def _mark(event: str) -> None:
+        chaos_events.append({"event": event,
+                             "t_s": round(time.perf_counter() - t0, 3)})
+
+    def chaos_schedule() -> None:
+        T = args.seconds
+        normal_wrap = collector.graph.exporters["tracedb/normal"]
+
+        def outage(batch):
+            raise RuntimeError("chaos soak: destination outage")
+
+        plan = [
+            (0.20 * T, "device_fault_injected",
+             lambda: engine.inject_device_fault("chaos soak: device "
+                                                "lost")),
+            (0.45 * T, "device_fault_cleared",
+             lambda: engine.clear_device_fault()),
+            (0.55 * T, "destination_outage_injected",
+             lambda: setattr(normal_wrap.inner, "export", outage)),
+            (0.80 * T, "destination_outage_cleared",
+             lambda: normal_wrap.inner.__dict__.pop("export", None)),
+        ]
+        for at_s, name, action in plan:
+            delay = at_s - (time.perf_counter() - t0)
+            if delay > 0 and stop.wait(delay):
+                return
+            action()
+            _mark(name)
+
     threads = [threading.Thread(target=sender, args=(i,), daemon=True)
                for i in range(args.senders)]
     probe_thread = threading.Thread(target=prober, daemon=True)
@@ -409,6 +477,11 @@ def run_soak(args, fast_path: bool) -> dict:
     for t in threads:
         t.start()
     probe_thread.start()
+    chaos_thread = None
+    if args.chaos:
+        chaos_thread = threading.Thread(target=chaos_schedule,
+                                        daemon=True)
+        chaos_thread.start()
     # fleet publish/evaluate cadence (ISSUE 10): the soak's main wait
     # doubles as the plane timer — each tick delta-publishes the
     # collector's snapshot + rollup under {collector=} and advances the
@@ -424,7 +497,21 @@ def run_soak(args, fast_path: bool) -> dict:
     for t in threads:
         t.join(timeout=90)
     probe_thread.join(timeout=60)
+    if chaos_thread is not None:
+        chaos_thread.join(timeout=10)
+        # belt and braces: the schedule clears its own faults, but a
+        # short run may end mid-fault — the record must measure the
+        # RECOVERED pipeline's ledger, not a wedged one
+        engine.clear_device_fault()
+        collector.graph.exporters["tracedb/normal"].inner.__dict__.pop(
+            "export", None)
     collector.drain_receivers(timeout=60.0)
+    if args.chaos:
+        # the spill queues must drain before "received" is read — a
+        # batch still in flight through the retry ladder is pending,
+        # not lost
+        for eid in ("tracedb/anomaly", "tracedb/normal"):
+            collector.graph.exporters[eid].flush(timeout=60.0)
     elapsed = time.perf_counter() - t0
 
     received = (anomaly.span_count + normal.span_count
@@ -462,7 +549,13 @@ def run_soak(args, fast_path: bool) -> dict:
             "pipeline": d["pipeline"], "component": d["component"],
             "signal": d["signal"], "reasons": dict(d["reasons"])})
     balances = flow_ledger.conservation()
-    conserved = (received == sent) and all(
+    # terminal drops the export retry queues NAMED (chaos mode): those
+    # spans left the pipeline and were accounted — explained, not lost
+    retry_dropped = sum(
+        collector.graph.exporters[eid].stats()["dropped_spans"]
+        for eid in ("tracedb/anomaly", "tracedb/normal")) \
+        if args.chaos else 0
+    conserved = (received + retry_dropped == sent) and all(
         b["leak"] == 0 for b in balances.values())
     admission_rejected = {
         k.split("reason=", 1)[1].rstrip("}"): int(v)
@@ -533,6 +626,27 @@ def run_soak(args, fast_path: bool) -> dict:
              + (engine_pool["misses"] if engine_pool else 0))
             / pool_agg["leases"], 4) \
             if pool_agg["leases"] else None
+
+    # chaos evidence (ISSUE 13), read BEFORE shutdown: the injected
+    # fault timeline, the breaker's transitions, the retry queues'
+    # ledgers, and the explicit zero-unexplained-loss verdict the
+    # acceptance asks for — sent == received + every NAMED terminal
+    # drop, with every pipeline balance exact
+    chaos_summary = None
+    if args.chaos:
+        retry_stats = {
+            eid: collector.graph.exporters[eid].stats()
+            for eid in ("tracedb/anomaly", "tracedb/normal")}
+        chaos_summary = {
+            "seed": args.chaos_seed,
+            "events": chaos_events,
+            "failover": engine.failover_status(),
+            "export_retry": retry_stats,
+            "retry_dropped_spans": retry_dropped,
+            # the acceptance verdict: every span either delivered or
+            # carries a named reason, and every balance closed exactly
+            "zero_unexplained_loss": bool(conserved),
+        }
 
     fleet_snap = fleet_plane.api_snapshot()
     fleet_summary = {
@@ -626,6 +740,8 @@ def run_soak(args, fast_path: bool) -> dict:
         "pipeline_e2e_ms": pipeline_e2e,
         # zero-allocation + GC-isolation evidence (ISSUE 12)
         "steady_state": steady_state,
+        # chaos fault timeline + degradation evidence (ISSUE 13)
+        "chaos": chaos_summary,
         "latency_note": ("probe batches ride the same wire/pipeline as "
                          "the load; p* = send-to-export wall time under "
                          f"full multi-sender soak load, CPU {args.model} "
@@ -723,6 +839,20 @@ def main() -> None:
                          "'knee' deep in the overload regime where "
                          "tails are governed by shed policy, not by "
                          "the path)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="inject faults MID-WINDOW (ISSUE 13): device "
+                         "loss at 20%% of the run (failover breaker "
+                         "trips to the CPU fallback, recovers after "
+                         "the 45%% clear) and a destination outage at "
+                         "55%% (spans spill into the export retry "
+                         "queue, drain after the 80%% restore); "
+                         "records CHAOS.json instead of SOAK.json "
+                         "with the fault timeline, breaker/retry "
+                         "evidence, and the zero-unexplained-loss "
+                         "verdict")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="seed for the chaos run's randomized draws "
+                         "(retry jitter) — same seed, same schedule")
     ap.add_argument("--model", default="zscore",
                     choices=["zscore", "transformer"],
                     help="scoring backend for the soak route")
@@ -817,12 +947,16 @@ def main() -> None:
         "spans/s are NOT comparable across machines (prior SOAK.json "
         "records came from larger hosts — compare fast path vs "
         "componentwise_baseline from the SAME record instead)")
-    with open(os.path.join(REPO, "SOAK.json"), "w") as f:
+    record = "CHAOS.json" if args.chaos else "SOAK.json"
+    with open(os.path.join(REPO, record), "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
     if not result["conservation"]:
         print(f"SPAN LOSS: sent {result['spans_sent']} received "
               f"{result['spans_received']}", file=sys.stderr)
+        sys.exit(1)
+    if args.chaos and not result["chaos"]["zero_unexplained_loss"]:
+        print("CHAOS: unexplained loss", file=sys.stderr)
         sys.exit(1)
 
 
