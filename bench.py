@@ -195,6 +195,13 @@ def main() -> None:
     print(json.dumps(result), flush=True)
 
     try:
+        result.update(hot_reload_bench())
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"hot reload bench failed: {type(e).__name__}: {e}")
+        result["hot_reload_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result), flush=True)
+
+    try:
         result.update(ingest_path_bench())
     except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
         log(f"ingest path bench failed: {type(e).__name__}: {e}")
@@ -962,6 +969,98 @@ def forwarder_lanes_bench() -> dict:
             "lane-pickup stage p50 from the latency ledger — the "
             "head-of-line the single forwarder serialized"),
     }
+
+
+def hot_reload_bench() -> dict:
+    """Incremental vs full hot-reload wall time (ISSUE 14 acceptance:
+    ≥10× reduction) on the SOAK-shaped config: the SAME single-knob
+    change (tpuanomaly threshold toggle) applied through the
+    incremental patch path vs forced through the historic full-rebuild
+    path (``Collector._reload_full`` — the exact code topology changes
+    still take). Interleaved rounds, per-mode p50 — the full path's
+    cost is graph build + stop/start of every node incl. the wire
+    receiver's rebind and the engine bounce; the incremental path is
+    one reconfigure call under the collector lock."""
+    import copy
+
+    from odigos_tpu.pipeline.service import Collector
+    from odigos_tpu.selftelemetry.flow import flow_ledger
+    from odigos_tpu.utils.telemetry import meter
+
+    cfg = {
+        "receivers": {"otlpwire": {
+            "admission": {"watermarks": {
+                "engine/zscore": {"queue_depth": 8},
+                "fastpath/traces/in": {"backlog_ms": 60.0,
+                                       "pending_spans": 96 * 1024},
+                "traces/in/memory_limiter": {"inflight_bytes": 400e6},
+                "traces/in/batch": {"pending_spans": 48 * 1024},
+            }, "refresh_ms": 2.0},
+        }},
+        "processors": {
+            "memory_limiter": {"limit_mib": 512},
+            "batch": {"send_batch_size": 8192, "timeout_s": 0.1},
+            "tpuanomaly": {"model": "zscore", "threshold": 0.6,
+                           "timeout_ms": 30000, "shared_engine": False,
+                           "warm_ladder": True},
+        },
+        "connectors": {"anomalyrouter": {
+            "anomaly_pipelines": ["traces/anomaly"],
+            "default_pipelines": ["traces/normal"],
+            "mode": "trace"}},
+        "exporters": {"tracedb/anomaly": {}, "tracedb/normal": {}},
+        "service": {"pipelines": {
+            "traces/in": {
+                "receivers": ["otlpwire"],
+                "processors": ["memory_limiter", "batch", "tpuanomaly"],
+                "exporters": ["anomalyrouter"],
+                "fast_path": {"deadline_ms": 100.0, "lanes": 4}},
+            "traces/anomaly": {"receivers": ["anomalyrouter"],
+                               "exporters": ["tracedb/anomaly"]},
+            "traces/normal": {"receivers": ["anomalyrouter"],
+                              "exporters": ["tracedb/normal"]},
+        }},
+    }
+    flow_ledger.reset()
+    collector = Collector(cfg).start()
+    try:
+        def knob(threshold):
+            new = copy.deepcopy(collector.config)
+            new["processors"]["tpuanomaly"]["threshold"] = threshold
+            return new
+
+        # warm both paths once (first full rebuild pays any residual
+        # jit/warm caches; neither warmup is timed)
+        collector.reload(knob(0.61))
+        collector._reload_full(knob(0.62), collector.config)
+
+        rounds = 5
+        inc_ms, full_ms = [], []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            collector.reload(knob(0.6 + 0.001 * (r + 1)))
+            inc_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            collector._reload_full(knob(0.7 + 0.001 * (r + 1)),
+                                   collector.config)
+            full_ms.append((time.perf_counter() - t0) * 1e3)
+        inc_p50 = float(np.percentile(inc_ms, 50))
+        full_p50 = float(np.percentile(full_ms, 50))
+        snap = meter.snapshot()
+        nodes = {a: int(snap.get(
+            f"odigos_collector_reload_nodes_total{{action={a}}}", 0))
+            for a in ("kept", "reconfigured", "replaced")}
+        log(f"hot reload: incremental p50 {inc_p50:.3f} ms vs full "
+            f"{full_p50:.1f} ms ({full_p50 / max(inc_p50, 1e-9):.0f}x)")
+        return {
+            "hot_reload_incremental_ms_p50": round(inc_p50, 4),
+            "hot_reload_full_ms_p50": round(full_p50, 3),
+            "hot_reload_speedup": round(
+                full_p50 / max(inc_p50, 1e-9), 1),
+            "hot_reload_nodes": nodes,
+        }
+    finally:
+        collector.shutdown()
 
 
 def flow_overhead_bench() -> dict:

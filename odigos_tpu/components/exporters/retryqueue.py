@@ -121,10 +121,23 @@ class RetryQueue:
     jittered-backoff replay while the destination is down. Duck-types
     the Exporter lifecycle; unknown attributes delegate to ``inner``."""
 
+    # incremental hot reload (ISSUE 14): the whole ``retry:`` stanza
+    # retunes live on the wrapper — spilled batches are kept, the next
+    # backoff draw sees the new ladder. Flipping the stanza's
+    # PRESENCE (wrap on/off) changes the seam's shape and replaces the
+    # node instead (configdiff's _wants_retry check).
+    RECONFIGURABLE_KEYS = frozenset({"retry"})
+
     def __init__(self, inner: Any, config: Any = None):
-        spec = dict(config) if isinstance(config, dict) else {}
         self.inner = inner
         self.name = inner.name
+        self._apply_spec(config)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._init_state()
+
+    def _apply_spec(self, config: Any) -> None:
+        spec = dict(config) if isinstance(config, dict) else {}
         self.initial_backoff_s = float(
             spec.get("initial_backoff_ms",
                      DEFAULTS["initial_backoff_ms"])) / 1e3
@@ -138,10 +151,25 @@ class RetryQueue:
         self.drain_timeout_s = float(
             spec.get("drain_timeout_s", DEFAULTS["drain_timeout_s"]))
         # seedable jitter: chaos scenarios run deterministic injections
-        # (--chaos-seed), so the backoff draw must be seedable too
-        self._rng = random.Random(spec.get("seed"))
-        self._lock = threading.Lock()
-        self._work = threading.Condition(self._lock)
+        # (--chaos-seed), so the backoff draw must be seedable too.
+        # RNG POSITION is state, not a knob: a reconfigure that keeps
+        # the seed keeps the stream — re-seeding a same-seeded fleet
+        # mid-outage would restart every collector's jitter at draw 0,
+        # re-synchronizing exactly the retry stampede jitter prevents.
+        seed = spec.get("seed")
+        if not hasattr(self, "_rng") or seed != self._seed:
+            self._seed = seed
+            self._rng = random.Random(seed)
+
+    def reconfigure(self, config: dict[str, Any]) -> None:
+        """Live retune of the exporter's ``retry`` stanza (ISSUE 14);
+        ``config`` is the full exporter config. Counters and the spill
+        queue carry over — only the knobs move."""
+        with self._lock:
+            self._apply_spec(config.get("retry"))
+            self._work.notify_all()  # re-evaluate against new bounds
+
+    def _init_state(self) -> None:
         self._drained = threading.Condition(self._lock)
         self._q: deque = deque()
         self._pending_spans = 0

@@ -22,16 +22,28 @@ from ..api import Capabilities, ComponentKind, Factory, Processor, register
 class BatchProcessor(Processor):
     capabilities = Capabilities(mutates_data=False)
 
+    # incremental hot reload (ISSUE 14): every sizing knob retunes live
+    # — buffered spans are kept, the next consume/tick sees new bounds
+    RECONFIGURABLE_KEYS = frozenset({
+        "send_batch_size", "send_batch_max_size", "timeout_s"})
+
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         self._lock = threading.Lock()
         self._pending: list[SpanBatch] = []
         self._pending_spans = 0
         self._timer: Optional[threading.Timer] = None
-        self.send_batch_size = int(config.get("send_batch_size", 8192))
-        self.send_batch_max_size = int(config.get("send_batch_max_size", 0))
-        self.timeout_s = float(config.get("timeout_s", 0.2))
+        self._apply_sizing(config)
         self._wm_name: str | None = None
+
+    def _apply_sizing(self, config: dict[str, Any]) -> None:
+        # ONE parse routine for __init__ and reconfigure — a default
+        # changed in one place only would otherwise retune a reloaded
+        # node differently from a freshly built one
+        self.send_batch_size = int(config.get("send_batch_size", 8192))
+        self.send_batch_max_size = int(config.get("send_batch_max_size",
+                                                  0))
+        self.timeout_s = float(config.get("timeout_s", 0.2))
 
     def _watermark_name(self) -> str:
         # resolved lazily: the graph stamps _flow_site after construction
@@ -39,6 +51,31 @@ class BatchProcessor(Processor):
         if name is None:
             name = self._wm_name = FlowContext.watermark_name(self)
         return name
+
+    def reconfigure(self, config: dict[str, Any]) -> None:
+        """Live retune (ISSUE 14): pending spans are NOT dropped — a
+        shrunk send_batch_size flushes immediately if the buffer
+        already crosses the new bound, and the flush timer is re-armed
+        under the NEW timeout (an armed old-timeout timer — or no
+        timer at all when timeout was 0 — would keep governing the
+        current buffer)."""
+        to_send: list[SpanBatch] = []
+        with self._lock:
+            self.config = config
+            self._apply_sizing(config)
+            if self._pending_spans >= self.send_batch_size:
+                to_send = self._take_locked()
+            else:
+                if self._timer is not None:
+                    self._timer.cancel()
+                    self._timer = None
+                if self._pending and self.timeout_s > 0:
+                    self._timer = threading.Timer(self.timeout_s,
+                                                  self._flush_timer)
+                    self._timer.daemon = True
+                    self._timer.start()
+        if to_send:
+            self._send(to_send)
 
     def consume(self, batch: SpanBatch) -> None:
         to_send: list[SpanBatch] = []

@@ -50,11 +50,15 @@ class MemoryLimiterError(RuntimeError):
 
 
 class MemoryLimiterProcessor(Processor):
+    # incremental hot reload (ISSUE 14): both budget knobs retune live;
+    # in-flight accounting carries over (the counter, not the limits,
+    # is the state)
+    RECONFIGURABLE_KEYS = frozenset({"limit_mib",
+                                     "spike_limit_fraction"})
+
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
-        self.limit_bytes = int(config.get("limit_mib", 512)) * 1024 * 1024
-        spike = float(config.get("spike_limit_fraction", 0.2))
-        self.soft_bytes = int(self.limit_bytes * (1.0 - spike))
+        self._apply_limits(config)
         self._inflight = 0
         self._lock = threading.Lock()
         # labeled rejection counter: the pipeline label the autoscaler
@@ -71,6 +75,19 @@ class MemoryLimiterProcessor(Processor):
         if name is None:
             name = self._wm_name = FlowContext.watermark_name(self)
         return name
+
+    def _apply_limits(self, config: dict[str, Any]) -> None:
+        # one parse routine for __init__ and reconfigure (no default
+        # drift between a reloaded node and a freshly built one)
+        self.limit_bytes = int(config.get("limit_mib",
+                                          512)) * 1024 * 1024
+        spike = float(config.get("spike_limit_fraction", 0.2))
+        self.soft_bytes = int(self.limit_bytes * (1.0 - spike))
+
+    def reconfigure(self, config: dict[str, Any]) -> None:
+        with self._lock:
+            self.config = config
+            self._apply_limits(config)
 
     def consume(self, batch: SpanBatch) -> None:
         size = batch_nbytes(batch)

@@ -97,6 +97,14 @@ class TpuAnomalyProcessor(Processor):
 
     capabilities = Capabilities(mutates_data=True)
 
+    # incremental hot reload (ISSUE 14): the two knobs OUTSIDE the
+    # EngineConfig identity retune live — the warmed engine (bucket
+    # ladder, ScoringPlan caches, failover state) is never rebuilt for
+    # a threshold tweak. Any engine-shaping key (model, mesh, batch
+    # geometry...) changes the shared-engine identity and replaces the
+    # node (or forces a full rebuild under a fast_path alias).
+    RECONFIGURABLE_KEYS = frozenset({"threshold", "timeout_ms"})
+
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
         fz = FeaturizerConfig(attr_slots=int(config.get("attr_slots", 0)))
@@ -134,8 +142,17 @@ class TpuAnomalyProcessor(Processor):
         )
         self.engine = _engine_for(self.engine_cfg,
                                   bool(config.get("shared_engine", True)))
+        self._apply_knobs(config)
+
+    def _apply_knobs(self, config: dict[str, Any]) -> None:
+        # one parse routine for __init__ and reconfigure (no default
+        # drift between a reloaded node and a freshly built one)
         self.threshold = float(config.get("threshold", 0.8))
         self.timeout_s = float(config.get("timeout_ms", 5.0)) / 1000.0
+
+    def reconfigure(self, config: dict[str, Any]) -> None:
+        self._apply_knobs(config)
+        self.config = config
 
     def start(self) -> None:
         super().start()

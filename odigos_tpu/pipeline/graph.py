@@ -23,7 +23,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Optional
 
 from ..components.api import (
     Component,
@@ -77,6 +77,14 @@ class Graph:
     # Collector.reload diffs old vs new to retire rules a reload
     # deleted (the remove_slo discipline, keyed by rule name)
     alert_rule_names: set[str] = field(default_factory=set)
+    # incremental hot reload (ISSUE 14): the FlowEdge feeding each node
+    # — (pipeline, component_id) -> edge — so ``patch`` can splice a
+    # replacement onto the EXISTING edge (stats re-bound, never reset);
+    # branch_edges are the per-terminal edges, (pipeline, terminal_id)
+    node_edges: dict[tuple[str, str], FlowEdge] = field(
+        default_factory=dict)
+    branch_edges: dict[tuple[str, str], FlowEdge] = field(
+        default_factory=dict)
 
     def all_components(self) -> list[Component]:
         # extensions first: healthcheck must be able to answer before any
@@ -111,6 +119,248 @@ class Graph:
             if fp.name == component_id:
                 return fp
         raise KeyError(component_id)
+
+    # ---------------------------------------- incremental patch (ISSUE 14)
+
+    def node_count(self) -> int:
+        return (len(self.receivers) + len(self.exporters)
+                + len(self.connectors) + len(self.extensions)
+                + len(self.processors) + len(self.fastpaths))
+
+    def patch(self, diff, new_config: dict[str, Any],
+              reg: Registry | None = None) -> dict[str, int]:
+        """Apply an INCREMENTAL ConfigDiff to this running graph:
+        reconfigure-in-place nodes retune live, replace nodes are
+        rebuilt one at a time and spliced onto their existing flow
+        edges (``edge.inner`` swap — the ledger counters re-bind, they
+        never reset), and every other node is never touched: kept
+        receivers keep their socket binds, kept scorers their warm
+        ladders and compiled plans, kept pools their buffers.
+
+        The caller (Collector.reload) holds the collector lock and
+        falls back to the full-rebuild path if anything here raises —
+        a half-applied patch never survives."""
+        reg = reg or default_registry
+        counts = {"kept": 0, "reconfigured": 0, "replaced": 0}
+        pipelines = new_config.get("service", {}).get("pipelines", {})
+        for act in diff.actions:
+            if act.kind == "fastpath":
+                pname = act.node[0]
+                fp = self.fastpaths.get(pname)
+                if fp is None:
+                    continue
+                fp.reconfigure(self._fastpath_runtime_cfg(pname,
+                                                          pipelines))
+                counts["reconfigured"] += 1
+            elif act.kind == "processor":
+                self._patch_processor(act, new_config, pipelines, reg,
+                                      counts)
+            elif act.kind == "receiver":
+                self._patch_receiver(act, new_config, reg, counts)
+            elif act.kind == "exporter":
+                self._patch_terminal(act, new_config, reg, counts,
+                                     connector=False)
+            elif act.kind == "connector":
+                self._patch_terminal(act, new_config, reg, counts,
+                                     connector=True)
+            elif act.kind == "extension":
+                self._patch_extension(act, new_config, reg, counts)
+        counts["kept"] = max(
+            0, self.node_count() - counts["reconfigured"]
+            - counts["replaced"])
+        return counts
+
+    def _fastpath_runtime_cfg(self, pname: str,
+                              pipelines: dict[str, Any]) -> dict:
+        """The fast path's effective config — ONE derivation shared
+        with build_graph (absent deadline_ms = the scoring stage's own
+        latency budget), so a patched route and a fully rebuilt one
+        cannot diverge."""
+        fp_cfg = (pipelines.get(pname) or {}).get("fast_path")
+        cfg = dict(fp_cfg) if isinstance(fp_cfg, dict) else {}
+        scorer = _pipeline_scorer(self.pipeline_processors.get(pname,
+                                                               []))
+        if scorer is not None:
+            cfg.setdefault("deadline_ms", scorer.timeout_s * 1e3)
+        return cfg
+
+    def _patch_processor(self, act, new_config, pipelines, reg,
+                         counts) -> None:
+        from .configdiff import RECONFIGURE, merged_component_config
+
+        pname, pid = act.node
+        comp = self.processors.get((pname, pid))
+        if comp is None:
+            return
+        user_cfg = (new_config.get("processors") or {}).get(pid)
+        signal = pname.split("/", 1)[0]
+        if act.action == RECONFIGURE:
+            comp.reconfigure(merged_component_config(
+                reg, ComponentKind.PROCESSOR, pid, user_cfg))
+            counts["reconfigured"] += 1
+        else:
+            # resolve the feeding edge BEFORE starting the new node:
+            # the guard raise must be side-effect-free (a started
+            # orphan in no table would never be shut down)
+            edge = self.node_edges.get((pname, pid))
+            if edge is None:
+                raise KeyError(f"no edge recorded for ({pname}, {pid})")
+            new = reg.get(ComponentKind.PROCESSOR, pid).build(pid,
+                                                              user_cfg)
+            new.set_consumer(comp.next_consumer)
+            new._flow_site = (pname, new.name, signal)
+            try:
+                new.start()
+            except Exception:
+                # a replacement that fails to start is in no table:
+                # stop whatever it half-spawned before the fallback
+                # runs, or its threads outlive the reload
+                try:
+                    new.shutdown()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+                raise
+            # splice: swap the feeding edge's inner FIRST (no new data
+            # reaches the old node), then flush the old node's pending
+            # through its still-wired downstream, then stop it —
+            # drain -> replace -> splice with zero lost spans
+            edge.inner = new
+            self.processors[(pname, pid)] = new
+            chain = self.pipeline_processors.get(pname, [])
+            for i, proc in enumerate(chain):
+                if proc is comp:
+                    chain[i] = new
+            flush = getattr(comp, "flush", None)
+            if flush is not None:
+                flush()
+            comp.shutdown()
+            flow_ledger.register_pipeline(pname, [new], [], signal)
+            counts["replaced"] += 1
+            comp = new
+        # fast-path glue: the route aliases the scorer's threshold (and
+        # derives its default deadline from the scorer's budget) — a
+        # retuned scorer must retune the route, or the two would tag at
+        # different thresholds until the next full rebuild
+        fp = self.fastpaths.get(pname)
+        if fp is not None and getattr(comp, "engine", None) is not None \
+                and fp.engine is comp.engine:
+            fp.threshold = float(comp.threshold)
+            fp_cfg = (pipelines.get(pname) or {}).get("fast_path")
+            if not (isinstance(fp_cfg, dict) and "deadline_ms" in fp_cfg):
+                fp.reconfigure(self._fastpath_runtime_cfg(pname,
+                                                          pipelines))
+
+    def _patch_receiver(self, act, new_config, reg, counts) -> None:
+        from .configdiff import RECONFIGURE, merged_component_config
+
+        (rid,) = act.node
+        comp = self.receivers.get(rid)
+        if comp is None:
+            return  # declared but unused: nothing was built
+        user_cfg = (new_config.get("receivers") or {}).get(rid)
+        if act.action == RECONFIGURE:
+            comp.reconfigure(merged_component_config(
+                reg, ComponentKind.RECEIVER, rid, user_cfg))
+            counts["reconfigured"] += 1
+            return
+        # build BEFORE stopping the old node: a replacement whose
+        # config dies in the constructor must leave the live receiver
+        # serving (binds happen in start(), so building first doesn't
+        # violate the fixed-port constraint). Stop-before-START still
+        # holds: the old node releases its bind before the new one
+        # binds it — scoped to the one changed receiver, every
+        # untouched receiver keeps serving throughout.
+        new = reg.get(ComponentKind.RECEIVER, rid).build(rid, user_cfg)
+        comp.shutdown()
+        new.set_consumer(comp.next_consumer)
+        try:
+            new.start()
+        except Exception:
+            # unwind: a replacement that cannot start (unbindable
+            # port) must not leave the slot dead — restore + restart
+            # the old node BEFORE re-raising, so the full-rebuild
+            # fallback (and its resurrect path) operates on a
+            # consistent old graph that can actually serve again
+            try:
+                new.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            comp.start()
+            raise
+        self.receivers[rid] = new
+        counts["replaced"] += 1
+
+    def _patch_terminal(self, act, new_config, reg, counts,
+                        connector: bool) -> None:
+        from .configdiff import RECONFIGURE, merged_component_config
+
+        (cid,) = act.node
+        table = self.connectors if connector else self.exporters
+        comp = table.get(cid)
+        if comp is None:
+            return
+        kind = ComponentKind.CONNECTOR if connector \
+            else ComponentKind.EXPORTER
+        user_cfg = (new_config.get(
+            "connectors" if connector else "exporters") or {}).get(cid)
+        if act.action == RECONFIGURE:
+            comp.reconfigure(merged_component_config(reg, kind, cid,
+                                                     user_cfg))
+            counts["reconfigured"] += 1
+            return
+        if connector:
+            new = reg.get(kind, cid).build(cid, user_cfg)
+            new.set_outputs(comp.outputs)
+        else:
+            new = _build_exporter(reg, cid, user_cfg,
+                                  new_config.get("extensions", {}))
+        try:
+            new.start()
+        except Exception:
+            # same orphan guard as the processor splice: the old node
+            # is still wired and serving, the failed replacement must
+            # not leak its half-started machinery
+            try:
+                new.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
+        # swap every branch edge feeding the old node (a singleton may
+        # terminate several pipelines), then flush+stop it — pending
+        # exports drain through the old instance before it dies
+        for (pname, tid), edge in self.branch_edges.items():
+            if tid == cid:
+                edge.inner = new
+        table[cid] = new
+        comp.shutdown()
+        counts["replaced"] += 1
+
+    def _patch_extension(self, act, new_config, reg, counts) -> None:
+        (xid,) = act.node
+        comp = self.extensions.get(xid)
+        if comp is None:
+            return
+        # build first (a bad config must not kill the live extension);
+        # old releases its port before the replacement binds in start()
+        new = reg.get(ComponentKind.EXTENSION,
+                      xid.split("/", 1)[0]).build(
+            xid, (new_config.get("extensions") or {}).get(xid) or {})
+        comp.shutdown()
+        if hasattr(new, "set_graph"):
+            new.set_graph(self)
+        try:
+            new.start()
+        except Exception:
+            # same unwind contract as the receiver splice: restore the
+            # old node before the fallback runs
+            try:
+                new.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            comp.start()
+            raise
+        self.extensions[xid] = new
+        counts["replaced"] += 1
 
 
 def validate_config(config: dict[str, Any]) -> list[str]:
@@ -350,6 +600,16 @@ def validate_config(config: dict[str, Any]) -> list[str]:
     return problems
 
 
+def _pipeline_scorer(procs: list) -> Any:
+    """The chain's scoring stage (engine + threshold) — the ONE
+    selection rule shared by build_graph's fast-path wiring and
+    Graph.patch's deadline re-derivation."""
+    return next(
+        (proc for proc in procs
+         if getattr(proc, "engine", None) is not None
+         and hasattr(proc, "threshold")), None)
+
+
 def _topological_pipelines(pipelines: dict[str, Any]) -> list[str]:
     """Kahn topo sort over connector edges (A -> B when a connector is an
     exporter of A and a receiver of B). Config validated acyclic already."""
@@ -376,6 +636,43 @@ def _topological_pipelines(pipelines: dict[str, Any]) -> list[str]:
             if indeg[nxt] == 0:
                 queue.append(nxt)
     return order
+
+
+def _build_exporter(reg: Registry, eid: str,
+                    ecfg: Optional[dict[str, Any]],
+                    extensions: dict[str, Any]):
+    """Build one exporter the way the graph does: resolve its
+    authenticator extension into ``auth_resolved`` and wrap it in a
+    RetryQueue when a ``retry:`` stanza asks for one. One
+    implementation for build_graph AND ``Graph.patch`` — a per-node
+    replacement must produce exactly what a full rebuild would."""
+    ref = (ecfg or {}).get("auth", {}).get("authenticator")
+    if ref:
+        # the extension TYPE rides along so the exporter knows which
+        # authenticator semantics apply (basicauth vs bearertoken vs
+        # oauth2client vs googleclientauth)
+        ecfg = {**ecfg, "auth_resolved": {
+            "_type": ref.split("/", 1)[0], **extensions[ref]}}
+    exp = reg.get(ComponentKind.EXPORTER, eid).build(eid, ecfg)
+    retry_spec = (ecfg or {}).get("retry")
+    if isinstance(retry_spec, dict) \
+            and not retry_spec.get("enabled", True):
+        # {"enabled": false} is an explicit opt-out — wrapping
+        # anyway would silently swallow the destination's failures
+        # the operator just asked to see
+        retry_spec = None
+    if retry_spec not in (None, False):  # {} = all defaults
+        # export retry/spill (ISSUE 13): wrap the destination in a
+        # bounded jittered-backoff spill queue — a destination
+        # outage degrades to Degraded(ExportRetrying) + a
+        # watermarked queue instead of per-batch failures, and
+        # every terminal loss is a named queue_full/shutdown_drain
+        # drop (components/exporters/retryqueue.py)
+        from ..components.exporters.retryqueue import RetryQueue
+
+        exp = RetryQueue(
+            exp, retry_spec if isinstance(retry_spec, dict) else {})
+    return exp
 
 
 def build_graph(config: dict[str, Any],
@@ -414,33 +711,7 @@ def build_graph(config: dict[str, Any],
                 f"factory for type {xtype!r} and no extensions "
                 f"config entry (authenticator)")
     for eid, ecfg in config.get("exporters", {}).items():
-        ref = (ecfg or {}).get("auth", {}).get("authenticator")
-        if ref:
-            # the extension TYPE rides along so the exporter knows which
-            # authenticator semantics apply (basicauth vs bearertoken vs
-            # oauth2client vs googleclientauth)
-            ecfg = {**ecfg, "auth_resolved": {
-                "_type": ref.split("/", 1)[0], **extensions[ref]}}
-        exp = reg.get(ComponentKind.EXPORTER, eid).build(eid, ecfg)
-        retry_spec = (ecfg or {}).get("retry")
-        if isinstance(retry_spec, dict) \
-                and not retry_spec.get("enabled", True):
-            # {"enabled": false} is an explicit opt-out — wrapping
-            # anyway would silently swallow the destination's failures
-            # the operator just asked to see
-            retry_spec = None
-        if retry_spec not in (None, False):  # {} = all defaults
-            # export retry/spill (ISSUE 13): wrap the destination in a
-            # bounded jittered-backoff spill queue — a destination
-            # outage degrades to Degraded(ExportRetrying) + a
-            # watermarked queue instead of per-batch failures, and
-            # every terminal loss is a named queue_full/shutdown_drain
-            # drop (components/exporters/retryqueue.py)
-            from ..components.exporters.retryqueue import RetryQueue
-
-            exp = RetryQueue(
-                exp, retry_spec if isinstance(retry_spec, dict) else {})
-        g.exporters[eid] = exp
+        g.exporters[eid] = _build_exporter(reg, eid, ecfg, extensions)
     for cid, ccfg in conn_cfgs.items():
         g.connectors[cid] = reg.get(ComponentKind.CONNECTOR, cid).build(cid, ccfg)
 
@@ -462,10 +733,16 @@ def build_graph(config: dict[str, Any],
         for eid in terminal_ids:
             cons: Consumer = (g.connectors[eid] if eid in g.connectors
                               else g.exporters[eid])
-            branches.append(FlowEdge(
+            branch = FlowEdge(
                 cons, flow_ledger.edge(pname, last_name, eid, signal,
                                        balance=False),
-                (pname, eid, signal)))
+                (pname, eid, signal))
+            # indexed for incremental hot reload (ISSUE 14): a
+            # per-node exporter/connector replacement swaps
+            # ``edge.inner`` on these, keeping the edge (and its
+            # conservation counters) in place
+            g.branch_edges[(pname, eid)] = branch
+            branches.append(branch)
         fan: Consumer = branches[0] if len(branches) == 1 \
             else FanoutConsumer(branches)
         no_chain = not chain
@@ -484,6 +761,7 @@ def build_graph(config: dict[str, Any],
                 proc, flow_ledger.edge(pname, from_name, proc.name,
                                        signal, entry=(i == 0)),
                 (pname, proc.name, signal))
+            g.node_edges[(pname, proc.name)] = tail
         g.pipeline_processors[pname] = chain
         # ingest fast path (ISSUE 6): replace the pipeline entry with a
         # route that featurizes each decoded frame once and scores it
@@ -498,10 +776,7 @@ def build_graph(config: dict[str, Any],
         if fp_cfg:
             from ..serving.fastpath import IngestFastPath
 
-            scorer = next(
-                (proc for proc in chain
-                 if getattr(proc, "engine", None) is not None
-                 and hasattr(proc, "threshold")), None)
+            scorer = _pipeline_scorer(chain)
             if scorer is None:
                 # validate_config guards the normal build path by id
                 # prefix; a registry substituting a non-scoring
@@ -510,9 +785,9 @@ def build_graph(config: dict[str, Any],
                 raise ValueError(
                     f"pipeline {pname}: fast_path requires a scoring "
                     f"processor (engine + threshold) in the chain")
-            cfg = dict(fp_cfg) if isinstance(fp_cfg, dict) else {}
-            # default deadline = the scoring stage's own latency budget
-            cfg.setdefault("deadline_ms", scorer.timeout_s * 1e3)
+            # effective config (deadline default = the scoring stage's
+            # own budget): one derivation with Graph.patch's reload path
+            cfg = g._fastpath_runtime_cfg(pname, pipelines)
             fp = IngestFastPath(pname, scorer.engine, scorer.threshold,
                                 downstream=scorer.next_consumer,
                                 config=cfg)
@@ -523,6 +798,7 @@ def build_graph(config: dict[str, Any],
                 fp, flow_ledger.edge(pname, ENTRY_NODE, fp.name, signal,
                                      entry=True),
                 (pname, fp.name, signal))
+            g.node_edges[(pname, fp.name)] = entry
         flow_ledger.register_pipeline(pname, reg_procs, terminal_ids,
                                       signal)
         from ..selftelemetry.latency import latency_ledger

@@ -11,6 +11,7 @@ while the old one drains.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional
 
 import odigos_tpu.components  # noqa: F401  (registers builtin factories)
@@ -18,8 +19,17 @@ import odigos_tpu.components  # noqa: F401  (registers builtin factories)
 from ..selftelemetry.flow import register_rollup, unregister_rollup
 from ..selftelemetry.profiler import start_from_config, stop_started
 from ..serving.gcisolation import gc_plane
-from ..utils.telemetry import meter
-from .graph import Graph, build_graph
+from ..utils.telemetry import labeled_key, meter
+from .configdiff import FULL, diff_configs
+from .graph import Graph, build_graph, validate_config
+
+# reload self-telemetry (ISSUE 14): duration histogram labeled by the
+# path taken (incremental = reconfigure-only, replace = ≥1 node
+# rebuilt+spliced, full = whole-graph rebuild) and per-node action
+# counters — "what did this reload cost and touch" from /metrics alone
+RELOAD_MS_METRIC = "odigos_collector_reload_ms"
+RELOAD_NODES_METRIC = "odigos_collector_reload_nodes_total"
+RELOAD_FAILURES_METRIC = "odigos_collector_reload_failures_total"
 
 
 class Collector:
@@ -34,6 +44,13 @@ class Collector:
         # those are stopped on shutdown (another owner's stay running)
         self._telemetry_started: list[str] = []
         self._gc_started = False
+        # set when an incremental patch raised mid-apply AND the full
+        # fallback also failed: live component state may then diverge
+        # from self.config, so the next reload must not no-op on
+        # config equality and must take the full path — a revert to
+        # the recorded config converges the graph instead of serving
+        # half-applied knobs forever
+        self._graph_dirty = False
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Collector":
@@ -133,14 +150,151 @@ class Collector:
 
     # ------------------------------------------------------------ hot swap
     def reload(self, new_config: dict[str, Any]) -> None:
-        """Swap in a rebuilt graph: drain + stop the old one first, then
-        start the new (otelcol reload semantics). Stop-before-start is
-        required for fixed-port receivers (the VM distribution's otlp
-        port): the old graph still holds the bind until it stops, and
-        allow_reuse_address makes the same-port rebind immediate."""
-        if new_config == self.config:
+        """Converge the running collector onto ``new_config``.
+
+        Incremental first (ISSUE 14): a structural differ
+        (pipeline/configdiff.py) classifies every node; when the change
+        is non-topological the live graph is PATCHED — unchanged nodes
+        (receivers with live binds, engines with warm ladders and
+        compiled plans, buffer pools, flow-edge stats) are kept,
+        declared-reconfigurable knobs retune in place, and everything
+        else is rebuilt per node and spliced onto its existing edges.
+        A knob change under full load costs milliseconds of patching,
+        not a pipeline teardown.
+
+        Topology changes (and anything the differ cannot prove safe)
+        take the historic full-rebuild path bit-equivalently: drain +
+        stop the old graph, build + start the new, atomically swap.
+        Stop-before-start is required there for fixed-port receivers
+        (the VM distribution's otlp port): the old graph holds the
+        bind until it stops, and allow_reuse_address makes the
+        same-port rebind immediate. On the incremental path an
+        untouched receiver never releases its bind at all.
+
+        Failures (invalid config, partial start) leave the old graph
+        serving and are counted ONCE here — never also by the
+        ConfigMap watcher (wire/hotreload.py)."""
+        if new_config == self.config and not self._graph_dirty:
             return  # a no-op reload must not bounce intake
-        old_config = self.config
+        t0 = time.perf_counter()
+        try:
+            mode, counts = self._reload_dispatch(new_config)
+        except Exception:
+            # the one failure-count site for every path — build errors,
+            # validation errors, partial-start unwinds (ISSUE 14
+            # satellite: watch_configmap used to count these a second
+            # time)
+            meter.add(RELOAD_FAILURES_METRIC)
+            raise
+        meter.record(labeled_key(RELOAD_MS_METRIC, mode=mode),
+                     (time.perf_counter() - t0) * 1e3)
+        for action, n in (counts or {}).items():
+            if n:
+                meter.add(labeled_key(RELOAD_NODES_METRIC,
+                                      action=action), n)
+        meter.add("odigos_collector_reloads_total")
+
+    def _reload_dispatch(
+            self, new_config: dict[str, Any]
+    ) -> tuple[str, Optional[dict[str, int]]]:
+        """Route one reload: incremental patch when the diff proves it
+        safe, the full rebuild otherwise (or when the patch fails
+        mid-way — a half-applied graph must never survive). Snapshot,
+        diff AND patch happen under ONE lock hold: two concurrent
+        reloads diffing against the same base would otherwise let the
+        second apply a stale (too-small) diff while overwriting
+        ``self.config`` wholesale — live state silently diverged from
+        the recorded config."""
+        with self._lock:
+            old_config = self.config
+            diff = None
+            if self._running and not self._graph_dirty:
+                try:
+                    diff = diff_configs(old_config, new_config,
+                                        self._registry,
+                                        graph=self.graph)
+                except Exception:  # noqa: BLE001 — malformed configs
+                    # classify by failing the full build's real error
+                    diff = None
+            if diff is not None and diff.mode != FULL:
+                # the full path validates inside build_graph; the
+                # incremental path must refuse an invalid config with
+                # the SAME surface — old graph intact, ValueError
+                # naming every problem
+                problems = validate_config(new_config)
+                if problems:
+                    raise ValueError("invalid pipeline config: "
+                                     + "; ".join(problems))
+                try:
+                    counts = self.graph.patch(diff, new_config,
+                                              self._registry)
+                    self._apply_service_stanzas(diff, old_config,
+                                                new_config)
+                    self.config = new_config
+                    return (("replace" if counts.get("replaced")
+                             else "incremental"), counts)
+                except Exception:  # noqa: BLE001 — fall back below,
+                    # never keep a half-patched graph. Mark it dirty
+                    # and make the abandonment countable: if the full
+                    # fallback ALSO fails (same bad value), applied
+                    # reconfigures survive — the dirty flag forces the
+                    # NEXT reload (even a revert to the recorded
+                    # config) through the full path so it converges.
+                    self._graph_dirty = True
+                    meter.add(
+                        "odigos_collector_reload_patch_fallbacks_total")
+        self._reload_full(new_config, self.config)
+        return "full", None
+
+    def _apply_service_stanzas(self, diff, old_config: dict[str, Any],
+                               new_config: dict[str, Any]) -> None:
+        """In-place application of the service-level stanzas the
+        incremental path carries as flags (each already had a live
+        update seam; the differ just routes to them). Caller holds the
+        collector lock."""
+        new_svc = new_config.get("service", {})
+        if diff.slo_changed:
+            from ..selftelemetry.latency import latency_ledger
+
+            pipelines = new_svc.get("pipelines", {})
+            for pname in diff.slo_changed:
+                slo = (pipelines.get(pname) or {}).get("slo")
+                if slo:
+                    latency_ledger.configure_slo(pname, dict(slo))
+                else:
+                    # a reload that DELETES the stanza retires the
+                    # tracker, or stale objectives keep evaluating
+                    latency_ledger.remove_slo(pname)
+        if diff.alerts_changed:
+            from ..selftelemetry.fleet import alert_engine
+
+            new_names: set[str] = set()
+            for rule_cfg in new_svc.get("alerts") or []:
+                # get-or-create stable on an identical spec: firing
+                # state survives a reload that didn't touch the rule
+                alert_engine.configure(dict(rule_cfg))
+                new_names.add(rule_cfg["name"])
+            for name in self.graph.alert_rule_names - new_names:
+                alert_engine.remove(name)
+            self.graph.alert_rule_names = new_names
+        if diff.telemetry_changed:
+            stop_started(self._telemetry_started)
+            self._telemetry_started = start_from_config(
+                new_svc.get("telemetry"))
+        if diff.gc_changed or not self._gc_started:
+            # bounce only on a CHANGED stanza: unfreeze + full collect
+            # + refreeze is tens of ms of GIL hold in live lane frames
+            if self._gc_started:
+                gc_plane.stop()
+            gc_plane.start(new_svc.get("gc"))
+            self._gc_started = True
+
+    def _reload_full(self, new_config: dict[str, Any],
+                     old_config: dict[str, Any]) -> None:
+        """The historic whole-graph swap: drain + stop the old graph,
+        build + start the new, atomically exchange (otelcol reload
+        semantics). Topology changes and differ fallbacks land here —
+        bit-equivalent to the pre-incremental behavior."""
         new_graph = build_graph(new_config, self._registry)
         with self._lock:
             old_graph, old_running = self.graph, self._running
@@ -161,8 +315,7 @@ class Collector:
                             pass
                     for comp in old_graph.all_components():
                         comp.start()
-                    meter.add("odigos_collector_reload_failures_total")
-                    raise
+                    raise  # counted once, by reload()
             # a reload that edited/deleted alert rules must retire the
             # ones no longer declared (the remove_slo discipline): the
             # new build upserted its own rules already, so the diff of
@@ -181,6 +334,9 @@ class Collector:
                 unregister_rollup(old_graph.flow_health)
                 register_rollup(new_graph.flow_health)
             self.graph, self.config = new_graph, new_config
+            # every node was rebuilt from new_config: whatever a
+            # failed patch left behind is gone with the old graph
+            self._graph_dirty = False
             if old_running:
                 # re-anchor the telemetry subsystems on the new stanza
                 stop_started(self._telemetry_started)
@@ -198,4 +354,3 @@ class Collector:
                         gc_plane.stop()
                     gc_plane.start(new_gc)
                     self._gc_started = True
-        meter.add("odigos_collector_reloads_total")

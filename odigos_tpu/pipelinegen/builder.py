@@ -115,6 +115,47 @@ class ResourceStatuses:
     processor: dict[str, Optional[str]] = field(default_factory=dict)
 
 
+def config_node_hashes(config: GenericMap) -> dict[str, str]:
+    """Per-node content fingerprints of a (generated) collector config:
+    one sha256 of canonical JSON per component id (``processors/batch``,
+    ``receivers/otlp``, ...), per pipeline (``pipelines/traces/in``) and
+    per service stanza (``service/alerts``...).
+
+    This is the incremental-reload contract pipelinegen owes the differ
+    (ISSUE 14): node identities are STABLE across regenerations — the
+    builder derives every id deterministically from destination/stream/
+    processor inputs, never from counters or ordering accidents — so a
+    re-render with unchanged inputs hashes identically node for node
+    and ``pipeline/configdiff.diff_configs`` classifies it all-keep.
+    The soak's ``--reload-storm`` embeds the changed-hash set per
+    reload to prove exactly which nodes a config push touched, and
+    tests pin the regeneration-stability property. One canonical hash
+    rule shared with the ConfigMap watcher (utils/canonical.py), so
+    the node fingerprints and the watcher's whole-config hash can
+    never disagree on what counts as a change."""
+    from ..utils.canonical import content_hash as _h
+
+    hashes: dict[str, str] = {}
+    for section in ("receivers", "processors", "exporters",
+                    "connectors", "extensions"):
+        for cid, ccfg in (config.get(section) or {}).items():
+            hashes[f"{section}/{cid}"] = _h(ccfg)
+    svc = config.get("service") or {}
+    for pname, pcfg in (svc.get("pipelines") or {}).items():
+        hashes[f"pipelines/{pname}"] = _h(pcfg)
+    for stanza in sorted(set(svc) - {"pipelines"}):
+        hashes[f"service/{stanza}"] = _h(svc[stanza])
+    return hashes
+
+
+def changed_node_hashes(old: GenericMap, new: GenericMap) -> list[str]:
+    """Node keys whose content hash differs between two configs (added
+    and removed nodes count as changed) — the one-line answer to "what
+    did this config push actually touch"."""
+    oh, nh = config_node_hashes(old), config_node_hashes(new)
+    return sorted(k for k in set(oh) | set(nh) if oh.get(k) != nh.get(k))
+
+
 def router_connector_name(signal: Signal) -> str:
     return f"odigosrouter/{signal.value}"
 

@@ -223,24 +223,24 @@ class IngestFastPath:
     module-cycle note above).
     """
 
-    def __init__(self, pipeline: str, engine: ScoringEngine,
-                 threshold: float, downstream: Any,
-                 config: dict[str, Any]):
-        self.name = str(config.get("name", "fastpath"))
-        self.config = config
-        self._started = False
-        self.pipeline = pipeline
-        self.engine = engine
-        self.threshold = float(threshold)
-        self.downstream = downstream
+    # incremental hot reload (ISSUE 14): the pacing/admission knobs
+    # retune live — in-flight frames keep the deadline they were
+    # admitted under, new frames see the new budget. Structural knobs
+    # (lanes/submit_lanes/ordered/pooled/name) re-thread the pools and
+    # the ordered-gate epoch and fall back to a full rebuild
+    # (pipeline/configdiff.py classifies from this table).
+    RECONFIGURABLE_KEYS = frozenset({
+        "deadline_ms", "max_pending_spans", "drain_timeout_s",
+        "predictive", "predictive_margin", "predictive_min_frames"})
+
+    def _apply_tuning(self, config: dict[str, Any]) -> None:
+        """The reconfigurable-knob parse, shared by ``__init__`` and
+        ``reconfigure`` — ONE set of defaults, so an omitted key on
+        reload returns to exactly what a fresh build would use."""
         self.deadline_ms = float(config.get("deadline_ms", 25.0))
         self._deadline_ns = int(self.deadline_ms * 1e6)
         self.max_pending_spans = int(config.get("max_pending_spans",
                                                 128 * 1024))
-        self.lanes = max(1, int(config.get("lanes", DEFAULT_LANES)))
-        self.submit_lanes = max(1, int(config.get("submit_lanes",
-                                                  self.lanes)))
-        self.ordered = bool(config.get("ordered", False))
         self.drain_timeout_s = float(config.get("drain_timeout_s", 30.0))
         self.predictive = bool(config.get("predictive", True))
         self.predictive_margin = float(config.get("predictive_margin",
@@ -252,6 +252,36 @@ class IngestFastPath:
         self.predictive_min_frames = min(
             int(config.get("predictive_min_frames", 32)),
             RECENT_WINDOW)
+        # re-price promptly: a new deadline/margin changes what the
+        # cached burn sum is compared against
+        self._stage_cost_next_ns = 0
+
+    def reconfigure(self, config: dict[str, Any]) -> None:
+        """Live retune of the declared-reconfigurable knobs. The
+        caller (Graph.patch) has already applied the scorer-derived
+        deadline default."""
+        with self._lock:
+            self.config = dict(config)
+            self._apply_tuning(config)
+        latency_ledger.set_deadline(self.pipeline, self.deadline_ms)
+
+    def __init__(self, pipeline: str, engine: ScoringEngine,
+                 threshold: float, downstream: Any,
+                 config: dict[str, Any]):
+        self.name = str(config.get("name", "fastpath"))
+        self.config = config
+        self._started = False
+        self.pipeline = pipeline
+        self.engine = engine
+        self.threshold = float(threshold)
+        self.downstream = downstream
+        self._apply_tuning(config)
+        # structural knobs (NOT reconfigurable: they re-thread the
+        # pools and the ordered-gate epoch — a change rebuilds)
+        self.lanes = max(1, int(config.get("lanes", DEFAULT_LANES)))
+        self.submit_lanes = max(1, int(config.get("submit_lanes",
+                                                  self.lanes)))
+        self.ordered = bool(config.get("ordered", False))
         self.pooled = bool(config.get("pooled", True))
         self._feat_cfg = engine.cfg.featurizer
         self._needs_features = getattr(engine.backend, "needs_features",

@@ -1,0 +1,21 @@
+"""Canonical config hashing — ONE serialization rule (ISSUE 14).
+
+The ConfigMap watcher's change detection (wire/hotreload.py) and the
+per-node config fingerprints (pipelinegen.builder.config_node_hashes)
+must agree on what counts as a change; two private copies of
+"sha256 of sorted-keys JSON" would silently diverge the first time one
+grows a different serializer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+
+def content_hash(obj: Any) -> str:
+    """sha256 hex digest of the canonical JSON form of ``obj``
+    (sorted keys; non-JSON values stringified)."""
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, default=str).encode()).hexdigest()

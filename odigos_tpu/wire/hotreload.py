@@ -9,20 +9,13 @@ autoscaler writes generated configs into the Store as ConfigMap resources;
 
 from __future__ import annotations
 
-import hashlib
-import json
 from typing import TYPE_CHECKING, Any, Callable, Optional
 
 from ..api.store import Event, EventType, Store
-from ..utils.telemetry import meter
+from ..utils.canonical import content_hash as _content_hash
 
 if TYPE_CHECKING:  # avoid import cycle: pipeline.service imports components
     from ..pipeline.service import Collector
-
-
-def _content_hash(data: dict[str, Any]) -> str:
-    return hashlib.sha256(
-        json.dumps(data, sort_keys=True, default=str).encode()).hexdigest()
 
 
 def watch_configmap(store: Store, namespace: str, name: str,
@@ -56,9 +49,14 @@ def watch_configmap(store: Store, namespace: str, name: str,
             try:
                 collector.reload(cfg)
             except Exception:
-                # bad generated config must not kill the running pipeline;
-                # keep serving the old graph (collector reload semantics)
-                meter.add("odigos_collector_reload_failures_total")
+                # bad generated config must not kill the running
+                # pipeline; keep serving the old graph (collector
+                # reload semantics). The failure metric is counted by
+                # Collector.reload itself — counting here too
+                # double-booked every failure (ISSUE 14 satellite).
+                # state["hash"] stays UNSET on purpose: the watch is
+                # level-triggered, so the next event retries the
+                # reload instead of skipping a hash it never applied.
                 return
             state["hash"] = h  # Collector.reload counts reloads itself
 

@@ -177,27 +177,52 @@ class WireReceiver(Receiver):
     max_inflight_bytes: admission soft limit (default 64 MiB)
     """
 
+    # incremental hot reload (ISSUE 14): the admission posture retunes
+    # live — the gate and byte budget are swapped on the SAME
+    # controller (in-flight accounting and the socket bind survive;
+    # host/port changes replace the node, which is the only time an
+    # otlp receiver releases its bind)
+    RECONFIGURABLE_KEYS = frozenset({"admission", "max_inflight_bytes"})
+
     def __init__(self, name: str, config: dict[str, Any]):
         super().__init__(name, config)
-        adm = config.get("admission") or {}
-        gate = None
-        if adm.get("watermarks"):
-            gate = WatermarkGate(
-                adm["watermarks"],
-                refresh_s=float(adm.get("refresh_ms", 5.0)) / 1e3,
-                inflight_fn=lambda: self.admission.inflight_bytes,
-                receiver_name=name)
         self.admission = AdmissionController(
             int(config.get("max_inflight_bytes", 64 << 20)),
-            watermark_gate=gate)
-        # per-reason rejection counter keys, cached (reason cardinality is
-        # the handful of configured watermark names)
+            watermark_gate=self._build_gate(config))
+        # per-reason rejection counter keys, cached (reason cardinality
+        # is the handful of configured watermark names)
         self._reject_keys: dict[str, tuple[str, str]] = {}
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+
+    def _build_gate(self,
+                    config: dict[str, Any]) -> Optional[WatermarkGate]:
+        adm = config.get("admission") or {}
+        if not adm.get("watermarks"):
+            return None
+        return WatermarkGate(
+            adm["watermarks"],
+            refresh_s=float(adm.get("refresh_ms", 5.0)) / 1e3,
+            inflight_fn=lambda: self.admission.inflight_bytes,
+            receiver_name=self.name)
+
+    def reconfigure(self, config: dict[str, Any]) -> None:
+        # parse EVERYTHING before assigning anything: a bad value must
+        # leave the live admission posture fully intact, never half the
+        # new config (the reload falls back / fails with the old graph
+        # "serving" — it must actually be the old posture). A fresh
+        # gate object means its cached verdict dies with it; the
+        # controller keeps its in-flight byte count — releases of
+        # already-admitted frames must still balance — and any chaos
+        # pressure_fn stays injected.
+        gate = self._build_gate(config)
+        max_bytes = int(config.get("max_inflight_bytes", 64 << 20))
+        self.admission.watermark_gate = gate
+        self.admission.max_inflight_bytes = max_bytes
+        self.config = config
 
     def _count_rejection(self, reason: str, detail: str,
                          nbytes: int) -> None:

@@ -1,0 +1,637 @@
+"""Incremental hot reload (ISSUE 14): the structural config differ's
+classification table (keep / reconfigure-in-place / replace-node /
+full-rebuild fallback), Graph.patch splicing on live edges, and
+Collector.reload routing — a knob change under load must cost a
+node-local patch, keep every warmed structure (receiver binds, shared
+engines), stay conserved, and record its own cost
+(odigos_collector_reload_ms{mode=} + reload_nodes_total{action=})."""
+
+import copy
+import threading
+import time
+
+import pytest
+
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline.configdiff import (
+    FULL,
+    INCREMENTAL,
+    NOOP,
+    RECONFIGURE,
+    REPLACE,
+    diff_configs,
+)
+from odigos_tpu.pipeline.service import Collector
+from odigos_tpu.selftelemetry.flow import flow_ledger
+from odigos_tpu.utils.telemetry import meter
+from odigos_tpu.wire.client import WireExporter
+
+
+def wait_for(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def base_config(**tpu_overrides):
+    cfg = {
+        "receivers": {"synthetic": {"n_batches": 0, "interval_s": 60}},
+        "processors": {
+            "memory_limiter": {"limit_mib": 512},
+            "batch": {"send_batch_size": 512, "timeout_s": 0.05},
+            "tpuanomaly": dict({"model": "mock", "threshold": 0.6,
+                                "timeout_ms": 10_000,
+                                "shared_engine": False},
+                               **tpu_overrides),
+        },
+        "exporters": {"tracedb": {}},
+        "service": {"pipelines": {"traces/in": {
+            "receivers": ["synthetic"],
+            "processors": ["memory_limiter", "batch", "tpuanomaly"],
+            "exporters": ["tracedb"]}}},
+    }
+    return cfg
+
+
+def wire_config(fast_path=True, threshold=0.6, port=0, **fp_overrides):
+    fp = dict({"deadline_ms": 10_000.0, "predictive": False},
+              **fp_overrides)
+    return {
+        "receivers": {"otlpwire": {"port": port}},
+        "processors": {
+            "memory_limiter": {"limit_mib": 512},
+            "batch": {"send_batch_size": 1, "timeout_s": 0.0},
+            "tpuanomaly": {"model": "mock", "threshold": threshold,
+                           "timeout_ms": 30_000,
+                           "shared_engine": False},
+        },
+        "exporters": {"tracedb": {}},
+        "service": {"pipelines": {"traces/in": dict(
+            {"receivers": ["otlpwire"],
+             "processors": ["memory_limiter", "batch", "tpuanomaly"],
+             "exporters": ["tracedb"]},
+            **({"fast_path": fp} if fast_path else {}))}},
+    }
+
+
+# --------------------------------------------------- differ classification
+
+
+class TestDiffClassification:
+    def test_identical_configs_are_noop(self):
+        cfg = base_config()
+        assert diff_configs(cfg, copy.deepcopy(cfg)).mode == NOOP
+
+    def test_explicit_default_is_keep(self):
+        """Normalization merges factory defaults: writing a key at its
+        default value is not a change."""
+        old = base_config()
+        new = copy.deepcopy(old)
+        new["processors"]["batch"]["send_batch_max_size"] = 0  # default
+        new["processors"]["tpuanomaly"]["max_len"] = 64  # default
+        d = diff_configs(old, new)
+        assert d.mode == INCREMENTAL and d.actions == []
+
+    def test_reconfigurable_knob_classifies_reconfigure(self):
+        old = base_config()
+        new = copy.deepcopy(old)
+        new["processors"]["tpuanomaly"]["threshold"] = 0.9
+        new["processors"]["batch"]["send_batch_size"] = 1024
+        new["processors"]["memory_limiter"]["limit_mib"] = 256
+        d = diff_configs(old, new)
+        assert d.mode == INCREMENTAL
+        acts = {a.node: a for a in d.actions}
+        assert acts[("traces/in", "tpuanomaly")].action == RECONFIGURE
+        assert acts[("traces/in", "tpuanomaly")].changed == ("threshold",)
+        assert acts[("traces/in", "batch")].action == RECONFIGURE
+        assert acts[("traces/in", "memory_limiter")].action == RECONFIGURE
+
+    def test_unknown_key_classifies_replace(self):
+        old = base_config()
+        new = copy.deepcopy(old)
+        # engine-shaping key: outside tpuanomaly's RECONFIGURABLE_KEYS
+        new["processors"]["tpuanomaly"]["trace_bucket"] = 128
+        new["receivers"]["synthetic"]["seed"] = 3  # no reconfigure at all
+        d = diff_configs(old, new)
+        assert d.mode == INCREMENTAL
+        acts = {a.node: a for a in d.actions}
+        assert acts[("traces/in", "tpuanomaly")].action == REPLACE
+        assert acts[("synthetic",)].action == REPLACE
+
+    @pytest.mark.parametrize("mutate,reason_frag", [
+        (lambda c: c["service"]["pipelines"].update(
+            {"traces/extra": {"receivers": ["synthetic"],
+                              "exporters": ["tracedb"]}}),
+         "pipeline set changed"),
+        (lambda c: c["service"]["pipelines"]["traces/in"][
+            "processors"].remove("batch"), "processors changed"),
+        (lambda c: c["exporters"].update({"debug": {}}),
+         "component set changed: exporters"),
+        (lambda c: c["service"].update({"mystery": 1}),
+         "service.mystery changed"),
+    ])
+    def test_topology_changes_classify_full(self, mutate, reason_frag):
+        old = base_config()
+        new = copy.deepcopy(old)
+        mutate(new)
+        d = diff_configs(old, new)
+        assert d.mode == FULL
+        assert any(reason_frag in r for r in d.reasons), d.reasons
+
+    def test_fast_path_toggle_and_structural_keys_are_full(self):
+        old = wire_config(fast_path=True)
+        off = wire_config(fast_path=False)
+        assert diff_configs(old, off).mode == FULL
+        lanes = wire_config(fast_path=True, lanes=2)
+        d = diff_configs(old, lanes)
+        assert d.mode == FULL
+        assert any("fast_path structural" in r for r in d.reasons)
+
+    def test_fast_path_knobs_classify_reconfigure(self):
+        old = wire_config(fast_path=True)
+        new = wire_config(fast_path=True)
+        new["service"]["pipelines"]["traces/in"]["fast_path"][
+            "deadline_ms"] = 5_000.0
+        d = diff_configs(old, new)
+        assert d.mode == INCREMENTAL
+        [act] = d.actions
+        assert act.kind == "fastpath" and act.action == RECONFIGURE
+
+    def test_scorer_replace_under_fast_path_is_full(self):
+        old = wire_config(fast_path=True)
+        new = copy.deepcopy(old)
+        new["processors"]["tpuanomaly"]["trace_bucket"] = 128
+        d = diff_configs(old, new)
+        assert d.mode == FULL
+        assert any("under fast_path" in r for r in d.reasons)
+
+    def test_retry_knob_reconfigures_wrap_toggle_replaces(self):
+        old = base_config()
+        old["exporters"]["tracedb"] = {"retry": {"initial_backoff_ms": 20}}
+        knob = copy.deepcopy(old)
+        knob["exporters"]["tracedb"]["retry"]["initial_backoff_ms"] = 40
+        d = diff_configs(old, knob)
+        assert d.mode == INCREMENTAL
+        [act] = d.actions
+        # classified from the live wrapper when a graph is given; from
+        # the config shape alone the wrap decision still matches, so
+        # the class-level table must answer the same way
+        assert act.action == RECONFIGURE and act.changed == ("retry",)
+        unwrapped = copy.deepcopy(old)
+        del unwrapped["exporters"]["tracedb"]["retry"]
+        # retry removed entirely = component-set unchanged, key changed
+        d2 = diff_configs(old, unwrapped)
+        [act2] = d2.actions
+        assert act2.action == REPLACE
+
+    def test_service_stanza_flags(self):
+        old = base_config()
+        new = copy.deepcopy(old)
+        new["service"]["alerts"] = [
+            {"name": "r", "expr": "latest(odigos_g[30s]) > 5"}]
+        new["service"]["gc"] = {"janitor_interval_s": 1.0}
+        new["service"]["pipelines"]["traces/in"]["slo"] = {
+            "latency_p99_ms": 100.0}
+        d = diff_configs(old, new)
+        assert d.mode == INCREMENTAL
+        assert d.alerts_changed and d.gc_changed
+        assert d.slo_changed == ["traces/in"]
+        assert d.actions == []
+
+
+# ------------------------------------------------ incremental reload (live)
+
+
+class TestIncrementalReload:
+    def test_single_knob_reload_keeps_every_node(self):
+        flow_ledger.reset()
+        cfg = base_config()
+        c = Collector(cfg).start()
+        try:
+            g0 = c.graph
+            recv0 = c.graph.receivers["synthetic"]
+            scorer0 = c.graph.processors[("traces/in", "tpuanomaly")]
+            engine0 = scorer0.engine
+            reloads0 = meter.counter("odigos_collector_reloads_total")
+            kept0 = meter.counter(
+                "odigos_collector_reload_nodes_total{action=kept}")
+            new = copy.deepcopy(cfg)
+            new["processors"]["tpuanomaly"]["threshold"] = 0.95
+            c.reload(new)
+            assert c.graph is g0, "incremental reload keeps the graph"
+            assert c.graph.receivers["synthetic"] is recv0
+            assert c.graph.processors[("traces/in",
+                                       "tpuanomaly")] is scorer0
+            assert scorer0.engine is engine0, \
+                "warm engine must survive a threshold tweak"
+            assert scorer0.threshold == 0.95
+            assert c.config == new
+            # satellite 2: the reload priced + attributed itself
+            assert meter.counter(
+                "odigos_collector_reloads_total") == reloads0 + 1
+            assert meter.counter(
+                "odigos_collector_reload_nodes_total"
+                "{action=reconfigured}") >= 1
+            assert meter.counter(
+                "odigos_collector_reload_nodes_total"
+                "{action=kept}") >= kept0 + 4
+            snap = meter.snapshot()
+            assert snap.get(
+                "odigos_collector_reload_ms_count{mode=incremental}",
+                0) >= 1
+        finally:
+            c.shutdown()
+
+    def test_replace_splices_on_existing_edges_and_conserves(self):
+        """A non-reconfigurable processor change rebuilds ONE node and
+        splices it onto the existing flow edges; traffic across the
+        swap stays conserved and the ledger keys persist."""
+        flow_ledger.reset()
+        cfg = base_config()
+        cfg["receivers"]["synthetic"] = {"traces_per_batch": 4,
+                                         "n_batches": 0,
+                                         "interval_s": 0.005}
+        cfg["processors"]["probabilisticsampler"] = {
+            "sampling_percentage": 100.0}
+        cfg["service"]["pipelines"]["traces/in"]["processors"] = [
+            "memory_limiter", "probabilisticsampler", "batch",
+            "tpuanomaly"]
+        c = Collector(cfg).start()
+        try:
+            time.sleep(0.1)
+            sampler0 = c.graph.processors[("traces/in",
+                                           "probabilisticsampler")]
+            batch0 = c.graph.processors[("traces/in", "batch")]
+            sink0 = c.graph.exporters["tracedb"]
+            new = copy.deepcopy(cfg)
+            new["processors"]["probabilisticsampler"] = {
+                "sampling_percentage": 100.0, "hash_seed": 7}
+            c.reload(new)
+            assert c.graph.processors[
+                ("traces/in", "probabilisticsampler")] is not sampler0, \
+                "changed node must be replaced"
+            assert c.graph.processors[("traces/in", "batch")] is batch0
+            assert c.graph.exporters["tracedb"] is sink0
+            assert meter.counter(
+                "odigos_collector_reload_nodes_total"
+                "{action=replaced}") >= 1
+            time.sleep(0.15)
+        finally:
+            c.shutdown()
+        bal = flow_ledger.conservation()["traces/in"]
+        assert bal["leak"] == 0, bal
+        assert bal["items_in"] > 0
+
+    def test_untouched_receiver_keeps_bind_under_live_traffic(self):
+        """The fixed-port constraint, incremental edition: a reload
+        that doesn't touch the wire receiver must not release its bind
+        — the same server socket keeps serving, senders never see a
+        connection reset, and the stream stays conserved."""
+        flow_ledger.reset()
+        cfg = wire_config(fast_path=True)
+        c = Collector(cfg).start()
+        stop = threading.Event()
+        try:
+            recv = c.graph.receivers["otlpwire"]
+            server0, port = recv._server, recv.port
+            fp0 = c.graph.fastpaths["traces/in"]
+            engine0 = fp0.engine
+            exp = WireExporter("t", {"endpoint": f"127.0.0.1:{port}",
+                                     "max_elapsed_s": 30.0})
+            exp.start()
+            batches = [synthesize_traces(16, seed=s) for s in range(4)]
+
+            def sender():
+                k = 0
+                while not stop.is_set():
+                    exp.export(batches[k % 4])
+                    k += 1
+                    while exp.queued > 8 and not stop.is_set():
+                        time.sleep(0.001)
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=sender, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            new = wire_config(fast_path=True, threshold=0.9)
+            c.reload(new)
+            assert c.graph.receivers["otlpwire"] is recv
+            assert recv._server is server0 and recv.port == port, \
+                "kept receiver must keep its exact bind"
+            assert c.graph.fastpaths["traces/in"] is fp0
+            assert fp0.engine is engine0
+            assert fp0.threshold == 0.9, \
+                "scorer reconfigure must retune the aliased fast path"
+            time.sleep(0.2)
+            stop.set()
+            t.join(timeout=10)
+            assert exp.flush(30.0)
+            exp.shutdown()
+            c.drain_receivers(30.0)
+            bal = flow_ledger.conservation()["traces/in"]
+            assert bal["leak"] == 0, bal
+            assert c.graph.exporters["tracedb"].span_count > 0
+        finally:
+            stop.set()
+            c.shutdown()
+
+    def test_fastpath_deadline_reconfigures_live(self):
+        flow_ledger.reset()
+        cfg = wire_config(fast_path=True)
+        c = Collector(cfg).start()
+        try:
+            fp = c.graph.fastpaths["traces/in"]
+            new = wire_config(fast_path=True)
+            new["service"]["pipelines"]["traces/in"]["fast_path"][
+                "deadline_ms"] = 5_000.0
+            c.reload(new)
+            assert c.graph.fastpaths["traces/in"] is fp
+            assert fp.deadline_ms == 5_000.0
+            assert fp._deadline_ns == int(5_000.0 * 1e6)
+        finally:
+            c.shutdown()
+
+    def test_admission_stanza_reconfigures_without_rebind(self):
+        flow_ledger.reset()
+        cfg = wire_config(fast_path=False)
+        c = Collector(cfg).start()
+        try:
+            recv = c.graph.receivers["otlpwire"]
+            server0 = recv._server
+            inflight0 = recv.admission
+            new = copy.deepcopy(cfg)
+            new["receivers"]["otlpwire"]["admission"] = {
+                "watermarks": {"traces/in/batch":
+                               {"pending_spans": 4096}}}
+            c.reload(new)
+            assert c.graph.receivers["otlpwire"] is recv
+            assert recv._server is server0
+            assert recv.admission is inflight0, \
+                "in-flight byte accounting must carry over"
+            assert recv.admission.watermark_gate is not None
+        finally:
+            c.shutdown()
+
+    def test_failed_replacement_build_leaves_old_node_serving(self):
+        """Review regression: a replacement whose CONSTRUCTOR raises
+        must leave the live node untouched (build-before-shutdown) —
+        the receiver keeps its exact bind after the failed reload."""
+        flow_ledger.reset()
+        cfg = wire_config(fast_path=False)
+        c = Collector(cfg).start()
+        try:
+            recv = c.graph.receivers["otlpwire"]
+            server0, port0 = recv._server, recv.port
+            bad = copy.deepcopy(cfg)
+            # host change -> REPLACE classification; the bad byte
+            # budget then dies in WireReceiver.__init__
+            bad["receivers"]["otlpwire"]["host"] = "127.0.0.1"
+            bad["receivers"]["otlpwire"]["max_inflight_bytes"] = "oops"
+            with pytest.raises(Exception):
+                c.reload(bad)
+            assert c.graph.receivers["otlpwire"] is recv
+            assert recv._server is server0 and recv.port == port0, \
+                "old receiver must still hold its bind"
+            assert c.config == cfg
+        finally:
+            c.shutdown()
+
+    def test_failed_replacement_start_restores_old_receiver(self):
+        """Review regression: a replacement that builds but cannot
+        START (unbindable port) must restore + restart the old node
+        before the fallback runs — the collector keeps serving with a
+        live receiver instead of a half-patched dead graph."""
+        import socket
+
+        flow_ledger.reset()
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        taken = blocker.getsockname()[1]
+        cfg = wire_config(fast_path=False)
+        c = Collector(cfg).start()
+        try:
+            recv = c.graph.receivers["otlpwire"]
+            bad = copy.deepcopy(cfg)
+            bad["receivers"]["otlpwire"]["port"] = taken  # REPLACE
+            with pytest.raises(OSError):
+                c.reload(bad)
+            assert c.config == cfg
+            assert c.graph.receivers["otlpwire"] is recv
+            assert recv._server is not None, \
+                "old receiver must be serving again after the unwind"
+            # the restored receiver actually answers (ephemeral port
+            # re-rolled by the restart — read it fresh)
+            exp = WireExporter("t", {
+                "endpoint": f"127.0.0.1:{recv.port}"})
+            exp.start()
+            exp.export(synthesize_traces(4, seed=0))
+            assert exp.flush(20.0)
+            exp.shutdown()
+            assert wait_for(
+                lambda: c.graph.exporters["tracedb"].span_count >= 4)
+        finally:
+            c.shutdown()
+            blocker.close()
+
+    def test_failed_reconfigure_parse_leaves_posture_intact(self):
+        """Review regression: WireReceiver.reconfigure parses every
+        value before assigning any — a bad byte budget must not leave
+        the NEW gate installed on the 'intact' old graph."""
+        flow_ledger.reset()
+        cfg = wire_config(fast_path=False)
+        cfg["receivers"]["otlpwire"]["admission"] = {
+            "watermarks": {"traces/in/batch": {"pending_spans": 4096}}}
+        c = Collector(cfg).start()
+        try:
+            recv = c.graph.receivers["otlpwire"]
+            gate0 = recv.admission.watermark_gate
+            assert gate0 is not None
+            bad = copy.deepcopy(cfg)
+            bad["receivers"]["otlpwire"]["admission"] = {
+                "watermarks": {"traces/in/batch":
+                               {"pending_spans": 1}}}
+            bad["receivers"]["otlpwire"]["max_inflight_bytes"] = "oops"
+            with pytest.raises(Exception):
+                c.reload(bad)
+            assert recv.admission.watermark_gate is gate0, \
+                "half-applied admission posture must never survive"
+            assert recv.admission.max_inflight_bytes == 64 << 20
+            assert c.config == cfg
+        finally:
+            c.shutdown()
+
+    def test_patch_failure_falls_back_to_full_rebuild(self, monkeypatch):
+        """A reconfigure that raises mid-patch must not leave a
+        half-upgraded graph: the reload falls back to the full-rebuild
+        path and still converges."""
+        flow_ledger.reset()
+        from odigos_tpu.components.processors.batch import BatchProcessor
+
+        def boom(self, config):
+            raise RuntimeError("injected reconfigure failure")
+
+        monkeypatch.setattr(BatchProcessor, "reconfigure", boom)
+        cfg = base_config()
+        c = Collector(cfg).start()
+        try:
+            g0 = c.graph
+            new = copy.deepcopy(cfg)
+            new["processors"]["batch"]["send_batch_size"] = 64
+            c.reload(new)  # must NOT raise
+            assert c.graph is not g0, "fallback takes the full path"
+            assert c.config == new
+            assert c.graph.processors[("traces/in",
+                                       "batch")].send_batch_size == 64
+            snap = meter.snapshot()
+            assert snap.get(
+                "odigos_collector_reload_ms_count{mode=full}", 0) >= 1
+        finally:
+            c.shutdown()
+
+    def test_batch_timeout_rearms_on_reconfigure(self):
+        """Review regression: buffered spans under timeout_s=0 (pure
+        size-based batching, no timer armed) must start flushing when
+        a reload introduces a timeout — reconfigure re-arms the flush
+        timer under the new value."""
+        from odigos_tpu.components.processors.batch import BatchProcessor
+
+        out = []
+
+        class Sink:
+            def consume(self, b):
+                out.append(b)
+
+        bp = BatchProcessor("batch", {"send_batch_size": 10_000,
+                                      "timeout_s": 0.0})
+        bp.set_consumer(Sink())
+        bp.start()
+        try:
+            bp.consume(synthesize_traces(2, seed=0))
+            assert not out, "below size bound, no timeout: buffered"
+            bp.reconfigure({"send_batch_size": 10_000,
+                            "timeout_s": 0.05})
+            assert wait_for(lambda: out, 5.0), \
+                "new timeout must govern the already-buffered spans"
+        finally:
+            bp.shutdown()
+
+    def test_half_applied_patch_converges_on_revert(self):
+        """Review regression: two reconfigurable knobs where the
+        SECOND dies parsing (passes validate_config, fails int()) —
+        the first retune is applied, the full fallback fails on the
+        same bad value, and the live graph diverges from the recorded
+        config. The dirty flag must force the operator's revert (to
+        the config the collector still RECORDS) through a full rebuild
+        that converges, instead of no-oping on config equality."""
+        flow_ledger.reset()
+        cfg = base_config()
+        c = Collector(cfg).start()
+        try:
+            bad = copy.deepcopy(cfg)
+            bad["processors"]["memory_limiter"]["limit_mib"] = 1024
+            bad["processors"]["batch"]["send_batch_size"] = "8k"
+            with pytest.raises(Exception):
+                c.reload(bad)
+            assert c.config == cfg, "recorded config must stay old"
+            # live limiter was retuned before the failure (patch order
+            # follows the chain) — the divergence this test pins
+            ml = c.graph.processors[("traces/in", "memory_limiter")]
+            assert ml.limit_bytes == 1024 * 1024 * 1024
+            assert meter.counter(
+                "odigos_collector_reload_patch_fallbacks_total") >= 1
+            # revert to the RECORDED config: equal dicts, but the
+            # dirty flag must force a converging full rebuild
+            c.reload(copy.deepcopy(cfg))
+            ml2 = c.graph.processors[("traces/in", "memory_limiter")]
+            assert ml2.limit_bytes == 512 * 1024 * 1024, \
+                "revert must converge the live graph"
+            assert c.config == cfg
+        finally:
+            c.shutdown()
+
+    def test_slo_only_change_is_incremental(self):
+        from odigos_tpu.selftelemetry.latency import latency_ledger
+
+        flow_ledger.reset()
+        cfg = base_config()
+        c = Collector(cfg).start()
+        try:
+            g0 = c.graph
+            new = copy.deepcopy(cfg)
+            new["service"]["pipelines"]["traces/in"]["slo"] = {
+                "latency_p99_ms": 250.0}
+            c.reload(new)
+            assert c.graph is g0
+            assert "traces/in" in latency_ledger.slo_status()
+            # deleting the stanza retires the tracker, still in place
+            c.reload(copy.deepcopy(cfg))
+            assert c.graph is g0
+            assert "traces/in" not in latency_ledger.slo_status()
+        finally:
+            c.shutdown()
+
+    def test_invalid_config_refused_with_old_graph_intact(self):
+        flow_ledger.reset()
+        cfg = base_config()
+        c = Collector(cfg).start()
+        try:
+            g0 = c.graph
+            failures0 = meter.counter(
+                "odigos_collector_reload_failures_total")
+            bad = copy.deepcopy(cfg)
+            # structurally identical (incremental candidate) but
+            # invalid: a malformed slo must die at validation
+            bad["service"]["pipelines"]["traces/in"]["slo"] = {
+                "latency_p99_ms": -1}
+            with pytest.raises(ValueError, match="slo.latency_p99_ms"):
+                c.reload(bad)
+            assert c.graph is g0 and c.config == cfg
+            # satellite 1: counted exactly once
+            assert meter.counter(
+                "odigos_collector_reload_failures_total") \
+                == failures0 + 1
+        finally:
+            c.shutdown()
+
+
+# ------------------------------------------- pipelinegen node fingerprints
+
+
+class TestNodeHashes:
+    def _gen(self, ids=("d1",)):
+        from odigos_tpu.components.api import Signal
+        from odigos_tpu.destinations.registry import Destination
+        from odigos_tpu.pipelinegen.builder import build_gateway_config
+
+        dests = [Destination(id=i, dest_type="tracedb",
+                             signals=[Signal.TRACES]) for i in ids]
+        cfg, status, _ = build_gateway_config(dests)
+        assert all(v is None for v in status.destination.values())
+        return cfg
+
+    def test_regeneration_is_hash_stable_node_for_node(self):
+        """Stable node identities: re-rendering unchanged inputs must
+        fingerprint identically per node, so the differ classifies a
+        no-op config push as all-keep."""
+        from odigos_tpu.pipelinegen.builder import config_node_hashes
+
+        h1 = config_node_hashes(self._gen())
+        h2 = config_node_hashes(self._gen())
+        assert h1 == h2 and h1, "generated configs must be byte-stable"
+
+    def test_destination_add_touches_only_its_nodes(self):
+        from odigos_tpu.pipelinegen.builder import changed_node_hashes
+
+        changed = changed_node_hashes(self._gen(("d1",)),
+                                      self._gen(("d1", "d2")))
+        assert changed, "a destination add must change nodes"
+        # the d1 exporter and its forward connector are untouched
+        assert not any("tracedb-d1" in k for k in changed), changed
+        # and the diff of the rendered configs is a FULL fallback
+        # (pipeline exporters list changed) — exactly today's behavior
+        d = diff_configs(self._gen(("d1",)), self._gen(("d1", "d2")))
+        assert d.mode == FULL

@@ -757,6 +757,123 @@ class TestChaosInjectorHygiene:
         assert "other_fn" in used
 
 
+class TestReconfigureHygiene:
+    """Incremental-reload lint (ISSUE 14 satellite): the
+    ``RECONFIGURABLE_KEYS`` table is the differ's classification
+    oracle, so it must stay CLOSED and honest — every class declaring
+    it implements ``reconfigure`` and vice versa (a declared key
+    without an implementation would classify a change as retunable and
+    then replace the node anyway; an implementation without the table
+    could never be reached), and every declared key must actually be
+    READ by the class (a stale key would classify a change as handled
+    while reconfigure silently ignores it — the config lies). AST
+    scan over the whole package, so a new reconfigurable component
+    cannot ship half-wired."""
+
+    @staticmethod
+    def _scan_classes(source: str):
+        """(class_name, declared_keys|None, has_reconfigure,
+        string_constants) per class in ``source``; declared_keys is
+        None when the class has no RECONFIGURABLE_KEYS assignment."""
+        out = []
+        for node in ast.walk(ast.parse(source)):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            keys = None
+            has_rec = False
+            consts = set()
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    if sub.name == "reconfigure":
+                        has_rec = True
+                    for inner in ast.walk(sub):
+                        if isinstance(inner, ast.Constant) \
+                                and isinstance(inner.value, str):
+                            consts.add(inner.value)
+                if isinstance(sub, ast.Assign) and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "RECONFIGURABLE_KEYS"
+                        for t in sub.targets):
+                    keys = {
+                        n.value for n in ast.walk(sub.value)
+                        if isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)}
+            out.append((node.name, keys, has_rec, consts))
+        return out
+
+    def _all_classes(self):
+        for dirpath, _dirs, names in os.walk(PKG_ROOT):
+            for n in names:
+                if not n.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, n)
+                with open(path) as f:
+                    for row in self._scan_classes(f.read()):
+                        yield path, row
+
+    def test_declaration_and_implementation_are_paired(self):
+        problems = []
+        for path, (cls, keys, has_rec, _consts) in self._all_classes():
+            if keys is not None and not has_rec:
+                problems.append(
+                    f"{path}:{cls} declares RECONFIGURABLE_KEYS but "
+                    f"implements no reconfigure()")
+            if has_rec and keys is None:
+                problems.append(
+                    f"{path}:{cls} implements reconfigure() but "
+                    f"declares no RECONFIGURABLE_KEYS")
+        assert not problems, problems
+
+    def test_no_stale_keys(self):
+        """Every declared key appears as a string literal inside the
+        class's methods (config.get("key") in __init__/reconfigure/
+        helpers) — the stale-key oracle."""
+        stale = []
+        found_any = False
+        for path, (cls, keys, _has_rec, consts) in self._all_classes():
+            if not keys:
+                continue
+            found_any = True
+            for key in sorted(keys - consts):
+                stale.append(f"{path}:{cls} declares {key!r} but never "
+                             f"reads it")
+        assert found_any, "no RECONFIGURABLE_KEYS tables found at all?"
+        assert not stale, stale
+
+    def test_lint_catches_unpaired_and_stale(self):
+        """The lint's own oracle (guards against the scan degenerating
+        into a no-op): an unpaired declaration, an unpaired
+        implementation, and a stale key must all be flagged."""
+        rows = {r[0]: r for r in self._scan_classes(
+            "class NoImpl:\n"
+            "    RECONFIGURABLE_KEYS = frozenset({'a'})\n"
+            "class NoTable:\n"
+            "    def reconfigure(self, cfg):\n        pass\n"
+            "class Stale:\n"
+            "    RECONFIGURABLE_KEYS = frozenset({'a', 'ghost'})\n"
+            "    def reconfigure(self, cfg):\n"
+            "        self.a = cfg.get('a')\n")}
+        name, keys, has_rec, consts = rows["NoImpl"]
+        assert keys == {"a"} and not has_rec
+        name, keys, has_rec, consts = rows["NoTable"]
+        assert keys is None and has_rec
+        name, keys, has_rec, consts = rows["Stale"]
+        assert keys - consts == {"ghost"}
+
+    def test_differ_fastpath_table_matches_validated_keys(self):
+        """Every fast-path reconfigurable key must be a key
+        graph.validate_config accepts — a key the validator refuses
+        could never reach reconfigure."""
+        from odigos_tpu.serving.fastpath import IngestFastPath
+
+        validated = {"deadline_ms", "max_pending_spans", "lanes",
+                     "submit_lanes", "ordered", "drain_timeout_s",
+                     "name", "predictive", "predictive_margin",
+                     "predictive_min_frames", "pooled"}
+        assert IngestFastPath.RECONFIGURABLE_KEYS <= validated
+
+
 class TestFlowAccounting:
     """Flow-ledger lint (ISSUE 5 satellite): any processor/connector
     module whose ``process``/``consume``/``_emit`` method conditionally

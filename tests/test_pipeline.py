@@ -176,19 +176,29 @@ def test_traffic_metrics_recorded():
 
 
 def test_hot_reload_swaps_graph():
+    """A receiver-only config change takes the INCREMENTAL reload path
+    (ISSUE 14): the changed receiver is rebuilt and spliced, every
+    other node — here the debug exporter — is kept live, so its state
+    (and the flow edges' counters) carry across the reload."""
     cfg = basic_config()
     cfg["receivers"]["synthetic"]["n_batches"] = 2
     with Collector(cfg) as c:
         c.drain_receivers()
-        first = c.component("debug").span_count
+        dbg = c.component("debug")
+        first = dbg.span_count
         assert first > 0
+        recv = c.graph.receivers["synthetic"]
         new_cfg = basic_config()
         new_cfg["receivers"]["synthetic"] = {"traces_per_batch": 2,
                                              "n_batches": 1, "seed": 99}
         c.reload(new_cfg)
         c.drain_receivers()
+        assert c.graph.receivers["synthetic"] is not recv, \
+            "changed receiver must be replaced"
         dbg2 = c.component("debug")
-        assert dbg2.span_count == len(synthesize_traces(2, seed=99))
+        assert dbg2 is dbg, "untouched exporter must be KEPT"
+        assert dbg2.span_count == first + len(synthesize_traces(2,
+                                                                seed=99))
 
 
 def test_mock_destination_rejects():

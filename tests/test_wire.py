@@ -438,6 +438,103 @@ class TestHotReload:
             unsub()
             collector.shutdown()
 
+    def test_start_failure_counted_once_via_watcher(self):
+        """ISSUE 14 satellite: a reload that fails at component START
+        (build succeeds, the new receiver can't bind) used to be
+        counted twice — once by Collector.reload's resurrect path and
+        again by watch_configmap's catch. Exactly once now, and the
+        old graph keeps serving."""
+        import socket
+
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        store = Store()
+        collector = Collector(self._config(0)).start()
+        failures = meter.counter("odigos_collector_reload_failures_total")
+        unsub = watch_configmap(store, "odigos-system", "gw-config",
+                                collector)
+        try:
+            bad = self._config(0)
+            # topology change (receiver added) -> full-rebuild path;
+            # the otlpwire receiver then fails to bind the taken port
+            bad["receivers"]["otlpwire"] = {"port": port}
+            bad["service"]["pipelines"]["traces"]["receivers"] = [
+                "synthetic", "otlpwire"]
+            store.apply(ConfigMap(
+                meta=ObjectMeta(name="gw-config",
+                                namespace="odigos-system"),
+                data=bad))
+            assert meter.counter(
+                "odigos_collector_reload_failures_total") \
+                == failures + 1, "failure must be counted exactly once"
+            assert collector.config == self._config(0)
+        finally:
+            unsub()
+            collector.shutdown()
+            blocker.close()
+
+    def test_failed_reload_retries_on_next_event(self):
+        """Level-triggered contract: a failed reload leaves the
+        watcher's hash UNSET, so the next event retries the same
+        content instead of skipping a hash it never applied."""
+        store = Store()
+        collector = Collector(self._config(0)).start()
+        failures = meter.counter("odigos_collector_reload_failures_total")
+        unsub = watch_configmap(store, "odigos-system", "gw-config",
+                                collector)
+        try:
+            bad = {"service": {"pipelines": {"traces": {
+                "receivers": ["nope"], "exporters": []}}}}
+            cm = ConfigMap(meta=ObjectMeta(name="gw-config",
+                                           namespace="odigos-system"),
+                           data=bad)
+            store.apply(cm)
+            assert meter.counter(
+                "odigos_collector_reload_failures_total") == failures + 1
+            # the SAME bad content on the next event must be retried,
+            # not swallowed by a prematurely-recorded hash
+            store.apply(cm)
+            assert meter.counter(
+                "odigos_collector_reload_failures_total") == failures + 2
+        finally:
+            unsub()
+            collector.shutdown()
+
+    def test_reverted_configmap_converges_without_spurious_reload(self):
+        """A bad push followed by a revert to the RUNNING config must
+        converge silently: the hash still matches the applied config,
+        so no reload fires and nothing is counted."""
+        store = Store()
+        collector = Collector(self._config(0)).start()
+        unsub = watch_configmap(store, "odigos-system", "gw-config",
+                                collector)
+        reloads = meter.counter("odigos_collector_reloads_total")
+        failures = meter.counter("odigos_collector_reload_failures_total")
+        try:
+            store.apply(ConfigMap(
+                meta=ObjectMeta(name="gw-config",
+                                namespace="odigos-system"),
+                data={"service": {"pipelines": {"traces": {
+                    "receivers": ["nope"], "exporters": []}}}}))
+            assert meter.counter(
+                "odigos_collector_reload_failures_total") == failures + 1
+            # operator reverts the ConfigMap to what is running
+            store.apply(ConfigMap(
+                meta=ObjectMeta(name="gw-config",
+                                namespace="odigos-system"),
+                data=self._config(0)))
+            assert collector.config == self._config(0)
+            assert meter.counter(
+                "odigos_collector_reloads_total") == reloads, \
+                "revert to the running config must not reload"
+            assert meter.counter(
+                "odigos_collector_reload_failures_total") == failures + 1
+        finally:
+            unsub()
+            collector.shutdown()
+
     def test_existing_configmap_applied_at_subscribe(self):
         store = Store()
         store.apply(ConfigMap(

@@ -470,6 +470,86 @@ def run_soak(args, fast_path: bool) -> dict:
             action()
             _mark(name)
 
+    # ---- reload storm (ISSUE 14): N single-knob reloads fired
+    # MID-WINDOW on the live collector. Each one must take the
+    # INCREMENTAL path (the threshold toggle is in tpuanomaly's
+    # RECONFIGURABLE_KEYS): per-reload wall time, intake-gap evidence
+    # (REJECTED backoffs + admission sheds + saturation during the
+    # reload call), engine recompile count, and the changed-node
+    # fingerprints land in the record — reload must read as a
+    # data-plane non-event, measured.
+    reload_events: list = []
+
+    def _storm_counters() -> dict:
+        snap = meter.snapshot()
+        return {
+            "rejected_backoffs": sum(
+                v for k, v in snap.items()
+                if k.startswith("odigos_exporter_backpressure_total")),
+            "admission_rejected_frames": sum(
+                v for k, v in snap.items()
+                if k.startswith("odigos_admission_rejected_frames")),
+            "saturated": sum(
+                v for k, v in snap.items()
+                if k.startswith("odigos_fastpath_saturated_total")),
+            "reload_nodes": {
+                action: snap.get(
+                    f"odigos_collector_reload_nodes_total"
+                    f"{{action={action}}}", 0.0)
+                for action in ("kept", "reconfigured", "replaced")},
+        }
+
+    def reload_storm() -> None:
+        import copy as _copy
+
+        from odigos_tpu.models import jitstats
+        from odigos_tpu.pipelinegen.builder import changed_node_hashes
+
+        n = args.reload_storm
+        for k in range(n):
+            # spread across the middle 80% of the window — the storm
+            # must hit steady state, not warmup or drain
+            at = (0.1 + 0.8 * (k + 1) / (n + 1)) * args.seconds
+            delay = at - (time.perf_counter() - t0)
+            if delay > 0 and stop.wait(delay):
+                return
+            new_cfg = _copy.deepcopy(collector.config)
+            new_cfg["processors"]["tpuanomaly"]["threshold"] = \
+                0.6 + 0.001 * ((k % 2) + 1)
+            changed = changed_node_hashes(collector.config, new_cfg)
+            before = _storm_counters()
+            compiles0 = sum(jitstats.cache_sizes().values())
+            w0 = time.perf_counter()
+            try:
+                collector.reload(new_cfg)
+                err = None
+            except Exception as e:  # noqa: BLE001 — record, keep storming
+                err = f"{type(e).__name__}: {e}"[:200]
+            wall_ms = (time.perf_counter() - w0) * 1e3
+            after = _storm_counters()
+            reload_events.append({
+                "reload": k,
+                "at_s": round(time.perf_counter() - t0, 3),
+                "wall_ms": round(wall_ms, 3),
+                "error": err,
+                "changed_nodes": changed,
+                "nodes": {a: int(after["reload_nodes"][a]
+                                 - before["reload_nodes"][a])
+                          for a in before["reload_nodes"]},
+                # intake-gap evidence ACROSS the reload call: REJECTED
+                # answers the senders rode, pre-decode sheds, and
+                # fast-path saturation — all must stay flat for the
+                # swap to count as a non-event (paced below the knee
+                # nothing else sheds)
+                "intake_gap": {
+                    key: int(after[key] - before[key])
+                    for key in ("rejected_backoffs",
+                                "admission_rejected_frames",
+                                "saturated")},
+                "recompiles": int(
+                    sum(jitstats.cache_sizes().values()) - compiles0),
+            })
+
     threads = [threading.Thread(target=sender, args=(i,), daemon=True)
                for i in range(args.senders)]
     probe_thread = threading.Thread(target=prober, daemon=True)
@@ -482,6 +562,11 @@ def run_soak(args, fast_path: bool) -> dict:
         chaos_thread = threading.Thread(target=chaos_schedule,
                                         daemon=True)
         chaos_thread.start()
+    storm_thread = None
+    if args.reload_storm:
+        storm_thread = threading.Thread(target=reload_storm,
+                                        daemon=True)
+        storm_thread.start()
     # fleet publish/evaluate cadence (ISSUE 10): the soak's main wait
     # doubles as the plane timer — each tick delta-publishes the
     # collector's snapshot + rollup under {collector=} and advances the
@@ -497,6 +582,8 @@ def run_soak(args, fast_path: bool) -> dict:
     for t in threads:
         t.join(timeout=90)
     probe_thread.join(timeout=60)
+    if storm_thread is not None:
+        storm_thread.join(timeout=60)
     if chaos_thread is not None:
         chaos_thread.join(timeout=10)
         # belt and braces: the schedule clears its own faults, but a
@@ -740,6 +827,26 @@ def run_soak(args, fast_path: bool) -> dict:
         "pipeline_e2e_ms": pipeline_e2e,
         # zero-allocation + GC-isolation evidence (ISSUE 12)
         "steady_state": steady_state,
+        # incremental hot reload under load (ISSUE 14): per-reload wall
+        # time, node action counts, intake-gap deltas across each
+        # reload call, and engine recompiles (must be zero — the warm
+        # ladder survives a knob change)
+        "reload_storm": ({
+            "reloads": reload_events,
+            "count": len(reload_events),
+            "max_wall_ms": max((e["wall_ms"] for e in reload_events),
+                               default=None),
+            "all_incremental": all(
+                e["nodes"]["replaced"] == 0 and e["error"] is None
+                and e["nodes"]["reconfigured"] >= 1
+                for e in reload_events),
+            "total_intake_gap": {
+                key: sum(e["intake_gap"][key] for e in reload_events)
+                for key in ("rejected_backoffs",
+                            "admission_rejected_frames", "saturated")},
+            "recompiles_total": sum(e["recompiles"]
+                                    for e in reload_events),
+        } if args.reload_storm else None),
         # chaos fault timeline + degradation evidence (ISSUE 13)
         "chaos": chaos_summary,
         "latency_note": ("probe batches ride the same wire/pipeline as "
@@ -850,6 +957,17 @@ def main() -> None:
                          "with the fault timeline, breaker/retry "
                          "evidence, and the zero-unexplained-loss "
                          "verdict")
+    ap.add_argument("--reload-storm", type=int, default=0,
+                    help="fire N single-knob hot reloads MID-WINDOW "
+                         "(ISSUE 14): each toggles the tpuanomaly "
+                         "threshold (an incremental-path knob) on the "
+                         "live collector and records per-reload wall "
+                         "time, intake-gap deltas (REJECTED backoffs, "
+                         "pre-decode sheds, fast-path saturation "
+                         "across the reload call), node action "
+                         "counts, changed-node fingerprints and "
+                         "engine recompile count into SOAK.json's "
+                         "reload_storm section")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="seed for the chaos run's randomized draws "
                          "(retry jitter) — same seed, same schedule")
@@ -947,7 +1065,10 @@ def main() -> None:
         "spans/s are NOT comparable across machines (prior SOAK.json "
         "records came from larger hosts — compare fast path vs "
         "componentwise_baseline from the SAME record instead)")
-    record = "CHAOS.json" if args.chaos else "SOAK.json"
+    # --reload-storm records its own artifact (the CHAOS.json
+    # precedent) so the standing knee/A-B SOAK.json record survives
+    record = "CHAOS.json" if args.chaos else (
+        "RELOAD.json" if args.reload_storm else "SOAK.json")
     with open(os.path.join(REPO, record), "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
@@ -957,6 +1078,18 @@ def main() -> None:
         sys.exit(1)
     if args.chaos and not result["chaos"]["zero_unexplained_loss"]:
         print("CHAOS: unexplained loss", file=sys.stderr)
+        sys.exit(1)
+    if args.reload_storm and not (
+            result["reload_storm"]["count"] == args.reload_storm
+            and result["reload_storm"]["all_incremental"]
+            and result["reload_storm"]["recompiles_total"] == 0):
+        # the acceptance verdict: ALL N requested reloads actually ran
+        # (an empty event list must not certify vacuously — a dead
+        # storm thread is a failed storm), every one took the
+        # incremental path (>=1 reconfigure, 0 replaced, no error),
+        # and nothing compiled
+        print("RELOAD STORM: missing/non-incremental reload or "
+              "recompile", file=sys.stderr)
         sys.exit(1)
 
 
