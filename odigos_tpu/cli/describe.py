@@ -242,6 +242,27 @@ def describe_install(state: CliState) -> str:
     for rec in fleet["recommendations"]:
         lines.append(f"  recommend[{rec['knob']}] {rec['name']}: "
                      f"{rec['recommendation']}")
+    # closed-loop actuator (ISSUE 15): armed state, the in-flight
+    # canary/promotion, and the recent action history — silent when
+    # the loop was never armed in this process
+    from ..controlplane.actuator import fleet_actuator
+
+    act = fleet_actuator.api_snapshot()
+    if act["enabled"] or act["in_flight"] or act["history"]:
+        mode = " (dry-run)" if act["dry_run"] else ""
+        lines.append(f"  actuator: {'armed' if act['enabled'] else 'disarmed'}"
+                     f"{mode}, state {act['state']}, "
+                     f"{len(act['collectors'])} target(s)")
+        cur = act["in_flight"]
+        if cur is not None:
+            lines.append(f"    in flight: {cur['phase']} "
+                         f"{cur['knob']} on {cur['target']} "
+                         f"(rule {cur['rule']})")
+        for h in list(act["history"])[-5:]:
+            detail = h.get("reason") or h.get("rollback_reason") or ""
+            lines.append(f"    [{h['outcome']}] {h['rule']} "
+                         f"knob={h['knob']}"
+                         + (f" — {detail}" if detail else ""))
     ics = state.store.list("InstrumentationConfig")
     lines.append(f"  instrumented workloads: {len(ics)}")
     for ic in ics:

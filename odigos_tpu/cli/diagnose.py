@@ -141,6 +141,13 @@ def collect_bundle(state: CliState, out_path: Optional[str] = None,
         add("fleet.json", json.dumps(
             fleet_plane.api_snapshot(config=state.config),
             indent=1, sort_keys=True))
+        # closed-loop actuator (ISSUE 15): what the fleet tried to tune
+        # about itself — proposals, canaries, promotions, rollbacks and
+        # refusals with reasons — frozen at bundle time
+        from ..controlplane.actuator import fleet_actuator
+
+        add("actuator.json", json.dumps(
+            fleet_actuator.api_snapshot(), indent=1, sort_keys=True))
         # device-runtime snapshot, taken fresh at bundle time: engine
         # gauges + (when jax is loaded) live arrays, device memory, and
         # per-jit-site cache/compile accounting. Read-only: a one-shot

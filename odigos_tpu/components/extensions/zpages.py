@@ -29,7 +29,13 @@ serves JSON (terminal-first operators curl it):
 * ``/debug/fleetz``      — the fleet plane (ISSUE 10): per-collector
                            health rollups, worst-of per group, alert
                            rule states with fired/cleared history, and
-                           the observe-only sizing recommendations
+                           the flap-guarded sizing recommendations
+* ``/debug/actuatorz``   — the closed-loop actuator (ISSUE 15): armed
+                           state, in-flight canary/promotion with its
+                           judgment window, the bounded action history
+                           (proposals, canaries, promotions,
+                           rollbacks, refusals), and the knob/refusal
+                           table
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
 """
@@ -142,6 +148,11 @@ class ZPagesExtension(HttpExtension):
 
         return 200, fleet_plane.api_snapshot()
 
+    def _actuatorz(self, q: dict[str, str]) -> tuple[int, dict]:
+        from ...controlplane.actuator import fleet_actuator
+
+        return 200, fleet_actuator.api_snapshot()
+
     def pages(self) -> dict[str, Page]:
         return {"/debug/pipelinez": self._pipelinez,
                 "/debug/servicez": self._servicez,
@@ -149,7 +160,8 @@ class ZPagesExtension(HttpExtension):
                 "/debug/tracez": self._tracez,
                 "/debug/flowz": self._flowz,
                 "/debug/latencyz": self._latencyz,
-                "/debug/fleetz": self._fleetz}
+                "/debug/fleetz": self._fleetz,
+                "/debug/actuatorz": self._actuatorz}
 
 
 register(Factory(

@@ -276,6 +276,13 @@ class Configuration:
     # gateway config's service.alerts stanza; empty list renders
     # nothing (byte-stable configs for installs without alerts)
     alerts: list[AlertRuleConfiguration] = field(default_factory=list)
+    # closed-loop actuator (ISSUE 15): a mapping rendered as the
+    # gateway config's service.actuator stanza (enabled, dry_run,
+    # judgment_window_s, cooldown_s, max_step, knobs allowlist,
+    # max_history — validated at load by controlplane/actuator.py).
+    # None renders nothing (byte-stable configs; the loop stays open
+    # unless the operator closes it).
+    actuator: Optional[dict] = None
     # Free-form bag for profile-applied settings without a dedicated field
     # (reference profiles patch arbitrary config, e.g. disable-gin).
     extra: dict[str, Any] = field(default_factory=dict)

@@ -10,6 +10,7 @@ limit = 1.25x request, memory-limiter hard limit = limit - 50MiB, spike =
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Any
 
 from .model import CollectorGatewayConfiguration, CollectorNodeConfiguration
 
@@ -40,20 +41,151 @@ class SizingPreset:
 
 
 # the sizing knobs the fleet recommender (selftelemetry/fleet.py) may
-# name in an observe-only recommendation: knob -> the config path an
-# operator (or, later, the ROADMAP auto-tuner) would turn. A closed
-# table for the same reason DROP_REASONS is — the package-hygiene lint
-# asserts every recommender rule's knob resolves here, so a
-# recommendation can never point at a knob that does not exist.
-TUNING_KNOBS: dict[str, str] = {
-    "max_batch": "anomaly.max_batch (device batch budget per call)",
-    "bucket_ladder": "anomaly trace_bucket / warm_ladder "
-                     "(precompiled row-bucket geometry)",
-    "replicas": "collector_gateway.min_replicas/max_replicas "
-                "(gateway replica count; bounded by the sizing preset)",
-    "submit_lanes": "anomaly fast_path.submit_lanes "
-                    "(featurize/submit thread pool width)",
+# name in a recommendation and the closed-loop actuator
+# (controlplane/actuator.py, ISSUE 15) may TURN. A closed table for the
+# same reason DROP_REASONS is — the package-hygiene lint asserts every
+# recommender rule's knob resolves here, and every ``actuatable`` knob
+# resolves to a validate_config-accepted config path whose edit the
+# structural differ classifies reconfigure/replace (never FULL), so a
+# knob addition can never silently make the actuator tear down
+# pipelines.
+@dataclass(frozen=True)
+class KnobSpec:
+    """One tunable knob: where it lives in a collector config, its hard
+    bounds, and whether the actuator may turn it autonomously.
+
+    ``kind`` decides resolution: ``processor`` knobs live on every
+    ``processors.<component>/...`` entry, ``fastpath`` knobs on every
+    pipeline's ``fast_path:`` mapping, ``controlplane`` knobs are not a
+    node-local config edit at all (replica counts — the autoscaler owns
+    them; the actuator reaches them only through a registered replica
+    scaler, never through ``Collector.reload``). Non-actuatable knobs
+    carry ``refusal`` — the reason the actuator surfaces instead of
+    acting (the refusal table in docs/architecture.md)."""
+
+    knob: str
+    path: str            # operator-facing prose (the TUNING_KNOBS text)
+    kind: str            # "processor" | "fastpath" | "controlplane"
+    key: str = ""        # config key at each resolved site
+    component: str = ""  # processor type, for kind="processor"
+    min_value: float = 0.0
+    max_value: float = 0.0
+    default: float = 0.0
+    integer: bool = False
+    actuatable: bool = False
+    refusal: str = ""    # why the actuator refuses (when not actuatable)
+
+
+KNOB_SPECS: dict[str, KnobSpec] = {
+    "max_batch": KnobSpec(
+        knob="max_batch",
+        path="anomaly.max_batch (device batch budget per call)",
+        kind="processor", component="tpuanomaly", key="max_batch",
+        min_value=256, max_value=262144, default=65536, integer=True,
+        actuatable=True),
+    "bucket_ladder": KnobSpec(
+        knob="bucket_ladder",
+        path="anomaly trace_bucket / warm_ladder "
+             "(precompiled row-bucket geometry)",
+        kind="processor", component="tpuanomaly", key="trace_bucket",
+        min_value=64, max_value=4096, default=256, integer=True,
+        actuatable=False,
+        refusal="two coupled keys (trace_bucket + warm_ladder) with "
+                "XLA recompile cost — no single bounded edit; operator "
+                "config push"),
+    "replicas": KnobSpec(
+        knob="replicas",
+        path="collector_gateway.min_replicas/max_replicas "
+             "(gateway replica count; bounded by the sizing preset)",
+        kind="controlplane", key="min_replicas",
+        min_value=DEFAULT_MIN_REPLICAS, max_value=DEFAULT_MAX_REPLICAS,
+        default=DEFAULT_MIN_REPLICAS, integer=True,
+        actuatable=True,
+        refusal="control-plane knob: actuated one replica at a time "
+                "through a registered replica scaler, never through "
+                "Collector.reload"),
+    "submit_lanes": KnobSpec(
+        knob="submit_lanes",
+        path="anomaly fast_path.submit_lanes "
+             "(featurize/submit thread pool width)",
+        kind="fastpath", key="submit_lanes",
+        min_value=1, max_value=64, default=4, integer=True,
+        actuatable=False,
+        refusal="structural fast_path knob (lane-pool re-thread): the "
+                "differ classifies a submit_lanes edit FULL — raise it "
+                "via operator config push"),
+    "admission_deadline": KnobSpec(
+        knob="admission_deadline",
+        path="anomaly fast_path.deadline_ms (per-frame admission "
+             "deadline; frames past it forward unscored)",
+        kind="fastpath", key="deadline_ms",
+        min_value=5.0, max_value=2000.0, default=25.0,
+        actuatable=True),
 }
+
+# knob -> operator prose; derived from KNOB_SPECS so the two tables can
+# never drift (existing consumers key on this mapping)
+TUNING_KNOBS: dict[str, str] = {k: s.path for k, s in KNOB_SPECS.items()}
+
+
+def knob_sites(knob: str, config: dict) -> list[tuple[tuple, Any]]:
+    """Resolve a knob to its concrete edit sites inside one collector
+    config dict: ``[(path, current_value)]`` where ``path`` is the key
+    chain a deep-set would follow (``("processors", "tpuanomaly",
+    "max_batch")`` / ``("service", "pipelines", "traces/in",
+    "fast_path", "deadline_ms")``). The current value falls back to the
+    spec default when the config leaves the key implicit (a rendered
+    ``fast_path: true`` carries no mapping). ``controlplane`` knobs
+    resolve to NO sites — they are not node-local config edits."""
+    spec = KNOB_SPECS[knob]
+    sites: list[tuple[tuple, Any]] = []
+    if spec.kind == "processor":
+        for pid, pcfg in (config.get("processors") or {}).items():
+            if pid.split("/", 1)[0] == spec.component:
+                cur = (pcfg or {}).get(spec.key, spec.default)
+                sites.append((("processors", pid, spec.key), cur))
+    elif spec.kind == "fastpath":
+        pipelines = (config.get("service") or {}).get("pipelines") or {}
+        for pname, p in pipelines.items():
+            fp = (p or {}).get("fast_path")
+            if not fp:
+                continue
+            cur = fp.get(spec.key, spec.default) \
+                if isinstance(fp, dict) else spec.default
+            sites.append((("service", "pipelines", pname,
+                           "fast_path", spec.key), cur))
+    return sites
+
+
+def bounded_step(knob: str, current: Any, observed: Any = None,
+                 threshold: Any = None, direction: str = "up",
+                 max_step: float = 2.0) -> Any:
+    """The proposed value for one knob edit: a multiplicative step
+    sized by how deep the observed breach is (``observed/threshold``,
+    symmetric for lower-bound rules), bounded by ``max_step`` (the
+    actuator config's per-actuation ceiling), clamped into the spec's
+    hard ``[min, max]``. Integers round. Returns a value equal to
+    ``current`` when the knob is already at its bound in the requested
+    direction — the caller refuses (``at_bound``) instead of actuating
+    a no-op."""
+    spec = KNOB_SPECS[knob]
+    ratio = 1.0
+    try:
+        o, t = abs(float(observed)), abs(float(threshold))
+        if o > 0 and t > 0:
+            ratio = max(o / t, t / o)  # depth of breach, cmp-agnostic
+    except (TypeError, ValueError):
+        pass
+    step = min(float(max_step), max(1.25, ratio))
+    cur = float(current)
+    v = cur * step if direction == "up" else cur / step
+    v = min(max(v, float(spec.min_value)), float(spec.max_value))
+    if spec.integer:
+        v = int(round(v))
+        if v == int(cur):
+            return type(current)(current) if isinstance(current, int) \
+                else int(cur)
+    return v
 
 # k8sutils/pkg/sizing/sizing.go presets (small/medium/large clusters)
 SIZING_PRESETS: dict[str, SizingPreset] = {

@@ -247,6 +247,7 @@ class Autoscaler:
             telemetry_config=self.config.selftelemetry,
             alerts=self.config.alerts,
             export_retry=self.config.collector_gateway.export_retry,
+            actuator=self.config.actuator,
         )
         with tracer.span("autoscaler/render-gateway-config") as sp:
             sp.set_attr("cr.kind", "ConfigMap")

@@ -72,6 +72,31 @@ class E2EEnvironment:
         self._unsub = watch_configmap(
             self.store, ODIGOS_NAMESPACE, GATEWAY_CONFIG_NAME, self.gateway,
             extract=lambda data: data["collector-conf"])
+        # replicas-knob channel (ISSUE 15): the actuator canaries a
+        # replica count ONE step at a time through this hook — it edits
+        # the authored Configuration (the autoscaler re-derives the
+        # deployment on the next reconcile round), never a collector
+        # config. Returns None at the preset bound (the at_bound
+        # refusal). No reconcile inside: the hook runs from the
+        # actuator tick which runs from reconcile itself.
+        from ..config.sizing import SIZING_PRESETS, gateway_resources
+        from ..controlplane.actuator import fleet_actuator
+
+        def _scale_replicas(delta: int):
+            preset = SIZING_PRESETS.get(self.config.resource_size_preset)
+            res = gateway_resources(self.config.collector_gateway,
+                                    preset)
+            new = res.min_replicas + int(delta)
+            if delta > 0 and new > res.max_replicas:
+                return None  # preset bound: the at_bound refusal
+            if delta < 0 and new < 1:
+                return None  # can't shed the last replica
+            new = max(1, new)
+            self.config.collector_gateway.min_replicas = new
+            self.scheduler.apply_authored(self.config)
+            return new
+
+        fleet_actuator.set_replica_scaler(_scale_replicas)
         # cluster-DNS role: the generated node configs address the gateway
         # by service name; register its real wire listener
         from ..wire.servicemap import register_service
@@ -117,12 +142,17 @@ class E2EEnvironment:
     def shutdown(self) -> None:
         # fleet churn: departing collectors leave the plane (and their
         # series leave the store) so aggregates stop answering for them
+        # — and leave the actuator's target registry (a canary must not
+        # judge a collector that no longer exists)
+        from ..controlplane.actuator import fleet_actuator
         from ..selftelemetry.fleet import fleet_plane
 
         for cid in (["gateway"]
                     + [f"node/{n}" for n in self.node_collectors]):
             fleet_plane.unregister(cid)
+            fleet_actuator.unregister(cid)
             self.cluster.unregister_collector(cid)
+        fleet_actuator.set_replica_scaler(None)
         if self._wire_tap is not None:
             self._wire_tap.shutdown()
             self._wire_tap = None
@@ -179,18 +209,26 @@ class E2EEnvironment:
             return
         from ..api.resources import (
             CollectorsGroupRole, Condition, ConditionStatus)
+        from ..controlplane.actuator import fleet_actuator
         from ..selftelemetry.fleet import fleet_plane
 
         fleet_plane.publish_collector(
             self.gateway, "gateway", group=self.GATEWAY_FLEET_GROUP)
         self.cluster.register_collector(
             "gateway", group=self.GATEWAY_FLEET_GROUP)
+        # closed-loop actuator (ISSUE 15): fleet membership doubles as
+        # the actuation-target registry, and every reconcile advances
+        # the actuator's state machine (canary judgment windows key on
+        # its clock; reconcile is the e2e tick cadence)
+        fleet_actuator.register("gateway", self.gateway)
         for node, collector in self.node_collectors.items():
             cid = f"node/{node}"
             fleet_plane.publish_collector(
                 collector, cid, group=self.NODE_FLEET_GROUP)
             self.cluster.register_collector(
                 cid, group=self.NODE_FLEET_GROUP, node=node)
+            fleet_actuator.register(cid, collector)
+        fleet_actuator.tick()
         group = next(
             (g for g in self.store.list("CollectorsGroup")
              if g.role == CollectorsGroupRole.CLUSTER_GATEWAY), None)

@@ -392,6 +392,14 @@ class _Handler(BaseHTTPRequestHandler):
                 from ..selftelemetry.fleet import fleet_plane
 
                 return self._json(fleet_plane.api_snapshot())
+            if path == "/api/actuator":
+                # the closed-loop actuator (ISSUE 15): armed state,
+                # in-flight canary/promotion, bounded action history,
+                # and the knob/refusal table — the "who turned that
+                # knob and why" surface
+                from ..controlplane.actuator import fleet_actuator
+
+                return self._json(fleet_actuator.api_snapshot())
             if path == "/api/slo":
                 # latency attribution & SLO burn (ISSUE 8): per-pipeline
                 # burn-rate status over the declared objectives, the

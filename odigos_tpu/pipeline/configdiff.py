@@ -74,7 +74,7 @@ _SECTIONS = (
 # OTHER service-level change is unknown territory and must take the
 # full-rebuild path rather than be silently dropped
 _KNOWN_SERVICE_KEYS = {"pipelines", "alerts", "gc", "telemetry",
-                       "extensions"}
+                       "extensions", "actuator"}
 # pipeline keys that are NOT topology: slo retunes through the latency
 # ledger, fast_path diffs against the route's own reconfigurable table
 _PIPELINE_VALUE_KEYS = {"slo", "fast_path"}
@@ -103,6 +103,7 @@ class ConfigDiff:
     alerts_changed: bool = False
     gc_changed: bool = False
     telemetry_changed: bool = False
+    actuator_changed: bool = False
 
 
 def merged_component_config(reg: Registry, kind: ComponentKind,
@@ -369,4 +370,6 @@ def diff_configs(old: dict, new: dict, reg: Registry | None = None,
         gc_changed=old_svc.get("gc") != new_svc.get("gc"),
         telemetry_changed=old_svc.get("telemetry")
         != new_svc.get("telemetry"),
+        actuator_changed=old_svc.get("actuator")
+        != new_svc.get("actuator"),
     )

@@ -537,6 +537,15 @@ def validate_config(config: dict[str, Any]) -> list[str]:
 
         problems.extend(validate_gc_config(gc_cfg))
 
+    # closed-loop actuator stanza (ISSUE 15): a typo'd knob or window
+    # must die at load — an actuator silently armed against nothing
+    # would never act while the operator believes the loop is closed
+    act_cfg = config.get("service", {}).get("actuator")
+    if act_cfg is not None:
+        from ..controlplane.actuator import validate_actuator_config
+
+        problems.extend(validate_actuator_config(act_cfg))
+
     # authenticator references must resolve to a defined+enabled extension
     # (the collector fails startup on a dangling authenticator; an auth'd
     # exporter silently sending unauthenticated would be worse)

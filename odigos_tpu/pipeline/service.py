@@ -44,6 +44,9 @@ class Collector:
         # those are stopped on shutdown (another owner's stay running)
         self._telemetry_started: list[str] = []
         self._gc_started = False
+        # did THIS collector's config arm the process-global actuator
+        # (service.actuator stanza)? Only then does shutdown disarm it.
+        self._actuator_configured = False
         # set when an incremental patch raised mid-apply AND the full
         # fallback also failed: live component state may then diverge
         # from self.config, so the next reload must not no-op on
@@ -73,6 +76,17 @@ class Collector:
             # soft-pressure hints need a thread to land on.
             gc_plane.start(self.config.get("service", {}).get("gc"))
             self._gc_started = True
+        # closed-loop actuator (ISSUE 15): the stanza arms the
+        # process-global actuator (last configure wins — one actuator
+        # per process, like the alert engine). OUTSIDE the lock: the
+        # actuator's tick may be mid-reload on another collector, and
+        # configure must never wait on a reload that waits on us.
+        act_cfg = self.config.get("service", {}).get("actuator")
+        if act_cfg is not None:
+            from ..controlplane.actuator import fleet_actuator
+
+            fleet_actuator.configure(act_cfg, owner=self)
+            self._actuator_configured = True
         meter.add("odigos_collector_starts_total")
         return self
 
@@ -96,6 +110,17 @@ class Collector:
                 gc_plane.stop()
                 self._gc_started = False
             self._running = False
+        if self._actuator_configured:
+            # disarm what THIS config armed (a dead collector's stanza
+            # must not leave the actuator canarying forever) — owner-
+            # checked, so a stale collector's shutdown never clobbers
+            # a newer collector's live config; default config =
+            # disabled, and a disabled tick rolls back any in-flight
+            # canary before going quiet
+            from ..controlplane.actuator import fleet_actuator
+
+            fleet_actuator.disarm(self)
+            self._actuator_configured = False
 
     def __enter__(self) -> "Collector":
         return self.start()
@@ -281,6 +306,15 @@ class Collector:
             stop_started(self._telemetry_started)
             self._telemetry_started = start_from_config(
                 new_svc.get("telemetry"))
+        if diff.actuator_changed:
+            from ..controlplane.actuator import fleet_actuator
+
+            new_act = new_svc.get("actuator")
+            if new_act is not None:
+                fleet_actuator.configure(new_act, owner=self)
+            else:
+                fleet_actuator.disarm(self)
+            self._actuator_configured = new_act is not None
         if diff.gc_changed or not self._gc_started:
             # bounce only on a CHANGED stanza: unfreeze + full collect
             # + refreeze is tens of ms of GIL hold in live lane frames
@@ -354,3 +388,13 @@ class Collector:
                         gc_plane.stop()
                     gc_plane.start(new_gc)
                     self._gc_started = True
+                old_act = old_config.get("service", {}).get("actuator")
+                new_act = new_config.get("service", {}).get("actuator")
+                if old_act != new_act:
+                    from ..controlplane.actuator import fleet_actuator
+
+                    if new_act is not None:
+                        fleet_actuator.configure(new_act, owner=self)
+                    else:
+                        fleet_actuator.disarm(self)
+                    self._actuator_configured = new_act is not None

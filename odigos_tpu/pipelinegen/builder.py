@@ -101,6 +101,10 @@ class GatewayOptions:
     # build_graph wraps those in the bounded jittered-backoff
     # RetryQueue. None renders nothing (byte-stable configs).
     export_retry: Optional[dict] = None
+    # closed-loop actuator (ISSUE 15): a mapping rendered as the
+    # service.actuator stanza (validated at graph load); None renders
+    # nothing — the loop stays open unless the operator closes it
+    actuator: Optional[dict] = None
     # extra processor ids (already configured in `processors`) to run in the
     # root pipeline per signal, e.g. compiled Actions.
     root_processors: dict[Signal, list[str]] = field(default_factory=dict)
@@ -500,6 +504,13 @@ def build_gateway_config(
             dataclasses.asdict(a if isinstance(a, AlertRuleConfiguration)
                                else AlertRuleConfiguration(**a))
             for a in options.alerts]
+
+    # --- closed-loop actuator (ISSUE 15): the service.actuator stanza
+    # the collector arms the process-global actuator from (canary ->
+    # judge -> promote/rollback over the recommender's proposals);
+    # validated by graph.validate_config at load. None renders nothing.
+    if options.actuator is not None:
+        config["service"]["actuator"] = dict(options.actuator)
 
     st = options.telemetry_config
     if st is not None and (st.profiler_enabled or st.device_runtime_enabled):

@@ -33,10 +33,13 @@ plane for our collectors, built on :mod:`seriesstate`:
 * **sizing recommendations** — a small rule table turns the PR 3 device
   runtime gauges (padding waste, ladder hit rate, queue depth) and the
   PR 9 ``backlog_ms`` watermark into NAMED recommendations against the
-  ``config/sizing.py`` knobs (batch size, ladder rungs, replica count).
-  Surfaced on ``/api/fleet`` / ``/debug/fleetz`` / describe / diagnose —
-  **never actuated**; the ROADMAP's auto-tuner item is the consumer that
-  will close that loop.
+  ``config/sizing.py`` knobs (batch size, ladder rungs, replica count,
+  admission deadline), each carrying a machine-readable ``proposal``
+  (concrete config-path edit, bounded proposed value). Surfaced on
+  ``/api/fleet`` / ``/debug/fleetz`` / describe / diagnose through the
+  flap-guarded :class:`Recommender` (pending→active ``for_s`` hold);
+  the closed-loop actuator (``controlplane/actuator.py``, ISSUE 15)
+  consumes the same held feed to canary → judge → promote/rollback.
 
 Kill switch: the plane rides :data:`seriesstate.series_store`'s
 ``ODIGOS_SERIES=0`` — publishing and evaluation no-op with it.
@@ -345,16 +348,22 @@ alert_engine = AlertEngine()
 
 @dataclass(frozen=True)
 class RecommendationRule:
-    """One observe-only sizing rule: when ``expr`` breaches (same
-    grammar and per-series semantics as alerts), recommend turning
-    ``knob`` (a ``config/sizing.py`` TUNING_KNOBS name). ``action`` is
-    the operator-facing sentence, formatted with the observed value."""
+    """One sizing rule: when ``expr`` breaches (same grammar and
+    per-series semantics as alerts), recommend turning ``knob`` (a
+    ``config/sizing.py`` KNOB_SPECS name) in ``direction``. ``action``
+    is the operator-facing sentence, formatted with the observed value.
+    ``for_s`` is the flap guard (ISSUE 15): the breach must persist
+    that long before the recommendation activates — the closed-loop
+    actuator consumes the HELD feed (:class:`Recommender`) and must
+    never canary a one-tick blip."""
 
     name: str
     expr: str
     knob: str
     action: str
     severity: str = "info"
+    direction: str = "up"   # which way the proposal turns the knob
+    for_s: float = 30.0     # pending -> active hold (the alert for_s)
 
 
 # the PR 3 gauges + PR 9 watermark -> sizing knobs table. Thresholds
@@ -368,7 +377,7 @@ RECOMMENDER_RULES: tuple[RecommendationRule, ...] = (
         action=("{value:.0%} of device rows are padding — densify the "
                 "bucket ladder (more rungs) or lower anomaly.max_batch "
                 "so packed batches sit closer to real row counts"),
-        severity="warning"),
+        severity="warning", direction="down", for_s=60.0),
     RecommendationRule(
         name="ladder-hit-rate-low",
         expr="avg(odigos_engine_bucket_ladder_hit_rate[120s]) < 0.9",
@@ -376,7 +385,7 @@ RECOMMENDER_RULES: tuple[RecommendationRule, ...] = (
         action=("bucket-ladder hit rate {value:.0%} — widen the warmed "
                 "ladder (more rungs / warm_ladder at start) so steady-"
                 "state shapes stop paying XLA recompiles"),
-        severity="warning"),
+        severity="warning", direction="up", for_s=60.0),
     RecommendationRule(
         name="engine-queue-sustained",
         expr="avg(odigos_engine_queue_depth[60s]) > 6",
@@ -385,39 +394,78 @@ RECOMMENDER_RULES: tuple[RecommendationRule, ...] = (
                 "path is the bottleneck; add gateway replicas (within "
                 "the sizing preset's max_replicas) or raise "
                 "anomaly.max_batch"),
-        severity="warning"),
+        severity="warning", direction="up", for_s=30.0),
+    # ISSUE 15 satellite: the old single rule said "raise fast_path
+    # submit_lanes" while naming knob=replicas (and the submit_lanes
+    # knob was referenced by no rule at all) — split into the lane rule
+    # (first response: widen the featurize/submit pool) and the replica
+    # rule (backlog persisting WELL past the lane fix's territory)
+    RecommendationRule(
+        name="submit-lanes-saturated",
+        expr="avg(odigos_flow_queue_high_watermark{queue=backlog_ms}"
+             "[60s]) > 50",
+        knob="submit_lanes",
+        action=("ingest backlog averaging {value:.0f} ms — the "
+                "featurize/submit lanes cannot keep up with intake; "
+                "raise fast_path submit_lanes"),
+        severity="warning", direction="up", for_s=30.0),
     RecommendationRule(
         name="ingest-backlog-pressure",
         expr="avg(odigos_flow_queue_high_watermark{queue=backlog_ms}"
-             "[60s]) > 50",
+             "[60s]) > 150",
         knob="replicas",
-        action=("ingest backlog averaging {value:.0f} ms — submit lanes "
-                "cannot keep up with intake; add gateway replicas or "
-                "raise fast_path submit_lanes"),
-        severity="warning"),
+        action=("ingest backlog averaging {value:.0f} ms persists well "
+                "past what wider submit lanes can absorb — add gateway "
+                "replicas"),
+        severity="warning", direction="up", for_s=30.0),
+    # ISSUE 15: frames queueing past the admission deadline forward
+    # unscored (scored_fraction SLO burn) — the one knob the actuator
+    # can turn incrementally under full load (fast_path.deadline_ms is
+    # in IngestFastPath.RECONFIGURABLE_KEYS: a ~0.3 ms node-local patch)
+    RecommendationRule(
+        name="deadline-expiry-storm",
+        expr="rate(odigos_latency_deadline_expired_spans_total[60s])"
+             " > 200",
+        knob="admission_deadline",
+        action=("deadline expiries at {value:.0f} spans/s — frames "
+                "queue past the admission deadline and forward "
+                "unscored; raise fast_path.deadline_ms (bounded) or "
+                "add capacity"),
+        severity="warning", direction="up", for_s=30.0),
 )
 
 
-def recommend(store=None, config=None) -> list[dict[str, Any]]:
-    """Evaluate the recommendation table against the (fleet) series
-    store. Returns one entry per breaching rule with the worst series
-    named — observe-only: nothing here writes config. ``config``
-    (a ``config.model.Configuration``) scopes the replica suggestions
-    to the install's sizing preset bounds."""
+def recommend(store=None, config=None, collector_config=None,
+              max_step: float = 2.0, rules=None) -> list[dict[str, Any]]:
+    """INSTANTANEOUS breach evaluation of the recommendation table —
+    the primitive. Surfaces and the actuator consume the HELD feed
+    (:class:`Recommender` / ``fleet_plane.recommender``), which wraps
+    this with the pending→active ``for_s`` lifecycle.
+
+    Each entry carries a machine-readable ``proposal`` (ISSUE 15): the
+    knob's config key, direction, hard bounds and actuatability from
+    ``sizing.KNOB_SPECS`` — and, when ``collector_config`` (a collector
+    config dict) is given, the CONCRETE grounded edits: per-site config
+    path, current value, and a ``bounded_step`` proposed value clamped
+    into the spec bounds. ``config`` (a ``config.model.Configuration``)
+    scopes replica suggestions to the install's sizing preset."""
     store = store if store is not None else series_store
     if not store.enabled:
         return []
     from ..config.sizing import (
-        SIZING_PRESETS, TUNING_KNOBS, gateway_resources)
+        KNOB_SPECS, SIZING_PRESETS, TUNING_KNOBS, bounded_step,
+        gateway_resources, knob_sites)
 
     replica_note = ""
+    replica_bounds = None
     if config is not None:
         preset = SIZING_PRESETS.get(config.resource_size_preset)
         res = gateway_resources(config.collector_gateway, preset)
         replica_note = (f" (preset bounds: {res.min_replicas}-"
                         f"{res.max_replicas} replicas)")
+        replica_bounds = [res.min_replicas, res.max_replicas]
     out: list[dict[str, Any]] = []
-    for rule in RECOMMENDER_RULES:
+    for rule in (rules if rules is not None else RECOMMENDER_RULES):
         p = parse_expr(rule.expr)
         values = store.series_values(p["metric"], p["fn"], p["window_s"],
                                      p["labels"] or None)
@@ -435,11 +483,139 @@ def recommend(store=None, config=None) -> list[dict[str, Any]]:
             "threshold": p["threshold"],
             "knob": rule.knob,
             "knob_path": TUNING_KNOBS.get(rule.knob, rule.knob),
+            "direction": rule.direction,
+            "for_s": rule.for_s,
             "recommendation": rule.action.format(value=value)
             + (replica_note if rule.knob == "replicas" else ""),
         }
+        spec = KNOB_SPECS.get(rule.knob)
+        if spec is not None:
+            proposal: dict[str, Any] = {
+                "knob": rule.knob,
+                "kind": spec.kind,
+                "key": spec.key,
+                "direction": rule.direction,
+                "bounds": (replica_bounds
+                           if rule.knob == "replicas" and replica_bounds
+                           else [spec.min_value, spec.max_value]),
+                "actuatable": spec.actuatable,
+                "refusal": spec.refusal,
+            }
+            if collector_config is not None \
+                    and spec.kind in ("processor", "fastpath"):
+                proposal["edits"] = [
+                    {"path": list(path), "current": cur,
+                     "proposed": bounded_step(
+                         rule.knob, cur, value, p["threshold"],
+                         rule.direction, max_step)}
+                    for path, cur in knob_sites(rule.knob,
+                                                collector_config)]
+            rec["proposal"] = proposal
         out.append(rec)
     return out
+
+
+class Recommender:
+    """Held pending→active recommendation lifecycle (ISSUE 15
+    satellite): the instant a rule's expr breaches it goes PENDING;
+    only after the breach persists ``for_s`` (the rule's flap guard)
+    does the recommendation activate — and recovery clears it
+    immediately. The alert engine's ``for_s`` discipline applied to
+    the recommender feed, because the closed-loop actuator must never
+    canary a one-tick blip. Pure function of (store contents, clock),
+    so alternating pollers agree — the AlertRule contract."""
+
+    def __init__(self, store=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 rules: Optional[tuple] = None):
+        self._store = store
+        self._clock = clock
+        self._rules: tuple[RecommendationRule, ...] = \
+            tuple(rules) if rules is not None else RECOMMENDER_RULES
+        self._lock = threading.Lock()
+        self._pending: dict[str, float] = {}  # rule -> pending_since
+
+    @property
+    def store(self):
+        return self._store if self._store is not None else series_store
+
+    def rules(self) -> tuple[RecommendationRule, ...]:
+        with self._lock:
+            return self._rules
+
+    def set_rules(self, rules: Optional[tuple]) -> None:
+        """Swap the rule table (harness seam: the soak/chaos runs need
+        test-timescale windows and holds). ``None`` restores the
+        built-in RECOMMENDER_RULES. Hold state resets — old pendings
+        must not vouch for new rules."""
+        with self._lock:
+            self._rules = tuple(rules) if rules is not None \
+                else RECOMMENDER_RULES
+            self._pending.clear()
+
+    def rule(self, name: str) -> Optional[RecommendationRule]:
+        with self._lock:
+            return next((r for r in self._rules if r.name == name), None)
+
+    def evaluate(self, config=None, collector_config=None,
+                 max_step: float = 2.0,
+                 now: Optional[float] = None) -> list[dict[str, Any]]:
+        """Advance the hold state machine and return the ACTIVE
+        recommendations (breaching continuously >= for_s), each with
+        ``state``/``held_s`` stamped. Pending breaches are withheld."""
+        now = now if now is not None else self._clock()
+        with self._lock:
+            rules = self._rules
+        recs = {r["name"]: r for r in recommend(
+            self.store, config, collector_config, max_step, rules=rules)}
+        out: list[dict[str, Any]] = []
+        with self._lock:
+            for rule in rules:
+                rec = recs.get(rule.name)
+                if rec is None:
+                    self._pending.pop(rule.name, None)
+                    continue
+                since = self._pending.setdefault(rule.name, now)
+                held = now - since
+                if held >= rule.for_s:
+                    rec["state"] = "active"
+                    rec["held_s"] = round(held, 3)
+                    out.append(rec)
+        out.sort(key=lambda r: r["name"])
+        return out
+
+    def rule_state(self, name: str,
+                   now: Optional[float] = None) -> str:
+        """``inactive`` | ``pending`` | ``active`` — WITHOUT advancing
+        holds (the actuator's breach-clear oracle re-evaluates the expr
+        itself; this is the surface view)."""
+        now = now if now is not None else self._clock()
+        with self._lock:
+            rule = next((r for r in self._rules if r.name == name), None)
+            since = self._pending.get(name)
+            if rule is None or since is None:
+                return "inactive"
+            return "active" if now - since >= rule.for_s else "pending"
+
+    def status(self, now: Optional[float] = None) -> list[dict[str, Any]]:
+        """Per-rule hold state for the surfaces (fleetz, describe)."""
+        now = now if now is not None else self._clock()
+        with self._lock:
+            out = []
+            for r in self._rules:
+                since = self._pending.get(r.name)
+                state = "inactive" if since is None else (
+                    "active" if now - since >= r.for_s else "pending")
+                out.append({"name": r.name, "knob": r.knob,
+                            "for_s": r.for_s, "state": state,
+                            "held_s": (round(now - since, 3)
+                                       if since is not None else None)})
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rules = RECOMMENDER_RULES
+            self._pending.clear()
 
 
 # --------------------------------------------------------------- the plane
@@ -490,6 +666,10 @@ class FleetPlane:
         self._collectors: dict[str, _CollectorEntry] = {}
         self._timer: Optional[threading.Thread] = None
         self._timer_stop = threading.Event()
+        # the HELD recommendation feed (ISSUE 15): surfaces and the
+        # closed-loop actuator read this, never the instantaneous
+        # recommend() primitive — a one-tick blip must not canary
+        self.recommender = Recommender(store=store, clock=clock)
 
     @property
     def store(self):
@@ -729,6 +909,15 @@ class FleetPlane:
                              conditions=payload.get("conditions"),
                              worst=payload.get("worst"), group=group)
         alert_engine.evaluate()
+        # closed-loop actuator (ISSUE 15): ride the same cadence the
+        # alert engine does, but ONLY if something already armed it —
+        # sys.modules-gated so a plane tick in a process that never
+        # touched the control plane imports nothing
+        import sys as _sys
+
+        act_mod = _sys.modules.get("odigos_tpu.controlplane.actuator")
+        if act_mod is not None:
+            act_mod.fleet_actuator.tick()
 
     def stop_timer(self) -> None:
         with self._lock:
@@ -767,7 +956,11 @@ class FleetPlane:
                 "rules": alert_engine.evaluate(),
                 "history": alert_engine.transitions(),
             },
-            "recommendations": recommend(self.store, config),
+            # the HELD feed (ISSUE 15): a recommendation appears only
+            # after its breach persisted for_s — the panel and the
+            # actuator see the same flap-guarded list
+            "recommendations": self.recommender.evaluate(config),
+            "recommender": self.recommender.status(),
             "store": self.store.stats(),
         }
 
@@ -779,6 +972,7 @@ class FleetPlane:
         with self._lock:
             self._collectors.clear()
         alert_engine.reset()
+        self.recommender.reset()
         self.store.reset()
 
 

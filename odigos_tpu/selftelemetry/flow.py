@@ -877,6 +877,21 @@ class HealthRollup:
                             node, HEALTHY, "WithinThreshold",
                             f"value {rule['value']}")
                     out.append(dict(cond))
+            # closed-loop actuator rows (ISSUE 15): one actuator/<rule>
+            # row while an actuation is in flight (CanaryInFlight /
+            # Promoting) — process-scoped like the engine rows, gone
+            # the moment the actuation resolves (the canary round trip
+            # the chaos matrix asserts). sys.modules-gated: a rollup in
+            # a process that never armed the actuator imports nothing.
+            import sys as _sys
+
+            _act = _sys.modules.get("odigos_tpu.controlplane.actuator")
+            if _act is not None:
+                for name, (status, reason, message) in sorted(
+                        _act.actuator_conditions().items()):
+                    live.add(name)
+                    out.append(dict(self._upsert(name, status, reason,
+                                                 message)))
             # prune components gone from the graph (reload removed them)
             for name in list(self._state):
                 if name not in live:

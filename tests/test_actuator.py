@@ -1,0 +1,1005 @@
+"""Closed-loop actuator tests (ISSUE 15): stanza validation, proposal
+grounding (sites / bounded step / clamping), the canary→judge→promote
+state machine on injected clocks, every refusal in the refusal table
+(allowlist, not-actuatable, FULL classification, at-bound, dry-run,
+kill switch), rollback on oracle breach AND on persisted recommendation
+breach, the replicas channel through a registered scaler, the forced-
+proposal chaos seam, Collector lifecycle arming/disarming, and the
+surfaces (/api/actuator, /debug/actuatorz, describe)."""
+
+import copy
+import json
+import urllib.request
+
+import pytest
+
+import odigos_tpu.components  # noqa: F401 — registers builtin factories
+from odigos_tpu.config.sizing import (
+    KNOB_SPECS, TUNING_KNOBS, bounded_step, knob_sites)
+from odigos_tpu.controlplane.actuator import (
+    ACTUATOR_ENV,
+    ActuatorConfig,
+    FleetActuator,
+    fleet_actuator,
+    validate_actuator_config,
+)
+from odigos_tpu.pipeline.service import Collector
+from odigos_tpu.selftelemetry.fleet import (
+    RecommendationRule, Recommender, fleet_plane)
+from odigos_tpu.selftelemetry.flow import flow_ledger
+from odigos_tpu.selftelemetry.seriesstate import SeriesStore
+from odigos_tpu.utils.telemetry import labeled_key, meter
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def clock():
+    return Clock()
+
+
+@pytest.fixture(autouse=True)
+def fresh_globals():
+    fleet_actuator.reset()
+    fleet_plane.reset()
+    flow_ledger.reset()
+    meter.reset()
+    yield
+    fleet_actuator.reset()
+    fleet_plane.reset()
+    flow_ledger.reset()
+    meter.reset()
+
+
+class FakeCollector:
+    """The actuation-target duck: config + reload + health_conditions.
+    ``bad`` injects (component, reason) Degraded rows for the oracle."""
+
+    graph = None
+
+    def __init__(self, cfg):
+        self.config = cfg
+        self.reloads = []
+        self.bad: list = []
+
+    def reload(self, cfg):
+        self.reloads.append(copy.deepcopy(cfg))
+        self.config = cfg
+
+    def health_conditions(self):
+        return [{"component": c, "status": "Degraded", "reason": r}
+                for c, r in self.bad]
+
+
+def fastpath_cfg(deadline=40.0, **fp_extra):
+    fp = {"deadline_ms": deadline}
+    fp.update(fp_extra)
+    return {
+        "receivers": {"otlpwire": {}},
+        "processors": {"tpuanomaly": {}},
+        "exporters": {"tracedb": {}},
+        "service": {"pipelines": {"traces/in": {
+            "receivers": ["otlpwire"], "processors": ["tpuanomaly"],
+            "exporters": ["tracedb"], "fast_path": fp}}},
+    }
+
+
+EXPIRY_RULE = RecommendationRule(
+    name="expiry", expr="latest(odigos_exp[20s]) > 5",
+    knob="admission_deadline", action="raise it ({value})",
+    direction="up", for_s=2.0, severity="warning")
+
+
+def harness(clock, rules=(EXPIRY_RULE,), **cfg):
+    """(store, recommender, actuator) wired on one injected clock."""
+    store = SeriesStore(interval_s=1.0, window=7200, clock=clock)
+    rec = Recommender(store=store, clock=clock, rules=tuple(rules))
+    act = FleetActuator(clock=clock, recommender=rec)
+    spec = {"enabled": True, "judgment_window_s": 3.0,
+            "cooldown_s": 5.0, "max_step": 4.0}
+    spec.update(cfg)
+    act.configure(spec)
+    return store, rec, act
+
+
+def breach(store, value=9.0):
+    store.observe("odigos_exp", value)
+
+
+def arm_breach(store, clock, act, dt=3.0):
+    """Breach, register the pending hold with a tick, then age it past
+    the rule's for_s — the next tick sees an ACTIVE recommendation."""
+    breach(store)
+    act.tick()
+    clock.advance(dt)
+    breach(store)
+
+
+def deadline_of(coll):
+    return coll.config["service"]["pipelines"]["traces/in"][
+        "fast_path"]["deadline_ms"]
+
+
+# ------------------------------------------------------------ validation
+
+
+def test_validate_actuator_config_aggregates_problems():
+    problems = validate_actuator_config({
+        "enabled": "yes", "max_step": 0.5, "knobs": ["bogus"],
+        "judgment_window_s": -1, "max_history": 0, "weird": 1})
+    text = "\n".join(problems)
+    assert "unknown keys" in text and "weird" in text
+    assert "enabled must be a boolean" in text
+    assert "max_step must be > 1.0" in text
+    assert "unknown knob 'bogus'" in text
+    assert "judgment_window_s" in text and "max_history" in text
+    assert validate_actuator_config(
+        {"enabled": True, "knobs": ["admission_deadline"]}) == []
+    assert validate_actuator_config("on") \
+        == ["service.actuator must be a mapping, got str"]
+
+
+def test_invalid_stanza_fails_collector_build():
+    from odigos_tpu.pipeline.graph import validate_config
+
+    cfg = fastpath_cfg()
+    cfg["service"]["actuator"] = {"enabled": True, "knobs": ["nope"]}
+    assert any("unknown knob" in p for p in validate_config(cfg))
+
+
+# ------------------------------------------------------------- grounding
+
+
+def test_knob_sites_and_bounded_step():
+    cfg = fastpath_cfg(deadline=40.0)
+    [(path, cur)] = knob_sites("admission_deadline", cfg)
+    assert path == ("service", "pipelines", "traces/in", "fast_path",
+                    "deadline_ms") and cur == 40.0
+    [(ppath, pcur)] = knob_sites("max_batch", cfg)
+    assert ppath == ("processors", "tpuanomaly", "max_batch")
+    assert pcur == KNOB_SPECS["max_batch"].default
+    assert knob_sites("replicas", cfg) == []  # control-plane knob
+    # step sized by breach depth, bounded by max_step, clamped to spec
+    assert bounded_step("admission_deadline", 40.0, 2000, 200,
+                        "up", 4.0) == 160.0  # 10x breach -> max_step
+    assert bounded_step("admission_deadline", 40.0, 260, 200,
+                        "up", 4.0) == 52.0  # mild breach -> 1.3x
+    assert bounded_step("admission_deadline", 2000.0,
+                        direction="up") == 2000.0  # at the hard bound
+    assert bounded_step("max_batch", 4096, 0.6, 0.25,
+                        "down", 2.0) == 2048  # integer rounds
+
+
+def test_recommend_emits_grounded_proposal(clock):
+    store = SeriesStore(interval_s=1.0, window=120, clock=clock)
+    from odigos_tpu.selftelemetry.fleet import recommend
+
+    store.observe("odigos_exp", 9.0)
+    [rec] = recommend(store, collector_config=fastpath_cfg(40.0),
+                      max_step=4.0, rules=(EXPIRY_RULE,))
+    p = rec["proposal"]
+    assert p["knob"] == "admission_deadline" and p["actuatable"]
+    assert p["bounds"] == [5.0, 2000.0]
+    [edit] = p["edits"]
+    assert edit["path"][-1] == "deadline_ms"
+    assert edit["current"] == 40.0 and edit["proposed"] > 40.0
+
+
+def test_every_tuning_knob_has_a_spec_and_vice_versa():
+    assert set(TUNING_KNOBS) == set(KNOB_SPECS)
+    for knob, spec in KNOB_SPECS.items():
+        if not spec.actuatable:
+            assert spec.refusal, f"{knob}: non-actuatable without a " \
+                                 f"documented refusal"
+
+
+# ---------------------------------------------------- canary -> promote
+
+
+def test_canary_judged_then_promoted_fleet_wide(clock):
+    store, rec, act = harness(clock)
+    gw, n1 = FakeCollector(fastpath_cfg(40.0)), \
+        FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    act.register("node/1", n1)
+    breach(store)
+    act.tick()  # breach pending, held
+    assert act.state == "idle" and deadline_of(gw) == 40.0
+    clock.advance(3)
+    breach(store)
+    act.tick()  # hold elapsed -> canary applies to ONE collector
+    assert act.state == "canary"
+    assert deadline_of(gw) > 40.0 and deadline_of(n1) == 40.0
+    assert act.current["reload_mode"] == "incremental"
+    # actuator/<rule> condition row during the in-flight canary
+    assert "actuator/expiry" in act.conditions()
+    # mid-window tick: still judging
+    clock.advance(1)
+    act.tick()
+    assert act.state == "canary"
+    # judgment window = max(3, expr window 20); the breach ages out
+    clock.advance(25)
+    act.tick()  # judged good -> promoting the second collector
+    assert act.state == "promoting"
+    assert deadline_of(n1) == deadline_of(gw)
+    clock.advance(25)
+    act.tick()  # step judged -> promoted
+    [h] = list(act.history)
+    assert h["outcome"] == "promoted"
+    assert h["steps"][0]["collector"] == "node/1"
+    assert h["steps"][0]["reload_mode"] == "incremental"
+    assert act.state == "cooldown"
+    assert act.conditions() == {}  # round trip: row gone at resolution
+    assert meter.counter(labeled_key(
+        "odigos_actuator_promotions_total", rule="expiry",
+        knob="admission_deadline")) == 1
+
+
+def test_one_actuation_in_flight_and_cooldown(clock):
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "canary"
+    applied = deadline_of(gw)
+    act.tick()  # a second tick mid-canary must not start another
+    assert deadline_of(gw) == applied and len(gw.reloads) == 1
+    clock.advance(25)
+    act.tick()  # promoted (fleet of one)
+    assert act.state == "cooldown"
+    # a fresh breach inside the cooldown must not actuate
+    arm_breach(store, clock, act)
+    act.tick()
+    assert len(gw.reloads) == 1 and act.state == "cooldown"
+    clock.advance(10)  # past cooldown_s=5
+    breach(store)
+    act.tick()
+    assert len(gw.reloads) == 2  # next actuation allowed
+
+
+def test_rollback_on_new_condition(clock):
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    gw.bad = [("slo/traces/in", "SLOBurn")]  # pre-existing: baseline
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "canary"
+    # a NEW bad condition the baseline doesn't share appears mid-window
+    gw.bad.append(("alert/queue-full-storm", "AlertFiring"))
+    clock.advance(0.1)
+    act.tick()  # first sighting: a suspect, not yet a verdict
+    assert act.state == "canary"
+    clock.advance(1)  # past the confirmation dwell, still present
+    act.tick()
+    [h] = list(act.history)
+    assert h["outcome"] == "rolled_back"
+    assert "alert/queue-full-storm" in h["rollback_reason"]
+    assert deadline_of(gw) == 40.0  # prior config restored
+    assert meter.counter(labeled_key(
+        "odigos_actuator_rollbacks_total", rule="expiry",
+        knob="admission_deadline")) == 1
+
+
+def test_baseline_conditions_do_not_block_promotion(clock):
+    """The breach being cured (SLOBurn, the firing alert) is in the
+    canary's baseline — it must not veto its own cure."""
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    gw.bad = [("slo/traces/in", "SLOBurn"),
+              ("alert/deadline-expiries", "AlertFiring")]
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    clock.advance(25)
+    act.tick()
+    assert list(act.history)[0]["outcome"] == "promoted"
+
+
+def test_fleet_shared_condition_does_not_roll_back(clock):
+    """Weather the whole fleet shows is not the canary's fault."""
+    store, rec, act = harness(clock)
+    gw, n1 = FakeCollector(fastpath_cfg(40.0)), \
+        FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    act.register("node/1", n1)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "canary"
+    shared = ("engine/zscore", "ModelFailover")
+    gw.bad.append(shared)
+    n1.bad.append(shared)  # the other collector shows it too
+    clock.advance(1)
+    act.tick()
+    assert act.state == "canary"  # no rollback
+
+
+def test_transient_condition_blip_does_not_roll_back(clock):
+    """The confirmation dwell: a bad condition that clears before the
+    dwell elapses (a ConservationLeak from one in-flight batch caught
+    between two ledger reads) must not kill a good canary."""
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "canary"
+    blip = ("pipeline/traces/default", "ConservationLeak")
+    gw.bad.append(blip)
+    clock.advance(0.1)
+    act.tick()  # suspect registered
+    gw.bad.remove(blip)  # the next evaluation clears it
+    clock.advance(1)
+    act.tick()
+    assert act.state == "canary"  # continuity broken: no rollback
+    clock.advance(25)
+    act.tick()
+    assert list(act.history)[0]["outcome"] == "promoted"
+
+
+def test_breach_clear_judged_per_collector(clock):
+    """Review regression: the breach-clear oracle scopes to the
+    CANARY's {collector=} series — another un-actuated member's
+    still-breaching series must not veto a cured canary forever (the
+    very situation fleet-wide promotion exists for)."""
+    store, rec, act = harness(clock)
+    gw, n1 = FakeCollector(fastpath_cfg(40.0)), \
+        FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    act.register("node/1", n1)
+    # per-collector breach series; gw is the worst -> canary target
+    store.observe("odigos_exp{collector=gw}", 20.0)
+    store.observe("odigos_exp{collector=node/1}", 9.0)
+    act.tick()
+    clock.advance(3)
+    store.observe("odigos_exp{collector=gw}", 20.0)
+    store.observe("odigos_exp{collector=node/1}", 9.0)
+    act.tick()
+    assert act.state == "canary" and act.current["target"] == "gw"
+    # the canary's series clears (ages out); node/1 keeps breaching
+    clock.advance(25)
+    store.observe("odigos_exp{collector=node/1}", 9.0)
+    act.tick()
+    # judged by gw's OWN series -> promoted (node/1's standing breach
+    # is what the promotion step is about to cure)
+    assert act.state == "promoting"
+    assert deadline_of(n1) == deadline_of(gw)
+
+
+def test_suspect_at_window_boundary_defers_judgment(clock):
+    """Review regression: a bad condition mid-dwell when judge_until
+    arrives must DEFER the verdict — confirming rolls back, clearing
+    promotes — never promote a canary that is actively degrading."""
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "canary"
+    # window = max(3, expr 20s); condition appears JUST before it ends
+    clock.advance(19.9)
+    gw.bad.append(("slo/traces/in", "SLOBurn"))
+    act.tick()  # suspect registered, dwell not elapsed
+    clock.advance(0.2)  # past judge_until, suspect still mid-dwell
+    act.tick()
+    assert act.state == "canary"  # deferred, NOT promoted
+    clock.advance(1)  # suspect persists past the dwell -> rollback
+    act.tick()
+    [h] = list(act.history)
+    assert h["outcome"] == "rolled_back"
+    assert "SLOBurn" in h["rollback_reason"]
+
+
+def test_stale_owner_shutdown_does_not_disarm_newer_config():
+    """Review regression: collector A armed the actuator, collector B
+    re-armed it (last configure wins); A's shutdown must not clobber
+    B's live config."""
+    stanza_a = {"enabled": True, "cooldown_s": 11.0}
+    stanza_b = {"enabled": True, "cooldown_s": 22.0}
+    a = Collector(_collector_cfg(actuator=stanza_a)).start()
+    b = Collector(_collector_cfg(actuator=stanza_b)).start()
+    assert fleet_actuator.config.cooldown_s == 22.0
+    a.shutdown()  # stale owner: must be a no-op on the live config
+    assert fleet_actuator.enabled
+    assert fleet_actuator.config.cooldown_s == 22.0
+    b.shutdown()  # the live owner disarms
+    assert not fleet_actuator.enabled
+
+
+def test_rollback_on_breach_persisting(clock):
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "canary"
+    # keep the breach alive through the whole judgment window
+    clock.advance(25)
+    breach(store)
+    act.tick()
+    [h] = list(act.history)
+    assert h["outcome"] == "rolled_back"
+    assert h["rollback_reason"] == "breach_persisted"
+    assert deadline_of(gw) == 40.0
+
+
+def test_promotion_step_failure_rolls_back_that_step(clock):
+    store, rec, act = harness(clock)
+    gw, n1 = FakeCollector(fastpath_cfg(40.0)), \
+        FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    act.register("node/1", n1)
+    arm_breach(store, clock, act)
+    act.tick()
+    judged = deadline_of(gw)
+    clock.advance(25)
+    act.tick()  # promoting node/1
+    assert act.state == "promoting"
+    n1.bad.append(("alert/engine-errors", "AlertFiring"))
+    clock.advance(0.1)
+    act.tick()  # suspect registered
+    clock.advance(1)  # persists past the confirmation dwell
+    act.tick()
+    [h] = list(act.history)
+    assert h["outcome"] == "rolled_back_step"
+    assert h["steps"][0]["outcome"] == "rolled_back"
+    # the failing step reverted; the judged canary keeps its value
+    assert deadline_of(n1) == 40.0 and deadline_of(gw) == judged
+
+
+# --------------------------------------------------------------- refusals
+
+
+def refusal_count(rule, knob, reason):
+    return meter.counter(labeled_key(
+        "odigos_actuator_refusals_total", rule=rule, knob=knob,
+        reason=reason))
+
+
+def test_full_classification_refused_never_actuated(clock):
+    """max_batch under a fast_path pipeline classifies FULL (scorer
+    replace under the alias) — the actuator must refuse, not tear the
+    pipeline down."""
+    rule = RecommendationRule(
+        name="padding", expr="latest(odigos_exp[20s]) > 5",
+        knob="max_batch", action="a", direction="down", for_s=0.0)
+    store, rec, act = harness(clock, rules=(rule,))
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    breach(store)
+    act.tick()
+    assert gw.reloads == []  # never actuated
+    assert refusal_count("padding", "max_batch", "full_reload") == 1
+    [h] = list(act.history)
+    assert h["outcome"] == "refused" and h["reason"] == "full_reload"
+    # the standing breach does not re-count the refusal every tick
+    act.tick()
+    assert refusal_count("padding", "max_batch", "full_reload") == 1
+
+
+def test_not_actuatable_and_allowlist_refusals(clock):
+    lanes = RecommendationRule(
+        name="lanes", expr="latest(odigos_exp[20s]) > 5",
+        knob="submit_lanes", action="a", for_s=0.0)
+    store, rec, act = harness(clock, rules=(lanes, EXPIRY_RULE),
+                              knobs=["max_batch"])
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert gw.reloads == []
+    # submit_lanes: structural -> not actuatable (the satellite's dead
+    # knob, now exercised through the refusal table)
+    assert refusal_count("lanes", "submit_lanes", "not_actuatable") == 1
+    # admission_deadline: actuatable but not allowlisted here
+    assert refusal_count("expiry", "admission_deadline",
+                         "not_allowlisted") == 1
+
+
+def test_at_bound_refusal(clock):
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(
+        KNOB_SPECS["admission_deadline"].max_value))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert gw.reloads == []
+    assert refusal_count("expiry", "admission_deadline", "at_bound") == 1
+
+
+def test_no_collectors_refusal(clock):
+    store, rec, act = harness(clock)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert refusal_count("expiry", "admission_deadline",
+                         "no_collectors") == 1
+
+
+def test_dry_run_records_without_touching(clock):
+    store, rec, act = harness(clock, dry_run=True)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert gw.reloads == [] and deadline_of(gw) == 40.0
+    [h] = list(act.history)
+    assert h["outcome"] == "refused" and h["reason"] == "dry_run"
+    assert "would canary" in h["message"]
+    assert meter.counter(labeled_key(
+        "odigos_actuator_proposals_total", rule="expiry",
+        knob="admission_deadline")) == 1
+
+
+def test_kill_switch_disables_and_rolls_back(clock, monkeypatch):
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "canary"
+    monkeypatch.setenv(ACTUATOR_ENV, "0")
+    assert not act.enabled
+    act.tick()
+    # disarm mid-flight restores the canary before going quiet
+    assert deadline_of(gw) == 40.0
+    assert list(act.history)[0]["outcome"] == "rolled_back"
+    monkeypatch.setenv(ACTUATOR_ENV, "1")
+    clock.advance(60)  # past the post-rollback cooldown
+    breach(store)
+    act.tick()  # re-enabled: actuation resumes
+    assert act.state == "canary"
+
+
+def test_full_fallback_applied_config_is_reverted(clock):
+    """Review regression: a reload that LANDS via the full-rebuild
+    path (patch fell back mid-apply) must not leave the proposed value
+    live and unjudged — the actuator reverts it and records the
+    refusal, honoring the never-FULL invariant about what RAN, not
+    what the differ predicted."""
+    store, rec, act = harness(clock)
+
+    class FallbackCollector(FakeCollector):
+        def reload(self, cfg):
+            super().reload(cfg)
+            if len(self.reloads) == 1:
+                # the first reload "falls back" mid-apply: every
+                # full-rebuild path swaps in a NEW graph object (the
+                # per-collector signal — an incremental patch mutates
+                # the existing graph in place)
+                self.graph = object()
+
+    gw = FallbackCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()
+    assert act.state == "idle" and act.current is None
+    assert deadline_of(gw) == 40.0  # reverted (reloads: apply+revert)
+    assert len(gw.reloads) == 2
+    [h] = list(act.history)
+    assert h["outcome"] == "refused" and h["reason"] == "full_reload"
+    assert "reverted" in h["message"]
+
+
+def test_dry_run_blocks_forced_proposals(clock):
+    """Review regression: an operator who armed look-don't-touch gets
+    exactly that — even from the chaos seam."""
+    store, rec, act = harness(clock, rules=(), dry_run=True)
+    gw = FakeCollector(fastpath_cfg(100.0))
+    act.register("gw", gw)
+    act.force("admission_deadline", rule="forced", direction="down",
+              target="gw", value=5.0)
+    act.tick()
+    assert gw.reloads == [] and deadline_of(gw) == 100.0
+    [h] = list(act.history)
+    assert h["outcome"] == "refused" and h["reason"] == "dry_run"
+
+
+def test_disarm_mid_promotion_reverts_only_unjudged_step(clock,
+                                                         monkeypatch):
+    """Review regression: kill switch mid-promotion must undo the
+    UNJUDGED in-flight step only — the canary (and any already-judged
+    member) keeps the value its own window proved good."""
+    store, rec, act = harness(clock)
+    gw, n1 = FakeCollector(fastpath_cfg(40.0)), \
+        FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    act.register("node/1", n1)
+    arm_breach(store, clock, act)
+    act.tick()
+    judged = deadline_of(gw)
+    clock.advance(25)
+    act.tick()  # canary judged -> promoting node/1
+    assert act.state == "promoting"
+    monkeypatch.setenv(ACTUATOR_ENV, "0")
+    act.tick()
+    assert deadline_of(n1) == 40.0  # unjudged step reverted
+    assert deadline_of(gw) == judged  # judged canary keeps its value
+    [h] = list(act.history)
+    assert h["outcome"] == "rolled_back_step"
+    assert h["steps"][0]["rollback_reason"] == "actuator_disabled"
+
+
+def test_unapplyable_edit_path_is_a_named_refusal(clock):
+    """Review regression: a truthy non-dict on the edit path
+    (fast_path: \"on\" — the graph runs it, the validator only checks
+    mappings) must refuse, never raise out of tick and kill the
+    plane-timer thread."""
+    store, rec, act = harness(clock)
+    cfg = fastpath_cfg(40.0)
+    cfg["service"]["pipelines"]["traces/in"]["fast_path"] = "on"
+    gw = FakeCollector(cfg)
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    act.tick()  # must not raise
+    assert gw.reloads == []
+    assert refusal_count("expiry", "admission_deadline",
+                         "full_reload") == 1
+    [h] = list(act.history)
+    assert "unapplyable edit path" in h["message"]
+
+
+# --------------------------------------------------------- replicas knob
+
+
+def test_replicas_via_scaler_canary_and_rollback(clock):
+    rule = RecommendationRule(
+        name="queue", expr="latest(odigos_exp[20s]) > 5",
+        knob="replicas", action="a", for_s=0.0, direction="up")
+    store, rec, act = harness(clock, rules=(rule,))
+    calls = []
+
+    def scaler(delta):
+        calls.append(delta)
+        return 2 + sum(calls)
+
+    # without a scaler: the named refusal
+    breach(store)
+    act.tick()
+    assert refusal_count("queue", "replicas", "no_replica_scaler") == 1
+    act.set_replica_scaler(scaler)
+    act._noted.clear()  # clear the refusal dedupe so it re-grounds
+    act.tick()
+    assert calls == [1] and act.state == "canary"  # one replica UP
+    # breach persists through the window -> the replica step reverts
+    clock.advance(25)
+    breach(store)
+    act.tick()
+    assert calls == [1, -1]
+    assert list(act.history)[-1]["outcome"] == "rolled_back"
+
+
+def test_replicas_scale_down_direction_respected(clock):
+    """Review regression: a direction='down' replicas proposal must
+    step -1 (and its rollback +1) — a scale-down rule must never scale
+    the fleet up."""
+    store, rec, act = harness(clock, rules=())
+    calls = []
+
+    def scaler(delta):
+        calls.append(delta)
+        return 3 + sum(calls)
+
+    act.set_replica_scaler(scaler)
+    store.observe("odigos_g", 1.0)
+    act.force("replicas", rule="shed", direction="down",
+              expr="latest(odigos_g[20s]) > 0")
+    act.tick()
+    assert calls == [-1] and act.state == "canary"
+    clock.advance(25)
+    store.observe("odigos_g", 1.0)  # breach never clears -> rollback
+    act.tick()
+    assert calls == [-1, 1]
+
+
+def test_apply_stage_refusal_does_not_retry_every_tick(clock):
+    """Review regression: a proposal refused AT the apply stage (a
+    reload that raises) must not hammer the broken reload once per
+    plane tick — and proposals_total counts once per activation, not
+    per tick."""
+    store, rec, act = harness(clock)
+
+    class BrokenCollector(FakeCollector):
+        def reload(self, cfg):
+            self.reloads.append(cfg)
+            raise RuntimeError("boom")
+
+    gw = BrokenCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    arm_breach(store, clock, act)
+    for _ in range(5):
+        act.tick()
+    assert len(gw.reloads) == 1  # one attempt, then blocked
+    assert refusal_count("expiry", "admission_deadline",
+                         "reload_error") == 1
+    assert meter.counter(labeled_key(
+        "odigos_actuator_proposals_total", rule="expiry",
+        knob="admission_deadline")) == 1
+    # the rec deactivating lifts the block; re-activation retries
+    clock.advance(60)  # breach ages out of the [20s] window
+    act.tick()
+    arm_breach(store, clock, act)
+    act.tick()
+    assert len(gw.reloads) == 2
+
+
+def test_holds_advance_during_inflight_actuation(clock):
+    """Review regression: a rule whose breach clears while another
+    actuation is in flight must lose its pending hold — otherwise a
+    post-actuation one-tick blip inherits the whole actuation span as
+    'held' and bypasses the flap guard."""
+    other = RecommendationRule(
+        name="other", expr="latest(odigos_other[20s]) > 5",
+        knob="admission_deadline", action="a", direction="up",
+        for_s=2.0)
+    store, rec, act = harness(clock, rules=(EXPIRY_RULE, other),
+                              cooldown_s=0.1)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    # both rules breach and hold; expiry actuates first (name order)
+    store.observe("odigos_other", 9.0)
+    arm_breach(store, clock, act)
+    store.observe("odigos_other", 9.0)
+    act.tick()
+    assert act.state == "canary"
+    # 'other' recovers mid-canary (its value ages out of the window);
+    # the tick that judges the canary advances the holds FIRST, so the
+    # recovery clears other's pending before anything can inherit it
+    clock.advance(25)
+    act.tick()  # holds advanced, then expiry judged + promoted
+    assert rec.rule_state("other") == "inactive"
+    clock.advance(1)
+    # a fresh one-tick blip of 'other' must be PENDING, not active
+    store.observe("odigos_other", 9.0)
+    act.tick()
+    assert rec.rule_state("other") == "pending"
+    assert len(gw.reloads) == 1  # no blip canary
+
+
+def test_validate_rejects_unhashable_knob_entry():
+    problems = validate_actuator_config(
+        {"knobs": [{"name": "admission_deadline"}, ["x"]]})
+    assert len([p for p in problems if "unknown knob" in p]) == 2
+
+
+def test_repeated_forced_refusals_each_counted(clock):
+    """Review regression: every force() call is an independent event —
+    its refusal must not be deduped against the previous one's."""
+    store, rec, act = harness(clock, rules=())
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    for _ in range(2):
+        act.force("submit_lanes", rule="forced", direction="up",
+                  target="gw")
+        act.tick()
+    assert refusal_count("forced", "submit_lanes",
+                         "not_actuatable") == 2
+    assert len([h for h in act.history
+                if h["outcome"] == "refused"]) == 2
+
+
+# ------------------------------------------------------------ forced seam
+
+
+def test_forced_bad_proposal_rolls_back(clock):
+    store, rec, act = harness(clock, rules=())
+    gw = FakeCollector(fastpath_cfg(100.0))
+    act.register("gw", gw)
+    store.observe("odigos_g", 1.0)
+    act.force("admission_deadline", rule="forced-bad",
+              direction="down", expr="latest(odigos_g[20s]) > 0",
+              target="gw", value=5.0)
+    act.tick()
+    assert act.state == "canary" and deadline_of(gw) == 5.0
+    clock.advance(25)
+    store.observe("odigos_g", 1.0)  # expr never clears
+    act.tick()
+    [h] = list(act.history)
+    assert h["outcome"] == "rolled_back"
+    assert deadline_of(gw) == 100.0
+
+
+# ------------------------------------------------- collector lifecycle
+
+
+def _collector_cfg(actuator=None):
+    cfg = {
+        "receivers": {"synthetic": {"n_batches": 0}},
+        "processors": {"batch": {}},
+        "exporters": {"tracedb": {}},
+        "service": {"pipelines": {"traces/in": {
+            "receivers": ["synthetic"], "processors": ["batch"],
+            "exporters": ["tracedb"]}}},
+    }
+    if actuator is not None:
+        cfg["service"]["actuator"] = actuator
+    return cfg
+
+
+def test_collector_stanza_arms_and_disarms():
+    stanza = {"enabled": True, "judgment_window_s": 2.0,
+              "cooldown_s": 1.0, "knobs": ["admission_deadline"]}
+    c = Collector(_collector_cfg(actuator=stanza)).start()
+    try:
+        assert fleet_actuator.enabled
+        assert fleet_actuator.config.judgment_window_s == 2.0
+        # incremental reload of the stanza alone retunes in place
+        c.reload(_collector_cfg(actuator=dict(stanza, cooldown_s=9.0)))
+        assert fleet_actuator.config.cooldown_s == 9.0
+        assert c.graph is not None
+    finally:
+        c.shutdown()
+    assert not fleet_actuator.enabled  # shutdown disarms
+
+
+def test_real_collector_canary_reloads_incrementally(clock):
+    """The loop against a REAL Collector: the canary edit rides
+    Collector.reload's incremental path (fast_path reconfigure — zero
+    node rebuilds) and the promoted config is the collector's config."""
+    cfg = {
+        "receivers": {"synthetic": {"n_batches": 0}},
+        # shared_engine False: the engine must die with the collector
+        # (a cached shared engine would outlive the test in the live
+        # registry and pollute the device-runtime collector's view)
+        "processors": {"tpuanomaly": {"model": "mock",
+                                      "shared_engine": False}},
+        "exporters": {"tracedb": {}},
+        "service": {"pipelines": {"traces/in": {
+            "receivers": ["synthetic"], "processors": ["tpuanomaly"],
+            "exporters": ["tracedb"],
+            "fast_path": {"deadline_ms": 25.0}}}},
+    }
+    store = SeriesStore(interval_s=1.0, window=7200, clock=clock)
+    rec = Recommender(store=store, clock=clock, rules=(EXPIRY_RULE,))
+    act = FleetActuator(clock=clock, recommender=rec)
+    act.configure({"enabled": True, "judgment_window_s": 1.0,
+                   "cooldown_s": 1.0, "max_step": 4.0})
+    c = Collector(cfg).start()
+    try:
+        act.register("gw", c)
+        arm_breach(store, clock, act)
+        reconfigured0 = meter.counter(labeled_key(
+            "odigos_collector_reload_nodes_total",
+            action="reconfigured"))
+        act.tick()
+        assert act.state == "canary"
+        fp = c.graph.fastpaths["traces/in"]
+        assert fp.deadline_ms > 25.0  # the LIVE route retuned
+        assert c.config["service"]["pipelines"]["traces/in"][
+            "fast_path"]["deadline_ms"] == fp.deadline_ms
+        assert meter.counter(labeled_key(
+            "odigos_collector_reload_nodes_total",
+            action="reconfigured")) > reconfigured0
+        assert act.current["reload_mode"] == "incremental"
+        clock.advance(25)
+        act.tick()
+        assert list(act.history)[0]["outcome"] == "promoted"
+    finally:
+        c.shutdown()
+
+
+# --------------------------------------------------------------- surfaces
+
+
+def test_api_snapshot_shape_and_json(clock):
+    store, rec, act = harness(clock)
+    gw = FakeCollector(fastpath_cfg(40.0))
+    act.register("gw", gw)
+    snap = act.api_snapshot()
+    assert snap["enabled"] and snap["state"] == "idle"
+    assert snap["collectors"] == ["gw"]
+    assert snap["in_flight"] is None and snap["history"] == []
+    assert snap["knobs"]["submit_lanes"]["actuatable"] is False
+    assert snap["knobs"]["submit_lanes"]["refusal"]
+    assert snap["knobs"]["admission_deadline"]["actuatable"] is True
+    json.dumps(snap)
+    arm_breach(store, clock, act)
+    act.tick()
+    json.dumps(act.api_snapshot())  # in-flight snapshot JSON-able too
+    clock.advance(25)
+    act.tick()
+    snap = act.api_snapshot()
+    assert snap["history"][0]["outcome"] == "promoted"
+    json.dumps(snap)
+
+
+def test_api_actuator_endpoint_and_actuatorz():
+    from odigos_tpu.api.store import Store
+    from odigos_tpu.frontend import FrontendServer
+
+    fleet_actuator.configure({"enabled": True})
+    fe = FrontendServer(Store(), metrics_port=None).start()
+    try:
+        with urllib.request.urlopen(
+                f"{fe.url}/api/actuator", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["enabled"] and doc["state"] == "idle"
+        assert "knobs" in doc and "history" in doc
+    finally:
+        fe.shutdown()
+    c = Collector({
+        "receivers": {"synthetic": {"n_batches": 0}},
+        "exporters": {"tracedb": {}},
+        "extensions": {"zpages": {"port": 0}},
+        "service": {"extensions": ["zpages"],
+                    "pipelines": {"traces/in": {
+                        "receivers": ["synthetic"], "processors": [],
+                        "exporters": ["tracedb"]}}},
+    }).start()
+    try:
+        port = c.graph.extensions["zpages"].port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/actuatorz",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        assert "state" in doc and "knobs" in doc
+    finally:
+        c.shutdown()
+
+
+def test_describe_prints_actuator_lines(tmp_path, clock):
+    from odigos_tpu.cli.describe import describe_install
+    from odigos_tpu.cli.state import create_state
+
+    fleet_actuator.configure({"enabled": True, "dry_run": True})
+    fleet_actuator._record({"rule": "expiry",
+                            "knob": "admission_deadline",
+                            "outcome": "refused", "reason": "dry_run"})
+    state = create_state(str(tmp_path / "install"))
+    text = describe_install(state)
+    assert "actuator: armed (dry-run), state idle" in text
+    assert "[refused] expiry knob=admission_deadline — dry_run" in text
+
+
+def test_pipelinegen_renders_actuator_stanza():
+    from odigos_tpu.components.api import Signal
+    from odigos_tpu.config.model import Configuration
+    from odigos_tpu.destinations import Destination
+    from odigos_tpu.pipelinegen.builder import (
+        GatewayOptions, build_gateway_config)
+
+    dests = [Destination(id="db", dest_type="tracedb",
+                         signals=[Signal.TRACES])]
+    base, _, _ = build_gateway_config(dests, options=GatewayOptions())
+    assert "actuator" not in base["service"]  # byte-stable when unset
+    opts = GatewayOptions(actuator={"enabled": True,
+                                    "knobs": ["admission_deadline"]})
+    cfg, _, _ = build_gateway_config(dests, options=opts)
+    assert cfg["service"]["actuator"] == {
+        "enabled": True, "knobs": ["admission_deadline"]}
+    c = Configuration(actuator={"enabled": True})
+    assert Configuration.from_dict(c.to_dict()).actuator \
+        == {"enabled": True}
+
+
+def test_rollup_shows_actuator_condition_row(clock):
+    """The actuator/<rule> row rides HealthRollup.evaluate while an
+    actuation is in flight — and leaves when it resolves (the condition
+    round trip the chaos oracle asserts)."""
+    stanza = {"enabled": True, "judgment_window_s": 60.0}
+    c = Collector(_collector_cfg(actuator=stanza)).start()
+    try:
+        # an in-flight record on the PROCESS-global actuator shows on
+        # the collector's rollup like the failover rows do
+        fleet_actuator.current = {
+            "rule": "expiry", "knob": "admission_deadline",
+            "phase": "canary", "target": "gw",
+            "edits": [{"path": [], "from": 40.0, "to": 80.0}]}
+        conds = {x["component"]: x for x in c.health_conditions()}
+        row = conds["actuator/expiry"]
+        assert row["status"] == "Healthy"
+        assert row["reason"] == "CanaryInFlight"
+        fleet_actuator.current = None
+        conds = {x["component"]: x for x in c.health_conditions()}
+        assert "actuator/expiry" not in conds
+    finally:
+        c.shutdown()

@@ -37,7 +37,9 @@ from odigos_tpu.config.model import (
     CollectorGatewayConfiguration,
     Configuration,
     RolloutConfiguration,
+    SloConfiguration,
 )
+from odigos_tpu.controlplane.actuator import fleet_actuator
 from odigos_tpu.destinations import Destination
 from odigos_tpu.e2e import (
     E2EEnvironment,
@@ -63,7 +65,8 @@ from odigos_tpu.e2e import (
 )
 from odigos_tpu.e2e.chaos import _gateway_engines
 from odigos_tpu.pdata import synthesize_traces
-from odigos_tpu.selftelemetry.fleet import alert_engine, fleet_plane
+from odigos_tpu.selftelemetry.fleet import (
+    RecommendationRule, alert_engine, fleet_plane)
 from odigos_tpu.selftelemetry.flow import (
     DROP_REASONS, HealthRollup, flow_ledger)
 from odigos_tpu.selftelemetry.latency import latency_ledger
@@ -84,7 +87,9 @@ def fresh_planes():
     flow_ledger.enabled = True
     latency_ledger.reset()
     fleet_plane.reset()
+    fleet_actuator.reset()
     yield
+    fleet_actuator.reset()
     fleet_plane.reset()
     latency_ledger.reset()
     flow_ledger.reset()
@@ -735,6 +740,249 @@ class TestRejectingDestinationIsolation:
                 for cls in e["failed"]}
             assert "MockDestinationError" in failed_classes, snap["edges"]
             assert balances  # at least one pipeline was registered
+
+
+# ------------------------------------------------- actuator (ISSUE 15)
+
+
+def expired_spans() -> int:
+    return int(sum(
+        v for k, v in meter.snapshot().items()
+        if k.startswith("odigos_latency_deadline_expired_spans_total")))
+
+
+def scored_spans() -> int:
+    return int(meter.counter("odigos_anomaly_scored_spans_total"))
+
+
+def gw_deadline(env) -> float:
+    return env.gateway.config["service"]["pipelines"]["traces/in"][
+        "fast_path"]["deadline_ms"]
+
+
+class TestActuatorCanaryPromote:
+    """ISSUE 15 acceptance at scenario scale: an injected overload (a
+    deliberately under-sized admission deadline under live wire
+    traffic) fires the alert AND the flap-guarded recommendation; the
+    actuator canaries a bounded ``fast_path.deadline_ms`` raise through
+    the INCREMENTAL reload path, judges it over the rule's own window
+    while traffic keeps flowing, promotes it, and scoring recovers —
+    with the standard four-part oracle (exact conservation, named
+    drops, actuator/<rule> condition round trip, the right alert
+    fired)."""
+
+    ALERT = AlertRuleConfiguration(
+        name="deadline-expiries",
+        expr="delta(odigos_latency_deadline_expired_spans_total[30s])"
+             " > 20",
+        for_s=0.0, severity="warning")
+
+    RULE = RecommendationRule(
+        name="deadline-expiry-storm",
+        expr="delta(odigos_latency_deadline_expired_spans_total[4s])"
+             " > 20",
+        knob="admission_deadline",
+        action="raise deadline ({value:.0f} expiries)",
+        direction="up", for_s=0.3, severity="warning")
+
+    def test_overload_canary_promote(self):
+        cfg = env_config(
+            anomaly=AnomalyStageConfiguration(
+                enabled=True, model="zscore", timeout_ms=3.0,
+                fast_path=True, fast_path_predictive=False,
+                slo=SloConfiguration(scored_fraction=0.9,
+                                     fast_window_s=3.0,
+                                     slow_window_s=6.0)),
+            alerts=[self.ALERT])
+        # the stanza rides pipelinegen -> service.actuator -> the
+        # gateway Collector arms the process-global actuator at start
+        cfg.actuator = {"enabled": True, "judgment_window_s": 1.0,
+                        "cooldown_s": 30.0, "max_step": 20.0,
+                        "knobs": ["admission_deadline"]}
+        # test-timescale rule (the production table holds for 30 s over
+        # 60 s windows; the loop under test is the same state machine)
+        fleet_plane.recommender.set_rules((self.RULE,))
+
+        state: dict = {"seed": 0}
+
+        def burst(e, n=4):
+            # the OVERLOAD: back-to-back frames queue behind each other
+            # inside the fast path, so under the 3 ms deadline the
+            # backlog expires en masse — while the same burst clears
+            # comfortably under the promoted deadline. Paced by wall
+            # time (not poll cadence) and sized to overload the
+            # DEADLINE, not to wedge the downstream batch stage (a
+            # heavier storm trips the conservation oracle — which would
+            # be the oracle correctly refusing to promote under
+            # unexplained pressure, but not this scenario)
+            now = time.monotonic()
+            if now - state.get("last_burst", 0.0) < 0.05:
+                return
+            state["last_burst"] = now
+            for _ in range(n):
+                state["seed"] += 1
+                e.send_traces(synthesize_traces(
+                    4, seed=state["seed"] % 97))
+
+        def overload_expires(e):
+            burst(e)
+            return expired_spans() > 200
+
+        def alert_fires(e):
+            burst(e)  # the storm is sustained, not a spent blip
+            return alert_fired("deadline-expiries")
+
+        def canary_in_flight(e):
+            burst(e)  # judgment must see live traffic, not silence
+            return expect_condition(
+                e, "actuator/deadline-expiry-storm", "Healthy",
+                "CanaryInFlight") and gw_deadline(e) > 3.0
+
+        def promoted(e):
+            burst(e)
+            return any(h["outcome"] == "promoted"
+                       for h in fleet_actuator.history)
+
+        def scoring_recovers(e):
+            state.setdefault("scored_at_promote", scored_spans())
+            burst(e)
+            return scored_spans() > state["scored_at_promote"] + 200
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("actuator-canary-promote", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("actuator armed from the rendered stanza",
+                     assert_fn=lambda e: fleet_actuator.enabled,
+                     timeout_s=10.0),
+                Step("overload: frames expire past the 3 ms deadline",
+                     assert_fn=overload_expires, timeout_s=30.0),
+                Step("expiry alert fired",
+                     assert_fn=alert_fires, timeout_s=15.0),
+                Step("held recommendation canaries the deadline "
+                     "(condition row raised, knob turned on the "
+                     "canary)",
+                     assert_fn=canary_in_flight, timeout_s=20.0),
+                Step("judged over the rule window, then promoted",
+                     assert_fn=promoted, timeout_s=30.0),
+                Step("scoring recovers under the raised deadline",
+                     assert_fn=scoring_recovers, timeout_s=20.0),
+            ], finally_steps=[
+                Step("clear all faults",
+                     script=lambda e: clear_all(e)),
+            ]).run(env)
+            # the canary rode the INCREMENTAL reload path (fast_path
+            # reconfigure — zero node rebuilds, zero teardown)
+            [promo] = [h for h in fleet_actuator.history
+                       if h["outcome"] == "promoted"]
+            assert promo["reload_mode"] == "incremental"
+            assert promo["knob"] == "admission_deadline"
+            # the bounded step raised the deadline (depth-of-breach
+            # sized, capped at max_step 20 -> at most 60 ms)
+            assert 3.0 < gw_deadline(env) <= 60.0
+            assert promo["edits"][0]["to"] == gw_deadline(env)
+            # condition round trip: the actuator row left with the
+            # actuation
+            assert condition(
+                env, "actuator/deadline-expiry-storm") is None
+            assert meter.counter(
+                "odigos_actuator_canaries_total"
+                "{rule=deadline-expiry-storm,knob=admission_deadline}"
+            ) >= 1
+            assert_conserved()
+
+
+class TestActuatorForcedRollback:
+    """The forced-bad-proposal variant: a proposal shrinking the
+    deadline to its floor is canaried, the oracle refuses to promote it
+    (its breach-clear expression never clears), the canary rolls back
+    to the recorded prior config, and the rollback alert fires — the
+    four-part oracle again, on the failure path."""
+
+    ALERT = AlertRuleConfiguration(
+        name="actuator-rollback",
+        expr="max(odigos_actuator_rollbacks_total[60s]) > 0",
+        for_s=0.0, severity="warning")
+
+    def test_forced_bad_proposal_rolls_back(self):
+        cfg = env_config(
+            anomaly=AnomalyStageConfiguration(
+                enabled=True, model="zscore", timeout_ms=5000.0,
+                fast_path=True, fast_path_predictive=False),
+            alerts=[self.ALERT])
+        cfg.actuator = {"enabled": True, "judgment_window_s": 2.0,
+                        "cooldown_s": 1.0, "max_step": 2.0}
+
+        def send(e, seed):
+            e.send_traces_wire(synthesize_traces(3, seed=seed),
+                               timeout=2.0)
+
+        state = {"seed": 100}
+
+        def send_next(e):
+            state["seed"] += 1
+            send(e, state["seed"])
+
+        def baseline_scored(e):
+            send_next(e)
+            return scored_spans() > 0
+
+        def force_bad(e):
+            # the chaos seam: a proposal whose breach-clear expression
+            # is always true (collector health status is always
+            # published >= 0), so the oracle can never promote it
+            fleet_actuator.force(
+                "admission_deadline", rule="forced-bad",
+                direction="down", target="gateway", value=5.0,
+                expr="latest(odigos_collector_health_status[5s]) >= 0")
+
+        def bad_canary_applied(e):
+            send_next(e)
+            return (gw_deadline(e) == 5.0 and expect_condition(
+                e, "actuator/forced-bad", "Healthy", "CanaryInFlight"))
+
+        def rolled_back(e):
+            send_next(e)
+            return any(h["outcome"] == "rolled_back"
+                       for h in fleet_actuator.history)
+
+        def scoring_continues(e):
+            before = scored_spans()
+            send_next(e)
+            return scored_spans() > before
+
+        with E2EEnvironment(nodes=1, config=cfg) as env:
+            Scenario("actuator-forced-rollback", [
+                Step("add destination",
+                     apply=lambda e: e.add_destination(tracedb_dest())),
+                Step("baseline traffic scored",
+                     assert_fn=baseline_scored, timeout_s=30.0),
+                Step("force a bad proposal (deadline -> floor)",
+                     script=force_bad),
+                Step("bad canary applied (condition row raised)",
+                     assert_fn=bad_canary_applied, timeout_s=15.0),
+                Step("oracle refuses: canary rolled back",
+                     assert_fn=rolled_back, timeout_s=20.0),
+                Step("prior config restored on the canary",
+                     assert_fn=lambda e: gw_deadline(e) == 5000.0),
+                Step("rollback alert fired",
+                     assert_fn=lambda e: alert_fired(
+                         "actuator-rollback"), timeout_s=15.0),
+                Step("scoring continues on the restored config",
+                     assert_fn=scoring_continues, timeout_s=20.0),
+            ], finally_steps=[
+                Step("clear all faults",
+                     script=lambda e: clear_all(e)),
+            ]).run(env)
+            [rb] = [h for h in fleet_actuator.history
+                    if h["outcome"] == "rolled_back"]
+            assert rb["rule"] == "forced-bad"
+            assert meter.counter(
+                "odigos_actuator_rollbacks_total"
+                "{rule=forced-bad,knob=admission_deadline}") >= 1
+            # round trip: no actuator row left behind
+            assert condition(env, "actuator/forced-bad") is None
+            assert_conserved()
 
 
 # ------------------------------------------------------ runner contract

@@ -200,6 +200,24 @@ class TestDiffClassification:
         assert d.alerts_changed and d.gc_changed
         assert d.slo_changed == ["traces/in"]
         assert d.actions == []
+        assert not d.actuator_changed
+
+    def test_actuator_stanza_change_is_incremental(self):
+        """ISSUE 15: an actuator stanza edit retunes in place (the
+        alerts/gc discipline) — it must never force a graph rebuild."""
+        old = base_config()
+        old["service"]["actuator"] = {"enabled": True,
+                                      "cooldown_s": 60.0}
+        new = copy.deepcopy(old)
+        new["service"]["actuator"]["cooldown_s"] = 5.0
+        d = diff_configs(old, new)
+        assert d.mode == INCREMENTAL and d.actuator_changed
+        assert d.actions == []
+        # deleting the stanza is also a non-topological change
+        gone = copy.deepcopy(old)
+        del gone["service"]["actuator"]
+        d2 = diff_configs(old, gone)
+        assert d2.mode == INCREMENTAL and d2.actuator_changed
 
 
 # ------------------------------------------------ incremental reload (live)

@@ -121,6 +121,13 @@ CONTRACT: dict[str, dict] = {
     # served, possibly empty)
     "fleet": {"endpoint": "/api/fleet",
               "fields": ["collectors", "alerts", "recommendations"]},
+    # closed-loop actuator panel (ISSUE 15): armed state, in-flight
+    # canary/promotion, bounded action history; per-row objects are
+    # reached via locals (h/cur) — top-level containers validated here
+    # (always served: in_flight is present-but-null when idle)
+    "act": {"endpoint": "/api/actuator",
+            "fields": ["enabled", "dry_run", "state", "in_flight",
+                       "history"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

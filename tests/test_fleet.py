@@ -530,6 +530,99 @@ def test_recommender_rules_parse():
         parse_expr(rule.expr)  # must not raise
 
 
+def test_backlog_rule_split_names_matching_knobs():
+    """ISSUE 15 satellite: the lane rule names the lane knob (the old
+    single rule said 'raise submit_lanes' while naming knob=replicas),
+    the replica rule names replicas at a strictly higher threshold, and
+    every TUNING_KNOBS entry is referenced by >= 1 rule (no dead
+    knobs)."""
+    from odigos_tpu.config.sizing import TUNING_KNOBS
+
+    by_name = {r.name: r for r in RECOMMENDER_RULES}
+    lanes = by_name["submit-lanes-saturated"]
+    replicas = by_name["ingest-backlog-pressure"]
+    assert lanes.knob == "submit_lanes"
+    assert "submit_lanes" in lanes.action
+    assert replicas.knob == "replicas"
+    assert "submit_lanes" not in replicas.action
+    assert parse_expr(replicas.expr)["threshold"] \
+        > parse_expr(lanes.expr)["threshold"]
+    referenced = {r.knob for r in RECOMMENDER_RULES}
+    assert referenced == set(TUNING_KNOBS), \
+        f"dead knob entries: {set(TUNING_KNOBS) - referenced}"
+
+
+# --------------------------------------------- flap guard (held lifecycle)
+
+
+HOLD_RULE = RECOMMENDER_RULES[0].__class__(
+    name="held", expr="latest(odigos_g[30s]) > 5", knob="max_batch",
+    action="a {value}", direction="down", for_s=10.0)
+
+
+def test_recommendation_holds_pending_then_activates(plane, clock):
+    """ISSUE 15 satellite: a breach goes pending the instant it
+    appears but only ACTIVATES after persisting for_s — the actuator's
+    feed never shows a one-tick blip."""
+    from odigos_tpu.selftelemetry.fleet import Recommender
+
+    rec = Recommender(store=plane.store, clock=clock,
+                      rules=(HOLD_RULE,))
+    plane.store.observe("odigos_g", 9.0)
+    assert rec.evaluate() == []
+    assert rec.rule_state("held") == "pending"
+    clock.advance(5)
+    plane.store.observe("odigos_g", 9.0)
+    assert rec.evaluate() == []  # inside the hold
+    clock.advance(6)
+    plane.store.observe("odigos_g", 9.0)
+    [active] = rec.evaluate()
+    assert active["state"] == "active" and active["held_s"] >= 10.0
+    assert rec.rule_state("held") == "active"
+    # recovery clears immediately — and the next breach re-holds from
+    # scratch (no credit for the previous incident)
+    clock.advance(40)  # the breach ages out of the 30 s window
+    assert rec.evaluate() == []
+    assert rec.rule_state("held") == "inactive"
+    plane.store.observe("odigos_g", 9.0)
+    assert rec.evaluate() == []
+    assert rec.rule_state("held") == "pending"
+
+
+def test_recommendation_blip_never_activates(plane, clock):
+    """A blip shorter than for_s must never reach the actuator."""
+    from odigos_tpu.selftelemetry.fleet import Recommender
+
+    rec = Recommender(store=plane.store, clock=clock,
+                      rules=(HOLD_RULE,))
+    plane.store.observe("odigos_g", 9.0)
+    assert rec.evaluate() == []  # pending
+    # the blip leaves the expr window before any evaluation finds it
+    # held long enough: not breaching at evaluation time -> pending
+    # resets, nothing ever activates
+    clock.advance(35)
+    assert rec.evaluate() == []
+    assert rec.rule_state("held") == "inactive"
+
+
+def test_plane_surfaces_use_held_feed(clock):
+    """api_snapshot recommendations come from the held recommender: an
+    instant breach shows nothing until the hold elapses."""
+    store = SeriesStore(interval_s=1.0, window=240, clock=clock)
+    plane = FleetPlane(store=store, clock=clock)
+    plane.recommender.set_rules((HOLD_RULE,))
+    store.observe("odigos_g", 9.0)
+    assert plane.api_snapshot()["recommendations"] == []
+    [status] = [s for s in plane.api_snapshot()["recommender"]
+                if s["name"] == "held"]
+    assert status["state"] == "pending"
+    clock.advance(12)
+    store.observe("odigos_g", 9.0)
+    recs = plane.api_snapshot()["recommendations"]
+    assert [r["name"] for r in recs] == ["held"]
+    assert recs[0]["state"] == "active"
+
+
 # ----------------------------------------------------------- surfaces
 
 

@@ -667,6 +667,154 @@ class TestFleetRuleHygiene:
         assert self._resolves("odigos_latency_e2e_ms_p99", registry)
 
 
+class TestActuatorKnobHygiene:
+    """Closed-loop actuator lint (ISSUE 15 satellite): every ACTUATABLE
+    node-config knob in ``sizing.KNOB_SPECS`` must resolve to a
+    ``validate_config``-accepted config path whose edit the structural
+    differ classifies reconfigure/replace — never FULL — on a
+    representative config of the knob's kind. A knob addition that
+    silently classifies FULL would make the actuator tear down the very
+    pipeline it exists to tune without a teardown. With a stale-entry
+    oracle: a spec pointing at a key the validator refuses (or that
+    resolves to no site) must be flagged."""
+
+    @staticmethod
+    def _representative_config(spec) -> dict:
+        """A minimal valid config of the knob's kind: fastpath knobs
+        need a fast_path pipeline; processor knobs a componentwise
+        chain (the same knob under a fast_path alias may legitimately
+        classify FULL — the actuator refuses that at runtime)."""
+        import odigos_tpu.components  # noqa: F401 — factories
+
+        cfg: dict = {
+            "receivers": {"otlpwire": {}},
+            "processors": {"tpuanomaly": {}},
+            "exporters": {"tracedb": {}},
+            "service": {"pipelines": {"traces/in": {
+                "receivers": ["otlpwire"],
+                "processors": ["tpuanomaly"],
+                "exporters": ["tracedb"]}}},
+        }
+        if spec.kind == "fastpath":
+            cfg["service"]["pipelines"]["traces/in"]["fast_path"] = {
+                "deadline_ms": 25.0}
+        return cfg
+
+    def _check(self, knob, spec) -> list:
+        """Problems for one actuatable node-config knob (the lint body,
+        factored so the stale-entry oracle can drive it)."""
+        import copy
+
+        from odigos_tpu.config.sizing import bounded_step, knob_sites
+        from odigos_tpu.pipeline.configdiff import FULL, diff_configs
+        from odigos_tpu.pipeline.graph import validate_config
+
+        problems = []
+        cfg = self._representative_config(spec)
+        sites = [(path, cur) for path, cur in knob_sites(knob, cfg)]
+        if not sites:
+            return [f"{knob}: resolves to no edit site in its "
+                    f"representative config (stale entry)"]
+        new = copy.deepcopy(cfg)
+        for path, cur in sites:
+            node = new
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = bounded_step(knob, cur,
+                                          direction="down"
+                                          if cur >= spec.max_value
+                                          else "up", max_step=2.0)
+            if node[path[-1]] == cur:
+                problems.append(f"{knob}: bounded_step produced a "
+                                f"no-op edit at {path}")
+        bad = validate_config(new)
+        if bad:
+            problems.append(f"{knob}: edited config refused by "
+                            f"validate_config: {bad}")
+            return problems
+        diff = diff_configs(cfg, new)
+        if diff.mode == FULL:
+            problems.append(f"{knob}: edit classifies FULL "
+                            f"({diff.reasons}) — the actuator would "
+                            f"refuse every proposal for this knob")
+        return problems
+
+    def test_every_actuatable_knob_classifies_incremental(self):
+        from odigos_tpu.config.sizing import KNOB_SPECS
+
+        problems = []
+        checked = 0
+        for knob, spec in KNOB_SPECS.items():
+            if not spec.actuatable or spec.kind == "controlplane":
+                continue
+            checked += 1
+            problems.extend(self._check(knob, spec))
+        assert checked, "no actuatable node-config knobs at all?"
+        assert not problems, "\n".join(problems)
+
+    def test_stale_entry_oracle(self):
+        """The lint's own oracle: a fabricated spec whose key the
+        validator refuses (ghost fast_path key) and one that resolves
+        to no site must both be flagged."""
+        import dataclasses
+
+        from odigos_tpu.config.sizing import KNOB_SPECS, KnobSpec
+
+        ghost = dataclasses.replace(KNOB_SPECS["admission_deadline"],
+                                    key="ghost_knob")
+        KNOB_SPECS["_ghost"] = ghost
+        try:
+            problems = self._check("_ghost", ghost)
+        finally:
+            del KNOB_SPECS["_ghost"]
+        assert problems and "validate_config" in problems[0]
+        orphan = KnobSpec(knob="_orphan", path="x", kind="processor",
+                          component="nosuchprocessor", key="k",
+                          min_value=1, max_value=10, default=5,
+                          actuatable=True)
+        KNOB_SPECS["_orphan"] = orphan
+        try:
+            problems = self._check("_orphan", orphan)
+        finally:
+            del KNOB_SPECS["_orphan"]
+        assert problems and "no edit site" in problems[0]
+
+    def test_actuator_metric_names_registered(self):
+        """The odigos_actuator_* family must resolve against the
+        registered name registry (the TestFleetRuleHygiene scan)."""
+        registry = TestFleetRuleHygiene._registered_metric_names()
+        for name in ("odigos_actuator_proposals_total",
+                     "odigos_actuator_canaries_total",
+                     "odigos_actuator_promotions_total",
+                     "odigos_actuator_rollbacks_total",
+                     "odigos_actuator_refusals_total",
+                     "odigos_actuator_state"):
+            assert name in registry, name
+
+    def test_soak_actuate_rules_resolve(self):
+        """The --actuate soak's rule/alert tables reference real
+        metrics and real knobs (the SOAK_ALERTS discipline)."""
+        import importlib.util
+
+        from odigos_tpu.config.sizing import KNOB_SPECS
+        from odigos_tpu.selftelemetry.fleet import (
+            referenced_metric, validate_alert_rules)
+
+        spec = importlib.util.spec_from_file_location(
+            "e2e_soak_lint2", os.path.join(REPO_ROOT, "tools",
+                                           "e2e_soak.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert validate_alert_rules(mod.ACTUATE_ALERTS) == []
+        registry = TestFleetRuleHygiene._registered_metric_names()
+        lint = TestFleetRuleHygiene()
+        for rule in mod.ACTUATE_RULES:
+            metric = referenced_metric(rule["expr"])
+            assert lint._resolves(metric, registry), \
+                f"actuate rule {rule['name']}: {metric!r} unregistered"
+            assert rule["knob"] in KNOB_SPECS
+
+
 class TestChaosInjectorHygiene:
     """Chaos injector lint (ISSUE 13 satellite): every ``inject_*`` in
     ``e2e/chaos.py`` must have a paired ``clear_*`` (a fault someone

@@ -112,6 +112,29 @@ CHAOS_ALERTS = [
      "for_s": 0.0, "severity": "warning"},
 ]
 
+# --actuate (ISSUE 15): the alert the injected overload must fire (the
+# alert->proposal->canary->promotion timeline's first event) and the
+# soak-timescale recommender rule the actuator consumes. Module-level
+# so the package-hygiene lint resolves the metrics and the knob.
+ACTUATE_ALERTS = [
+    {"name": "deadline-expiry-storm",
+     "expr": "rate(odigos_latency_deadline_expired_spans_total[5s])"
+             " > 200",
+     "for_s": 1.0, "severity": "warning"},
+]
+ACTUATE_RULES = [
+    # the production table's deadline-expiry-storm rule at soak
+    # timescale: a [5s] window (the judgment window must exceed it for
+    # the breach-clear oracle to be observable) and a short hold
+    {"name": "deadline-expiry-storm",
+     "expr": "rate(odigos_latency_deadline_expired_spans_total[5s])"
+             " > 200",
+     "knob": "admission_deadline", "direction": "up", "for_s": 1.5,
+     "severity": "warning",
+     "action": "deadline expiries at {value:.0f} spans/s — raise "
+               "fast_path.deadline_ms"},
+]
+
 
 def run_soak(args, fast_path: bool) -> dict:
     if args.mesh:
@@ -144,6 +167,26 @@ def run_soak(args, fast_path: bool) -> dict:
     # The span-denominated bounds stay as memory backstops (bufferbloat
     # is the old soak's 1.16 s p99 pathology — a 64-deep engine queue
     # of 8k-span batches).
+    if args.actuate:
+        # actuator soak (ISSUE 15): start with a deliberately tight
+        # admission deadline (sized for the BASELINE pace) and turn
+        # predictive shed off — the injected overload must produce
+        # in-pipeline expiries (unscored forwards = a scored_fraction
+        # SLO burn the actuator's resize must cure), not pre-featurize
+        # rejections the SLO never sees
+        args.deadline_ms = args.actuate_deadline_ms
+        args.no_predictive = True
+        # the backlog gate must not shed the overload before it can
+        # expire (the expiry IS the breach signal under actuation)
+        args.backlog_ms = max(args.backlog_ms, 6 * args.deadline_ms)
+        # and the pending window must HOLD the big-frame overload: a
+        # window of ~5 oversized frames would saturate into queue_full
+        # storms and make the window — not the deadline — the binding
+        # constraint (the canary would honestly roll back on the
+        # QueueSaturation its own overload caused)
+        args.max_pending_spans = max(
+            args.max_pending_spans,
+            args.overload_size_mult * 64 * 1024)
     if fast_path:
         # completion-driven multi-lane retirement (ISSUE 9): N lanes
         # overlap tag/forward of independent frames; unordered by
@@ -166,9 +209,22 @@ def run_soak(args, fast_path: bool) -> dict:
         # were judged against informally.
         pipeline_in["slo"] = {
             "latency_p99_ms": args.slo_p99_ms,
-            "scored_fraction": 0.5,
-            "fast_window_s": max(args.seconds / 4, 2.0),
-            "slow_window_s": max(args.seconds, 8.0)}
+            # the actuate soak's SLO objective is the scored fraction
+            # the expiry storm burns (and the resize must recover).
+            # 0.98, not a looser target: fast-burn pages at 14.4x, and
+            # a budget of 1-Y must be small enough that a mass-expiry
+            # storm can actually reach it (target 0.9 caps the burn at
+            # 10x — mathematically un-pageable)
+            "scored_fraction": 0.98 if args.actuate else 0.5,
+            "fast_window_s": max(args.seconds / 10, 2.0)
+            if args.actuate else max(args.seconds / 4, 2.0),
+            "slow_window_s": max(args.seconds, 8.0),
+            # actuate: page earlier than the 14.4x default — the whole
+            # point is that the actuator reacts within seconds, so the
+            # burn must cross the page line BEFORE the cure lands for
+            # the record to show the SLOBurn round trip
+            **({"fast_burn_threshold": 5.0,
+                "slow_burn_threshold": 0.5} if args.actuate else {})}
     # warm_ladder precompiles every scoring bucket at start: the
     # adaptive coalescer's variable batch sizes must never pay a
     # worker-stalling XLA compile mid-soak
@@ -246,7 +302,21 @@ def run_soak(args, fast_path: bool) -> dict:
         },
         "service": {
             "alerts": [dict(a) for a in SOAK_ALERTS]
-            + ([dict(a) for a in CHAOS_ALERTS] if args.chaos else []),
+            + ([dict(a) for a in CHAOS_ALERTS] if args.chaos else [])
+            + ([dict(a) for a in ACTUATE_ALERTS] if args.actuate
+               else []),
+            # closed-loop actuator (ISSUE 15), armed only for
+            # --actuate: judgment window > the rule's [5s] expr window
+            # (a rate cannot visibly clear inside its own window),
+            # soak-timescale cooldown, step bound sized so one
+            # promotion can lift the deadline clear of the overload's
+            # latency (the hard KNOB_SPECS bounds still clamp)
+            **({"actuator": {
+                "enabled": True, "dry_run": False,
+                "judgment_window_s": 6.0, "cooldown_s": 10.0,
+                "max_step": 6.0,
+                "knobs": ["admission_deadline"]}}
+               if args.actuate else {}),
             # GC isolation (ISSUE 12), BOTH arms (the A/B compares the
             # paths, not the GC posture): the paced janitor owns gen-0/1
             # sweeps, thresholds absorb per-frame churn, and freeze
@@ -298,6 +368,29 @@ def run_soak(args, fast_path: bool) -> dict:
             b, _, _ = inject_faults(b, fault_fraction=0.2, seed=100 + s)
         batches.append(b)
     batch_spans = [len(b) for b in batches]
+    # --actuate overload set: --overload-size-mult-sized frames whose
+    # per-frame service time (featurize/pack/score scale with span
+    # count) lands past the tight initial deadline BY CONSTRUCTION — a
+    # pure rate overload is a queueing knife edge that storms on one
+    # run and rides under the deadline on the next (box noise), which
+    # is exactly the flake a recorded acceptance cannot stand on
+    big_batches: list = []
+    big_spans: list = []
+    if args.actuate:
+        for s in range(8):
+            b = synthesize_traces(
+                args.traces_per_batch * args.overload_size_mult,
+                seed=50 + s)
+            if s % 4 == 0:
+                b, _, _ = inject_faults(b, fault_fraction=0.2,
+                                        seed=150 + s)
+            big_batches.append(b)
+        big_spans = [len(b) for b in big_batches]
+    # which batch set the senders draw from (the overload flips it):
+    # ONE tuple swapped/read atomically — assigning batches and spans
+    # as two separate keys would let a sender pair a baseline batch
+    # with a 16x span count mid-swap and mis-state conservation
+    active_set = {"cur": (batches, batch_spans)}
 
     sent_spans = [0] * args.senders
     sent_batches = [0] * args.senders
@@ -314,10 +407,12 @@ def run_soak(args, fast_path: bool) -> dict:
     # buffers), not the paths themselves. Paced below the knee, both
     # arms carry the identical offered load losslessly and the probe
     # measures pure path transit.
-    pace_interval_s = 0.0
+    # mutable so the --actuate overload can retune the offered load
+    # MID-WINDOW (senders read it every iteration)
+    pace = {"interval_s": 0.0}
     if args.pace_spans_per_sec:
         mean_batch = sum(batch_spans) / len(batch_spans)
-        pace_interval_s = mean_batch * args.senders \
+        pace["interval_s"] = mean_batch * args.senders \
             / args.pace_spans_per_sec
 
     def sender(i: int) -> None:
@@ -334,36 +429,50 @@ def run_soak(args, fast_path: bool) -> dict:
         exp.start()
         k = i
         next_t = time.monotonic()
+        last_iv = pace["interval_s"]
+        # exact span counts of the most recent enqueues: the overload
+        # swaps batch sets mid-run, so the flush-failure residual walk
+        # must remember what was ACTUALLY queued, not re-derive it from
+        # one set's sizes (queue_size 64 bounds how far back matters)
+        recent_spans: list = []
         while not stop.is_set():
-            exp.export(batches[k % len(batches)])
-            sent_spans[i] += batch_spans[k % len(batches)]
+            bset, bsp = active_set["cur"]  # one atomic reference read
+            exp.export(bset[k % len(bset)])
+            sent_spans[i] += bsp[k % len(bset)]
+            recent_spans.append(bsp[k % len(bset)])
+            if len(recent_spans) > 160:
+                del recent_spans[:-80]  # keep > queue_size entries
             sent_batches[i] += 1
             k += args.senders
             # bounded in-flight: wait for the queue to drain enough that
             # "sent" means accepted-by-socket, not buffered locally
             while exp.queued > 32 and not stop.is_set():
                 time.sleep(0.001)
-            if pace_interval_s:
+            iv = pace["interval_s"]
+            if iv:
+                if iv != last_iv:
+                    # the --actuate overload retuned the pace: re-anchor
+                    # the absolute schedule so the new rate starts NOW
+                    # instead of bursting to catch up on the old one
+                    next_t = time.monotonic()
+                    last_iv = iv
                 # absolute-schedule pacing (no drift): a late export
                 # shortens the next sleep instead of stretching the
                 # whole schedule
-                next_t += pace_interval_s
+                next_t += iv
                 delay = next_t - time.monotonic()
                 if delay > 0:
                     stop.wait(delay)
         ok = exp.flush(timeout=60.0)
         if not ok:
-            # the residual queue holds the most recently enqueued batches
-            # (FIFO drains from the front); this sender enqueued indices
-            # i, i+senders, i+2*senders, ... so walk back from the last
-            # one (k - senders) to count the exact spans still queued —
-            # batches differ in span count per seed, so multiplying by
-            # batch_spans[0] would mis-state conservation precisely in
-            # the failure case this check exists to catch
+            # the residual queue holds the most recently enqueued
+            # batches (FIFO drains from the front): sum the EXACT span
+            # counts this sender recorded at enqueue time — batches
+            # differ in span count per seed (and per overload set), so
+            # any size re-derivation would mis-state conservation
+            # precisely in the failure case this check exists to catch
             q = exp.queued
-            dropped_spans[i] = sum(
-                batch_spans[(k - args.senders * (j + 1)) % len(batches)]
-                for j in range(q))
+            dropped_spans[i] = sum(recent_spans[-q:]) if q else 0
         exp.shutdown()
 
     # ---- latency probe: wrap the terminal exporters to stamp arrival
@@ -431,6 +540,53 @@ def run_soak(args, fast_path: bool) -> dict:
             stop.wait(0.1)
         exp.flush(timeout=30.0)
         exp.shutdown()
+
+    # ---- actuator soak (ISSUE 15): arm the closed loop and inject a
+    # mid-window OVERLOAD (offered load multiplied) that drives frames
+    # past the tight admission deadline — expiries burn the
+    # scored_fraction SLO and fire the expiry alert; the actuator's
+    # held recommendation canaries a bounded deadline raise through the
+    # incremental reload path, judges it, promotes it, and the burn
+    # recovers with zero operator input. Every phase is timestamped
+    # into ACTUATOR.json.
+    actuate_events: list = []
+    slo_timeline: list = []
+
+    def _actuate_mark(event: str, **extra) -> None:
+        actuate_events.append({"event": event,
+                               "t_s": round(time.perf_counter() - t0,
+                                            3), **extra})
+
+    if args.actuate:
+        from odigos_tpu.controlplane.actuator import fleet_actuator
+        from odigos_tpu.selftelemetry.fleet import RecommendationRule
+
+        fleet_actuator.register("soak-gateway", collector)
+        fleet_plane.recommender.set_rules(tuple(
+            RecommendationRule(**r) for r in ACTUATE_RULES))
+
+    def overload_schedule() -> None:
+        at = args.overload_at * args.seconds
+        delay = at - (time.perf_counter() - t0)
+        if delay > 0 and stop.wait(delay):
+            return
+        # the overload is STRUCTURAL, not just a rate step: bigger
+        # frames (per-frame featurize/pack/score wall scales with span
+        # count, landing past the tight deadline by construction) at
+        # --overload-factor times the frame rate — a pure rate step
+        # sits on a queueing knife edge and storms only on a noisy run
+        size_mult = (sum(big_spans) / len(big_spans)) \
+            / (sum(batch_spans) / len(batch_spans))
+        active_set["cur"] = (big_batches, big_spans)
+        # --overload-factor multiplies the FRAME rate; offered spans/s
+        # rise by factor x the frame-size multiplier
+        pace["interval_s"] = pace["interval_s"] / args.overload_factor
+        _actuate_mark("overload_injected",
+                      offered_spans_per_sec=round(
+                          args.pace_spans_per_sec
+                          * args.overload_factor * size_mult))
+        # sustained to the end of the window: recovery must come from
+        # the actuation, never from the overload politely leaving
 
     # ---- chaos schedule (ISSUE 13): faults injected MID-WINDOW on the
     # live pipeline — device loss at 20% (failover trips to the CPU
@@ -567,6 +723,11 @@ def run_soak(args, fast_path: bool) -> dict:
         storm_thread = threading.Thread(target=reload_storm,
                                         daemon=True)
         storm_thread.start()
+    overload_thread = None
+    if args.actuate:
+        overload_thread = threading.Thread(target=overload_schedule,
+                                           daemon=True)
+        overload_thread.start()
     # fleet publish/evaluate cadence (ISSUE 10): the soak's main wait
     # doubles as the plane timer — each tick delta-publishes the
     # collector's snapshot + rollup under {collector=} and advances the
@@ -576,7 +737,21 @@ def run_soak(args, fast_path: bool) -> dict:
     while time.monotonic() < t_end:
         fleet_plane.publish_collector(collector, "soak-gateway",
                                       group="soak")
-        fleet_plane.tick()
+        fleet_plane.tick()  # advances alerts AND the armed actuator
+        if args.actuate:
+            # the SLO-burn timeline: the record must show the burn
+            # rising under the overload and recovering after the
+            # promotion, sampled live — not re-derived post hoc
+            slo = latency_ledger.slo_status().get("traces/in") or {}
+            slo_timeline.append({
+                "t_s": round(time.perf_counter() - t0, 3),
+                "burning": bool(slo.get("burning")),
+                "fast_burn": (slo.get("fast") or {}).get("burn"),
+                "deadline_ms": collector.config["service"][
+                    "pipelines"]["traces/in"]["fast_path"][
+                    "deadline_ms"],
+                "actuator_state": fleet_actuator.state,
+            })
         time.sleep(min(0.5, max(t_end - time.monotonic(), 0.0)))
     stop.set()
     for t in threads:
@@ -584,6 +759,8 @@ def run_soak(args, fast_path: bool) -> dict:
     probe_thread.join(timeout=60)
     if storm_thread is not None:
         storm_thread.join(timeout=60)
+    if overload_thread is not None:
+        overload_thread.join(timeout=10)
     if chaos_thread is not None:
         chaos_thread.join(timeout=10)
         # belt and braces: the schedule clears its own faults, but a
@@ -735,6 +912,61 @@ def run_soak(args, fast_path: bool) -> dict:
             "zero_unexplained_loss": bool(conserved),
         }
 
+    # actuator evidence (ISSUE 15), read BEFORE shutdown: the full
+    # alert->proposal->canary->promotion timeline with per-step reload
+    # modes, the SLO-burn recovery trace, and the acceptance verdicts
+    actuator_summary = None
+    if args.actuate:
+        from odigos_tpu.selftelemetry.fleet import alert_engine
+
+        act_snap = fleet_actuator.api_snapshot()
+        wall_anchor = time.time() - (time.perf_counter() - t0)
+        timeline = list(actuate_events)
+        for ev in alert_engine.transitions():
+            timeline.append({
+                "event": f"alert_{ev['event']}", "rule": ev["rule"],
+                "t_s": round(ev["unix_ts"] - wall_anchor, 3)})
+        for h in act_snap["history"]:
+            ts = h.get("ts") or {}
+            for phase in ("proposed", "canary", "judged", "finished"):
+                if phase in ts:
+                    timeline.append({
+                        "event": (h["outcome"] if phase == "finished"
+                                  else phase),
+                        "rule": h["rule"], "knob": h["knob"],
+                        "t_s": round(ts[phase] - wall_anchor, 3)})
+        timeline.sort(key=lambda e: e["t_s"])
+        promoted = [h for h in act_snap["history"]
+                    if h["outcome"] == "promoted"]
+        reload_modes = [h.get("reload_mode") for h in promoted] + [
+            s.get("reload_mode") for h in promoted
+            for s in h.get("steps") or []
+            if s.get("reload_mode") is not None]
+        burned = any(s["burning"] for s in slo_timeline)
+        final_burning = (slo_timeline[-1]["burning"]
+                         if slo_timeline else None)
+        actuator_summary = {
+            "config": act_snap["config"],
+            "timeline": timeline,
+            "history": act_snap["history"],
+            "slo_timeline": slo_timeline,
+            "deadline_ms_final": collector.config["service"][
+                "pipelines"]["traces/in"]["fast_path"]["deadline_ms"],
+            "reload_modes": reload_modes,
+            # the acceptance verdicts (main() gates the exit code)
+            "promoted": len(promoted),
+            "rollbacks": len([h for h in act_snap["history"]
+                              if "rolled_back" in h["outcome"]]),
+            "refusals": len([h for h in act_snap["history"]
+                             if h["outcome"] == "refused"]),
+            "all_reloads_incremental": bool(reload_modes) and all(
+                m == "incremental" for m in reload_modes),
+            "slo_burned_under_overload": burned,
+            "slo_recovered": bool(burned and final_burning is False),
+        }
+        fleet_actuator.unregister("soak-gateway")
+        fleet_plane.recommender.set_rules(None)
+
     fleet_snap = fleet_plane.api_snapshot()
     fleet_summary = {
         "collectors": [
@@ -849,6 +1081,11 @@ def run_soak(args, fast_path: bool) -> dict:
         } if args.reload_storm else None),
         # chaos fault timeline + degradation evidence (ISSUE 13)
         "chaos": chaos_summary,
+        # closed-loop actuation evidence (ISSUE 15): the overload ->
+        # alert -> proposal -> canary -> promotion timeline, per-step
+        # reload modes (must ALL be incremental), and the SLO burn's
+        # rise-and-recovery trace
+        "actuator": actuator_summary,
         "latency_note": ("probe batches ride the same wire/pipeline as "
                          "the load; p* = send-to-export wall time under "
                          f"full multi-sender soak load, CPU {args.model} "
@@ -968,6 +1205,47 @@ def main() -> None:
                          "counts, changed-node fingerprints and "
                          "engine recompile count into SOAK.json's "
                          "reload_storm section")
+    ap.add_argument("--actuate", action="store_true",
+                    help="arm the closed-loop actuator (ISSUE 15) and "
+                         "inject a mid-window OVERLOAD (offered load x "
+                         "--overload-factor at --overload-at of the "
+                         "window, sustained to the end): the tight "
+                         "--actuate-deadline-ms expires frames, the "
+                         "scored_fraction SLO burns and the expiry "
+                         "alert fires, the actuator canaries a bounded "
+                         "fast_path.deadline_ms raise through the "
+                         "INCREMENTAL reload path, judges and promotes "
+                         "it, and the burn recovers with zero operator "
+                         "input; records ACTUATOR.json (timeline, "
+                         "per-step reload mode, SLO recovery, "
+                         "conservation) — non-zero exit if no "
+                         "promotion, any non-incremental reload, or "
+                         "no SLO recovery. Requires "
+                         "--pace-spans-per-sec (the overload is a "
+                         "paced-load step)")
+    ap.add_argument("--actuate-deadline-ms", type=float, default=25.0,
+                    help="initial fast_path admission deadline for "
+                         "--actuate: sized to the BASELINE pace, "
+                         "under-sized for the overload")
+    ap.add_argument("--overload-at", type=float, default=0.35,
+                    help="fraction of the window at which --actuate "
+                         "multiplies the offered load")
+    ap.add_argument("--overload-factor", type=float, default=1.25,
+                    help="FRAME-rate multiplier for the --actuate "
+                         "overload (sustained to the end of the run); "
+                         "the overload also switches to "
+                         "--overload-size-mult-sized frames, so "
+                         "offered spans/s rise ~size_mult x this. "
+                         "Size baseline x size_mult x factor BELOW "
+                         "the box's knee: the knob, not capacity, "
+                         "must be the thing the actuator fixes")
+    ap.add_argument("--overload-size-mult", type=int, default=16,
+                    help="frame-size multiplier for the --actuate "
+                         "overload: per-frame service time scales "
+                         "with span count, so frames this much bigger "
+                         "overrun the initial deadline by "
+                         "construction (and still clear the promoted "
+                         "one)")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="seed for the chaos run's randomized draws "
                          "(retry jitter) — same seed, same schedule")
@@ -979,6 +1257,16 @@ def main() -> None:
                          "(simulated host devices without a TPU); "
                          "requires --model transformer")
     args = ap.parse_args()
+    if args.actuate and not args.pace_spans_per_sec:
+        # the overload is a step in OFFERED load; a closed-loop
+        # saturating sender has no baseline to step from
+        ap.error("--actuate requires --pace-spans-per-sec")
+    if args.actuate and args.no_fast_path:
+        ap.error("--actuate tunes the fast path's admission deadline")
+    if args.actuate and args.ab:
+        # the componentwise arm has no fast path for the armed
+        # actuator to tune — it would spend the run refusing no_site
+        ap.error("--actuate and --ab are mutually exclusive")
     if args.mesh and args.model != "transformer":
         # zscore serves single-device and would silently ignore the
         # mesh — a SOAK.json claiming a mesh that never ran is worse
@@ -1068,7 +1356,8 @@ def main() -> None:
     # --reload-storm records its own artifact (the CHAOS.json
     # precedent) so the standing knee/A-B SOAK.json record survives
     record = "CHAOS.json" if args.chaos else (
-        "RELOAD.json" if args.reload_storm else "SOAK.json")
+        "RELOAD.json" if args.reload_storm else (
+            "ACTUATOR.json" if args.actuate else "SOAK.json"))
     with open(os.path.join(REPO, record), "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
@@ -1079,6 +1368,23 @@ def main() -> None:
     if args.chaos and not result["chaos"]["zero_unexplained_loss"]:
         print("CHAOS: unexplained loss", file=sys.stderr)
         sys.exit(1)
+    if args.actuate:
+        act = result["actuator"]
+        ok = (act["promoted"] >= 1
+              and act["all_reloads_incremental"]
+              and act["slo_burned_under_overload"]
+              and act["slo_recovered"])
+        if not ok:
+            # the acceptance verdict: the overload burned the SLO, the
+            # actuator promoted a resize, EVERY applied reload stayed
+            # on the incremental path, and the burn recovered — all
+            # with zero operator input
+            print(f"ACTUATOR: loop incomplete — promoted="
+                  f"{act['promoted']} incremental="
+                  f"{act['all_reloads_incremental']} burned="
+                  f"{act['slo_burned_under_overload']} recovered="
+                  f"{act['slo_recovered']}", file=sys.stderr)
+            sys.exit(1)
     if args.reload_storm and not (
             result["reload_storm"]["count"] == args.reload_storm
             and result["reload_storm"]["all_incremental"]
