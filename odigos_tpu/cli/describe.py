@@ -263,6 +263,25 @@ def describe_install(state: CliState) -> str:
             lines.append(f"    [{h['outcome']}] {h['rule']} "
                          f"knob={h['knob']}"
                          + (f" — {detail}" if detail else ""))
+    # flight recorder (ISSUE 16): black-box counters and the frozen
+    # incident store — silent when nothing was ever recorded
+    from ..selftelemetry.flightrecorder import flight_recorder
+
+    fr = flight_recorder.api_snapshot()
+    if fr["events_total"] or fr["incidents"]:
+        lines.append(
+            f"  flight recorder: "
+            f"{'on' if fr['enabled'] else 'off'}, "
+            f"{fr['events_total']} event(s) recorded, "
+            f"{len(fr['incidents'])} incident(s) frozen"
+            + (f", {fr['suppressed']} suppressed (cooldown)"
+               if fr["suppressed"] else ""))
+        for it in fr["incidents"][:5]:
+            state_mark = "sealed" if it["sealed"] else "open"
+            lines.append(
+                f"    [{it['id']}] {it['trigger']}"
+                + (f" rule={it['rule']}" if it.get("rule") else "")
+                + f" ({state_mark}): {it['detail']}")
     ics = state.store.list("InstrumentationConfig")
     lines.append(f"  instrumented workloads: {len(ics)}")
     for ic in ics:

@@ -148,6 +148,17 @@ def collect_bundle(state: CliState, out_path: Optional[str] = None,
 
         add("actuator.json", json.dumps(
             fleet_actuator.api_snapshot(), indent=1, sort_keys=True))
+        # flight recorder (ISSUE 16): the frozen incident bundles — the
+        # black box an operator opens first after a page. Full bundles
+        # (event timeline, series excerpt, worst-frame exemplars, config
+        # hash, conditions), not summaries: a diagnose archive must
+        # stand alone offline.
+        from ..selftelemetry.flightrecorder import flight_recorder
+
+        add("incidents.json", json.dumps({
+            "snapshot": flight_recorder.api_snapshot(),
+            "incidents": flight_recorder.incidents(),
+        }, indent=1, sort_keys=True))
         # device-runtime snapshot, taken fresh at bundle time: engine
         # gauges + (when jax is loaded) live arrays, device memory, and
         # per-jit-site cache/compile accounting. Read-only: a one-shot

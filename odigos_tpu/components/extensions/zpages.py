@@ -36,6 +36,13 @@ serves JSON (terminal-first operators curl it):
                            (proposals, canaries, promotions,
                            rollbacks, refusals), and the knob/refusal
                            table
+* ``/debug/incidentz``   — the flight recorder (ISSUE 16): incident
+                           store summaries, the recent black-box event
+                           timeline, and the trigger registry;
+                           ``?id=<incident>`` pivots to that incident's
+                           full frozen bundle (event lookback + tail,
+                           series excerpt, worst-frame trace
+                           exemplars, config hash, conditions)
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
 """
@@ -153,6 +160,18 @@ class ZPagesExtension(HttpExtension):
 
         return 200, fleet_actuator.api_snapshot()
 
+    def _incidentz(self, q: dict[str, str]) -> tuple[int, dict]:
+        from ...selftelemetry.flightrecorder import flight_recorder
+
+        if "id" in q:  # pivot: one incident's full frozen bundle
+            bundle = flight_recorder.incident(q["id"])
+            if bundle is None:
+                return 404, {"error": f"no incident {q['id']!r}"}
+            return 200, bundle
+        out = flight_recorder.api_snapshot()
+        out["recent_events"] = flight_recorder.recent_events()
+        return 200, out
+
     def pages(self) -> dict[str, Page]:
         return {"/debug/pipelinez": self._pipelinez,
                 "/debug/servicez": self._servicez,
@@ -161,7 +180,8 @@ class ZPagesExtension(HttpExtension):
                 "/debug/flowz": self._flowz,
                 "/debug/latencyz": self._latencyz,
                 "/debug/fleetz": self._fleetz,
-                "/debug/actuatorz": self._actuatorz}
+                "/debug/actuatorz": self._actuatorz,
+                "/debug/incidentz": self._incidentz}
 
 
 register(Factory(

@@ -64,6 +64,7 @@ from collections import deque
 from typing import Any, Callable, Optional
 
 from ..config.sizing import KNOB_SPECS, bounded_step, knob_sites
+from ..selftelemetry.flightrecorder import flight_recorder
 from ..utils.telemetry import labeled_key, meter
 
 ACTUATOR_ENV = "ODIGOS_ACTUATOR"
@@ -306,6 +307,12 @@ class FleetActuator:
             "severity": "warning", "observed": None, "threshold": None,
             "collector": target or "", "forced": True, "value": value,
         })
+        # the force() seam IS a chaos injection: record it as one so
+        # the black box explains the rollback it is about to cause
+        flight_recorder.trigger(
+            "chaos_injection", fault="forced_proposal",
+            detail=f"forced {direction} proposal on {knob} "
+                   f"(rule {rule})", rule=rule)
 
     # ------------------------------------------------------------ tick
 
@@ -406,6 +413,9 @@ class FleetActuator:
             self._noted.add(key)
         meter.add(labeled_key(REFUSALS_METRIC, rule=key[0],
                               knob=rec["knob"], reason=reason))
+        flight_recorder.record("actuator", event="refused",
+                               rule=key[0], knob=rec["knob"],
+                               reason=reason)
         self._record({
             "rule": key[0], "knob": rec["knob"], "outcome": "refused",
             "reason": reason, "message": message,
@@ -504,6 +514,10 @@ class FleetActuator:
             self._proposed.add(key)
             meter.add(labeled_key(PROPOSALS_METRIC, rule=p["rule"],
                                   knob=p["knob"]))
+            flight_recorder.record("actuator", event="proposed",
+                                   rule=p["rule"], knob=p["knob"],
+                                   direction=p.get("direction"),
+                                   target=p.get("target"))
         if self.config.dry_run:
             # dry_run wins over EVERYTHING, forced proposals included:
             # an operator who armed look-don't-touch must get exactly
@@ -567,6 +581,10 @@ class FleetActuator:
         self.current = record
         meter.add(labeled_key(CANARIES_METRIC, rule=p["rule"],
                               knob=p["knob"]))
+        flight_recorder.record("actuator", event="canary",
+                               rule=p["rule"], knob=p["knob"],
+                               target=p.get("target"),
+                               mode=record.get("reload_mode"))
         self._set_state("canary")
 
     def _judgment_window(self, expr: Optional[str]) -> float:
@@ -894,6 +912,12 @@ class FleetActuator:
         cur["rollback_reason"] = reason
         meter.add(labeled_key(ROLLBACKS_METRIC, rule=cur["rule"],
                               knob=cur["knob"]))
+        flight_recorder.trigger(
+            "actuator_rollback",
+            detail=f"canary {cur['knob']} on "
+                   f"{cur.get('target', '')} rolled back: {reason}",
+            rule=cur["rule"], expr=cur.get("expr"),
+            knob=cur["knob"], reason=reason)
         self._finish("rolled_back", now)
 
     def _rollback_step(self, step: dict, reason: str,
@@ -909,6 +933,12 @@ class FleetActuator:
         meter.add(labeled_key(ROLLBACKS_METRIC,
                               rule=self.current["rule"],
                               knob=self.current["knob"]))
+        flight_recorder.trigger(
+            "actuator_rollback",
+            detail=f"promotion step {step['collector']} rolled back: "
+                   f"{reason}",
+            rule=self.current["rule"], expr=self.current.get("expr"),
+            knob=self.current["knob"], reason=reason)
         self.current["rollback_reason"] = f"step {step['collector']}: " \
                                           f"{reason}"
         self._finish("rolled_back_step", now)
@@ -932,6 +962,9 @@ class FleetActuator:
         if outcome == "promoted":
             meter.add(labeled_key(PROMOTIONS_METRIC, rule=cur["rule"],
                                   knob=cur["knob"]))
+        flight_recorder.record("actuator", event=outcome,
+                               rule=cur["rule"], knob=cur["knob"],
+                               reason=cur.get("rollback_reason"))
         self._record(cur)
         self.current = None
         self._cooldown_until = now + self.config.cooldown_s

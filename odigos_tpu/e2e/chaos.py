@@ -36,6 +36,17 @@ from .environment import E2EEnvironment
 _RESTORE_ATTR = "_chaos_restore"
 
 
+def _flight(fault: str, detail: str) -> None:
+    """Every successful injection freezes exactly one incident naming
+    its fault — the chaos matrix's fifth oracle reads these back (and
+    the recorder's per-(trigger, fault) cooldown keeps a re-injection
+    inside one scenario from minting a second)."""
+    from ..selftelemetry.flightrecorder import flight_recorder
+
+    flight_recorder.trigger("chaos_injection", detail=detail,
+                            fault=fault)
+
+
 def _restore_map(env: E2EEnvironment) -> dict:
     m = getattr(env, _RESTORE_ATTR, None)
     if m is None:
@@ -86,6 +97,11 @@ def inject_exporter_chaos(env: E2EEnvironment, exporter_id: str, *,
         exp.config["reject_fraction"] = float(reject_fraction)
     if response_duration_ms is not None:
         exp.config["response_duration_ms"] = float(response_duration_ms)
+    if reject_fraction or response_duration_ms:
+        # zero-valued knobs are the clear_* spelling, not a fault
+        _flight("exporter_chaos",
+                f"{exporter_id}: reject={reject_fraction} "
+                f"latency={response_duration_ms}ms")
 
 
 def clear_exporter_chaos(env: E2EEnvironment, exporter_id: str) -> None:
@@ -116,6 +132,8 @@ def inject_destination_outage(env: E2EEnvironment,
 
     restore[key] = (target, target.__dict__.get("export"))
     target.export = dead_export
+    _flight("destination_outage",
+            f"{exporter_id}: every export raises until cleared")
 
 
 def clear_destination_outage(env: E2EEnvironment,
@@ -152,6 +170,10 @@ def inject_memory_pressure(env: E2EEnvironment, on: bool = True) -> None:
         raise RuntimeError("gateway has no wire otlp receiver")
     for recv in receivers:
         recv.admission.pressure_fn = (lambda: True) if on else None
+    if on:
+        _flight("memory_pressure",
+                f"{len(receivers)} wire receiver(s) rejecting "
+                f"pre-decode")
 
 
 def clear_memory_pressure(env: E2EEnvironment) -> None:
@@ -174,6 +196,7 @@ def inject_device_fault(env: E2EEnvironment,
                            "stage not enabled?)")
     for eng in engines:
         eng.inject_device_fault(message)
+    _flight("device_fault", message)
 
 
 def clear_device_fault(env: E2EEnvironment) -> None:
@@ -218,6 +241,7 @@ def inject_clock_skew(env: E2EEnvironment,
         restore[key] = (recv, recv.next_consumer)
         recv.next_consumer = _SkewConsumer(recv.next_consumer,
                                            int(offset_s * 1e9))
+    _flight("clock_skew", f"producer clocks shifted {offset_s:+.0f}s")
 
 
 def clear_clock_skew(env: E2EEnvironment) -> None:
@@ -258,6 +282,8 @@ def inject_malformed_frame_storm(env: E2EEnvironment,
                 answered += 1
             else:  # server closed / unexpected: stop, scenario asserts
                 break
+    _flight("malformed_frame_storm",
+            f"{frames} junk frames sent, {answered} MALFORMED answers")
     return answered
 
 
@@ -297,6 +323,8 @@ def inject_reconnect_stampede(env: E2EEnvironment, clients: int = 12,
             t.start()
         for t in threads:
             t.join(timeout=5.0)
+    _flight("reconnect_stampede",
+            f"{clients} half-frame clients x {rounds} rounds")
 
 
 def clear_reconnect_stampede(env: E2EEnvironment) -> None:
@@ -320,6 +348,7 @@ def inject_hot_reload(env: E2EEnvironment) -> None:
     env.add_destination(Destination(
         id=_RELOAD_DEST_ID, dest_type="tracedb",
         signals=[Signal.TRACES]))
+    _flight("hot_reload", "throwaway destination added under load")
 
 
 def clear_hot_reload(env: E2EEnvironment) -> None:

@@ -400,6 +400,22 @@ class _Handler(BaseHTTPRequestHandler):
                 from ..controlplane.actuator import fleet_actuator
 
                 return self._json(fleet_actuator.api_snapshot())
+            if path == "/api/incidents":
+                # the incident flight recorder (ISSUE 16): black-box
+                # health + frozen incident summaries; ?id=<incident>
+                # pivots to one full bundle (timeline, series excerpt,
+                # worst-frame exemplars, config hash, conditions)
+                from ..selftelemetry.flightrecorder import \
+                    flight_recorder
+
+                if q.get("id"):
+                    bundle = flight_recorder.incident(q["id"])
+                    if bundle is None:
+                        return self._json(
+                            {"error": f"no incident {q['id']!r}"},
+                            status=404)
+                    return self._json(bundle)
+                return self._json(flight_recorder.api_snapshot())
             if path == "/api/slo":
                 # latency attribution & SLO burn (ISSUE 8): per-pipeline
                 # burn-rate status over the declared objectives, the

@@ -10,12 +10,15 @@ while the old one drains.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import threading
 import time
 from typing import Any, Optional
 
 import odigos_tpu.components  # noqa: F401  (registers builtin factories)
 
+from ..selftelemetry.flightrecorder import flight_recorder
 from ..selftelemetry.flow import register_rollup, unregister_rollup
 from ..selftelemetry.profiler import start_from_config, stop_started
 from ..serving.gcisolation import gc_plane
@@ -32,12 +35,21 @@ RELOAD_NODES_METRIC = "odigos_collector_reload_nodes_total"
 RELOAD_FAILURES_METRIC = "odigos_collector_reload_failures_total"
 
 
+def config_hash(config: dict[str, Any]) -> str:
+    """Stable short hash of a pipeline config (the OpAMP remote-config
+    hash discipline) — incident bundles pin 'which config was live'."""
+    return hashlib.sha256(
+        json.dumps(config, sort_keys=True,
+                   default=str).encode()).hexdigest()[:16]
+
+
 class Collector:
     def __init__(self, config: dict[str, Any], registry=None):
         self._registry = registry
         self._lock = threading.Lock()
         self.config = config
         self.graph: Graph = build_graph(config, registry)
+        flight_recorder.note_config(config_hash(config))
         self._running = False
         # which process-global telemetry subsystems (continuous profiler,
         # device-runtime collector) THIS collector's config started — only
@@ -218,6 +230,8 @@ class Collector:
                 meter.add(labeled_key(RELOAD_NODES_METRIC,
                                       action=action), n)
         meter.add("odigos_collector_reloads_total")
+        flight_recorder.note_reload(mode,
+                                    config_hash=config_hash(new_config))
 
     def _reload_dispatch(
             self, new_config: dict[str, Any]
@@ -268,6 +282,11 @@ class Collector:
                     self._graph_dirty = True
                     meter.add(
                         "odigos_collector_reload_patch_fallbacks_total")
+                    flight_recorder.trigger(
+                        "patch_fallback",
+                        detail="incremental patch raised mid-apply; "
+                               "graph marked dirty, falling back to "
+                               "full rebuild")
         self._reload_full(new_config, self.config)
         return "full", None
 

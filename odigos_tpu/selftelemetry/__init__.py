@@ -28,6 +28,11 @@ from .fleet import (  # noqa: F401
     referenced_metric,
     validate_alert_rules,
 )
+from .flightrecorder import (  # noqa: F401
+    FlightRecorder,
+    TRIGGERS,
+    flight_recorder,
+)
 from .instrument import TracedEntry, trace_pipeline_entry  # noqa: F401
 from .latency import (  # noqa: F401
     ENGINE_STAGES,

@@ -55,6 +55,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ..utils.telemetry import labeled_key, meter
+from .flightrecorder import flight_recorder
 from .seriesstate import COUNTER, GAUGE, series_store, split_key, with_label
 
 HEALTH_STATUS_METRIC = "odigos_collector_health_status"
@@ -317,6 +318,22 @@ class AlertEngine:
             meter.add(labeled_key("odigos_fleet_alert_transitions_total",
                                   rule=event["rule"],
                                   event=event["event"]))
+            flight_recorder.record(
+                "alert", event=event["event"], rule=event["rule"],
+                severity=event["severity"], value=event["value"],
+                series=event["series"])
+            if event["event"] == "fired":
+                flight_recorder.trigger(
+                    "alert_firing",
+                    detail=f"{event['rule']} fired on "
+                           f"{event['series']} = {event['value']}",
+                    rule=event["rule"], severity=event["severity"])
+        for rule in rules:
+            # continuous capture of the series a HOT rule references
+            # (pending/firing): the pre-trigger ramp is in the black
+            # box even when the freeze comes from another trigger
+            if rule.state != "inactive":
+                flight_recorder.excerpt_tick(rule.name, rule.expr)
         out.sort(key=lambda r: r["name"])
         return out
 

@@ -1,0 +1,474 @@
+"""Incident flight recorder: the process-global black box.
+
+The planes built so far *detect* trouble (alert rules, the failover
+breaker, the conservation checker) and *react* to it (the actuator's
+canary/rollback loop, patch-fallback reloads) — but the evidence ages
+out: series leave the 240-slot ring, conditions round-trip back to
+Healthy, and a rolled-back canary survives only as a counter. This
+module is the black box that makes the last incident explainable after
+the fact, the way a flight recorder outlives the flight.
+
+Two layers:
+
+* **event ring** — a bounded deque of structured events, recorded
+  continuously by every plane that does something worth explaining:
+  alert transitions, breaker trips/recoveries, actuator proposals/
+  canaries/promotions/rollbacks/refusals, reload classifications and
+  patch fallbacks, coalesced drop bursts (carrying the dropping frame's
+  self-trace id), GC pauses over threshold, admission-watermark verdict
+  transitions, chaos injections, and periodic compressed excerpts of
+  the series alert rules reference. Recording is lock-light (one short
+  critical section per event) and always on; ``ODIGOS_FLIGHT=0`` turns
+  the whole recorder into a no-op.
+* **incident store** — when a :data:`TRIGGERS` source fires, the
+  recorder *freezes an incident*: the pre-trigger lookback of the event
+  ring, a post-trigger tail (sealed after a bounded count/window), the
+  triggering rule's series excerpt gathered at freeze time, the
+  worst-frame self-trace exemplars from the stage-latency recorder,
+  the active config hash + last reload classification, and the
+  conditions snapshot. Incidents are retained in a bounded ring with
+  evictions counted, and each (trigger, scope) pair is cooldown'd so a
+  flapping source cannot flood the store.
+
+The trigger registry is CLOSED — ``trigger()`` raises on an unknown
+name, and package hygiene lints every call site against
+:data:`TRIGGERS` (the DROP_REASONS / INJECTORS discipline).
+
+Everything upstream of :mod:`utils.telemetry` is imported lazily at
+freeze time: the recorder must be importable from any plane (fleet,
+actuator, failover, flow, fastpath, wire) without creating a cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..utils.telemetry import labeled_key, meter
+
+# ------------------------------------------------------------- metrics
+
+EVENTS_METRIC = "odigos_flightrecorder_events_total"
+EVENTS_EVICTED_METRIC = "odigos_flightrecorder_events_evicted_total"
+INCIDENTS_METRIC = "odigos_flightrecorder_incidents_total"
+SUPPRESSED_METRIC = "odigos_flightrecorder_suppressed_total"
+INCIDENTS_EVICTED_METRIC = \
+    "odigos_flightrecorder_incidents_evicted_total"
+
+# ------------------------------------------------------------- registry
+
+# The closed trigger registry: every source that can freeze an incident
+# must be named here, and every name here must have a live call site —
+# TestFlightTriggerHygiene lints both directions (the stale-entry
+# oracle). Values are the one-line operator description rendered on
+# /debug/incidentz and in the docs trigger table.
+TRIGGERS: dict[str, str] = {
+    "alert_firing": "an alert rule transitioned to firing",
+    "actuator_rollback": "a canary or promotion step rolled back",
+    "breaker_trip": "the failover breaker opened on a scoring model",
+    "conservation_leak": "flow conservation found a stable leak",
+    "patch_fallback": "an incremental reload fell back to a rebuild",
+    "chaos_injection": "a chaos injector faulted the system on purpose",
+}
+
+# ------------------------------------------------------------- sizing
+
+EVENT_RING = 2048          # black-box timeline depth
+LOOKBACK_EVENTS = 256      # pre-trigger slice copied into a bundle
+TAIL_EVENTS = 64           # post-trigger events before the tail seals
+TAIL_WINDOW_S = 15.0       # ... or this much wall time, whichever first
+MAX_INCIDENTS = 32         # incident store cap (evictions counted)
+TRIGGER_COOLDOWN_S = 30.0  # per (trigger, scope) refreeze suppression
+EXCERPT_SERIES = 8         # series per excerpt (cardinality guard)
+EXCERPT_POINTS = 32        # points per series after compression
+EXCERPT_INTERVAL_S = 5.0   # periodic excerpt cadence per rule
+WORST_FRAMES = 8           # trace exemplars joined into a bundle
+DROP_COALESCE_S = 0.25     # drop-burst events merge inside this window
+
+
+def _compress(pts: list[tuple[float, float]],
+              cap: int = EXCERPT_POINTS) -> list[list[float]]:
+    """Stride-downsample a point list to ``cap`` entries, always
+    keeping the newest point (the one an operator reads first)."""
+    if len(pts) > cap:
+        stride = len(pts) / float(cap)
+        pts = [pts[min(int(i * stride), len(pts) - 1)]
+               for i in range(cap - 1)] + [pts[-1]]
+    return [[round(float(t), 3), float(v)] for t, v in pts]
+
+
+class FlightRecorder:
+    """Process-global black box + incident store (singleton:
+    :data:`flight_recorder`)."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.reset()
+
+    # ------------------------------------------------------- lifecycle
+
+    def reset(self) -> None:
+        """Drop all state and re-sample the kill switch (the test
+        seam every plane singleton exposes)."""
+        with self._lock:
+            self.enabled = os.environ.get("ODIGOS_FLIGHT", "1") != "0"
+            self._events: deque[dict[str, Any]] = deque(
+                maxlen=EVENT_RING)
+            self._seq = 0
+            self._events_total = 0
+            self._events_evicted = 0
+            self._incidents: deque[dict[str, Any]] = deque()
+            self._incident_seq = 0
+            self._incidents_evicted = 0
+            self._open: list[dict[str, Any]] = []
+            self._last_trigger: dict[tuple[str, str], float] = {}
+            self._suppressed = 0
+            self._excerpt_at: dict[str, float] = {}
+            self._config: dict[str, Any] = {"hash": None,
+                                            "last_reload": None}
+
+    # ------------------------------------------------------ event ring
+
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one structured event to the black box. Lock-light:
+        one short critical section, one labeled counter bump."""
+        if not self.enabled:
+            return
+        evt: dict[str, Any] = {"kind": kind,
+                               "unix_ts": time.time()}
+        evt.update(fields)
+        now = self._clock()
+        with self._lock:
+            self._seq += 1
+            evt["seq"] = self._seq
+            if len(self._events) == EVENT_RING:
+                self._events_evicted += 1
+            self._events.append(evt)
+            self._events_total += 1
+            self._feed_tails(evt, now)
+        meter.add(labeled_key(EVENTS_METRIC, kind=kind))
+        if len(self._events) == EVENT_RING:
+            meter.set_gauge(EVENTS_EVICTED_METRIC,
+                            float(self._events_evicted))
+
+    def record_drop_burst(self, pipeline: str, component: str,
+                          reason: str, n: int,
+                          blame: Optional[str] = None,
+                          trace_id: Optional[str] = None,
+                          span_id: Optional[str] = None) -> None:
+        """Drop-burst event with in-place coalescing: consecutive drops
+        of the same (pipeline, component, reason) inside
+        :data:`DROP_COALESCE_S` mutate the last event's count instead
+        of minting a new one — a 10k-frame shed is one timeline line,
+        not 10k. The trace fields carry the ACTIVE self-trace of the
+        dropping frame (the flowz last-drop witness, unified on one
+        field pair)."""
+        if not self.enabled:
+            return
+        now_unix = time.time()
+        with self._lock:
+            last = self._events[-1] if self._events else None
+            if (last is not None and last.get("kind") == "drop_burst"
+                    and last.get("pipeline") == pipeline
+                    and last.get("component") == component
+                    and last.get("reason") == reason
+                    and now_unix - last["unix_ts"] <= DROP_COALESCE_S):
+                last["n"] += n
+                if trace_id is not None:
+                    last["trace_id"] = trace_id
+                    last["span_id"] = span_id
+                return
+        fields: dict[str, Any] = {"pipeline": pipeline,
+                                  "component": component,
+                                  "reason": reason, "n": n}
+        if blame is not None:
+            fields["blame"] = blame
+        if trace_id is not None:
+            fields["trace_id"] = trace_id
+            fields["span_id"] = span_id
+        self.record("drop_burst", **fields)
+
+    def excerpt_tick(self, rule: str, expr: str) -> None:
+        """Periodic compressed excerpt of the series a rule references
+        (rate-limited per rule) — the continuous-capture half of the
+        tentpole: even before any trigger, the black box holds recent
+        shape of every watched series."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        with self._lock:
+            last = self._excerpt_at.get(rule)
+            if last is not None and now - last < EXCERPT_INTERVAL_S:
+                return
+            self._excerpt_at[rule] = now
+        ex = self._series_excerpt(expr)
+        if ex is None:
+            return
+        stats = {key: {"last": s["last"], "min": s["min"],
+                       "max": s["max"], "count": s["count"]}
+                 for key, s in ex["series"].items()}
+        self.record("series_excerpt", rule=rule,
+                    metric=ex["metric"], series=stats)
+
+    def note_config(self, config_hash: Optional[str],
+                    collector: str = "") -> None:
+        """Remember the active config hash (collector build time)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._config["hash"] = config_hash
+            if collector:
+                self._config["collector"] = collector
+
+    def note_reload(self, mode: str, config_hash: Optional[str] = None,
+                    collector: str = "", detail: str = "") -> None:
+        """Remember the last reload's diff classification + record the
+        timeline event (``patch``/``partial``/``full``/
+        ``patch_fallback`` — the PR 13 vocabulary)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._config["last_reload"] = {
+                "mode": mode, "collector": collector,
+                "detail": detail, "unix_ts": time.time()}
+            if config_hash is not None:
+                self._config["hash"] = config_hash
+            if collector:
+                self._config["collector"] = collector
+        self.record("reload", mode=mode, collector=collector,
+                    detail=detail)
+
+    # -------------------------------------------------------- triggers
+
+    def trigger(self, name: str, detail: str = "",
+                rule: Optional[str] = None,
+                expr: Optional[str] = None,
+                **fields: Any) -> Optional[str]:
+        """Freeze an incident. ``name`` must be in :data:`TRIGGERS`
+        (closed registry — unknown names raise, and the hygiene lint
+        catches them statically). ``rule``/``expr`` select the series
+        excerpt; extra ``fields`` ride into the bundle (``fault=`` for
+        chaos injections). Returns the incident id, or None when the
+        recorder is off or the (trigger, scope) pair is cooling down."""
+        if name not in TRIGGERS:
+            raise ValueError(f"unregistered flight trigger {name!r} "
+                             f"(known: {sorted(TRIGGERS)})")
+        if not self.enabled:
+            return None
+        now = self._clock()
+        scope = str(fields.get("fault") or rule or "")
+        with self._lock:
+            last = self._last_trigger.get((name, scope))
+            if last is not None and now - last < TRIGGER_COOLDOWN_S:
+                self._suppressed += 1
+                suppressed = True
+            else:
+                self._last_trigger[(name, scope)] = now
+                suppressed = False
+        if suppressed:
+            meter.add(labeled_key(SUPPRESSED_METRIC, trigger=name))
+            return None
+        # bundle assembly happens OUTSIDE the lock: the excerpt /
+        # worst-frame / conditions reads take other planes' locks, and
+        # holding ours across them is the ABBA half of a deadlock
+        if expr is None and rule is not None:
+            expr = self._rule_expr(rule)
+        incident: dict[str, Any] = {
+            "trigger": name, "detail": detail, "rule": rule,
+            "unix_ts": time.time(),
+            "series_excerpt": self._series_excerpt(expr),
+            "worst_frames": self._worst_frames(),
+            "config": None,  # filled under the lock below
+            "conditions": self._conditions(),
+            "tail": [], "sealed": False,
+        }
+        incident.update(fields)
+        with self._lock:
+            self._incident_seq += 1
+            incident["id"] = f"inc-{self._incident_seq:04d}"
+            incident["events"] = [dict(e) for e in
+                                  list(self._events)[-LOOKBACK_EVENTS:]]
+            incident["config"] = dict(self._config)
+            incident["_seal_at"] = now + TAIL_WINDOW_S
+            self._incidents.append(incident)
+            self._open.append(incident)
+            while len(self._incidents) > MAX_INCIDENTS:
+                evicted = self._incidents.popleft()
+                if evicted in self._open:
+                    self._open.remove(evicted)
+                self._incidents_evicted += 1
+        meter.add(labeled_key(INCIDENTS_METRIC, trigger=name))
+        meter.set_gauge(INCIDENTS_EVICTED_METRIC,
+                        float(self._incidents_evicted))
+        self.record("incident_frozen", trigger=name,
+                    incident=incident["id"], detail=detail)
+        return incident["id"]
+
+    def _feed_tails(self, evt: dict[str, Any], now: float) -> None:
+        """Append a fresh event to every open incident's post-trigger
+        tail; seal tails that hit their count or window bound. Caller
+        holds the lock."""
+        if not self._open:
+            return
+        still_open = []
+        for inc in self._open:
+            if now >= inc["_seal_at"]:
+                inc["sealed"] = True
+                continue
+            inc["tail"].append(dict(evt))
+            if len(inc["tail"]) >= TAIL_EVENTS:
+                inc["sealed"] = True
+            else:
+                still_open.append(inc)
+        self._open = still_open
+
+    def _seal_expired(self) -> None:
+        now = self._clock()
+        with self._lock:
+            still_open = []
+            for inc in self._open:
+                if now >= inc["_seal_at"]:
+                    inc["sealed"] = True
+                else:
+                    still_open.append(inc)
+            self._open = still_open
+
+    # ------------------------------------------- bundle ingredient taps
+
+    def _rule_expr(self, rule: str) -> Optional[str]:
+        try:
+            from .fleet import alert_engine
+            with alert_engine._lock:
+                r = alert_engine._rules.get(rule)
+            return r.expr if r is not None else None
+        except Exception:  # noqa: BLE001 — a broken tap must not
+            return None    # lose the incident itself
+
+    def _series_excerpt(self, expr: Optional[str]
+                        ) -> Optional[dict[str, Any]]:
+        """Compressed points of every series the triggering expression
+        references, over twice its window (enough pre-breach shape to
+        see the ramp, bounded enough to stay a bundle not a dump)."""
+        if not expr:
+            return None
+        try:
+            from .fleet import parse_expr
+            from .seriesstate import series_store
+            if not series_store.enabled:
+                return None
+            p = parse_expr(expr)
+            out: dict[str, Any] = {"expr": expr, "metric": p["metric"],
+                                   "window_s": p["window_s"],
+                                   "series": {}}
+            keys = sorted(series_store.select(
+                p["metric"], p["labels"] or None))[:EXCERPT_SERIES]
+            for key in keys:
+                pts = series_store.points(key, p["window_s"] * 2.0)
+                if not pts:
+                    continue
+                vals = [v for _, v in pts]
+                out["series"][key] = {
+                    "points": _compress(pts),
+                    "count": len(pts),
+                    "min": min(vals), "max": max(vals),
+                    "last": vals[-1],
+                }
+            return out if out["series"] else out
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _worst_frames(self) -> list[dict[str, Any]]:
+        try:
+            from .latency import latency_ledger
+            return latency_ledger.worst_frames()[:WORST_FRAMES]
+        except Exception:  # noqa: BLE001
+            return []
+
+    def _conditions(self) -> list[dict[str, Any]]:
+        """Best-effort snapshot of every registered rollup's CURRENT
+        condition rows, without evaluating and without taking rollup
+        locks: triggers fire from under plane locks (the breaker's
+        ``_trip`` holds the breaker lock) that a concurrent
+        ``HealthRollup.evaluate`` — holding the rollup lock — reads
+        back through, so taking the rollup lock here is the ABBA half
+        of a deadlock, and re-evaluating would recurse through the
+        alert engine into this very trigger. A torn read loses one
+        display row, never the incident."""
+        try:
+            from .flow import iter_rollups
+            merged: dict[str, dict[str, Any]] = {}
+            for rollup in iter_rollups():
+                try:
+                    conds = [dict(c) for c in
+                             list(rollup._state.values())]
+                except RuntimeError:  # resized mid-iteration
+                    conds = []
+                for cond in conds:
+                    merged[cond["component"]] = cond
+            return sorted(merged.values(),
+                          key=lambda c: c["component"])
+        except Exception:  # noqa: BLE001
+            return []
+
+    # -------------------------------------------------------- surfaces
+
+    def incidents(self) -> list[dict[str, Any]]:
+        """Full incident bundles, newest first (diagnose's
+        incidents.json)."""
+        self._seal_expired()
+        with self._lock:
+            out = []
+            for inc in reversed(self._incidents):
+                pub = {k: v for k, v in inc.items()
+                       if not k.startswith("_")}
+                pub["events"] = [dict(e) for e in pub["events"]]
+                pub["tail"] = [dict(e) for e in pub["tail"]]
+                out.append(pub)
+            return out
+
+    def incident(self, incident_id: str) -> Optional[dict[str, Any]]:
+        for inc in self.incidents():
+            if inc["id"] == incident_id:
+                return inc
+        return None
+
+    def api_snapshot(self) -> dict[str, Any]:
+        """The /api/incidents payload: store summaries + recorder
+        health, full bundles by id via :meth:`incident`."""
+        self._seal_expired()
+        with self._lock:
+            summaries = []
+            for inc in reversed(self._incidents):
+                summaries.append({
+                    "id": inc["id"], "trigger": inc["trigger"],
+                    "rule": inc["rule"], "detail": inc["detail"],
+                    "unix_ts": inc["unix_ts"],
+                    "sealed": inc["sealed"],
+                    "events": len(inc["events"]),
+                    "tail": len(inc["tail"]),
+                    "worst_frames": len(inc["worst_frames"]),
+                    "config_hash": (inc["config"] or {}).get("hash"),
+                })
+            return {
+                "enabled": self.enabled,
+                "events": len(self._events),
+                "events_total": self._events_total,
+                "events_evicted": self._events_evicted,
+                "incidents": summaries,
+                "incidents_evicted": self._incidents_evicted,
+                "suppressed": self._suppressed,
+                "triggers": sorted(TRIGGERS),
+                "cooldown_s": TRIGGER_COOLDOWN_S,
+            }
+
+    def recent_events(self, n: int = 64) -> list[dict[str, Any]]:
+        """Newest-first tail of the black box (/debug/incidentz)."""
+        with self._lock:
+            return [dict(e) for e in list(self._events)[-n:]][::-1]
+
+
+flight_recorder = FlightRecorder()

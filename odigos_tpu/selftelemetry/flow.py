@@ -60,6 +60,7 @@ from typing import Any, Callable, Iterable, Optional
 
 from ..hooks.tracecontext import _active
 from ..utils.telemetry import labeled_key, meter
+from .flightrecorder import flight_recorder
 
 # closed drop-reason taxonomy (ISSUE 5): a drop MUST name one of these —
 # free-form reasons would rot into unaggregatable cardinality and defeat
@@ -304,6 +305,12 @@ class FlowLedger:
         meter.add(labeled_key(DROPPED_METRIC, **labels), n)
         meter.record(labeled_key(DROP_SIZE_METRIC, **labels), float(n),
                      exemplar=(ctx[0], ctx[1]) if ctx else None)
+        # black-box timeline: same trace fields as the flowz last-drop
+        # witness above (one unified field pair), bursts coalesced
+        flight_recorder.record_drop_burst(
+            pipeline, component, reason, n, blame=blame,
+            trace_id=f"{ctx[0]:032x}" if ctx else None,
+            span_id=f"{ctx[1]:016x}" if ctx else None)
 
     def watermark(self, component: str, queue: str, value: float) -> None:
         if not self.enabled:
@@ -802,6 +809,10 @@ class HealthRollup:
                           and prev == (leak, bal["items_in"]))
                 self._last_leak[pname] = (leak, bal["items_in"])
                 if stable:
+                    prev_cond = self._state.get(node)
+                    leaking_already = (
+                        prev_cond is not None
+                        and prev_cond["reason"] == "ConservationLeak")
                     cond = self._upsert(
                         node, DEGRADED, "ConservationLeak",
                         f"{leak} items unaccounted "
@@ -809,6 +820,15 @@ class HealthRollup:
                         f"dropped={sum(bal['dropped'].values())} "
                         f"failed={sum(bal['failed'].values())} "
                         f"pending={bal['pending']})")
+                    if not leaking_already:
+                        # freeze on the TRANSITION into the leak, not
+                        # on every evaluation of a standing one
+                        flight_recorder.trigger(
+                            "conservation_leak", rule=node,
+                            detail=f"{pname}: {leak} items "
+                                   f"unaccounted "
+                                   f"(in={bal['items_in']} "
+                                   f"out={bal['items_out']})")
                 else:
                     cond = self._upsert(
                         node, HEALTHY, "Conserved",

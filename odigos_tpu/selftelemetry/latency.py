@@ -266,7 +266,7 @@ class _Recorder:
 
     __slots__ = ("pipeline", "deadline_ms", "frames", "scored_frames",
                  "overlap_ms_total", "_stage_keys", "_e2e_key", "_totals",
-                 "_expired", "recent", "_lock")
+                 "_expired", "recent", "_worst_blame", "_lock")
 
     def __init__(self, pipeline: str):
         self.pipeline = pipeline
@@ -281,6 +281,10 @@ class _Recorder:
         self._totals: dict[str, list[float]] = {}  # stage -> [sum, count]
         self._expired: dict[str, int] = {}         # blame -> spans
         self.recent: deque[dict[str, Any]] = deque(maxlen=RECENT_WINDOW)
+        # blame -> (wall_ms, trace_id, span_id, unix_ts): the worst
+        # EXPIRED frame per blame dimension that carried a self-trace
+        # (incident bundles join these — a p99 spike names one frame)
+        self._worst_blame: dict[str, tuple] = {}
         self._lock = threading.Lock()
 
     def observe(self, clock: StageClock, scored: bool) -> None:
@@ -317,13 +321,53 @@ class _Recorder:
                     tot[1] += 1
             # raw refs only — the clock is dead after retire, and
             # rendering dicts per frame costs more than the rest of
-            # this method (snapshot() renders on demand)
+            # this method (snapshot() renders on demand). The ctx ref
+            # rides along so worst_frames() can name the slowest
+            # frame's self-trace without a per-frame allocation.
             self.recent.append(
-                (clock.stages, wall, clock.overlap_ms, scored))
+                (clock.stages, wall, clock.overlap_ms, scored, ex))
 
-    def record_expiry(self, blame: str, n_spans: int) -> None:
+    def record_expiry(self, blame: str, n_spans: int,
+                      clock=None) -> None:
         with self._lock:
             self._expired[blame] = self._expired.get(blame, 0) + n_spans
+            if clock is not None and clock.ctx is not None:
+                wall = clock.wall_ms()
+                prev = self._worst_blame.get(blame)
+                if prev is None or wall > prev[0]:
+                    self._worst_blame[blame] = (
+                        wall, clock.ctx[0], clock.ctx[1], time.time())
+
+    def worst_frames(self) -> list[dict[str, Any]]:
+        """Worst-frame trace exemplars: the slowest traced frame over
+        the recent window, plus the worst expired frame per ``blame=``
+        dimension — each a concrete self-trace id an operator (or an
+        incident bundle) can pull the full timeline for."""
+        out: list[dict[str, Any]] = []
+        with self._lock:
+            worst = None
+            for stages, wall, _ov, scored, ex in self.recent:
+                if ex is None:
+                    continue
+                if worst is None or wall > worst[0]:
+                    worst = (wall, ex, scored)
+            blames = dict(self._worst_blame)
+        if worst is not None:
+            out.append({
+                "pipeline": self.pipeline, "scope": "window",
+                "wall_ms": round(worst[0], 4),
+                "trace_id": f"{worst[1][0]:032x}",
+                "span_id": f"{worst[1][1]:016x}",
+                "scored": worst[2],
+            })
+        for blame, (wall, tid, sid, ts) in sorted(blames.items()):
+            out.append({
+                "pipeline": self.pipeline, "scope": f"blame:{blame}",
+                "wall_ms": round(wall, 4),
+                "trace_id": f"{tid:032x}", "span_id": f"{sid:016x}",
+                "unix_ts": ts,
+            })
+        return out
 
     def stage_means(self) -> tuple[int, dict[str, float]]:
         """(scored frames in window, per-stage mean ms over the RECENT
@@ -340,7 +384,7 @@ class _Recorder:
             sums: dict[str, float] = {}
             counts: dict[str, int] = {}
             n = 0
-            for stages, _wall, _ov, scored in self.recent:
+            for stages, _wall, _ov, scored, _ex in self.recent:
                 if not scored:
                     continue
                 n += 1
@@ -406,7 +450,8 @@ class _Recorder:
                             for s, d in stages],
                  "wall_ms": round(wall, 4),
                  "overlap_ms": round(ov, 4), "scored": sc}
-                for stages, wall, ov, sc in recent],
+                for stages, wall, ov, sc, _ex in recent],
+            "worst_frames": self.worst_frames(),
         }
 
 
@@ -580,12 +625,14 @@ class LatencyLedger:
             tracker.observe(clock.wall_ms(), scored, n_spans)
 
     def record_expiry(self, pipeline: str, blame,
-                      n_spans: int) -> None:
+                      n_spans: int, clock=None) -> None:
         """An expired admission deadline, blamed on the stage that
         consumed the budget (the burn dimension on the drop taxonomy).
         ``blame`` is a :class:`Stage` for realized expiries, or
         :data:`PREDICTED_BLAME` for frames the predictive gate shed
-        before any budget was spent (ISSUE 12)."""
+        before any budget was spent (ISSUE 12). ``clock`` (when the
+        expiring frame's is at hand) lets the recorder retain the
+        worst expired frame's self-trace id per blame dimension."""
         if not self.enabled:
             return
         bval = blame.value if isinstance(blame, Stage) else str(blame)
@@ -596,7 +643,19 @@ class LatencyLedger:
                     labeled_key(EXPIRED_METRIC, pipeline=pipeline,
                                 blame=bval)
         meter.add(key, n_spans)
-        self.recorder(pipeline).record_expiry(bval, n_spans)
+        self.recorder(pipeline).record_expiry(bval, n_spans,
+                                              clock=clock)
+
+    def worst_frames(self) -> list[dict[str, Any]]:
+        """Every pipeline's worst-frame trace exemplars, slowest first
+        (the flight recorder joins these into incident bundles)."""
+        with self._lock:
+            recs = list(self._recorders.values())
+        out: list[dict[str, Any]] = []
+        for r in recs:
+            out.extend(r.worst_frames())
+        out.sort(key=lambda f: f["wall_ms"], reverse=True)
+        return out
 
     # -------------------------------------------------------- surfaces
 

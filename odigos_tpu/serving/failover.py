@@ -269,6 +269,16 @@ class FailoverSupervisor:
             "event": "tripped", "model": self.model, "unix_ts": time.time(),
             "error": self._last_error,
             "fallback": self.cfg.fallback_model})
+        from ..selftelemetry.flightrecorder import flight_recorder
+
+        flight_recorder.record("breaker", event="tripped",
+                               model=self.model,
+                               error=self._last_error,
+                               fallback=self.cfg.fallback_model)
+        flight_recorder.trigger(
+            "breaker_trip", rule=self.model,
+            detail=f"{self.model} tripped to "
+                   f"{self.cfg.fallback_model}: {self._last_error}")
 
     def _recover(self, now: float) -> None:
         self.recoveries += 1
@@ -280,6 +290,10 @@ class FailoverSupervisor:
         self.history.append({
             "event": "recovered", "model": self.model,
             "unix_ts": time.time()})
+        from ..selftelemetry.flightrecorder import flight_recorder
+
+        flight_recorder.record("breaker", event="recovered",
+                               model=self.model)
 
     # ----------------------------------------------------------- surfaces
 

@@ -402,7 +402,11 @@ class IngestFastPath:
                 predicted_ms = head_ms + self._stage_cost_ms
                 if predicted_ms > self.deadline_ms \
                         * self.predictive_margin:
-                    claim_clock()  # a shed frame's timeline dies here
+                    # a shed frame's timeline dies here — but its
+                    # clock (bound to the active self-trace) still
+                    # names the worst predicted-shed frame exemplar
+                    shed_clock = claim_clock()
+                    shed_clock.bind_trace(_active.get())
                     meter.add(self._predicted_key)
                     self._refresh_watermarks_locked(now_ns)
                     err = FastPathSaturated(
@@ -416,7 +420,8 @@ class IngestFastPath:
                     FlowContext.drop(n, "queue_full", component=self,
                                      exc=err, blame=PREDICTED_BLAME)
                     latency_ledger.record_expiry(
-                        self.pipeline, PREDICTED_BLAME, n)
+                        self.pipeline, PREDICTED_BLAME, n,
+                        clock=shed_clock)
                     raise err
             # RESERVE inside the check's lock hold: concurrent receiver
             # threads must not all pass the bound at once — the pending
@@ -801,7 +806,7 @@ class IngestFastPath:
                         self.pipeline,
                         Stage.DEVICE if req is not None
                         and req.dispatched_ns else Stage.QUEUE,
-                        len(frame.batch))
+                        len(frame.batch), clock=clock)
             finally:
                 # the gate step and the reservation release run even
                 # if a telemetry call above raises: skipping advance

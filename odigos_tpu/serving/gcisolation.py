@@ -154,9 +154,15 @@ class GcPlane:
             self._callback_installed = True
         gc.callbacks.append(self._gc_callback)
 
+    # pauses this long get a flight-recorder timeline line (a gen-2
+    # sweep stalling the data path is incident-relevant context; the
+    # per-collection noise floor is not)
+    FLIGHT_PAUSE_MS = 10.0
+
     def _drain_pending(self) -> None:
         """Publish callback-recorded pauses into the histogram (janitor
-        thread — the one place meter locks are safe to take)."""
+        thread — the one place meter locks are safe to take; the GC
+        callback itself stays lock- and meter-free)."""
         while True:
             try:
                 gen, ms = self._pending.popleft()
@@ -164,6 +170,12 @@ class GcPlane:
                 return
             meter.record(self._pause_keys.get(gen, self._pause_keys[2]),
                          ms)
+            if ms >= self.FLIGHT_PAUSE_MS:
+                from ..selftelemetry.flightrecorder import \
+                    flight_recorder
+
+                flight_recorder.record("gc_pause", gen=gen,
+                                       ms=round(ms, 3))
 
     # ------------------------------------------------------- the janitor
     def hint(self) -> None:

@@ -84,6 +84,7 @@ class WatermarkGate:
             for comp, queues in self.limits.items() for q in queues}
         self._inflight_key = labeled_key(ADMISSION_INFLIGHT_GAUGE,
                                          receiver=receiver_name)
+        self.receiver_name = receiver_name
         self._lock = threading.Lock()
         self._next_eval = 0.0
         # (component, queue, ledger_reason) or None
@@ -112,7 +113,17 @@ class WatermarkGate:
             meter.set_gauge(self._inflight_key,
                             float(self.inflight_fn()))
         with self._lock:
-            self._verdict = verdict
+            prev, self._verdict = self._verdict, verdict
+        if verdict is not None and verdict != prev:
+            # watermark breach TRANSITIONS are flight-recorder events
+            # (a standing breach re-evaluated every refresh_s is one
+            # line, not a line per refresh)
+            from ..selftelemetry.flightrecorder import flight_recorder
+
+            flight_recorder.record(
+                "admission_breach", receiver=self.receiver_name,
+                component=verdict[0], queue=verdict[1],
+                reason=verdict[2])
         return verdict
 
 

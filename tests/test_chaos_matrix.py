@@ -4,7 +4,7 @@ blame, condition transitions, and alerting as the machine-checked oracle.
 Every scenario runs against the full in-process stack (E2EEnvironment:
 control plane + live gateway collector) through the chainsaw-style
 runner, injects a fault from the paired registry in ``e2e/chaos.py``,
-and asserts the FOUR-part oracle — "no silent loss, no unexplained
+and asserts the FIVE-part oracle — "no silent loss, no unexplained
 latency" as assertions, not a slogan:
 
 1. **ledger balance exact** — every registered pipeline's flow-ledger
@@ -16,7 +16,10 @@ latency" as assertions, not a slogan:
    (ModelFailover, ExportRetrying, MemoryPressure...);
 4. **the right alert fired** — the PR 10 rule the scenario declares in
    its ``service.alerts`` stanza transitions to firing (and quiet
-   scenarios assert that NO alert fired).
+   scenarios assert that NO alert fired);
+5. **the black box saw it** — the flight recorder (ISSUE 16) froze
+   EXACTLY ONE ``chaos_injection`` incident naming the scenario's
+   injected fault — no missed incident, nothing spurious.
 
 Injections are deterministic; anything randomized threads the
 ``--chaos-seed`` pytest option (the ``chaos_seed`` fixture). Scenario
@@ -67,6 +70,7 @@ from odigos_tpu.e2e.chaos import _gateway_engines
 from odigos_tpu.pdata import synthesize_traces
 from odigos_tpu.selftelemetry.fleet import (
     RecommendationRule, alert_engine, fleet_plane)
+from odigos_tpu.selftelemetry.flightrecorder import flight_recorder
 from odigos_tpu.selftelemetry.flow import (
     DROP_REASONS, HealthRollup, flow_ledger)
 from odigos_tpu.selftelemetry.latency import latency_ledger
@@ -88,7 +92,9 @@ def fresh_planes():
     latency_ledger.reset()
     fleet_plane.reset()
     fleet_actuator.reset()
+    flight_recorder.reset()
     yield
+    flight_recorder.reset()
     fleet_actuator.reset()
     fleet_plane.reset()
     latency_ledger.reset()
@@ -163,6 +169,20 @@ def drop_total(reason: str, component: str = "") -> int:
             continue
         total += d["reasons"].get(reason, 0)
     return total
+
+
+def assert_incident(fault: str) -> dict:
+    """Oracle part 5: the flight recorder froze EXACTLY ONE
+    ``chaos_injection`` incident and it names this scenario's injected
+    fault — the black box saw the chaos, and nothing spurious rode
+    along. Returns the bundle for scenario-specific follow-ups."""
+    incs = [i for i in flight_recorder.incidents()
+            if i["trigger"] == "chaos_injection"]
+    assert len(incs) == 1, (
+        f"expected exactly one chaos incident, got "
+        f"{[(i['id'], i.get('fault')) for i in incs]}")
+    assert incs[0].get("fault") == fault, incs[0]
+    return incs[0]
 
 
 def alert_fired(rule: str) -> bool:
@@ -275,6 +295,10 @@ class TestDeviceLossFailover:
             assert sup.trips >= 1 and sup.recoveries >= 1
             assert sup.fallback_spans > 0
             assert_conserved()
+            assert_incident("device_fault")
+            # the breaker trip froze its own incident alongside
+            assert any(i["trigger"] == "breaker_trip"
+                       for i in flight_recorder.incidents())
 
 
 class TestDeviceLossNoFailover:
@@ -320,6 +344,7 @@ class TestDeviceLossNoFailover:
                      script=lambda e: clear_device_fault(e)),
             ]).run(env)
             assert_conserved()
+            assert_incident("device_fault")
 
 
 class TestDestinationOutageRetrySpill:
@@ -387,6 +412,7 @@ class TestDestinationOutageRetrySpill:
             assert stats["dropped_spans"] == 0
             assert stats["delivered_spans"] == sent["spans"]
             assert_conserved()
+            assert_incident("destination_outage")
 
 
 class TestDestinationOutageQueueOverflow:
@@ -448,6 +474,7 @@ class TestDestinationOutageQueueOverflow:
                 == sent["spans"]
             assert _db(env).span_count == stats["delivered_spans"]
             assert_conserved()
+            assert_incident("destination_outage")
 
 
 class TestMemoryPressureBackpressure:
@@ -505,6 +532,7 @@ class TestMemoryPressureBackpressure:
                      script=lambda e: clear_memory_pressure(e)),
             ]).run(env)
             assert_conserved()
+            assert_incident("memory_pressure")
 
 
 class TestClockSkewStorm:
@@ -547,6 +575,7 @@ class TestClockSkewStorm:
             ]).run(env)
             assert drop_total("invalid") == 0
             assert_conserved()
+            assert_incident("clock_skew")
 
 
 class TestMalformedFrameStorm:
@@ -589,6 +618,7 @@ class TestMalformedFrameStorm:
                      script=lambda e: clear_malformed_frame_storm(e)),
             ]).run(env)
             assert_conserved()
+            assert_incident("malformed_frame_storm")
 
 
 class TestReconnectStampede:
@@ -617,6 +647,7 @@ class TestReconnectStampede:
                      script=lambda e: clear_reconnect_stampede(e)),
             ]).run(env)
             assert_conserved()
+            assert_incident("reconnect_stampede")
 
 
 class TestHotReloadUnderLoad:
@@ -687,6 +718,7 @@ class TestHotReloadUnderLoad:
                      script=lambda e: clear_hot_reload(e)),
             ]).run(env)
             assert_conserved()
+            assert_incident("hot_reload")
 
 
 class TestRejectingDestinationIsolation:
@@ -740,6 +772,7 @@ class TestRejectingDestinationIsolation:
                 for cls in e["failed"]}
             assert "MockDestinationError" in failed_classes, snap["edges"]
             assert balances  # at least one pipeline was registered
+            assert_incident("exporter_chaos")
 
 
 # ------------------------------------------------- actuator (ISSUE 15)
@@ -890,6 +923,10 @@ class TestActuatorCanaryPromote:
                 "{rule=deadline-expiry-storm,knob=admission_deadline}"
             ) >= 1
             assert_conserved()
+            # nothing was injected: the black box froze no chaos
+            # incident (alert-firing incidents are legitimate here)
+            assert not [i for i in flight_recorder.incidents()
+                        if i["trigger"] == "chaos_injection"]
 
 
 class TestActuatorForcedRollback:
@@ -983,6 +1020,12 @@ class TestActuatorForcedRollback:
             # round trip: no actuator row left behind
             assert condition(env, "actuator/forced-bad") is None
             assert_conserved()
+            # the forced proposal is chaos through the force() seam,
+            # and the oracle's refusal froze its own rollback incident
+            assert_incident("forced_proposal")
+            [rbi] = [i for i in flight_recorder.incidents()
+                     if i["trigger"] == "actuator_rollback"]
+            assert rbi["rule"] == "forced-bad"
 
 
 # ------------------------------------------------------ runner contract

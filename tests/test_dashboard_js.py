@@ -128,6 +128,13 @@ CONTRACT: dict[str, dict] = {
     "act": {"endpoint": "/api/actuator",
             "fields": ["enabled", "dry_run", "state", "in_flight",
                        "history"]},
+    # flight recorder panel (ISSUE 16): black-box counters + frozen
+    # incident summaries; per-incident rows are reached via a local (it)
+    # — top-level containers validated here (always served, possibly
+    # empty on a clean run)
+    "inc": {"endpoint": "/api/incidents",
+            "fields": ["enabled", "incidents", "events_total",
+                       "suppressed", "incidents_evicted"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

@@ -1,0 +1,469 @@
+"""Incident flight recorder tests (ISSUE 16): black-box ring bounds +
+eviction accounting, the kill switch, per-(trigger, scope) cooldown,
+tail sealing by count and by window, the incident-store cap — and the
+acceptance bundles pinned through LIVE paths: an alert rule firing
+through the real engine/store, and a forced-proposal canary rolling
+back through the real actuator state machine. Each bundle must carry
+the event timeline, the triggering rule's series excerpt, at least one
+worst-frame trace exemplar id, and the active config hash. Satellite:
+every named drop class surfaces the dropping frame's self-trace id in
+the black box when tracing is on. Tier-1 overhead guard: the recorder's
+inline cost on a drop-naming pipeline must stay under 2%."""
+
+import copy
+import json
+import time
+
+import numpy as np
+import pytest
+
+import odigos_tpu.components  # noqa: F401 — registers builtin factories
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.pipeline.service import Collector
+from odigos_tpu.selftelemetry.fleet import alert_engine, fleet_plane
+from odigos_tpu.selftelemetry.flightrecorder import (
+    MAX_INCIDENTS,
+    TAIL_EVENTS,
+    TAIL_WINDOW_S,
+    TRIGGER_COOLDOWN_S,
+    TRIGGERS,
+    FlightRecorder,
+    flight_recorder,
+)
+from odigos_tpu.selftelemetry.flow import (
+    DROP_REASONS, FlowContext, flow_ledger)
+from odigos_tpu.selftelemetry.latency import (
+    Stage, StageClock, latency_ledger)
+from odigos_tpu.selftelemetry.seriesstate import series_store
+from odigos_tpu.selftelemetry.tracer import tracer
+from odigos_tpu.utils.telemetry import meter
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    # fleet_plane.reset() also resets alert_engine + the global store
+    fleet_plane.reset()
+    flow_ledger.reset()
+    latency_ledger.reset()
+    flight_recorder.reset()
+    meter.reset()
+    yield
+    fleet_plane.reset()
+    flow_ledger.reset()
+    latency_ledger.reset()
+    flight_recorder.reset()
+    meter.reset()
+
+
+def traced_frame(pipeline="traces/in", trace_id=0xABCDEF,
+                 span_id=0x1234):
+    """Retire one traced frame through the ledger so worst_frames()
+    has a window exemplar to join into bundles."""
+    c = StageClock(ctx=(trace_id, span_id))
+    c.stamp(Stage.ADMISSION)
+    c.stamp(Stage.DECODE)
+    latency_ledger.observe(pipeline, c, scored=True, n_spans=10)
+    return f"{trace_id:032x}"
+
+
+# --------------------------------------------------------- the black box
+
+
+class TestBlackBox:
+    def test_ring_bounds_and_eviction_accounting(self):
+        fr = FlightRecorder()
+        ring = fr._events.maxlen
+        for i in range(ring + 50):
+            fr.record("marker", i=i)
+        snap = fr.api_snapshot()
+        assert snap["events"] == ring
+        assert snap["events_total"] == ring + 50
+        assert snap["events_evicted"] == 50
+        # newest-first tail keeps the latest sequence numbers
+        assert fr.recent_events(1)[0]["i"] == ring + 49
+
+    def test_kill_switch_disables_everything(self, monkeypatch):
+        monkeypatch.setenv("ODIGOS_FLIGHT", "0")
+        fr = FlightRecorder()
+        fr.record("marker")
+        fr.record_drop_burst("p", "c", "filtered", 3)
+        fr.note_config("deadbeef")
+        assert fr.trigger("alert_firing", rule="r") is None
+        snap = fr.api_snapshot()
+        assert snap["enabled"] is False
+        assert snap["events_total"] == 0
+        assert snap["incidents"] == []
+        # the global singleton re-samples the env on reset (the seam
+        # every plane singleton exposes)
+        flight_recorder.reset()
+        assert flight_recorder.enabled is False
+
+    def test_cooldown_is_scoped_per_trigger_and_fault(self):
+        clk = Clock()
+        fr = FlightRecorder(clock=clk)
+        assert fr.trigger("chaos_injection", fault="device_fault",
+                          detail="a") is not None
+        # same (trigger, scope) inside the window: suppressed
+        assert fr.trigger("chaos_injection", fault="device_fault",
+                          detail="b") is None
+        # a DIFFERENT fault is a different scope — it freezes
+        assert fr.trigger("chaos_injection", fault="destination_outage",
+                          detail="c") is not None
+        assert fr.api_snapshot()["suppressed"] == 1
+        clk.advance(TRIGGER_COOLDOWN_S + 1)
+        assert fr.trigger("chaos_injection", fault="device_fault",
+                          detail="d") is not None
+
+    def test_incident_store_bounded_with_evictions_counted(self):
+        clk = Clock()
+        fr = FlightRecorder(clock=clk)
+        for i in range(MAX_INCIDENTS + 5):
+            assert fr.trigger("alert_firing", rule=f"r{i}") is not None
+        incs = fr.incidents()
+        assert len(incs) == MAX_INCIDENTS
+        # newest first; the 5 oldest were evicted
+        assert incs[0]["rule"] == f"r{MAX_INCIDENTS + 4}"
+        assert all(i["rule"] != "r0" for i in incs)
+        assert fr.api_snapshot()["incidents_evicted"] == 5
+
+    def test_tail_seals_on_event_count(self):
+        clk = Clock()
+        fr = FlightRecorder(clock=clk)
+        fr.trigger("breaker_trip", detail="x")
+        for i in range(TAIL_EVENTS + 10):
+            fr.record("after", i=i)
+        [inc] = fr.incidents()
+        assert inc["sealed"] is True
+        assert len(inc["tail"]) == TAIL_EVENTS
+        # the freeze marker itself opens the tail; post-seal events
+        # stay out of the bundle
+        assert inc["tail"][0]["kind"] == "incident_frozen"
+        assert all(e.get("i") != TAIL_EVENTS + 9 for e in inc["tail"])
+
+    def test_tail_seals_on_window_expiry(self):
+        clk = Clock()
+        fr = FlightRecorder(clock=clk)
+        fr.trigger("breaker_trip", detail="x")
+        fr.record("inside")
+        clk.advance(TAIL_WINDOW_S + 1)
+        fr.record("outside")
+        [inc] = fr.incidents()
+        assert inc["sealed"] is True
+        kinds = [e["kind"] for e in inc["tail"]]
+        assert "inside" in kinds and "outside" not in kinds
+
+    def test_lookback_carries_pretrigger_events(self):
+        fr = FlightRecorder()
+        for i in range(10):
+            fr.record("before", i=i)
+        fr.trigger("patch_fallback", detail="x")
+        [inc] = fr.incidents()
+        assert [e["i"] for e in inc["events"]
+                if e["kind"] == "before"] == list(range(10))
+
+
+# ----------------------------------------------- live-path bundle pinning
+
+
+class TestLiveAlertBundle:
+    def test_alert_firing_freezes_complete_bundle(self):
+        """Acceptance: the bundle frozen by a REAL alert transition —
+        rule configured in the engine, breach observed in the global
+        store, evaluate() fires — carries the event timeline, the
+        triggering rule's series excerpt, a worst-frame trace exemplar
+        id, and the active config hash."""
+        flight_recorder.note_config("cfg-abc123", collector="gw")
+        tid = traced_frame()
+        for i in range(3):
+            flight_recorder.record("marker", i=i)
+        alert_engine.configure({
+            "name": "flight-live",
+            "expr": "latest(odigos_g[30s]) > 5",
+            "for_s": 0.0, "severity": "critical"})
+        series_store.observe("odigos_g{collector=x}", 9.0)
+        alert_engine.evaluate()
+
+        [inc] = [i for i in flight_recorder.incidents()
+                 if i["trigger"] == "alert_firing"]
+        assert inc["rule"] == "flight-live"
+        assert "flight-live fired" in inc["detail"]
+        # 1. event timeline: the pre-trigger lookback holds both the
+        # markers and the alert transition event itself
+        kinds = [e["kind"] for e in inc["events"]]
+        assert kinds.count("marker") == 3
+        assert any(e["kind"] == "alert" and e["event"] == "fired"
+                   for e in inc["events"])
+        # 2. the triggering rule's series excerpt, resolved from the
+        # live engine registry (trigger passed only the rule name)
+        ex = inc["series_excerpt"]
+        assert ex["expr"] == "latest(odigos_g[30s]) > 5"
+        assert ex["metric"] == "odigos_g"
+        [(key, series)] = list(ex["series"].items())
+        assert "odigos_g" in key
+        assert series["last"] == 9.0
+        assert series["points"]
+        # 3. worst-frame trace exemplar joined from the latency ledger
+        assert any(f["trace_id"] == tid for f in inc["worst_frames"])
+        # 4. active config hash
+        assert inc["config"]["hash"] == "cfg-abc123"
+        assert inc["config"]["collector"] == "gw"
+        # bundle structure: conditions snapshot + open tail present,
+        # and the whole thing survives the diagnose serialization
+        assert isinstance(inc["conditions"], list)
+        assert inc["sealed"] is False
+        json.dumps(flight_recorder.incidents())
+        summary = flight_recorder.api_snapshot()["incidents"][0]
+        assert summary["config_hash"] == "cfg-abc123"
+        assert summary["worst_frames"] >= 1
+
+    def test_worst_blame_exemplar_joins_bundle(self):
+        """The per-blame worst EXPIRED frame (satellite 1) rides
+        worst_frames into a bundle alongside the window exemplar."""
+        c = StageClock(ctx=(0xFEED, 0xBEEF))
+        c.stamp(Stage.ADMISSION)
+        latency_ledger.record_expiry("traces/in", Stage.DEVICE, 7,
+                                     clock=c)
+        flight_recorder.trigger("breaker_trip", detail="x")
+        [inc] = flight_recorder.incidents()
+        [f] = [f for f in inc["worst_frames"]
+               if f.get("scope") == "blame:device"]
+        assert f["trace_id"] == f"{0xFEED:032x}"
+
+
+class FakeCollector:
+    """The actuation-target duck (test_actuator's): config + reload +
+    health_conditions."""
+
+    graph = None
+
+    def __init__(self, cfg):
+        self.config = cfg
+        self.reloads = []
+        self.bad: list = []
+
+    def reload(self, cfg):
+        self.reloads.append(copy.deepcopy(cfg))
+        self.config = cfg
+
+    def health_conditions(self):
+        return []
+
+
+def fastpath_cfg(deadline=40.0):
+    return {
+        "receivers": {"otlpwire": {}},
+        "processors": {"tpuanomaly": {}},
+        "exporters": {"tracedb": {}},
+        "service": {"pipelines": {"traces/in": {
+            "receivers": ["otlpwire"], "processors": ["tpuanomaly"],
+            "exporters": ["tracedb"],
+            "fast_path": {"deadline_ms": deadline}}}},
+    }
+
+
+class TestActuatorRollbackBundle:
+    def test_forced_rollback_freezes_complete_bundle(self, ):
+        """Acceptance: the bundle frozen when a REAL canary rolls back
+        through the actuator state machine — forced bad proposal,
+        judgment expiry, revert — carries the actuator event trail,
+        the oracle expression's series excerpt, a worst-frame trace
+        exemplar, and the active config hash."""
+        from odigos_tpu.controlplane.actuator import FleetActuator
+        from odigos_tpu.selftelemetry.fleet import Recommender
+        from odigos_tpu.selftelemetry.seriesstate import SeriesStore
+
+        flight_recorder.note_config("cfg-rollback-77")
+        tid = traced_frame(trace_id=0xC0FFEE)
+        # the forced oracle expr reads the actuator's PRIVATE store;
+        # the excerpt tap reads the GLOBAL one — feed both so the
+        # bundle's excerpt carries real points
+        series_store.observe("odigos_g", 7.0)
+
+        clock = Clock()
+        store = SeriesStore(interval_s=1.0, window=7200, clock=clock)
+        rec = Recommender(store=store, clock=clock, rules=())
+        act = FleetActuator(clock=clock, recommender=rec)
+        act.configure({"enabled": True, "judgment_window_s": 3.0,
+                       "cooldown_s": 5.0, "max_step": 4.0})
+        gw = FakeCollector(fastpath_cfg(100.0))
+        act.register("gw", gw)
+        store.observe("odigos_g", 1.0)
+        act.force("admission_deadline", rule="forced-bad",
+                  direction="down", expr="latest(odigos_g[20s]) > 0",
+                  target="gw", value=5.0)
+        act.tick()
+        assert act.state == "canary"
+        clock.advance(25)
+        store.observe("odigos_g", 1.0)  # oracle never clears
+        act.tick()
+        [h] = list(act.history)
+        assert h["outcome"] == "rolled_back"
+
+        # the force() seam froze its own chaos incident first
+        [chaos] = [i for i in flight_recorder.incidents()
+                   if i["trigger"] == "chaos_injection"]
+        assert chaos["fault"] == "forced_proposal"
+        [inc] = [i for i in flight_recorder.incidents()
+                 if i["trigger"] == "actuator_rollback"]
+        assert inc["rule"] == "forced-bad"
+        assert inc["knob"] == "admission_deadline"
+        assert "rolled back" in inc["detail"]
+        # 1. event timeline: the actuator's proposed/canary trail and
+        # the chaos freeze all precede the rollback trigger
+        actuator_events = [e["event"] for e in inc["events"]
+                           if e["kind"] == "actuator"]
+        assert "proposed" in actuator_events
+        assert "canary" in actuator_events
+        assert any(e["kind"] == "incident_frozen"
+                   and e["incident"] == chaos["id"]
+                   for e in inc["events"])
+        # 2. the oracle expression's series excerpt
+        ex = inc["series_excerpt"]
+        assert ex["expr"] == "latest(odigos_g[20s]) > 0"
+        assert any(s["points"] for s in ex["series"].values())
+        # 3. worst-frame trace exemplar
+        assert any(f["trace_id"] == tid for f in inc["worst_frames"])
+        # 4. active config hash
+        assert inc["config"]["hash"] == "cfg-rollback-77"
+        json.dumps(inc)
+
+
+# ------------------------------------------- drop-burst trace witnesses
+
+
+class TestDropTraceWitnesses:
+    def test_every_drop_class_surfaces_active_trace_id(self):
+        """Satellite: each reason in the closed DROP_REASONS taxonomy,
+        dropped under an active self-trace, lands in the black box as
+        a drop_burst event carrying that frame's trace id — looping
+        the taxonomy so a future reason extends this oracle for free."""
+        enabled = tracer.enabled
+        tracer.enabled = True
+        try:
+            with tracer.span("unit/flight-drops") as sp:
+                for reason in DROP_REASONS:
+                    FlowContext.drop(
+                        3, reason, pipeline="traces/w",
+                        component_name=f"comp/{reason}",
+                        signal="traces")
+                tid = f"{sp.trace_id:032x}"
+        finally:
+            tracer.enabled = enabled
+        bursts = {e["reason"]: e
+                  for e in flight_recorder.recent_events(128)
+                  if e["kind"] == "drop_burst"}
+        assert set(bursts) == set(DROP_REASONS)
+        for reason, evt in bursts.items():
+            assert evt["trace_id"] == tid, (reason, evt)
+            assert len(evt["span_id"]) == 16
+
+    def test_drop_bursts_coalesce_into_one_timeline_line(self):
+        FlowContext.drop(5, "queue_full", pipeline="traces/w",
+                         component_name="q", signal="traces")
+        FlowContext.drop(2, "queue_full", pipeline="traces/w",
+                         component_name="q", signal="traces")
+        bursts = [e for e in flight_recorder.recent_events(32)
+                  if e["kind"] == "drop_burst"]
+        assert len(bursts) == 1
+        assert bursts[0]["n"] == 7
+
+    def test_trigger_registry_matches_closed_set(self):
+        # the bundle vocabulary every surface renders from — changing
+        # it must be a conscious act (the hygiene lint covers call
+        # sites; this pins the set itself)
+        assert set(TRIGGERS) == {
+            "alert_firing", "actuator_rollback", "breaker_trip",
+            "conservation_leak", "patch_fallback", "chaos_injection"}
+
+
+# ------------------------------------------------------- overhead guard
+
+
+class TestOverheadGuard:
+    def test_flightrecorder_overhead_under_2_percent(self):
+        """Enabled-vs-disabled wall time through a drop-naming pipeline
+        (the filter sheds ~a third of every batch, so each consume pays
+        the recorder's drop-burst tap): the always-on black box must
+        cost <2%. Same paired design as the tracing-overhead bar — the
+        identical batch consumed in both modes back-to-back, within-
+        pair order alternating, median of the paired ratios, up to
+        three windows; one clean window proves the recorder CAN run
+        under 2%, a preempted one cannot refute it."""
+        cfg = {
+            "receivers": {"synthetic": {"traces_per_batch": 2,
+                                        "n_batches": 1}},
+            "processors": {
+                "filter": {"exclude": [
+                    {"attr": {"key": "http.status", "value": 500}}]},
+                "attributes": {"actions": [
+                    {"action": "upsert", "key": "bench.tag",
+                     "value": "x"}]},
+                "resource": {"attributes": [
+                    {"action": "upsert", "key": "odigos.version",
+                     "value": "bench"}]}},
+            "exporters": {"debug": {}},
+            "service": {"pipelines": {"traces/bench": {
+                "receivers": ["synthetic"],
+                "processors": ["filter", "attributes", "resource"],
+                "exporters": ["debug"]}}},
+        }
+
+        def make_batch(seed):
+            batch = synthesize_traces(4000, seed=seed)
+            rng = np.random.default_rng(seed)
+            n = len(batch)
+            return batch.with_span_attrs({
+                "http.status": rng.choice([200, 404, 500], n).tolist(),
+            }, np.ones(n, dtype=bool))
+
+        with Collector(cfg) as col:
+            col.drain_receivers()
+            entry = col.graph.pipeline_entries["traces/bench"]
+            batches = [make_batch(100 + i) for i in range(4)]
+
+            def consume_timed(b):
+                t0 = time.perf_counter()
+                entry.consume(b)
+                return time.perf_counter() - t0
+
+            for enabled in (True, False):  # warm both paths + caches
+                flight_recorder.enabled = enabled
+                for b in batches:
+                    entry.consume(b)
+
+            def measure():
+                ratios = []
+                for i in range(10):
+                    for j, b in enumerate(batches):
+                        t = {}
+                        modes = ((True, False) if (i + j) % 2
+                                 else (False, True))
+                        for enabled in modes:
+                            flight_recorder.enabled = enabled
+                            t[enabled] = consume_timed(b)
+                        ratios.append(t[True] / t[False])
+                ratios.sort()
+                return ratios[len(ratios) // 2], ratios
+
+            medians = []
+            for _ in range(3):
+                median, ratios = measure()
+                medians.append(median)
+                if median <= 1.02:
+                    break
+        assert min(medians) <= 1.02, (
+            f"flight-recorder overhead too high: median "
+            f"enabled/disabled ratios across trials "
+            f"{[f'{m:.4f}' for m in medians]} "
+            f"(last samples: {ratios[:3]} .. {ratios[-3:]})")

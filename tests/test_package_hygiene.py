@@ -1370,3 +1370,116 @@ class TestComponentObservability:
             "components with no own-telemetry metric or span — add a "
             "meter counter or tracer span before registering:\n  "
             + "\n  ".join(unobservable))
+
+
+class TestFlightTriggerHygiene:
+    """Flight-recorder trigger lint (ISSUE 16 satellite): the TRIGGERS
+    registry is the closed vocabulary of incident causes, so it must
+    stay honest in both directions — every registered trigger has at
+    least one literal ``flight_recorder.trigger("name", ...)`` call
+    site in the package (a trigger nobody can fire is a dead registry
+    entry that pads the /debug/incidentz table), and every literal
+    call site names a registered trigger (the runtime check raises
+    ValueError, but the lint catches the typo before any test has to
+    reach that code path). With a stale-entry oracle, and the
+    odigos_flightrecorder_* metric family checked against the ISSUE 3
+    name registry."""
+
+    @staticmethod
+    def _trigger_call_sites() -> dict:
+        """trigger-name -> [file:line, ...] for every literal
+        ``<recv>.trigger("name", ...)`` call in odigos_tpu/."""
+        sites: dict = {}
+        for dirpath, _dirs, files in os.walk(PKG_ROOT):
+            for n in files:
+                if not n.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, n)
+                with open(path) as f:
+                    tree = ast.parse(f.read())
+                for node in ast.walk(tree):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "trigger"
+                            and node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+                        sites.setdefault(node.args[0].value, []).append(
+                            f"{os.path.relpath(path, PKG_ROOT)}:"
+                            f"{node.lineno}")
+        return sites
+
+    @staticmethod
+    def _check(registry: dict, sites: dict) -> list:
+        """Problems for a (registry, call-sites) pair — factored so the
+        stale-entry oracle can drive it with a doctored registry."""
+        problems = []
+        for name in sorted(registry):
+            if name not in sites:
+                problems.append(
+                    f"trigger {name!r} registered but never fired "
+                    f"anywhere in the package (stale entry)")
+        for name, where in sorted(sites.items()):
+            if name not in registry:
+                problems.append(
+                    f"trigger {name!r} fired at {where} but not in "
+                    f"the TRIGGERS registry")
+        return problems
+
+    def test_trigger_registry_closed_both_directions(self):
+        from odigos_tpu.selftelemetry.flightrecorder import TRIGGERS
+
+        sites = self._trigger_call_sites()
+        assert sites, "no flight_recorder.trigger call sites at all?"
+        assert self._check(TRIGGERS, sites) == []
+
+    def test_stale_entry_oracle(self):
+        """The lint's own oracle: a ghost registry entry nobody fires,
+        and a call site naming an unregistered trigger, must both be
+        flagged (guards against the scan degenerating into a no-op)."""
+        from odigos_tpu.selftelemetry.flightrecorder import TRIGGERS
+
+        sites = self._trigger_call_sites()
+        ghost = dict(TRIGGERS)
+        ghost["_ghost_trigger"] = "never fired by anyone"
+        problems = self._check(ghost, sites)
+        assert any("_ghost_trigger" in p and "stale" in p
+                   for p in problems), problems
+        rogue = dict(sites)
+        rogue["_rogue_trigger"] = ["nowhere.py:1"]
+        problems = self._check(TRIGGERS, rogue)
+        assert any("_rogue_trigger" in p and "registry" in p
+                   for p in problems), problems
+
+    def test_unregistered_trigger_raises_at_runtime(self):
+        """The runtime half of the closed registry: trigger() on an
+        unknown name is a programming error, not a silent no-op."""
+        from odigos_tpu.selftelemetry.flightrecorder import FlightRecorder
+
+        fr = FlightRecorder()
+        with pytest.raises(ValueError, match="_not_a_trigger"):
+            fr.trigger("_not_a_trigger", detail="x")
+
+    def test_trigger_descriptions_nonempty(self):
+        """Every registry entry carries a human description — the
+        /debug/incidentz trigger table renders these."""
+        from odigos_tpu.selftelemetry.flightrecorder import TRIGGERS
+
+        assert TRIGGERS, "TRIGGERS registry empty?"
+        for name, desc in TRIGGERS.items():
+            assert re.fullmatch(r"[a-z_]+", name), name
+            assert isinstance(desc, str) and desc.strip(), name
+
+    def test_flightrecorder_metric_names_registered(self):
+        """The odigos_flightrecorder_* family must resolve against the
+        registered name registry (the TestFleetRuleHygiene scan) — the
+        constants must stay string literals for the AST scan to see
+        them."""
+        from odigos_tpu.selftelemetry import flightrecorder as fr
+
+        registry = TestFleetRuleHygiene._registered_metric_names()
+        for name in (fr.EVENTS_METRIC, fr.EVENTS_EVICTED_METRIC,
+                     fr.INCIDENTS_METRIC, fr.SUPPRESSED_METRIC,
+                     fr.INCIDENTS_EVICTED_METRIC):
+            assert name.startswith("odigos_flightrecorder_"), name
+            assert name in registry, name

@@ -151,6 +151,7 @@ def run_soak(args, fast_path: bool) -> dict:
 
     from odigos_tpu.pdata import synthesize_traces
     from odigos_tpu.pipeline.service import Collector
+    from odigos_tpu.selftelemetry.flightrecorder import flight_recorder
     from odigos_tpu.selftelemetry.flow import flow_ledger
     from odigos_tpu.selftelemetry.latency import latency_ledger
     from odigos_tpu.utils.telemetry import labeled_key, meter
@@ -341,6 +342,7 @@ def run_soak(args, fast_path: bool) -> dict:
     latency_ledger.reset()
     fleet_plane.reset()
     gc_plane.reset_stats()
+    flight_recorder.reset()
     collector = Collector(cfg).start()
     port = collector.graph.receivers["otlpwire"].port
 
@@ -608,14 +610,27 @@ def run_soak(args, fast_path: bool) -> dict:
         def outage(batch):
             raise RuntimeError("chaos soak: destination outage")
 
+        # the soak injects faults directly (engine seam + exporter
+        # monkeypatch), bypassing the e2e/chaos.py injectors that fire
+        # the flight trigger — so the schedule freezes the incident
+        # itself, same fault vocabulary as the INJECTORS registry
+        def inject_device():
+            engine.inject_device_fault("chaos soak: device lost")
+            flight_recorder.trigger(
+                "chaos_injection", fault="device_fault",
+                detail="chaos soak: persistent device fault injected")
+
+        def inject_outage():
+            normal_wrap.inner.export = outage
+            flight_recorder.trigger(
+                "chaos_injection", fault="destination_outage",
+                detail="chaos soak: tracedb/normal outage injected")
+
         plan = [
-            (0.20 * T, "device_fault_injected",
-             lambda: engine.inject_device_fault("chaos soak: device "
-                                                "lost")),
+            (0.20 * T, "device_fault_injected", inject_device),
             (0.45 * T, "device_fault_cleared",
              lambda: engine.clear_device_fault()),
-            (0.55 * T, "destination_outage_injected",
-             lambda: setattr(normal_wrap.inner, "export", outage)),
+            (0.55 * T, "destination_outage_injected", inject_outage),
             (0.80 * T, "destination_outage_cleared",
              lambda: normal_wrap.inner.__dict__.pop("export", None)),
         ]
@@ -901,6 +916,27 @@ def run_soak(args, fast_path: bool) -> dict:
         retry_stats = {
             eid: collector.graph.exporters[eid].stats()
             for eid in ("tracedb/anomaly", "tracedb/normal")}
+        # flight-recorder verdict (ISSUE 16): each injected fault froze
+        # exactly one chaos_injection incident; consequence incidents
+        # (the breaker tripping, the chaos alerts firing) are expected;
+        # anything else — or a chaos incident naming a fault nobody
+        # injected — is spurious and fails the run
+        expected_faults = {"device_fault", "destination_outage"}
+        benign_triggers = {"chaos_injection", "breaker_trip",
+                           "alert_firing"}
+        bundles = flight_recorder.incidents()
+        fault_counts: dict = {}
+        for b in bundles:
+            if b["trigger"] == "chaos_injection":
+                f = b.get("fault")
+                fault_counts[f] = fault_counts.get(f, 0) + 1
+        incidents_missing = sorted(
+            f for f in expected_faults if fault_counts.get(f, 0) != 1)
+        incidents_spurious = sorted(
+            f"chaos_injection:{f}" for f in fault_counts
+            if f not in expected_faults) + sorted(
+            f"{b['trigger']}:{b['id']}" for b in bundles
+            if b["trigger"] not in benign_triggers)
         chaos_summary = {
             "seed": args.chaos_seed,
             "events": chaos_events,
@@ -910,6 +946,13 @@ def run_soak(args, fast_path: bool) -> dict:
             # the acceptance verdict: every span either delivered or
             # carries a named reason, and every balance closed exactly
             "zero_unexplained_loss": bool(conserved),
+            # the frozen incident store, summarized (full bundles live
+            # in a diagnose archive, not a perf record)
+            "incidents": flight_recorder.api_snapshot()["incidents"],
+            "incidents_missing": incidents_missing,
+            "incidents_spurious": incidents_spurious,
+            "incident_verdict": not incidents_missing
+            and not incidents_spurious,
         }
 
     # actuator evidence (ISSUE 15), read BEFORE shutdown: the full
@@ -981,6 +1024,18 @@ def run_soak(args, fast_path: bool) -> dict:
         "series_store": {k: fleet_snap["store"][k]
                          for k in ("series", "metrics",
                                    "dropped_series")},
+    }
+
+    # flight recorder (ISSUE 16), read BEFORE shutdown: incident counts
+    # ride every record — a CLEAN soak must freeze nothing (main()
+    # gates plain runs on it; incidents on a fault-free run mean either
+    # a real regression or a trigger misfiring)
+    fr_snap = flight_recorder.api_snapshot()
+    flight_summary = {
+        "enabled": fr_snap["enabled"],
+        "events_total": fr_snap["events_total"],
+        "suppressed": fr_snap["suppressed"],
+        "incidents": fr_snap["incidents"],
     }
 
     collector.shutdown()
@@ -1081,6 +1136,9 @@ def run_soak(args, fast_path: bool) -> dict:
         } if args.reload_storm else None),
         # chaos fault timeline + degradation evidence (ISSUE 13)
         "chaos": chaos_summary,
+        # flight-recorder black box (ISSUE 16): always-on counters and
+        # the frozen incident store at end of run
+        "flight": flight_summary,
         # closed-loop actuation evidence (ISSUE 15): the overload ->
         # alert -> proposal -> canary -> promotion timeline, per-step
         # reload modes (must ALL be incremental), and the SLO burn's
@@ -1367,6 +1425,24 @@ def main() -> None:
         sys.exit(1)
     if args.chaos and not result["chaos"]["zero_unexplained_loss"]:
         print("CHAOS: unexplained loss", file=sys.stderr)
+        sys.exit(1)
+    if args.chaos and not result["chaos"]["incident_verdict"]:
+        # each injected fault must freeze exactly one incident, and
+        # nothing unexplained may freeze beside them
+        print(f"CHAOS: incident mismatch — missing="
+              f"{result['chaos']['incidents_missing']} spurious="
+              f"{result['chaos']['incidents_spurious']}",
+              file=sys.stderr)
+        sys.exit(1)
+    if not args.chaos and not args.actuate \
+            and result["flight"]["incidents"]:
+        # a clean soak (no fault injected, no deliberate SLO burn) must
+        # freeze ZERO incidents — anything here is a regression or a
+        # trigger misfiring
+        rows = [(i["id"], i["trigger"], i["detail"])
+                for i in result["flight"]["incidents"]]
+        print(f"FLIGHT: incident(s) frozen on a clean run: {rows}",
+              file=sys.stderr)
         sys.exit(1)
     if args.actuate:
         act = result["actuator"]
