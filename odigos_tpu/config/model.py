@@ -175,6 +175,12 @@ class AnomalyStageConfiguration:
     # spends host time on them (blame=predicted); rendered as
     # fast_path.predictive
     fast_path_predictive: bool = True
+    # fused device-side featurize→pack→score (ISSUE 19): the submit
+    # lane hands the engine raw span columns and one jitted call does
+    # hashing, the parent join, packing, and the model forward;
+    # rendered as fast_path.fused ONLY when true (opt-in — existing
+    # configs stay byte-identical), kill-switchable via ODIGOS_FUSED=0
+    fast_path_fused: bool = False
     # declarative burn-rate SLOs for the root traces pipeline (ISSUE 8);
     # None renders nothing — existing configs stay byte-identical
     slo: Optional[SloConfiguration] = None

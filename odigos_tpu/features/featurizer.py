@@ -88,6 +88,49 @@ class SpanFeatures:
         return int(self.categorical.shape[0])
 
 
+@dataclass(frozen=True)
+class SpanColumns:
+    """The raw column views one frame's featurization actually reads —
+    the fused route's input contract (ISSUE 19). Every array is a view
+    into the decoded SpanBatch's columns (no copies); ``strings`` is the
+    frame's interned string table. Both featurize paths (numpy
+    :func:`featurize_columns`, device :func:`featurize_columns_jax`)
+    consume exactly this set, so the two can't silently read different
+    inputs.
+    """
+
+    strings: tuple[str, ...]
+    service: np.ndarray          # int32 string-table index
+    name: np.ndarray             # int32 string-table index
+    kind: np.ndarray             # int8
+    status_code: np.ndarray      # int8
+    span_id: np.ndarray          # uint64
+    parent_span_id: np.ndarray   # uint64 (0 => root)
+    trace_id_hi: np.ndarray      # uint64
+    trace_id_lo: np.ndarray      # uint64
+    start_unix_nano: np.ndarray  # uint64
+    end_unix_nano: np.ndarray    # uint64
+
+    def __len__(self) -> int:
+        return int(self.span_id.shape[0])
+
+
+def batch_columns(batch: SpanBatch) -> SpanColumns:
+    """The :class:`SpanColumns` view of a SpanBatch (zero-copy)."""
+    return SpanColumns(
+        strings=batch.strings,
+        service=batch.col("service"),
+        name=batch.col("name"),
+        kind=batch.col("kind"),
+        status_code=batch.col("status_code"),
+        span_id=batch.col("span_id"),
+        parent_span_id=batch.col("parent_span_id"),
+        trace_id_hi=batch.col("trace_id_hi"),
+        trace_id_lo=batch.col("trace_id_lo"),
+        start_unix_nano=batch.col("start_unix_nano"),
+        end_unix_nano=batch.col("end_unix_nano"))
+
+
 @lru_cache(maxsize=65536)
 def _stable_hash(s: str) -> int:
     return int.from_bytes(hashlib.blake2b(s.encode(), digest_size=8).digest(),
@@ -213,26 +256,31 @@ def _attr_slot_matrix(batch: SpanBatch, slots: int,
     return out
 
 
-def featurize(batch: SpanBatch,
-              config: Optional[FeaturizerConfig] = None) -> SpanFeatures:
+def featurize_columns(cols: SpanColumns,
+                      config: Optional[FeaturizerConfig] = None
+                      ) -> SpanFeatures:
+    """The featurize spec over bare columns — THE definition of the
+    feature semantics. :func:`featurize` delegates here (then overlays
+    attr slots, which need the batch's attr store), and
+    :func:`featurize_columns_jax` is its line-for-line device twin; one
+    body per operation keeps the two routes from drifting."""
     config = config or FeaturizerConfig()
-    n = len(batch)
+    n = len(cols)
     if n == 0:
         return SpanFeatures(_alloc((0, config.cat_width), np.int32, 0),
                             _alloc((0, config.cont_width), np.float32, 0))
 
-    service_h = _hash_table(batch.strings, config.service_vocab)
-    name_h = _hash_table(batch.strings, config.name_vocab)
+    service_h = _hash_table(cols.strings, config.service_vocab)
+    name_h = _hash_table(cols.strings, config.name_vocab)
 
-    svc_col = batch.col("service")
-    service_ids = service_h[svc_col]
-    name_ids = name_h[batch.col("name")]
-    kind = batch.col("kind").astype(np.int32)
-    status = batch.col("status_code").astype(np.int32)
+    service_ids = service_h[cols.service]
+    name_ids = name_h[cols.name]
+    kind = cols.kind.astype(np.int32)
+    status = cols.status_code.astype(np.int32)
 
     # parent edge: vectorized self-join span_id -> service id
-    span_ids = batch.col("span_id")
-    parent_ids = batch.col("parent_span_id")
+    span_ids = cols.span_id
+    parent_ids = cols.parent_span_id
     order = np.argsort(span_ids, kind="stable")
     sorted_ids = span_ids[order]
     pos = np.searchsorted(sorted_ids, parent_ids)
@@ -241,19 +289,25 @@ def featurize(batch: SpanBatch,
     parent_rows = order[pos]
     parent_service = np.where(found, service_ids[parent_rows], 0).astype(np.int32)
 
-    cols = (service_ids, name_ids, kind, status, parent_service)
+    cat_cols = (service_ids, name_ids, kind, status, parent_service)
 
     # output matrices come from the buffer pool (a column_stack here was
     # the frame's largest steady-state allocation); column writes into
     # an exact-shape C-order view are bitwise what column_stack built
     categorical = _alloc((n, config.cat_width), np.int32)
-    for i, c in enumerate(cols):
+    for i, c in enumerate(cat_cols):
         categorical[:, i] = c
     if config.attr_slots:
-        categorical[:, len(cols):] = _attr_slot_matrix(
-            batch, config.attr_slots, config.attr_vocab)
+        # pool buffers arrive uninitialized; the slot region is zeroed
+        # here and overlaid by featurize() when a batch is in hand
+        categorical[:, len(cat_cols):] = 0
 
-    dur_us = batch.duration_ns.astype(np.float64) / 1_000.0
+    # duration from the raw clocks, matching SpanBatch.duration_ns
+    # (int64 end - start, clamped at 0)
+    start = cols.start_unix_nano.astype(np.int64)
+    end = cols.end_unix_nano.astype(np.int64)
+    dur_ns = np.maximum(end - start, 0)
+    dur_us = dur_ns.astype(np.float64) / 1_000.0
     log_dur = np.log1p(dur_us).astype(np.float32)
     is_root = (parent_ids == 0).astype(np.float32)
     # depth hint: children of found parents get parent depth unknown here;
@@ -266,6 +320,104 @@ def featurize(batch: SpanBatch,
     continuous[:, 2] = depth_hint
 
     return SpanFeatures(categorical, continuous)
+
+
+def featurize(batch: SpanBatch,
+              config: Optional[FeaturizerConfig] = None) -> SpanFeatures:
+    config = config or FeaturizerConfig()
+    features = featurize_columns(batch_columns(batch), config)
+    if config.attr_slots and len(batch):
+        features.categorical[:, len(CAT_FIELDS):] = _attr_slot_matrix(
+            batch, config.attr_slots, config.attr_vocab)
+    return features
+
+
+def featurize_columns_jax(service_table, name_table, service, name, kind,
+                          status_code, span_id_hi, span_id_lo,
+                          parent_id_hi, parent_id_lo, end_hi, end_lo,
+                          start_hi, start_lo, frame_id):
+    """Device twin of :func:`featurize_columns` — pure jnp, traceable
+    under jit, x32-safe (uint64 columns arrive pre-split into uint32
+    hi/lo halves). Inputs are (N,) device arrays where N is the padded
+    span bucket; ``frame_id`` is the span's frame ordinal within the
+    coalesced group (< 0 at padding). The hash ``*_table`` arrays are
+    the device-resident gather tables (host-hashed once per string
+    pool, see serving/fused.py).
+
+    Semantics mirror the numpy body operation-for-operation:
+
+    * service/name ids: gather through the hashed tables;
+    * parent edge: the stable searchsorted self-join, expressed as one
+      lexsort over the 2N merged (span ∪ parent) keys + a segment_min
+      that picks the FIRST matching span in original order — exactly
+      what stable argsort + searchsorted(left) picks on the host. The
+      join is salted with ``frame_id`` so a coalesced group joins
+      per-frame, like the host path (featurize runs per request there);
+    * continuous: log1p(duration_us) with the duration recomposed from
+      the split clocks (borrow arithmetic, clamped at 0). The single
+      documented divergence from the host: the f64 intermediate becomes
+      f32, a ~1e-7 relative wobble on log_duration_us (the ULP bound
+      in docs/architecture.md).
+
+    Returns ``(categorical (N, 5) int32, continuous (N, 3) float32)``
+    in CAT_FIELDS/CONT_FIELDS order; attr slots are not supported on
+    this path (the fused route falls back when attr_slots > 0).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = span_id_hi.shape[0]
+    service_ids = service_table[service]
+    name_ids = name_table[name]
+    kind32 = kind.astype(jnp.int32)
+    status32 = status_code.astype(jnp.int32)
+
+    # ---- parent self-join over the merged key stream: entries 0..N-1
+    # declare span ids, N..2N-1 query parent ids; equal (frame, id) keys
+    # become one run after the lexsort (frame primary => per-frame join)
+    all_hi = jnp.concatenate([span_id_hi, parent_id_hi])
+    all_lo = jnp.concatenate([span_id_lo, parent_id_lo])
+    all_frame = jnp.concatenate([frame_id, frame_id])
+    is_query = jnp.concatenate([jnp.zeros(n, bool), jnp.ones(n, bool)])
+    order = jnp.lexsort((all_lo, all_hi, all_frame))
+    f_s = all_frame[order]
+    h_s = all_hi[order]
+    l_s = all_lo[order]
+    new_run = jnp.concatenate([
+        jnp.ones(1, bool),
+        (f_s[1:] != f_s[:-1]) | (h_s[1:] != h_s[:-1]) | (l_s[1:] != l_s[:-1])])
+    run_id = jnp.cumsum(new_run) - 1
+    # first span (lowest original row) declaring each run's id; 2N = none
+    big = 2 * n
+    span_pos = jnp.where(is_query[order], big, order)
+    first_span = jax.ops.segment_min(span_pos, run_id, num_segments=2 * n)
+    match = first_span[run_id]
+    # route each query's match back to its original span row
+    dest = jnp.where(is_query[order], order - n, n)
+    parent_row_raw = jnp.zeros(n, jnp.int32).at[dest].set(
+        match.astype(jnp.int32), mode="drop")
+    found = parent_row_raw < n
+    parent_row = jnp.minimum(parent_row_raw, n - 1)
+    parent_service = jnp.where(found, service_ids[parent_row], 0)
+
+    categorical = jnp.stack(
+        [service_ids, name_ids, kind32, status32, parent_service], axis=1)
+
+    # ---- continuous block: duration via split-clock borrow arithmetic
+    borrow = (end_lo < start_lo).astype(jnp.uint32)
+    lo_diff = end_lo - start_lo          # uint32 wraparound is the borrow
+    hi_diff = end_hi - start_hi - borrow
+    negative = (end_hi < start_hi) | ((end_hi == start_hi)
+                                      & (end_lo < start_lo))
+    dur_ns = (hi_diff.astype(jnp.float32) * jnp.float32(4294967296.0)
+              + lo_diff.astype(jnp.float32))
+    dur_us = jnp.where(negative, 0.0, dur_ns) / jnp.float32(1000.0)
+    log_dur = jnp.log1p(dur_us)
+    no_parent = (parent_id_hi | parent_id_lo) == 0
+    is_root = no_parent.astype(jnp.float32)
+    depth_hint = jnp.where(no_parent, 0.0, jnp.where(found, 1.0, 0.5))
+    continuous = jnp.stack([log_dur, is_root, depth_hint], axis=1)
+    return categorical, continuous
 
 
 # shape-bucket spec for the leading (trace/row) axis of assembled tensors:

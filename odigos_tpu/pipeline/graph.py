@@ -483,7 +483,7 @@ def validate_config(config: dict[str, Any]) -> list[str]:
                 known = {"deadline_ms", "max_pending_spans", "lanes",
                          "submit_lanes", "ordered", "drain_timeout_s",
                          "name", "predictive", "predictive_margin",
-                         "predictive_min_frames", "pooled"}
+                         "predictive_min_frames", "pooled", "fused"}
                 unknown = sorted(set(fp) - known)
                 if unknown:
                     problems.append(
@@ -503,7 +503,7 @@ def validate_config(config: dict[str, Any]) -> list[str]:
                         problems.append(
                             f"pipeline {pname}: fast_path.{key} must be "
                             f"a positive integer")
-                for key in ("ordered", "predictive", "pooled"):
+                for key in ("ordered", "predictive", "pooled", "fused"):
                     if key in fp and not isinstance(fp[key], bool):
                         problems.append(
                             f"pipeline {pname}: fast_path.{key} must be "

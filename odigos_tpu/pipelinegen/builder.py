@@ -433,6 +433,11 @@ def build_gateway_config(
                 # frames priced past the deadline before featurize
                 # touches them, named blame=predicted
                 "predictive": anomaly.fast_path_predictive}
+            if getattr(anomaly, "fast_path_fused", False):
+                # fused device-side featurize→pack→score (ISSUE 19):
+                # rendered only when armed so every existing install's
+                # config stays byte-identical
+                root["fast_path"]["fused"] = True
             root["processors"] = (
                 ["memory_limiter", "tpuanomaly"]
                 + [pid for pid in root["processors"]

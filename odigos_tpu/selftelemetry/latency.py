@@ -100,6 +100,7 @@ class Stage(enum.Enum):
     ENQUEUE = "enqueue"       # featurized -> engine queue accepted
     QUEUE = "queue"           # engine queue wait (submit -> pack start)
     PACK = "pack"             # host coalesce/pack (pack start -> dispatch)
+    FUSED = "fused"           # fused route: column assembly -> device enqueue
     DEVICE = "device"         # device execution (dispatch -> harvest start)
     HARVEST = "harvest"       # result fetch + scatter (harvest -> scores)
     WAIT = "wait"             # scores landed -> retirement-lane pickup
@@ -113,7 +114,21 @@ class Stage(enum.Enum):
 # tuple as those stages' single stamp site
 ENGINE_STAGES = (Stage.QUEUE, Stage.PACK, Stage.DEVICE, Stage.HARVEST)
 
-STAGES = tuple(s.value for s in Stage)
+# the fused-route variant (ISSUE 19): host featurize+pack collapse into a
+# single FUSED stage (column assembly + device-call enqueue) so the burn
+# table prices the route it actually runs. Selected by ``merge_engine``
+# when the engine flags the group as fused; together with ENGINE_STAGES
+# these tuples are the single stamp site for their member stages.
+ENGINE_STAGES_FUSED = (Stage.QUEUE, Stage.FUSED, Stage.DEVICE, Stage.HARVEST)
+
+# the full stage vocabulary in traversal order — metric keys, waterfalls
+# and burn tables iterate this (a fused frame's stages must aggregate
+# like any other). STAGES keeps its pre-fused meaning: the HOST-route
+# traversal, exactly the stages one non-fused frame stamps, once each,
+# in order (the tiling tests pin frame["stages"] == STAGES); a fused
+# frame swaps featurize+pack for the single `fused` stamp instead.
+ALL_STAGES = tuple(s.value for s in Stage)
+STAGES = tuple(s.value for s in Stage if s is not Stage.FUSED)
 
 # blame value for PREDICTIVE admission sheds (ISSUE 12): a frame the
 # fast path rejected because the priced burn table said it would expire
@@ -167,7 +182,8 @@ class StageClock:
         depth-2 window races submit), and a negative stage would corrupt
         the tiling by more than the microseconds it saves."""
         mark = self._mark
-        for stage, end in zip(ENGINE_STAGES,
+        stages = ENGINE_STAGES_FUSED if info.get("fused") else ENGINE_STAGES
+        for stage, end in zip(stages,
                               (info["pack0"], info["dispatch"],
                                info["harvest0"], info["end"])):
             end = max(int(end), mark)
@@ -276,7 +292,7 @@ class _Recorder:
         self.overlap_ms_total = 0.0
         self._stage_keys = {
             s: labeled_key(STAGE_METRIC, pipeline=pipeline, stage=s)
-            for s in STAGES}
+            for s in ALL_STAGES}
         self._e2e_key = labeled_key(E2E_METRIC, pipeline=pipeline)
         self._totals: dict[str, list[float]] = {}  # stage -> [sum, count]
         self._expired: dict[str, int] = {}         # blame -> spans
@@ -399,7 +415,7 @@ class _Recorder:
         out: dict[str, dict[str, float]] = {}
         with self._lock:
             totals = {s: (t[0], t[1]) for s, t in self._totals.items()}
-        for s in STAGES:
+        for s in ALL_STAGES:
             tot = totals.get(s)
             if not tot or not tot[1]:
                 continue
@@ -424,7 +440,7 @@ class _Recorder:
             expired = dict(self._expired)
             deadline = self.deadline_ms
         by_stage = {}
-        for s in STAGES:
+        for s in ALL_STAGES:
             tot = totals.get(s)
             if not tot or not tot[1]:
                 continue
@@ -680,7 +696,7 @@ class LatencyLedger:
             recs = list(self._recorders.values())
         return {
             "enabled": self.enabled,
-            "stages": list(STAGES),
+            "stages": list(ALL_STAGES),
             "pipelines": {r.pipeline: r.snapshot() for r in recs},
             "slo": self.slo_status(),
         }

@@ -585,11 +585,21 @@ def _remote_backend(cfg: "EngineConfig", mesh: Any = None):
     return RemoteBackend(cfg)  # mesh lives sidecar-side for remote
 
 
+def _fused_backend(cfg: "EngineConfig", mesh: Any = None):
+    # FusedSequenceBackend IS a SequenceBackend — the host dispatch and
+    # every non-fused engine stays bit-identical; the subclass only adds
+    # the columns→scores route (ISSUE 19). Imported lazily so mock/
+    # zscore engines never pull the fused module's import chain.
+    from .fused import FusedSequenceBackend
+
+    return FusedSequenceBackend(cfg, mesh=mesh)
+
+
 _BACKENDS = {
     "mock": MockBackend,
     "zscore": ZScoreBackend,
-    "transformer": SequenceBackend,
-    "autoencoder": SequenceBackend,
+    "transformer": _fused_backend,
+    "autoencoder": _fused_backend,
     "remote": _remote_backend,
 }
 
@@ -628,6 +638,12 @@ class _ColumnBatch:
 class ScoreRequest:
     batch: SpanBatch
     features: SpanFeatures
+    # fused route (ISSUE 19): the frame's raw SpanColumns view when the
+    # submit lane skipped host featurize. The pack stage scores columns
+    # device-side when the whole group carries them and the backend has
+    # a fused kernel; otherwise it host-featurizes here (batch always
+    # rides alongside, so the conversion is the bit-exact host path).
+    columns: Any = None
     done: threading.Event = field(default_factory=threading.Event)
     scores: Optional[np.ndarray] = None
     submitted_ns: int = 0
@@ -719,6 +735,9 @@ class _InflightGroup:
     # group's result may resolve the half-open probe slot.
     backend: Any = None
     probe: bool = False
+    # fused-route marker (ISSUE 19): selects the latency ledger's
+    # fused stage taxonomy when this group scored columns device-side
+    fused: bool = False
 
 
 class ScoringEngine:
@@ -949,6 +968,7 @@ class ScoringEngine:
                deadline_ns: Optional[int] = None,
                on_done: Optional[Callable[[ScoreRequest], None]] = None,
                on_features_consumed: Optional[Callable[[], None]] = None,
+               columns: Any = None,
                ) -> Optional[ScoreRequest]:
         """Enqueue for scoring; returns None (and counts) if queue is full
         or the engine is draining for shutdown. ``deadline_ns`` (monotonic)
@@ -969,12 +989,16 @@ class ScoringEngine:
                              component_name=f"engine/{self.cfg.model}",
                              signal="requests")
             return None
-        if features is None and getattr(self.backend, "needs_features", True):
+        if features is None and columns is None \
+                and getattr(self.backend, "needs_features", True):
             # a remote backend ships the raw batch and the sidecar
             # featurizes server-side; featurizing here too would pay the
-            # host cost twice against the latency budget
+            # host cost twice against the latency budget. A columns-
+            # carrying request (fused route) defers featurization to the
+            # pack stage — device-side when the group fuses, the same
+            # host featurize otherwise.
             features = featurize(batch, self.cfg.featurizer)
-        req = ScoreRequest(batch=batch, features=features,
+        req = ScoreRequest(batch=batch, features=features, columns=columns,
                            submitted_ns=time.monotonic_ns(),
                            deadline_ns=deadline_ns, on_done=on_done,
                            on_features_consumed=on_features_consumed)
@@ -1248,57 +1272,88 @@ class ScoringEngine:
         lease = self._pack_pool.lease() if pools_enabled() else None
         try:
             with lease_scope(lease):
-                if len(reqs) == 1:
-                    merged, feats = reqs[0].batch, reqs[0].features
-                else:
-                    feats = None
-                    if all(r.features is not None for r in reqs):
-                        cats = [r.features.categorical for r in reqs]
-                        conts = [r.features.continuous for r in reqs]
-                        rows = sum(c.shape[0] for c in cats)
-                        feats = SpanFeatures(
-                            np.concatenate(cats, out=_pool_alloc(
-                                (rows, cats[0].shape[1]), cats[0].dtype)),
-                            np.concatenate(conts, out=_pool_alloc(
-                                (rows, conts[0].shape[1]),
-                                conts[0].dtype)))
-                    if feats is not None and getattr(
-                            backend, "coalesce_columns",
-                            None) is not None:
-                        # every request pre-featurized + a backend that
-                        # only reads id/time columns: skip the merged
-                        # batch — the ingest fast path's
-                        # zero-rematerialization seam
-                        merged: Any = _ColumnBatch(
-                            [r.batch for r in reqs])
-                    else:
-                        from ..pdata.spans import concat_batches
-
-                        merged = concat_batches([r.batch for r in reqs])
                 if self._device_fault is not None \
                         and backend is self.backend:
                     # injected device loss (chaos hook): only the
                     # PRIMARY route faults — the fallback must keep
                     # scoring or there is nothing to fail over TO
                     raise DeviceFaultInjected(self._device_fault)
-                dispatch = getattr(backend, "dispatch", None)
-                with self._backend_lock:
-                    if dispatch is not None:
-                        handle = dispatch(merged, feats)
+                # fused route (ISSUE 19): a whole group of columns-
+                # carrying requests on a backend with a fused kernel
+                # scores in one featurize→pack→score device call. The
+                # decision is per group AND per selected backend: a
+                # failover trip to the CPU fallback (no fused kernel)
+                # converts the same requests on the host path below.
+                fused = (getattr(backend, "supports_fused", False)
+                         and all(r.columns is not None for r in reqs))
+                if fused:
+                    with self._backend_lock:
+                        handle = backend.dispatch_columns(
+                            [r.columns for r in reqs])
+                        bucket_hit = getattr(backend, "last_bucket_hit",
+                                             None)
+                        shape = getattr(backend, "last_shape", None)
+                        waste = getattr(backend, "last_padding_waste",
+                                        None)
+                else:
+                    for r in reqs:
+                        if r.features is None and r.columns is not None \
+                                and getattr(backend, "needs_features",
+                                            True):
+                            # columns-carrying request on a non-fused
+                            # call: the bit-exact host featurize the
+                            # submit lane deferred (fallback ladder)
+                            r.features = featurize(r.batch,
+                                                   self.cfg.featurizer)
+                    if len(reqs) == 1:
+                        merged, feats = reqs[0].batch, reqs[0].features
                     else:
-                        # depth-1 backend: the whole call happens here,
-                        # eagerly — identical to the serial engine
-                        # (ordering guarantees for zscore online updates
-                        # and the remote sidecar deadline)
-                        handle = backend.score(merged, feats)
-                    # snapshot while still holding the lock: a concurrent
-                    # warmup() score would overwrite the last_* fields
-                    # with the warmup call's shape before we read them
-                    bucket_hit = getattr(backend, "last_bucket_hit",
-                                         None)
-                    shape = getattr(backend, "last_shape", None)
-                    waste = getattr(backend, "last_padding_waste",
-                                    None)
+                        feats = None
+                        if all(r.features is not None for r in reqs):
+                            cats = [r.features.categorical for r in reqs]
+                            conts = [r.features.continuous for r in reqs]
+                            rows = sum(c.shape[0] for c in cats)
+                            feats = SpanFeatures(
+                                np.concatenate(cats, out=_pool_alloc(
+                                    (rows, cats[0].shape[1]),
+                                    cats[0].dtype)),
+                                np.concatenate(conts, out=_pool_alloc(
+                                    (rows, conts[0].shape[1]),
+                                    conts[0].dtype)))
+                        if feats is not None and getattr(
+                                backend, "coalesce_columns",
+                                None) is not None:
+                            # every request pre-featurized + a backend
+                            # that only reads id/time columns: skip the
+                            # merged batch — the ingest fast path's
+                            # zero-rematerialization seam
+                            merged: Any = _ColumnBatch(
+                                [r.batch for r in reqs])
+                        else:
+                            from ..pdata.spans import concat_batches
+
+                            merged = concat_batches(
+                                [r.batch for r in reqs])
+                    dispatch = getattr(backend, "dispatch", None)
+                    with self._backend_lock:
+                        if dispatch is not None:
+                            handle = dispatch(merged, feats)
+                        else:
+                            # depth-1 backend: the whole call happens
+                            # here, eagerly — identical to the serial
+                            # engine (ordering guarantees for zscore
+                            # online updates and the remote sidecar
+                            # deadline)
+                            handle = backend.score(merged, feats)
+                        # snapshot while still holding the lock: a
+                        # concurrent warmup() score would overwrite the
+                        # last_* fields with the warmup call's shape
+                        # before we read them
+                        bucket_hit = getattr(backend, "last_bucket_hit",
+                                             None)
+                        shape = getattr(backend, "last_shape", None)
+                        waste = getattr(backend, "last_padding_waste",
+                                        None)
         except Exception as e:
             meter.add("odigos_anomaly_engine_errors_total")
             if self.failover is not None:
@@ -1333,7 +1388,7 @@ class ScoringEngine:
             t_pack0=t0, t_dispatch=t1,
             overlap_ms=(t1 - t0) / 1e6 if overlapped else 0.0,
             bucket_hit=bucket_hit, shape=shape, padding_waste=waste,
-            lease=lease, backend=backend, probe=probe)
+            lease=lease, backend=backend, probe=probe, fused=fused)
 
     def _retire(self, grp: _InflightGroup) -> None:
         """Harvest stage: block on the oldest in-flight device call, split
@@ -1387,7 +1442,8 @@ class ScoringEngine:
             # boundaries diffed (selftelemetry/latency.StageClock)
             stage_ns = {"pack0": grp.t_pack0, "dispatch": grp.t_dispatch,
                         "harvest0": t_h0, "end": time.monotonic_ns(),
-                        "overlap_ms": grp.overlap_ms}
+                        "overlap_ms": grp.overlap_ms,
+                        "fused": grp.fused}
             for r in grp.reqs:
                 r.stage_ns = stage_ns
         try:

@@ -94,6 +94,7 @@ from ..selftelemetry.latency import (
     PREDICTED_BLAME, RECENT_WINDOW, Stage, claim_clock, latency_ledger)
 from ..utils.telemetry import labeled_key, meter
 from .engine import PASSTHROUGH_METRIC, ScoringEngine
+from .fused import FALLBACK_REASONS, extract_columns, fused_enabled
 from .lanes import SHUTDOWN_BACKSTOP_S, OrderedGate, RetirementLanes
 
 SCORE_ATTR = "odigos.anomaly.score"
@@ -105,6 +106,12 @@ SATURATED_METRIC = "odigos_fastpath_saturated_total"
 FORWARD_ERRORS_METRIC = "odigos_fastpath_forward_errors_total"
 SUBMIT_ERRORS_METRIC = "odigos_fastpath_submit_errors_total"
 PREDICTED_SHED_METRIC = "odigos_fastpath_predicted_shed_total"
+# fused route (ISSUE 19): frames scored through the device-side
+# featurize→pack→score call, and frames the route was armed for but
+# that fell back to the host path (labeled with the closed reason set
+# serving/fused.py:FALLBACK_REASONS)
+FUSED_FRAMES_METRIC = "odigos_fastpath_fused_frames_total"
+FUSED_FALLBACK_METRIC = "odigos_fastpath_fused_fallback_total"
 
 DEFAULT_LANES = 4
 
@@ -117,8 +124,8 @@ DEFAULT_LANES = 4
 # experiencing, and adding the wait means on top double-counts it —
 # measured as shedding deliverable traffic well below the deadline
 PREDICT_STAGES = (Stage.FEATURIZE.value, Stage.ENQUEUE.value,
-                  Stage.PACK.value, Stage.DEVICE.value,
-                  Stage.HARVEST.value)
+                  Stage.PACK.value, Stage.FUSED.value,
+                  Stage.DEVICE.value, Stage.HARVEST.value)
 # stage-cost recompute throttle: the burn table moves at EWMA speed,
 # the admission decision happens per frame — pricing reads a cached sum
 PREDICT_REFRESH_NS = 100_000_000
@@ -217,6 +224,13 @@ class IngestFastPath:
                        (default true; the steady state then allocates
                        nothing per frame). Also globally killable via
                        ODIGOS_POOL=0
+    fused:             score raw span columns device-side (ISSUE 19):
+                       the submit lane skips host featurize entirely
+                       and the engine runs featurize→pack→score as ONE
+                       jitted call. Opt-in (default false); per-frame
+                       kill switch ODIGOS_FUSED=0; any frame the
+                       kernel doesn't cover silently takes the host
+                       route with the fallback reason counted
 
     Duck-types the Component lifecycle (name/start/shutdown/health) so
     the graph can manage it, without importing components.api (see the
@@ -231,7 +245,8 @@ class IngestFastPath:
     # (pipeline/configdiff.py classifies from this table).
     RECONFIGURABLE_KEYS = frozenset({
         "deadline_ms", "max_pending_spans", "drain_timeout_s",
-        "predictive", "predictive_margin", "predictive_min_frames"})
+        "predictive", "predictive_margin", "predictive_min_frames",
+        "fused"})
 
     def _apply_tuning(self, config: dict[str, Any]) -> None:
         """The reconfigurable-knob parse, shared by ``__init__`` and
@@ -252,6 +267,10 @@ class IngestFastPath:
         self.predictive_min_frames = min(
             int(config.get("predictive_min_frames", 32)),
             RECENT_WINDOW)
+        # fused route (ISSUE 19): reconfigurable so flipping it is a
+        # millisecond patch, not a teardown — the submit lanes read it
+        # per frame, so in-flight frames keep the route they entered on
+        self.fused = bool(config.get("fused", False))
         # re-price promptly: a new deadline/margin changes what the
         # cached burn sum is compared against
         self._stage_cost_next_ns = 0
@@ -339,6 +358,19 @@ class IngestFastPath:
                                               pipeline=pipeline)
         self._predicted_key = labeled_key(PREDICTED_SHED_METRIC,
                                           pipeline=pipeline)
+        # fused route (ISSUE 19): capability is a property of the
+        # PRIMARY backend (failover's CPU fallback converts columns
+        # host-side in the engine's pack stage); keys precomputed —
+        # the closed reason set makes the fallback counter's label
+        # space enumerable at build time
+        self._fused_capable = bool(getattr(engine.backend,
+                                           "supports_fused", False))
+        self._fused_frames_key = labeled_key(FUSED_FRAMES_METRIC,
+                                             pipeline=pipeline)
+        self._fused_fallback_keys = {
+            r: labeled_key(FUSED_FALLBACK_METRIC, pipeline=pipeline,
+                           reason=r)
+            for r in FALLBACK_REASONS}
 
     # ------------------------------------------------------------ intake
     def consume(self, batch: SpanBatch) -> None:
@@ -502,6 +534,29 @@ class IngestFastPath:
         self._stage_cost_ms = sum(
             means.get(s, 0.0) for s in PREDICT_STAGES)
 
+    # ------------------------------------------------------- fused route
+    def _fused_columns(self, frame: _Frame) -> Any:
+        """The fused route's per-frame gate: the frame's SpanColumns
+        view when the route is armed AND covers it, else None — with
+        the fallback reason counted, so a mixed fused/fallback storm
+        is fully attributable. The knob (``fused``) and the kill
+        switch (``ODIGOS_FUSED``) are both read here, per frame: the
+        operator's flip takes effect on the very next frame, and
+        in-flight frames keep the route they entered on."""
+        if not self.fused:
+            return None  # route not armed: the host path is not a fallback
+        if not fused_enabled():
+            reason = "disabled"
+        elif not self._fused_capable:
+            reason = "backend"
+        else:
+            cols, reason = extract_columns(frame.batch, self._feat_cfg)
+            if cols is not None:
+                meter.add(self._fused_frames_key)
+                return cols
+        meter.add(self._fused_fallback_keys[reason])
+        return None
+
     # ------------------------------------------------------- submit lane
     def _submit_run(self, stop: threading.Event, lane: int = 0) -> None:
         """Featurize + engine submit, off the receiver threads (ISSUE 9:
@@ -549,6 +604,11 @@ class IngestFastPath:
             # featurize would let frames sit unbounded in _submit_q
             # and still "meet" their deadline
             deadline = frame.t_in_ns + self._deadline_ns
+            # fused route (ISSUE 19): when armed and the kernel covers
+            # this frame, hand the engine the raw column views and skip
+            # host featurize entirely — the frame's featurize/pack wall
+            # collapses into the engine's single FUSED stage
+            cols = self._fused_columns(frame)
             # featurize into this lane's buffer pool (ISSUE 12): the
             # lease holds the frame's feature tensors, refcounted TWICE
             # when an engine request exists — this lane releases its
@@ -559,24 +619,26 @@ class IngestFastPath:
             # while the scores are still in flight — the lifetime that
             # makes steady-state misses actually reach zero.
             lease = None
-            if pool is not None and self._needs_features \
-                    and pools_enabled():
+            if cols is None and pool is not None \
+                    and self._needs_features and pools_enabled():
                 lease = pool.lease()
             retained = False
             try:
                 feats = None
-                if self._needs_features:
-                    # lease_scope(None) is an explicit plain-numpy
-                    # scope, so one call site covers pooled and not
-                    with lease_scope(lease):
-                        feats = featurize(frame.batch, self._feat_cfg)
-                clock.stamp(Stage.FEATURIZE)
-                if lease is not None:
-                    # the engine's reference, taken BEFORE submit: the
-                    # worker can consume the request (and fire the
-                    # hook) before submit even returns
-                    lease.retain()
-                    retained = True
+                if cols is None:
+                    if self._needs_features:
+                        # lease_scope(None) is an explicit plain-numpy
+                        # scope, so one call site covers pooled and not
+                        with lease_scope(lease):
+                            feats = featurize(frame.batch,
+                                              self._feat_cfg)
+                    clock.stamp(Stage.FEATURIZE)
+                    if lease is not None:
+                        # the engine's reference, taken BEFORE submit:
+                        # the worker can consume the request (and fire
+                        # the hook) before submit even returns
+                        lease.retain()
+                        retained = True
                 # req None = engine queue full / draining: the engine
                 # already counted the shed request; the frame still
                 # forwards unscored (lossless pass-through, exactly the
@@ -587,7 +649,8 @@ class IngestFastPath:
                     frame.batch, feats, deadline_ns=deadline,
                     on_done=lambda r, f=frame: self._completed(f, r),
                     on_features_consumed=lease.release
-                    if lease is not None else None)
+                    if lease is not None else None,
+                    columns=cols)
                 if req is None and lease is not None:
                     # no request was enqueued: the engine will never
                     # fire the features-consumed hook

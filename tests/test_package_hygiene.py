@@ -430,9 +430,13 @@ class TestSteadyStateAllocHygiene:
     """
 
     MODULES = ("features/featurizer.py", "features/bufferpool.py",
-               "serving/fastpath.py", "serving/lanes.py")
+               "serving/fastpath.py", "serving/lanes.py",
+               "serving/fused.py")
     ALLOC_FNS = {"zeros", "empty", "full"}
     ALLOWLIST = {
+        ("serving/fused.py", "_device_tables"):
+            "value-keyed LRU memo of padded device hash tables — a "
+            "setup path that outlives any frame, like _hash_table",
         ("features/featurizer.py", "_hash_table"):
             "value-keyed LRU memo: the frozen table outlives any frame",
         ("features/featurizer.py", "_attr_slot_matrix"):
@@ -544,10 +548,15 @@ class TestLatencyStageHygiene:
         return sites
 
     def test_every_stage_member_stamped_exactly_once(self):
-        from odigos_tpu.selftelemetry.latency import ENGINE_STAGES, Stage
+        from odigos_tpu.selftelemetry.latency import (
+            ENGINE_STAGES, ENGINE_STAGES_FUSED, Stage)
 
         sites = self._stamp_sites()
-        for s in ENGINE_STAGES:
+        # the engine's merged boundary dict counts as ONE site per
+        # member whichever taxonomy (host or fused) stamps it — the two
+        # tuples share QUEUE/DEVICE/HARVEST and are mutually exclusive
+        # per frame, so the union credits each member once
+        for s in set(ENGINE_STAGES) | set(ENGINE_STAGES_FUSED):
             sites.setdefault(s.name, []).append(
                 "selftelemetry/latency.py:ENGINE_STAGES")
         problems = []
@@ -566,11 +575,15 @@ class TestLatencyStageHygiene:
 
     def test_stage_taxonomy_is_closed_and_labeled(self):
         """Stage values are the metric label vocabulary: lowercase,
-        label-safe, and unique (the closed-taxonomy contract)."""
-        from odigos_tpu.selftelemetry.latency import STAGES, Stage
+        label-safe, and unique (the closed-taxonomy contract). STAGES
+        stays the host-route traversal; ALL_STAGES is the vocabulary
+        (the fused route swaps featurize+pack for one `fused` stage)."""
+        from odigos_tpu.selftelemetry.latency import (ALL_STAGES, STAGES,
+                                                      Stage)
 
-        assert len(STAGES) == len(set(STAGES)) == len(list(Stage))
-        for v in STAGES:
+        assert len(ALL_STAGES) == len(set(ALL_STAGES)) == len(list(Stage))
+        assert set(ALL_STAGES) - set(STAGES) == {Stage.FUSED.value}
+        for v in ALL_STAGES:
             assert re.fullmatch(r"[a-z_]+", v), v
 
 
@@ -790,6 +803,27 @@ class TestActuatorKnobHygiene:
                      "odigos_actuator_refusals_total",
                      "odigos_actuator_state"):
             assert name in registry, name
+
+    def test_fused_route_metric_names_registered(self):
+        """The fused-route counters (ISSUE 19 satellite) must resolve
+        against the registered name registry, match the constants the
+        fast path actually exports, and the fallback-reason vocabulary
+        must stay a closed, label-safe set — a renamed constant or a
+        free-form reason string would mint unregistered series."""
+        from odigos_tpu.serving.fused import FALLBACK_REASONS
+
+        registry = TestFleetRuleHygiene._registered_metric_names()
+        for name in ("odigos_fastpath_fused_frames_total",
+                     "odigos_fastpath_fused_fallback_total"):
+            assert name in registry, name
+        from odigos_tpu.serving.fastpath import (
+            FUSED_FALLBACK_METRIC, FUSED_FRAMES_METRIC)
+        assert FUSED_FRAMES_METRIC == "odigos_fastpath_fused_frames_total"
+        assert FUSED_FALLBACK_METRIC == \
+            "odigos_fastpath_fused_fallback_total"
+        assert len(FALLBACK_REASONS) == len(set(FALLBACK_REASONS))
+        for reason in FALLBACK_REASONS:
+            assert re.fullmatch(r"[a-z_]+", reason), reason
 
     def test_soak_actuate_rules_resolve(self):
         """The --actuate soak's rule/alert tables reference real
@@ -1018,7 +1052,7 @@ class TestReconfigureHygiene:
         validated = {"deadline_ms", "max_pending_spans", "lanes",
                      "submit_lanes", "ordered", "drain_timeout_s",
                      "name", "predictive", "predictive_margin",
-                     "predictive_min_frames", "pooled"}
+                     "predictive_min_frames", "pooled", "fused"}
         assert IngestFastPath.RECONFIGURABLE_KEYS <= validated
 
 

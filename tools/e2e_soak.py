@@ -202,6 +202,13 @@ def run_soak(args, fast_path: bool) -> dict:
             "submit_lanes": args.submit_lanes or args.lanes,
             "ordered": bool(args.ordered),
             "predictive": not args.no_predictive}
+        if args.fused:
+            # fused device-side featurize→pack→score (ISSUE 19): submit
+            # lanes hand the engine raw column views and ONE jitted call
+            # does hashing/join/assembly/pack/forward — covered frames
+            # skip host featurize entirely; every uncovered frame takes
+            # the host route with its reason counted
+            pipeline_in["fast_path"]["fused"] = True
         # declarative SLO (ISSUE 8): evaluated live during the soak with
         # fast/slow-window burn rates; the verdict lands in SOAK.json so
         # every soak run is self-judging, not just self-attributing.
@@ -357,6 +364,38 @@ def run_soak(args, fast_path: bool) -> dict:
             ("traces/in", "tpuanomaly")].engine
     engine.score_sync(synthesize_traces(args.traces_per_batch, seed=999),
                       timeout_s=30.0)
+
+    # ---- fused parity gate (ISSUE 19): before the timed window, the
+    # LIVE engine's backend must score a sample frame identically on
+    # both routes (within the documented f32 duration bound,
+    # tests/test_fused.py) — a soak that silently soaked a divergent
+    # kernel would certify garbage. The verdict gates the exit code.
+    fused_parity = None
+    if args.fused:
+        import numpy as np
+
+        from odigos_tpu.features import featurize
+        from odigos_tpu.serving.fused import extract_columns, fused_enabled
+
+        if not fused_enabled():
+            raise RuntimeError(
+                "--fused armed but ODIGOS_FUSED=0 in the environment")
+        backend = engine.backend
+        if not getattr(backend, "supports_fused", False):
+            raise RuntimeError(
+                "--fused armed but the engine backend has no fused kernel")
+        pb = synthesize_traces(args.traces_per_batch, seed=998)
+        want = backend.score(pb, featurize(pb, engine.cfg.featurizer))
+        cols, reason = extract_columns(pb, engine.cfg.featurizer)
+        if cols is None:
+            raise RuntimeError(f"fused parity frame not coverable: {reason}")
+        got = backend.harvest(backend.dispatch_columns([cols]))
+        fused_parity = {
+            "spans": len(pb),
+            "max_abs_diff": round(float(np.max(np.abs(got - want))), 8),
+            "rtol_bound": 2e-5,
+            "passed": bool(np.allclose(got, want, rtol=2e-5, atol=1e-5)),
+        }
 
     # pre-synthesize a few distinct batches per sender (generation must not
     # rate-limit the wire); a quarter carry injected faults so the anomaly
@@ -721,6 +760,42 @@ def run_soak(args, fast_path: bool) -> dict:
                     sum(jitstats.cache_sizes().values()) - compiles0),
             })
 
+    # ---- fused kill-switch slice (ISSUE 19): ODIGOS_FUSED=0 flipped
+    # MID-WINDOW at 40% of the run and restored at 60% — the env var is
+    # read per frame, so the flip lands on the very next frame with no
+    # reload. The slice proves the big red button live: every frame in
+    # it falls back to the bit-identical host route (reason=disabled),
+    # nothing is lost, and fused dispatch resumes on restore. Counter
+    # snapshots at both boundaries are the evidence.
+    fused_events: list = []
+
+    def _fused_counters() -> dict:
+        from odigos_tpu.serving.fastpath import (FUSED_FALLBACK_METRIC,
+                                                 FUSED_FRAMES_METRIC)
+
+        return {
+            "fused_frames_total": int(meter.counter(labeled_key(
+                FUSED_FRAMES_METRIC, pipeline="traces/in"))),
+            "disabled_fallbacks_total": int(meter.counter(labeled_key(
+                FUSED_FALLBACK_METRIC, pipeline="traces/in",
+                reason="disabled"))),
+        }
+
+    def fused_kill_schedule() -> None:
+        T = args.seconds
+        for at_s, action in ((0.40 * T, "kill"), (0.60 * T, "restore")):
+            delay = at_s - (time.perf_counter() - t0)
+            if delay > 0 and stop.wait(delay):
+                return
+            if action == "kill":
+                os.environ["ODIGOS_FUSED"] = "0"
+            else:
+                os.environ.pop("ODIGOS_FUSED", None)
+            fused_events.append({
+                "event": f"kill_switch_{action}",
+                "t_s": round(time.perf_counter() - t0, 3),
+                **_fused_counters()})
+
     threads = [threading.Thread(target=sender, args=(i,), daemon=True)
                for i in range(args.senders)]
     probe_thread = threading.Thread(target=prober, daemon=True)
@@ -743,6 +818,11 @@ def run_soak(args, fast_path: bool) -> dict:
         overload_thread = threading.Thread(target=overload_schedule,
                                            daemon=True)
         overload_thread.start()
+    fused_thread = None
+    if args.fused and fast_path:
+        fused_thread = threading.Thread(target=fused_kill_schedule,
+                                        daemon=True)
+        fused_thread.start()
     # fleet publish/evaluate cadence (ISSUE 10): the soak's main wait
     # doubles as the plane timer — each tick delta-publishes the
     # collector's snapshot + rollup under {collector=} and advances the
@@ -776,6 +856,11 @@ def run_soak(args, fast_path: bool) -> dict:
         storm_thread.join(timeout=60)
     if overload_thread is not None:
         overload_thread.join(timeout=10)
+    if fused_thread is not None:
+        fused_thread.join(timeout=10)
+        # never leak the kill switch past the run (a --ab / --find-knee
+        # follow-up soak in this process must start with fused armed)
+        os.environ.pop("ODIGOS_FUSED", None)
     if chaos_thread is not None:
         chaos_thread.join(timeout=10)
         # belt and braces: the schedule clears its own faults, but a
@@ -905,6 +990,70 @@ def run_soak(args, fast_path: bool) -> dict:
              + (engine_pool["misses"] if engine_pool else 0))
             / pool_agg["leases"], 4) \
             if pool_agg["leases"] else None
+
+    # fused-route evidence (ISSUE 19), read BEFORE shutdown: frames
+    # fused vs fallback (per named reason), the pre-window parity-gate
+    # verdict, the kill-switch slice timeline with its two acceptance
+    # verdicts (the slice actually fell back; fused dispatch resumed
+    # after restore), and the per-frame host wall delta the run itself
+    # measured — the fused stage's mean against featurize+pack from the
+    # host-route frames (the kill slice and fallbacks supply them)
+    fused_summary = None
+    if args.fused and fast_path:
+        from odigos_tpu.serving.fastpath import FUSED_FALLBACK_METRIC
+        from odigos_tpu.serving.fused import FALLBACK_REASONS
+
+        counters = _fused_counters()
+        fallbacks = {}
+        for reason in FALLBACK_REASONS:
+            v = int(meter.counter(labeled_key(
+                FUSED_FALLBACK_METRIC, pipeline="traces/in",
+                reason=reason)))
+            if v:
+                fallbacks[reason] = v
+        wf_in = latency_ledger.recorder("traces/in").waterfall()
+
+        # p50, not mean: a fresh coalesce shape pays its XLA compile
+        # INSIDE the fused stage stamp mid-run (the host ladder warmed
+        # at start), and on a shared box 2-3 compile outliers decide
+        # the mean — the median is the steady-state frame both claims
+        # are about
+        def _p50(stage):
+            return (wf_in.get(stage, {}) or {}).get("p50_ms")
+
+        host_ms = None
+        if _p50("featurize") is not None:
+            host_ms = round((_p50("featurize") or 0.0)
+                            + (_p50("pack") or 0.0), 3)
+        fused_ms = _p50("fused")
+        ev = {e["event"]: e for e in fused_events}
+        kill, restore = (ev.get("kill_switch_kill"),
+                         ev.get("kill_switch_restore"))
+        fused_summary = {
+            "frames_fused": counters["fused_frames_total"],
+            "frames_fallback": fallbacks,
+            "parity_gate": fused_parity,
+            "kill_switch": fused_events,
+            # the slice's frames all fell back, counted as disabled
+            "kill_switch_fell_back": bool(
+                kill and restore
+                and restore["disabled_fallbacks_total"]
+                > kill["disabled_fallbacks_total"]),
+            # and the route came back after restore
+            "resumed_after_restore": bool(
+                restore and counters["fused_frames_total"]
+                > restore["fused_frames_total"]),
+            # per-frame HOST wall, from this run's own waterfall: the
+            # fused stage (column staging -> device enqueue) vs the host
+            # frames' featurize+pack, median frame each
+            "host_stage_p50_ms": host_ms,
+            "fused_stage_p50_ms": fused_ms,
+            "host_wall_delta_p50_ms": (round(host_ms - fused_ms, 3)
+                                       if host_ms is not None
+                                       and fused_ms is not None
+                                       else None),
+            "conservation": bool(conserved),
+        }
 
     # chaos evidence (ISSUE 13), read BEFORE shutdown: the injected
     # fault timeline, the breaker's transitions, the retry queues'
@@ -1144,6 +1293,9 @@ def run_soak(args, fast_path: bool) -> dict:
         # reload modes (must ALL be incremental), and the SLO burn's
         # rise-and-recovery trace
         "actuator": actuator_summary,
+        # fused-route evidence (ISSUE 19): frames fused vs fallback,
+        # parity-gate verdict, kill-switch slice, host wall delta
+        "fused": fused_summary,
         "latency_note": ("probe batches ride the same wire/pipeline as "
                          "the load; p* = send-to-export wall time under "
                          f"full multi-sender soak load, CPU {args.model} "
@@ -1307,6 +1459,22 @@ def main() -> None:
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="seed for the chaos run's randomized draws "
                          "(retry jitter) — same seed, same schedule")
+    ap.add_argument("--fused", action="store_true",
+                    help="arm the fused device-side featurize→pack→"
+                         "score route (ISSUE 19) on the fast path: "
+                         "covered frames skip host featurize entirely "
+                         "(one jitted call per coalesced group), every "
+                         "uncovered frame takes the host route with "
+                         "its reason counted. Runs a pre-window parity "
+                         "gate on the live backend and flips the "
+                         "ODIGOS_FUSED=0 kill switch for the 40-60%% "
+                         "slice of the window; SOAK.json gains a "
+                         "'fused' section (frames fused vs fallback, "
+                         "host wall delta, kill-switch evidence) and "
+                         "the run exits non-zero on a parity trip, a "
+                         "never-fused run, or a kill slice that did "
+                         "not fall back. Requires --model transformer "
+                         "(zscore has no fused kernel)")
     ap.add_argument("--model", default="zscore",
                     choices=["zscore", "transformer"],
                     help="scoring backend for the soak route")
@@ -1330,6 +1498,17 @@ def main() -> None:
         # mesh — a SOAK.json claiming a mesh that never ran is worse
         # than refusing
         ap.error("--mesh requires --model transformer")
+    if args.fused and args.model != "transformer":
+        # the zscore backend has no fused kernel: every frame would
+        # count a backend fallback and the record would claim a route
+        # that never ran
+        ap.error("--fused requires --model transformer")
+    if args.fused and args.no_fast_path:
+        ap.error("--fused arms a fast-path route; drop --no-fast-path")
+    if args.fused and args.mesh:
+        # the mesh partition plan keeps its own sharded call graph —
+        # supports_fused is False and the soak would soak the fallback
+        ap.error("--fused requires a single-device engine (no --mesh)")
 
     knee = None
     knee_sweep = []
@@ -1460,6 +1639,24 @@ def main() -> None:
                   f"{act['all_reloads_incremental']} burned="
                   f"{act['slo_burned_under_overload']} recovered="
                   f"{act['slo_recovered']}", file=sys.stderr)
+            sys.exit(1)
+    if args.fused:
+        fu = result["fused"]
+        ok = (fu["parity_gate"]["passed"]
+              and fu["frames_fused"] > 0
+              and fu["kill_switch_fell_back"]
+              and fu["resumed_after_restore"])
+        if not ok:
+            # the acceptance verdict: the live backend passed the
+            # parity gate, frames actually rode the fused route, the
+            # mid-window kill switch fell back per frame (counted as
+            # reason=disabled, nothing lost — conservation gated
+            # above), and fused dispatch resumed after restore
+            print(f"FUSED: route verdict failed — parity="
+                  f"{fu['parity_gate']} fused_frames="
+                  f"{fu['frames_fused']} kill_fell_back="
+                  f"{fu['kill_switch_fell_back']} resumed="
+                  f"{fu['resumed_after_restore']}", file=sys.stderr)
             sys.exit(1)
     if args.reload_storm and not (
             result["reload_storm"]["count"] == args.reload_storm
