@@ -240,6 +240,13 @@ def main() -> None:
     print(json.dumps(result), flush=True)
 
     try:
+        result.update(device_attribution_overhead_bench())
+    except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
+        log(f"device attribution bench failed: {type(e).__name__}: {e}")
+        result["device_attrib_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result), flush=True)
+
+    try:
         result.update(forwarder_lanes_bench())
     except Exception as e:  # noqa: BLE001 — degrade, don't zero the run
         log(f"forwarder lanes bench failed: {type(e).__name__}: {e}")
@@ -1033,6 +1040,101 @@ def fused_path_bench() -> dict:
         f"host vs {out['fused_path_host_wall_ms_fused']} fused "
         f"({out['fused_path_host_wall_ratio']}x), "
         f"{out.get('fused_path_allocs_per_frame')} allocs/frame fused")
+    return out
+
+
+def device_attribution_overhead_bench() -> dict:
+    """Sampled intra-fused attribution A/B (ISSUE 20): per-frame host
+    wall of ``dispatch_columns`` on the warmed SOAK-geometry fused
+    transformer route with the 1-in-32 sampler armed vs disarmed,
+    PAIRED interleaved on the same warmed backend (the identical frame
+    dispatched in both modes back to back, within-pair order
+    alternating). The p50 of the paired ratios is the bound the tier-1
+    guard enforces (<2%): 31 of 32 armed frames pay only the ordinal
+    tick and a None check, and the median pair cannot be the sampled
+    one. The sampled frame's own cost (a blocking fused stamp plus five
+    sub-stage replays) is reported separately — it is the price of the
+    waterfall, deliberately not hidden inside the median."""
+    import jax.numpy as jnp
+
+    from odigos_tpu.models import TransformerConfig
+    from odigos_tpu.pdata import synthesize_traces
+    from odigos_tpu.serving.engine import EngineConfig, ScoringEngine
+    from odigos_tpu.serving.fused import extract_columns
+
+    soak_tf = TransformerConfig(d_model=64, n_layers=2, d_ff=256,
+                                n_heads=4, max_len=32, dtype=jnp.float32)
+    cfg = EngineConfig(model="transformer", model_config=soak_tf,
+                       max_len=32, trace_bucket=64,
+                       device_attribution=True,
+                       device_attribution_stride=32)
+    eng = ScoringEngine(cfg)  # unstarted: direct backend A/B
+    backend = eng.backend
+    attrib = backend._attrib
+    fcfg = eng.cfg.featurizer
+    N_VARIANTS = 4
+    batches = [synthesize_traces(256, seed=70 + v)
+               for v in range(N_VARIANTS)]
+    col_sets = []
+    for b in batches:
+        cols, reason = extract_columns(b, fcfg)
+        if cols is None:
+            raise RuntimeError(f"bench frame not fused-coverable: {reason}")
+        col_sets.append([cols])
+
+    # warm the fused jit, the sub-stage jits, and the sampler grid:
+    # drive sampled ticks until a full waterfall published (the first
+    # sampled tick per bucket is the warmup compile pass, discarded by
+    # design — the measured window must contain only warm samples)
+    for i in range(4 * attrib.stride):
+        backend.harvest(backend.dispatch_columns(col_sets[i % N_VARIANTS]))
+        if attrib.sampled >= 1:
+            break
+    if attrib.sampled < 1:
+        raise RuntimeError(f"sampler never published: {attrib.stats()}")
+
+    wall = {"on": [], "off": []}
+    ratios = []
+    sampled0 = attrib.sampled
+    for i in range(2 * attrib.stride):  # two full stride grids
+        cols = col_sets[i % N_VARIANTS]
+        t = {}
+        modes = ("on", "off") if i % 2 else ("off", "on")
+        for mode in modes:
+            backend._attrib = attrib if mode == "on" else None
+            t0 = time.perf_counter()
+            h = backend.dispatch_columns(cols)
+            t[mode] = time.perf_counter() - t0
+            backend.harvest(h)  # block OUTSIDE the timer
+        wall["on"].append(t["on"])
+        wall["off"].append(t["off"])
+        ratios.append(t["on"] / max(t["off"], 1e-9))
+    backend._attrib = attrib
+    ratios.sort()
+    p50 = {m: sorted(ws)[len(ws) // 2] for m, ws in wall.items()}
+    wf = attrib.last_waterfall or {}
+    out = {
+        "device_attrib_overhead_ratio_p50": round(
+            ratios[len(ratios) // 2], 4),
+        "device_attrib_host_wall_ms_p50_on": round(p50["on"] * 1e3, 4),
+        "device_attrib_host_wall_ms_p50_off": round(p50["off"] * 1e3, 4),
+        "device_attrib_sampled_frames": attrib.sampled - sampled0,
+        "device_attrib_sampled_frame_ms": wf.get("total_ms"),
+        "device_attrib_reconcile_ratio": wf.get("reconcile_ratio"),
+        "device_attrib_note": (
+            "paired armed/disarmed dispatch_columns host wall on one "
+            "warmed SOAK-geometry fused backend, stride 32, within-pair "
+            "order alternating; overhead_ratio_p50 = median paired "
+            "ratio (the tier-1 guard bound, <1.02). sampled_frame_ms is "
+            "the 1-in-32 sampled frame's own sub-stage replay cost — "
+            "amortized, not median, by construction"),
+    }
+    log(f"device_attrib: ratio_p50="
+        f"{out['device_attrib_overhead_ratio_p50']} "
+        f"({out['device_attrib_host_wall_ms_p50_on']} ms on vs "
+        f"{out['device_attrib_host_wall_ms_p50_off']} off), "
+        f"{out['device_attrib_sampled_frames']} sampled @ "
+        f"{out['device_attrib_sampled_frame_ms']} ms")
     return out
 
 

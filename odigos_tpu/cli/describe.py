@@ -282,6 +282,45 @@ def describe_install(state: CliState) -> str:
                 f"    [{it['id']}] {it['trigger']}"
                 + (f" rule={it['rule']}" if it.get("rule") else "")
                 + f" ({state_mark}): {it['detail']}")
+    # device plane (ISSUE 20): sampled intra-fused attribution, the XLA
+    # cost/efficiency ledger, and compile events — silent until a fused
+    # engine armed attribution or a cost row was captured
+    from ..selftelemetry.profiler import device_snapshot
+
+    dev = device_snapshot()
+    if dev["attribution"] or dev["cost"]["rows"] or dev["compiles"]:
+        for ab in dev["attribution"]:
+            wf = ab.get("last_waterfall")
+            lines.append(
+                f"  device attribution[{ab['site']}]: 1-in-{ab['stride']}"
+                f" ({'armed' if ab['enabled'] else 'killed'}), "
+                f"{ab['sampled']} sampled, "
+                f"{sum(ab['skipped'].values())} skipped")
+            if wf:
+                stages = ", ".join(f"{s}={ms:.2f}ms"
+                                   for s, ms in wf["stages"].items())
+                lines.append(
+                    f"    last waterfall [{wf['bucket']}]: {stages} "
+                    f"(fused stamp {wf['fused_device_ms']:.2f}ms, "
+                    f"reconcile {wf['reconcile_ratio']})")
+        rows = dev["cost"]["rows"]
+        if rows:
+            lines.append(f"  xla cost ledger: {len(rows)} row(s)")
+            for r in rows[:5]:
+                eff = (f", efficiency={r['efficiency']:.3f}"
+                       if r.get("efficiency") is not None else "")
+                waste = (f", waste={r['flop_waste_frac']:.3f}"
+                         if r.get("flop_waste_frac") is not None else "")
+                lines.append(
+                    f"    {r['site']} [{r['bucket']}]: "
+                    f"flops={r['flops']:.3g} "
+                    f"bytes={r['bytes_accessed']:.3g}{waste}{eff}")
+        if dev["compiles"]:
+            unplanned = sum(1 for ev in dev["compiles"]
+                            if not ev["warm"])
+            lines.append(
+                f"  compile events: {len(dev['compiles'])} ringed "
+                f"({unplanned} unplanned)")
     ics = state.store.list("InstrumentationConfig")
     lines.append(f"  instrumented workloads: {len(ics)}")
     for ic in ics:

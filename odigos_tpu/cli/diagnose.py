@@ -166,6 +166,15 @@ def collect_bundle(state: CliState, out_path: Optional[str] = None,
         add("device_runtime.json",
             json.dumps(DeviceRuntimeCollector().collect_once(
                 publish=False), indent=1, sort_keys=True))
+        # device plane (ISSUE 20): the XLA cost/efficiency ledger,
+        # sampled intra-fused attribution state per engine, recent
+        # compile events, and the device-resident table footprint —
+        # "what should the device be doing and what is it actually
+        # doing", frozen at bundle time
+        from ..selftelemetry.profiler import device_snapshot
+
+        add("device.json", json.dumps(device_snapshot(),
+                                      indent=1, sort_keys=True))
         # continuous profiler (ISSUE 3): ring metadata + the merged
         # folded profile — where CPU time went over the retained windows.
         # With the profiler off (the default) a brief on-demand sample

@@ -43,6 +43,13 @@ serves JSON (terminal-first operators curl it):
                            full frozen bundle (event lookback + tail,
                            series excerpt, worst-frame trace
                            exemplars, config hash, conditions)
+* ``/debug/xlaz``        — the device plane (ISSUE 20): the XLA cost/
+                           efficiency ledger (expected FLOPs/bytes,
+                           flop-waste, achieved efficiency per jit
+                           site × shape bucket), recent compile events
+                           with trace ids, the sampled intra-fused
+                           attribution waterfall per engine, and the
+                           device-resident table/plan footprint
 
 Debug-only: binds loopback. Config: ``endpoint``/``host``/``port``.
 """
@@ -172,6 +179,11 @@ class ZPagesExtension(HttpExtension):
         out["recent_events"] = flight_recorder.recent_events()
         return 200, out
 
+    def _xlaz(self, q: dict[str, str]) -> tuple[int, dict]:
+        from ...selftelemetry.profiler import device_snapshot
+
+        return 200, device_snapshot()
+
     def pages(self) -> dict[str, Page]:
         return {"/debug/pipelinez": self._pipelinez,
                 "/debug/servicez": self._servicez,
@@ -181,7 +193,8 @@ class ZPagesExtension(HttpExtension):
                 "/debug/latencyz": self._latencyz,
                 "/debug/fleetz": self._fleetz,
                 "/debug/actuatorz": self._actuatorz,
-                "/debug/incidentz": self._incidentz}
+                "/debug/incidentz": self._incidentz,
+                "/debug/xlaz": self._xlaz}
 
 
 register(Factory(

@@ -139,6 +139,11 @@ class TpuAnomalyProcessor(Processor):
             bucket_ladder=int(config.get("bucket_ladder", 4)),
             warm_ladder=bool(config.get("warm_ladder", False)),
             failover=config.get("failover"),
+            # ISSUE 20: sampled intra-fused attribution (fused route)
+            device_attribution=bool(config.get("device_attribution",
+                                               False)),
+            device_attribution_stride=int(
+                config.get("device_attribution_stride", 32)),
         )
         self.engine = _engine_for(self.engine_cfg,
                                   bool(config.get("shared_engine", True)))

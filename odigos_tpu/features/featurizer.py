@@ -362,16 +362,45 @@ def featurize_columns_jax(service_table, name_table, service, name, kind,
     Returns ``(categorical (N, 5) int32, continuous (N, 3) float32)``
     in CAT_FIELDS/CONT_FIELDS order; attr slots are not supported on
     this path (the fused route falls back when attr_slots > 0).
+
+    The body is three composable phases (`featurize_hash_jax`,
+    `featurize_join_jax`, `featurize_assemble_jax`) so the device
+    attribution sampler (serving/deviceattrib.py) can time each phase
+    as its own jitted sub-stage; composed under one jit they trace to
+    the identical jaxpr this function always produced.
     """
-    import jax
+    service_ids, name_ids, kind32, status32 = featurize_hash_jax(
+        service_table, name_table, service, name, kind, status_code)
+    found, parent_service = featurize_join_jax(
+        service_ids, span_id_hi, span_id_lo, parent_id_hi, parent_id_lo,
+        frame_id)
+    return featurize_assemble_jax(
+        service_ids, name_ids, kind32, status32, parent_service, found,
+        parent_id_hi, parent_id_lo, end_hi, end_lo, start_hi, start_lo)
+
+
+def featurize_hash_jax(service_table, name_table, service, name, kind,
+                       status_code):
+    """HASH phase: gather string ids through the device-resident hashed
+    tables and widen the raw enum columns. Pure jnp; the first third of
+    :func:`featurize_columns_jax`."""
     import jax.numpy as jnp
 
-    n = span_id_hi.shape[0]
     service_ids = service_table[service]
     name_ids = name_table[name]
     kind32 = kind.astype(jnp.int32)
     status32 = status_code.astype(jnp.int32)
+    return service_ids, name_ids, kind32, status32
 
+
+def featurize_join_jax(service_ids, span_id_hi, span_id_lo,
+                       parent_id_hi, parent_id_lo, frame_id):
+    """JOIN phase: the stable per-frame parent self-join. Returns the
+    ``(found, parent_service)`` pair the assemble phase consumes."""
+    import jax
+    import jax.numpy as jnp
+
+    n = span_id_hi.shape[0]
     # ---- parent self-join over the merged key stream: entries 0..N-1
     # declare span ids, N..2N-1 query parent ids; equal (frame, id) keys
     # become one run after the lexsort (frame primary => per-frame join)
@@ -399,6 +428,16 @@ def featurize_columns_jax(service_table, name_table, service, name, kind,
     found = parent_row_raw < n
     parent_row = jnp.minimum(parent_row_raw, n - 1)
     parent_service = jnp.where(found, service_ids[parent_row], 0)
+    return found, parent_service
+
+
+def featurize_assemble_jax(service_ids, name_ids, kind32, status32,
+                           parent_service, found, parent_id_hi,
+                           parent_id_lo, end_hi, end_lo, start_hi,
+                           start_lo):
+    """ASSEMBLE phase: stack the categorical block and build the
+    continuous block via split-clock borrow arithmetic."""
+    import jax.numpy as jnp
 
     categorical = jnp.stack(
         [service_ids, name_ids, kind32, status32, parent_service], axis=1)

@@ -17,6 +17,8 @@ Endpoints:
     GET  /api/pipeline                     (gateway topology graph)
     GET  /api/metrics                      (per-source/destination throughput)
     GET  /api/anomalies                    (flagged/scored counters + rates)
+    GET  /api/device                       (device plane: XLA cost ledger,
+                                            fused attribution, compile events)
     GET  /api/describe/workload?namespace=&kind=&name=
     GET  /api/events                       (SSE stream of store events)
     GET  /api/destination-types            (63-backend registry + schemas)
@@ -433,6 +435,15 @@ class _Handler(BaseHTTPRequestHandler):
                         c for c in active_conditions()
                         if c["component"].startswith("slo/")],
                 })
+            if path == "/api/device":
+                # the device plane (ISSUE 20): XLA cost/efficiency
+                # ledger, recent compile events, sampled intra-fused
+                # attribution per engine, and the device-resident
+                # table/plan footprint — the four containers are
+                # always present (empty until the subsystem arms)
+                from ..selftelemetry.profiler import device_snapshot
+
+                return self._json(device_snapshot())
             if path == "/api/metrics":
                 out = fe.metrics.throughput()
                 # the server process's own meter complements the stream

@@ -298,6 +298,20 @@ class ScoringPlan:
         return self._packed_jit(v, categorical, continuous, segments,
                                 positions)
 
+    def placed_bytes(self) -> int:
+        """Bytes held on device by the cached placed weight pytree (the
+        plan's staging footprint — 0 until ``place_variables`` ran).
+        Read by the DeviceRuntimeCollector's device-table gauges
+        (ISSUE 20): the fused route's resident footprint is tables +
+        whatever each live plan keeps placed."""
+        placed = self._cache.get("placed")
+        if placed is None:
+            return 0
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(placed):
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+        return total
+
     def score_spans(self, variables, categorical, continuous, mask):
         """Sequence-route scoring (autoencoder): params per rules, inputs
         on "data"; the model's own jit propagates the placements and XLA

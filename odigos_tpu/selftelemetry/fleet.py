@@ -449,6 +449,25 @@ RECOMMENDER_RULES: tuple[RecommendationRule, ...] = (
                 "unscored; raise fast_path.deadline_ms (bounded) or "
                 "add capacity"),
         severity="warning", direction="up", for_s=30.0),
+    # ISSUE 20: compile events are first-class incidents — unplanned
+    # (warm=false) XLA recompiles mid-steady-state are the silent
+    # latency cliff the device plane exists to catch. The cure is the
+    # same knob as ladder-hit-rate-low (widen the warmed bucket
+    # ladder so live shapes land on precompiled rungs), but the
+    # trigger is the compile EVENTS themselves: a storm pages even
+    # when the hit-rate average hasn't moved yet. Threshold sits well
+    # above the startup ramp's handful of cold-bucket compiles.
+    RecommendationRule(
+        name="compile-storm",
+        expr="rate(odigos_jit_compile_events_total{warm=false}[120s])"
+             " > 0.05",
+        knob="bucket_ladder",
+        action=("unplanned XLA recompiles at {value:.2f}/s — live "
+                "shapes are churning off the warmed ladder and paying "
+                "compiles mid-run; widen the bucket ladder (more "
+                "rungs / warm_ladder at start) and check /debug/xlaz "
+                "for the recompiling shapes"),
+        severity="critical", direction="up", for_s=60.0),
 )
 
 

@@ -72,6 +72,7 @@ TRIGGERS: dict[str, str] = {
     "conservation_leak": "flow conservation found a stable leak",
     "patch_fallback": "an incremental reload fell back to a rebuild",
     "chaos_injection": "a chaos injector faulted the system on purpose",
+    "compile_storm": "unplanned XLA recompiles burst inside one window",
 }
 
 # ------------------------------------------------------------- sizing

@@ -152,7 +152,8 @@ class StageClock:
     (receiver thread -> forwarder thread); the window queue is the
     synchronization, the clock itself is never shared concurrently."""
 
-    __slots__ = ("t0", "_mark", "stages", "ctx", "overlap_ms")
+    __slots__ = ("t0", "_mark", "stages", "ctx", "overlap_ms",
+                 "device_attrib", "fused_bucket")
 
     def __init__(self, ctx: Optional[tuple[int, int]] = None):
         self.t0 = self._mark = time.monotonic_ns()
@@ -160,6 +161,11 @@ class StageClock:
         self.stages: list[tuple[str, float]] = []
         self.ctx = ctx  # (trace_id, span_id) exemplar link
         self.overlap_ms = 0.0
+        # ISSUE 20 device-plane payloads, merged from the engine call:
+        # the sampled intra-fused waterfall (None on unsampled frames)
+        # and the fused shape bucket ("r{rows}x{len}") the frame ran in
+        self.device_attrib: Optional[dict] = None
+        self.fused_bucket: Optional[str] = None
 
     def stamp(self, stage: Stage) -> None:
         now = time.monotonic_ns()
@@ -191,6 +197,8 @@ class StageClock:
             mark = end
         self._mark = mark
         self.overlap_ms = float(info.get("overlap_ms") or 0.0)
+        self.device_attrib = info.get("device_attrib")
+        self.fused_bucket = info.get("fused_bucket")
 
     def wall_ms(self) -> float:
         return (self._mark - self.t0) / 1e6
@@ -213,6 +221,8 @@ class _NullClock:
     ctx = None
     overlap_ms = 0.0
     stages: list = []
+    device_attrib = None
+    fused_bucket = None
 
     def stamp(self, stage: Stage) -> None:
         pass
@@ -282,7 +292,10 @@ class _Recorder:
 
     __slots__ = ("pipeline", "deadline_ms", "frames", "scored_frames",
                  "overlap_ms_total", "_stage_keys", "_e2e_key", "_totals",
-                 "_expired", "recent", "_worst_blame", "_lock")
+                 "_expired", "recent", "_worst_blame", "_lock",
+                 "_device_stages", "_device_sampled",
+                 "_device_fused_ms_total", "_device_recent",
+                 "_worst_fused")
 
     def __init__(self, pipeline: str):
         self.pipeline = pipeline
@@ -301,6 +314,19 @@ class _Recorder:
         # EXPIRED frame per blame dimension that carried a self-trace
         # (incident bundles join these — a p99 spike names one frame)
         self._worst_blame: dict[str, tuple] = {}
+        # ISSUE 20 device burn table, nested under the FUSED stage:
+        # sub-stage -> [sum_ms, count] over sampled attribution frames,
+        # plus the fused stamps those samples decomposed and a short
+        # ring of raw waterfalls for /debug/latencyz
+        self._device_stages: dict[str, list[float]] = {}
+        self._device_sampled = 0
+        self._device_fused_ms_total = 0.0
+        self._device_recent: deque[dict] = deque(maxlen=8)
+        # (fused_stage_ms, trace_id, span_id, bucket, unix_ts): the
+        # worst fused-stage frame that carried a self-trace — the
+        # exemplar join's anchor (its bucket keys the compile-event
+        # ring and the cost ledger)
+        self._worst_fused: Optional[tuple] = None
         self._lock = threading.Lock()
 
     def observe(self, clock: StageClock, scored: bool) -> None:
@@ -323,18 +349,44 @@ class _Recorder:
             meter.record_many(samples, exemplar=stage_ex)
         else:
             meter.record(self._e2e_key, wall, exemplar=ex)
+        attrib = clock.device_attrib
+        bucket = clock.fused_bucket
         with self._lock:
             self.frames += 1
             if scored:
                 self.scored_frames += 1
                 self.overlap_ms_total += clock.overlap_ms
                 totals = self._totals
+                fused_ms = None
                 for stage, d in clock.stages:
                     tot = totals.get(stage)
                     if tot is None:
                         tot = totals[stage] = [0.0, 0]
                     tot[0] += d
                     tot[1] += 1
+                    if stage == Stage.FUSED.value:
+                        fused_ms = d
+                if attrib is not None:
+                    # sampled intra-fused waterfall: fold the sub-stage
+                    # stamps into the device burn table nested under
+                    # FUSED (ISSUE 20)
+                    self._device_sampled += 1
+                    self._device_fused_ms_total += float(
+                        attrib.get("fused_device_ms") or 0.0)
+                    dstages = self._device_stages
+                    for sub, d in (attrib.get("stages") or {}).items():
+                        tot = dstages.get(sub)
+                        if tot is None:
+                            tot = dstages[sub] = [0.0, 0]
+                        tot[0] += d
+                        tot[1] += 1
+                    self._device_recent.append(attrib)
+                if (bucket is not None and fused_ms is not None
+                        and ex is not None):
+                    worst = self._worst_fused
+                    if worst is None or fused_ms > worst[0]:
+                        self._worst_fused = (fused_ms, ex[0], ex[1],
+                                             bucket, time.time())
             # raw refs only — the clock is dead after retire, and
             # rendering dicts per frame costs more than the rest of
             # this method (snapshot() renders on demand). The ctx ref
@@ -383,7 +435,74 @@ class _Recorder:
                 "trace_id": f"{tid:032x}", "span_id": f"{sid:016x}",
                 "unix_ts": ts,
             })
+        with self._lock:
+            worst_fused = self._worst_fused
+        if worst_fused is not None:
+            fused_ms, tid, sid, bucket, ts = worst_fused
+            entry = {
+                "pipeline": self.pipeline, "scope": "fused",
+                # the fused stamp doubles as wall_ms: the ledger-level
+                # worst_frames() sorts every scope on that key
+                "wall_ms": round(fused_ms, 4),
+                "fused_ms": round(fused_ms, 4),
+                "trace_id": f"{tid:032x}", "span_id": f"{sid:016x}",
+                "bucket": bucket, "unix_ts": ts,
+            }
+            # exemplar join (ISSUE 20): the worst fused-stage frame
+            # links to its bucket's most recent compile event and its
+            # cost-ledger row — a tail spike names the shape, whether
+            # it recompiled, and what XLA expected it to cost
+            try:
+                from ..models import jitstats
+                from ..models.costmodel import cost_ledger
+                compiles = jitstats.recent_compiles(shape=bucket)
+                if compiles:
+                    entry["last_compile"] = compiles[0]
+                row = None
+                for r in cost_ledger.snapshot()["rows"]:
+                    if r["bucket"] == bucket:
+                        row = r
+                        break
+                if row is not None:
+                    entry["cost"] = row
+            except Exception:  # noqa: BLE001 — the join is best-effort
+                pass
+            out.append(entry)
         return out
+
+    def device_burn(self) -> Optional[dict[str, Any]]:
+        """The sampled intra-fused device burn table (ISSUE 20), nested
+        under the FUSED stage: per-sub-stage mean device ms over the
+        sampled attribution frames, the mean fused stamp those samples
+        decomposed, and the reconcile ratio (Σ sub-stage means ÷ mean
+        fused stamp — ≈1.0 means the decomposition accounts for the
+        opaque stamp; the residue is lost cross-stage XLA fusion plus
+        per-stage dispatch). None until a frame was sampled, so existing
+        payload shapes are untouched when attribution is off."""
+        with self._lock:
+            if not self._device_sampled:
+                return None
+            sampled = self._device_sampled
+            fused_total = self._device_fused_ms_total
+            dstages = {s: (t[0], t[1])
+                       for s, t in self._device_stages.items()}
+            recent = list(self._device_recent)
+        by_stage = {}
+        sub_sum = 0.0
+        for s, (tot, n) in dstages.items():
+            mean = tot / n
+            sub_sum += mean
+            by_stage[s] = {"mean_ms": round(mean, 4), "count": n}
+        fused_mean = fused_total / sampled if sampled else 0.0
+        return {
+            "sampled_frames": sampled,
+            "fused_mean_ms": round(fused_mean, 4),
+            "substage_sum_ms": round(sub_sum, 4),
+            "reconcile_ratio": round(sub_sum / fused_mean, 4)
+            if fused_mean > 0 else None,
+            "stages": by_stage,
+            "recent": recent,
+        }
 
     def stage_means(self) -> tuple[int, dict[str, float]]:
         """(scored frames in window, per-stage mean ms over the RECENT
@@ -449,8 +568,14 @@ class _Recorder:
             if deadline:
                 row["frac_of_budget"] = round(mean / deadline, 4)
             by_stage[s] = row
-        return {"deadline_ms": deadline, "stages": by_stage,
-                "expired_spans_by_blame": expired}
+        out = {"deadline_ms": deadline, "stages": by_stage,
+               "expired_spans_by_blame": expired}
+        device = self.device_burn()
+        if device is not None:
+            # sampled sub-stage decomposition nested under the fused
+            # stamp — present only when attribution sampled a frame
+            out["device"] = device
+        return out
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:

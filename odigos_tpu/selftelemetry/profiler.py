@@ -443,6 +443,7 @@ class DeviceRuntimeCollector:
         out: dict[str, float] = {}
         out.update(self._collect_engines())
         out.update(self._collect_jax())
+        out.update(self._collect_device_tables())
         # a stop() racing a stalled pass must win: publishing after the
         # event is set would re-freeze gauges stop() just cleared, with
         # no collector left to ever refresh them
@@ -535,6 +536,42 @@ class DeviceRuntimeCollector:
             pass
         return out
 
+    @staticmethod
+    def _collect_device_tables() -> dict[str, float]:
+        """Device-resident footprint of the fused route (ISSUE 20):
+        bytes pinned by the interned hash-table LRU plus each live
+        plan's placed weight pytree. Published via the same `_published`
+        set as everything else, so a shut-down engine's plan gauge is
+        stale-cleared, never frozen. Reads module state only — never the
+        importer (no jax module in ``sys.modules`` means the fused
+        module cannot be there either, and the getattr chain degrades
+        to nothing)."""
+        out: dict[str, float] = {}
+        fused = sys.modules.get("odigos_tpu.serving.fused")
+        if fused is not None:
+            try:
+                table_bytes = float(fused.device_table_bytes())
+                if table_bytes > 0:
+                    out[labeled_key("odigos_device_table_bytes",
+                                    site="fused.tables")] = table_bytes
+            except Exception:  # noqa: BLE001
+                pass
+        for ordinal, eng in engines.live():
+            try:
+                plan = getattr(getattr(eng, "backend", None), "_plan",
+                               None)
+                if plan is None:
+                    continue
+                placed = float(plan.placed_bytes())
+                if placed > 0:
+                    out[labeled_key(
+                        "odigos_device_table_bytes",
+                        site=f"plan.{plan.key}",
+                        engine=str(ordinal))] = placed
+            except Exception:  # noqa: BLE001 — a dying engine: skip it
+                continue
+        return out
+
 
 # ----------------------------------------------------------- process-global
 
@@ -579,3 +616,67 @@ def stop_started(started: list[str]) -> None:
         profiler.stop()
     if "device_runtime" in started:
         device_runtime.stop()
+
+
+def device_snapshot() -> dict[str, Any]:
+    """The device-plane observability join (ISSUE 20): one JSON-able
+    dict backing ``GET /api/device``, ``/debug/xlaz``, ``describe``,
+    and the diagnose bundle's ``device.json``. The four top-level
+    containers are ALWAYS present (empty when the subsystem never
+    armed) so every consumer indexes without existence checks:
+
+    * ``attribution`` — per live fused engine, the sampler's stats
+      (stride, kill-switch state, sampled/skipped counters, the last
+      published sub-stage waterfall);
+    * ``cost`` — the XLA cost/efficiency ledger snapshot (expected
+      FLOPs/bytes, flop-waste, achieved efficiency per site × bucket);
+    * ``compiles`` — the ring of recent compile events, newest first;
+    * ``tables`` — device-resident fused footprint in bytes (interned
+      hash tables + each live plan's placed weights).
+    """
+    out: dict[str, Any] = {
+        "attribution": [],
+        "cost": {"rows": [], "best_flops_per_s": {},
+                 "captures_skipped": 0},
+        "compiles": [],
+        "tables": {},
+    }
+    try:
+        from ..models.costmodel import cost_ledger
+        out["cost"] = cost_ledger.snapshot()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from ..models import jitstats
+        out["compiles"] = jitstats.recent_compiles()
+    except Exception:  # noqa: BLE001
+        pass
+    for ordinal, eng in engines.live():
+        try:
+            backend = getattr(eng, "backend", None)
+            attrib = getattr(backend, "_attrib", None)
+            if attrib is None:
+                continue
+            entry = {"engine": ordinal,
+                     "site": getattr(backend, "fused_site", None)
+                     or "fused"}
+            entry.update(attrib.stats())
+            out["attribution"].append(entry)
+        except Exception:  # noqa: BLE001 — a dying engine: skip it
+            continue
+    fused = sys.modules.get("odigos_tpu.serving.fused")
+    if fused is not None:
+        try:
+            out["tables"]["fused.tables"] = int(
+                fused.device_table_bytes())
+        except Exception:  # noqa: BLE001
+            pass
+    for ordinal, eng in engines.live():
+        try:
+            plan = getattr(getattr(eng, "backend", None), "_plan", None)
+            if plan is not None:
+                out["tables"][f"plan.{plan.key}"] = \
+                    int(plan.placed_bytes())
+        except Exception:  # noqa: BLE001
+            continue
+    return out

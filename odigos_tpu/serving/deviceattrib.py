@@ -1,0 +1,305 @@
+"""Sampled intra-fused device attribution (ISSUE 20).
+
+PR 17's fused route made featurize→pack→score ONE jitted call — a 4.1×
+host-wall win that also collapsed the waterfall's view of the hot path
+into a single opaque ``fused`` stamp. This module reopens that box
+without giving the win back: 1-in-N frames (absolute-tick sampled over
+the frame ordinal grid, the profiler's discipline applied to frames
+instead of seconds) run the *same* pipeline as its five jitted
+sub-stages and stamp each one with a blocking device timing. The five
+names are a CLOSED vocabulary (:data:`SUB_STAGES`, package-hygiene
+linted both directions against the ``_stage_*`` builders below):
+
+======== ==============================================================
+hash     string-table gathers + enum widening (featurize_hash_jax)
+join     the per-frame parent self-join (featurize_join_jax)
+assemble categorical stack + split-clock continuous (featurize_assemble_jax)
+pack     trace sort + next-fit packing scatter (fused._build_pack_*)
+forward  the model matmul core + inverse scatter (fused._build_forward_*)
+======== ==============================================================
+
+Because ``_build_fused_impl`` *composes these exact functions*, the
+sampled sub-stage sum is a true decomposition of the fused stamp (modulo
+lost cross-stage XLA fusion and per-stage dispatch, which is precisely
+the interesting residue). Every sampled frame is parity-guarded: the
+sub-staged scores must match the fused output within the documented
+bench bound or the waterfall is discarded and the skip counted.
+
+Route discipline mirrors the fused route itself:
+
+* **Opt-in** via ``EngineConfig.device_attribution`` (stride
+  ``device_attribution_stride``, env override
+  ``ODIGOS_DEVICE_ATTRIB_N``);
+* **Kill-switchable live**: ``ODIGOS_DEVICE_ATTRIB=0``, read per
+  sampled tick, drops back to the plain fused call with the skip
+  counted — and re-enabling resumes on the same absolute grid;
+* **Every skip counted** under a closed reason set
+  (:data:`SKIP_REASONS`);
+* a sampled frame whose (span bucket, rows) key is cold first *warms*
+  the five sub-stage jits — those compile-contaminated stamps are never
+  published (reason ``warmup``) and each sub-stage compile is recorded
+  as a planned (warm) compile event.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..utils.telemetry import labeled_key, meter
+
+# jit-site shape discipline (tests/test_package_hygiene.py): every
+# sub-stage jit goes through _attrib_stage_jit and inherits the fused
+# call's shapes unchanged
+SHAPE_BUCKETING = {
+    "attrib_stage": "sub-stages consume the fused call's already-"
+                    "bucketed operands unchanged: span axis padded to "
+                    "_span_bucket, packed rows static per bucket via "
+                    "BucketLadder.round_rows, hash tables padded to "
+                    "_table_bucket (rows is a static argname on "
+                    "pack/forward)",
+}
+
+# the closed intra-fused sub-stage vocabulary; each name has exactly one
+# builder (_stage_<name>) below and the hygiene lint holds the two sets
+# equal in both directions
+SUB_STAGES = ("hash", "join", "assemble", "pack", "forward")
+
+# the closed set of reasons a sampled tick publishes no waterfall
+# (metric odigos_device_attrib_skipped_total{reason=...})
+SKIP_REASONS = (
+    "disabled",   # ODIGOS_DEVICE_ATTRIB=0 kill switch
+    "warmup",     # cold (bucket, rows) key: sub-stage jits compiled,
+                  # stamps discarded as compile-contaminated
+    "parity",     # sub-staged scores diverged from the fused output
+    "error",      # any exception: attribution must never fail a frame
+)
+
+ATTRIB_FRAMES_METRIC = "odigos_device_attrib_frames_total"
+ATTRIB_SKIPPED_METRIC = "odigos_device_attrib_skipped_total"
+
+# sub-staged scores must match the fused output within the fused bench
+# parity bound (the composition is op-identical; only XLA fusion
+# decisions differ across the jit boundaries)
+PARITY_RTOL = 2e-5
+PARITY_ATOL = 1e-5
+
+
+def attribution_enabled() -> bool:
+    """Live kill switch: ``ODIGOS_DEVICE_ATTRIB=0`` disarms sampled
+    attribution per tick (no restart, no reconfigure)."""
+    return os.environ.get("ODIGOS_DEVICE_ATTRIB", "1") != "0"
+
+
+def _attrib_stage_jit(fn, static: tuple = ()):
+    """Single funnel for every sub-stage jit (the module's one
+    ``jax.jit`` call site, covered by SHAPE_BUCKETING above)."""
+    import jax
+
+    attrib_stage = jax.jit(fn, static_argnames=static)
+    return attrib_stage
+
+
+# ------------------------------------------------- sub-stage builders
+#
+# One builder per SUB_STAGES entry, named _stage_<name> (the hygiene
+# lint's anchor). Each returns the jitted callable for that sub-stage,
+# closed over the backend's geometry/model exactly like the fused impl.
+
+
+def _stage_hash(backend):
+    from ..features.featurizer import featurize_hash_jax
+    return _attrib_stage_jit(featurize_hash_jax)
+
+
+def _stage_join(backend):
+    from ..features.featurizer import featurize_join_jax
+    return _attrib_stage_jit(featurize_join_jax)
+
+
+def _stage_assemble(backend):
+    from ..features.featurizer import featurize_assemble_jax
+    return _attrib_stage_jit(featurize_assemble_jax)
+
+
+def _stage_pack(backend):
+    from .fused import _build_pack_packed, _build_pack_spans
+    build = _build_pack_packed if backend.cfg.model == "transformer" \
+        else _build_pack_spans
+    return _attrib_stage_jit(build(backend.max_len), static=("rows",))
+
+
+def _stage_forward(backend):
+    from .fused import _build_forward_packed, _build_forward_spans
+    if backend.cfg.model == "transformer":
+        fn = _build_forward_packed(backend.model, backend._quantized)
+    else:
+        fn = _build_forward_spans(backend.model)
+    return _attrib_stage_jit(fn, static=("rows",))
+
+
+_STAGE_BUILDERS = {
+    "hash": _stage_hash,
+    "join": _stage_join,
+    "assemble": _stage_assemble,
+    "pack": _stage_pack,
+    "forward": _stage_forward,
+}
+
+
+class DeviceAttribution:
+    """Per-backend attribution sampler: owns the ordinal grid, the five
+    sub-stage jits, the skip counters, and the last published
+    waterfall."""
+
+    def __init__(self, backend, stride: int = 32):
+        env = os.environ.get("ODIGOS_DEVICE_ATTRIB_N")
+        if env:
+            try:
+                stride = int(env)
+            except ValueError:
+                pass
+        self._backend = backend
+        self.stride = max(int(stride), 1)
+        self._ordinal = 0
+        self._jits: Optional[dict] = None
+        self._warm_keys: set = set()
+        self.sampled = 0
+        self.skipped: dict[str, int] = {r: 0 for r in SKIP_REASONS}
+        self.last_waterfall: Optional[dict] = None
+
+    # ---------------------------------------------------------- sampling
+
+    def tick(self) -> bool:
+        """Advance the frame ordinal; True on the absolute 1-in-stride
+        grid. The ordinal advances even while killed/skipping so
+        re-enabling resumes the same cadence."""
+        o = self._ordinal
+        self._ordinal += 1
+        return (o % self.stride) == 0
+
+    # ------------------------------------------------------------- run
+
+    def run(self, fn, variables, tables, arrays, rows: int,
+            n_real: int) -> tuple:
+        """Execute the fused call for a sampled frame and, when armed
+        and warm, the five sub-stages after it. Returns ``(dev,
+        waterfall-or-None)`` — the fused device handle is ALWAYS the
+        scoring result; attribution only ever observes."""
+        if not attribution_enabled():
+            self._skip("disabled")
+            return fn(variables, *tables, *arrays, rows=rows), None
+        t0 = time.perf_counter()
+        dev = fn(variables, *tables, *arrays, rows=rows)
+        try:
+            waterfall = self._attribute(dev, variables, tables, arrays,
+                                        rows, n_real, t0)
+        except Exception:  # noqa: BLE001 — observation must never fail a frame
+            self._skip("error")
+            waterfall = None
+        if waterfall is not None:
+            self.sampled += 1
+            self.last_waterfall = waterfall
+            meter.add(labeled_key(ATTRIB_FRAMES_METRIC,
+                                  site=self._backend.fused_site or "fused"))
+        return dev, waterfall
+
+    def _attribute(self, dev, variables, tables, arrays, rows: int,
+                   n_real: int, t0: float) -> Optional[dict]:
+        import jax
+
+        from ..models import jitstats
+
+        # the fused call was just enqueued: blocking now stamps its
+        # device execution (attribution pays this block; the sampled
+        # frame's scores were going to be harvested anyway)
+        jax.block_until_ready(dev)
+        fused_ms = (time.perf_counter() - t0) * 1e3
+
+        L = self._backend.max_len
+        shape_label = f"r{rows}x{L}"
+        key = (arrays[0].shape[0], rows)
+        cold = key not in self._warm_keys
+        jits = self._stage_jits()
+        (svc, nam, kind, status, span_lo, span_hi, par_lo, par_hi,
+         start_lo, start_hi, end_lo, end_hi, thi_lo, thi_hi, tlo_lo,
+         tlo_hi, frame) = arrays
+        svc_tab, nam_tab = tables
+
+        stages: dict[str, float] = {}
+
+        def timed(name, *args, **kw):
+            t = time.perf_counter()
+            out = jits[name](*args, **kw)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t
+            stages[name] = round(dt * 1e3, 4)
+            if cold:
+                # planned first-build of this sub-stage for the shape —
+                # recorded warm so it never counts toward a storm
+                jitstats.record_compile_event(
+                    f"attrib.{name}", dt, shape=shape_label, warm=True)
+            return out
+
+        service_ids, name_ids, kind32, status32 = timed(
+            "hash", svc_tab, nam_tab, svc, nam, kind, status)
+        found, parent_service = timed(
+            "join", service_ids, span_hi, span_lo, par_hi, par_lo, frame)
+        cat, cont = timed(
+            "assemble", service_ids, name_ids, kind32, status32,
+            parent_service, found, par_hi, par_lo, end_hi, end_lo,
+            start_hi, start_lo)
+        packed = timed(
+            "pack", cat, cont, start_lo, start_hi, thi_lo, thi_hi,
+            tlo_lo, tlo_hi, frame, rows=rows)
+        scores = timed("forward", variables, *packed, rows=rows)
+
+        if cold:
+            self._warm_keys.add(key)
+            self._skip("warmup")
+            return None
+
+        want = np.asarray(dev, np.float32)[:n_real]
+        got = np.asarray(scores, np.float32)[:n_real]
+        if not np.allclose(got, want, rtol=PARITY_RTOL, atol=PARITY_ATOL):
+            self._skip("parity")
+            return None
+
+        total = sum(stages.values())
+        return {
+            "stages": stages,
+            "total_ms": round(total, 4),
+            "fused_device_ms": round(fused_ms, 4),
+            "reconcile_ratio": round(total / fused_ms, 4)
+            if fused_ms > 0 else None,
+            "n_spans": n_real,
+            "shape": [rows, L],
+            "bucket": shape_label,
+            "t": time.time(),
+        }
+
+    # ---------------------------------------------------------- plumbing
+
+    def _stage_jits(self) -> dict:
+        if self._jits is None:
+            self._jits = {name: build(self._backend)
+                          for name, build in _STAGE_BUILDERS.items()}
+        return self._jits
+
+    def _skip(self, reason: str) -> None:
+        self.skipped[reason] = self.skipped.get(reason, 0) + 1
+        meter.add(labeled_key(ATTRIB_SKIPPED_METRIC, reason=reason))
+
+    def stats(self) -> dict:
+        return {
+            "stride": self.stride,
+            "enabled": attribution_enabled(),
+            "frames_seen": self._ordinal,
+            "sampled": self.sampled,
+            "skipped": dict(self.skipped),
+            "last_waterfall": dict(self.last_waterfall)
+            if self.last_waterfall else None,
+        }

@@ -77,6 +77,23 @@ def _record_compile_seconds(site: str, seconds: float) -> None:
 
     jitstats.record_compile_seconds(site, seconds)
 
+
+def _record_compile_event(site: str, seconds: float,
+                          shape: Optional[str] = None,
+                          trace_id: Optional[str] = None,
+                          warm: bool = False) -> None:
+    """Compile-as-event twin of :func:`_record_compile_seconds` (ISSUE
+    20): same jax-free gate, but the compile also lands in the flight
+    recorder's timeline and the storm detector."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return
+    from ..models import jitstats
+
+    jitstats.record_compile_event(site, seconds, shape=shape,
+                                  trace_id=trace_id, warm=warm)
+
 PASSTHROUGH_METRIC = "odigos_anomaly_passthrough_total"
 QUEUE_FULL_METRIC = "odigos_anomaly_queue_full_total"
 SCORED_METRIC = "odigos_anomaly_scored_spans_total"
@@ -150,6 +167,13 @@ class EngineConfig:
     # in __post_init__ (shared-engine keying hashes the config); None/
     # False = no breaker (the pre-ISSUE-13 behavior, byte-identical).
     failover: Any = None
+    # ---- sampled intra-fused device attribution (ISSUE 20): 1-in-
+    # stride fused frames run as their five jitted sub-stages with
+    # per-sub-stage device stamps (serving/deviceattrib.py). Opt-in;
+    # the off path is the untouched PR 17 dispatch. Live kill switch:
+    # ODIGOS_DEVICE_ATTRIB=0; stride override: ODIGOS_DEVICE_ATTRIB_N.
+    device_attribution: bool = False
+    device_attribution_stride: int = 32
 
     def __post_init__(self) -> None:
         m = self.mesh
@@ -552,21 +576,48 @@ class SequenceBackend:
         for R in self.ladder.buckets:
             t0 = time.monotonic()
             if self.cfg.model == "transformer":
-                dev = self._device_call(_ZeroPacked(
+                zero = _ZeroPacked(
                     np.zeros((R, L, C), np.int32),
                     np.zeros((R, L, D), np.float32),
                     np.zeros((R, L), np.int32),
-                    np.zeros((R, L), np.int32)))
+                    np.zeros((R, L), np.int32))
+                dev = self._device_call(zero)
             else:
-                dev, _ = self._seq_call(
-                    np.zeros((R, L, C), np.int32),
-                    np.zeros((R, L, D), np.float32),
-                    np.zeros((R, L), bool))
+                zero = (np.zeros((R, L, C), np.int32),
+                        np.zeros((R, L, D), np.float32),
+                        np.zeros((R, L), bool))
+                dev, _ = self._seq_call(*zero)
             np.asarray(dev)  # block: compile finished before serving
             self.ladder.mark_warm(R)
             # ladder warming is the one place every bucket compile is
             # observable end-to-end — feed the per-site compile ledger
-            _record_compile_seconds(site, time.monotonic() - t0)
+            # (warm=True: a planned compile, never a storm signal) and
+            # snapshot XLA's cost model for the freshly compiled shape
+            _record_compile_event(site, time.monotonic() - t0,
+                                  shape=f"r{R}", warm=True)
+            self._capture_warm_cost(site, R, zero)
+
+    def _capture_warm_cost(self, site: str, R: int, zero) -> None:
+        """Ask XLA's cost model about the rung just warmed (graceful
+        no-op where the jit under this route exposes no analysis —
+        mesh plans and remote/mock backends simply record nothing)."""
+        from ..models.costmodel import cost_ledger
+
+        if self.cfg.model == "transformer":
+            if self._plan is not None or self._quantized is not None:
+                # plan/quantized wrap their jits behind their own call
+                # graphs; their cost rows come from the fused route's
+                # cold-key capture instead
+                return
+            fn = self.model.score_packed
+            args = (self.variables, zero.categorical, zero.continuous,
+                    zero.segments, zero.positions)
+        else:
+            if self._plan is not None:
+                return
+            fn = self.model.score_spans
+            args = (self.variables, *zero)
+        cost_ledger.capture(site, f"r{R}", fn, args)
 
 
 @dataclass(frozen=True)
@@ -738,6 +789,14 @@ class _InflightGroup:
     # fused-route marker (ISSUE 19): selects the latency ledger's
     # fused stage taxonomy when this group scored columns device-side
     fused: bool = False
+    # device attribution (ISSUE 20): the sampled intra-fused waterfall
+    # dispatch_columns produced for this very group (None = not sampled
+    # or skipped), the span-axis bucket (FLOP-waste denominator), and
+    # the fused cold-key dispatch wall (a compile event at retire time,
+    # where the group's self-trace id is in hand)
+    attrib: Optional[dict] = None
+    span_bucket: Optional[int] = None
+    cold_dispatch_s: float = 0.0
 
 
 class ScoringEngine:
@@ -1270,6 +1329,9 @@ class ScoringEngine:
         # worker's buffer pool; the lease rides the in-flight group and
         # releases after harvest — steady state packs allocation-free
         lease = self._pack_pool.lease() if pools_enabled() else None
+        attrib = None
+        span_bucket = None
+        cold_dispatch_s = 0.0
         try:
             with lease_scope(lease):
                 if self._device_fault is not None \
@@ -1288,13 +1350,23 @@ class ScoringEngine:
                          and all(r.columns is not None for r in reqs))
                 if fused:
                     with self._backend_lock:
+                        t_f0 = time.monotonic()
                         handle = backend.dispatch_columns(
                             [r.columns for r in reqs])
+                        t_f1 = time.monotonic()
                         bucket_hit = getattr(backend, "last_bucket_hit",
                                              None)
                         shape = getattr(backend, "last_shape", None)
                         waste = getattr(backend, "last_padding_waste",
                                         None)
+                        attrib = getattr(backend, "last_attrib", None)
+                        span_bucket = getattr(backend,
+                                              "last_span_bucket", None)
+                    # a bucket-miss dispatch wall is (almost entirely)
+                    # the fused jit compiling for the new shape — a
+                    # compile event once this group's trace id is known
+                    if bucket_hit is False:
+                        cold_dispatch_s = t_f1 - t_f0
                 else:
                     for r in reqs:
                         if r.features is None and r.columns is not None \
@@ -1388,7 +1460,9 @@ class ScoringEngine:
             t_pack0=t0, t_dispatch=t1,
             overlap_ms=(t1 - t0) / 1e6 if overlapped else 0.0,
             bucket_hit=bucket_hit, shape=shape, padding_waste=waste,
-            lease=lease, backend=backend, probe=probe, fused=fused)
+            lease=lease, backend=backend, probe=probe, fused=fused,
+            attrib=attrib, span_bucket=span_bucket,
+            cold_dispatch_s=cold_dispatch_s)
 
     def _retire(self, grp: _InflightGroup) -> None:
         """Harvest stage: block on the oldest in-flight device call, split
@@ -1444,6 +1518,15 @@ class ScoringEngine:
                         "harvest0": t_h0, "end": time.monotonic_ns(),
                         "overlap_ms": grp.overlap_ms,
                         "fused": grp.fused}
+            if grp.fused and grp.shape is not None:
+                # bucket label for the latency ledger's exemplar join
+                # (worst fused frame -> this bucket's compile event +
+                # cost-ledger row)
+                stage_ns["fused_bucket"] = "r{}x{}".format(*grp.shape)
+            if grp.attrib is not None:
+                # the sampled intra-fused waterfall rides the same
+                # boundary dict into StageClock.merge_engine
+                stage_ns["device_attrib"] = grp.attrib
             for r in grp.reqs:
                 r.stage_ns = stage_ns
         try:
@@ -1506,6 +1589,22 @@ class ScoringEngine:
             ScoringEngine._ADAPT_PRIORS[self._adapt_key] = (
                 self._ewma_call_ms, self._ewma_call_spans,
                 self._ewma_spans_per_row, self._ewma_harvest_ms)
+        if grp.fused and grp.shape is not None:
+            # device-plane ledger joins (ISSUE 20): the measured stamp
+            # against XLA's expectation, and the cold-key compile as a
+            # first-class event now that the group's trace id is in hand
+            bucket = "r{}x{}".format(*grp.shape)
+            site = getattr(backend, "fused_site", None) or "fused"
+            from ..models.costmodel import cost_ledger
+            cost_ledger.observe_device_ms(
+                site, bucket, device_ms, n_real=grp.n_spans,
+                n_padded=grp.span_bucket)
+            if grp.cold_dispatch_s >= 0.05:
+                tid = getattr(grp.span, "trace_id", None)
+                _record_compile_event(
+                    site, grp.cold_dispatch_s, shape=bucket,
+                    trace_id=f"{tid:032x}" if tid is not None else None,
+                    warm=False)
         self._stage_log.append({
             "pack_ms": pack_ms, "device_ms": device_ms,
             "harvest_ms": harvest_ms, "overlap_ms": grp.overlap_ms,
@@ -1571,5 +1670,10 @@ class ScoringEngine:
                 "zscore.score" if self.cfg.model == "zscore" else None)
             if site is not None and not self.cfg.warm_ladder \
                     and est >= 1.0:
-                _record_compile_seconds(site, est / 1e3)
+                tid = getattr(sp, "trace_id", None)
+                _record_compile_event(
+                    site, est / 1e3,
+                    shape="x".join(map(str, grp.shape))
+                    if grp.shape else None,
+                    trace_id=f"{tid:032x}" if tid is not None else None)
         self._device_calls += 1

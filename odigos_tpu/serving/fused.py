@@ -35,8 +35,8 @@ device comparisons/sorts treat (hi, lo) pairs as one 64-bit key.
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
-from functools import lru_cache
 from typing import Any, Optional
 
 import numpy as np
@@ -143,7 +143,14 @@ def _split_u64(col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return v[:, 0], v[:, 1]
 
 
-@lru_cache(maxsize=32)
+# value-keyed LRU of device-resident table pairs; a hand-rolled
+# OrderedDict (vs functools.lru_cache) so the cache can also answer
+# "how many device bytes do these tables pin?" for the footprint gauge
+_TABLE_LRU = 32
+_table_lock = threading.Lock()
+_table_cache: OrderedDict = OrderedDict()
+
+
 def _device_tables(strings: tuple[str, ...], service_vocab: int,
                    name_vocab: int):
     """Device-resident hash gather tables for one interned string pool,
@@ -151,6 +158,13 @@ def _device_tables(strings: tuple[str, ...], service_vocab: int,
     host ``_hash_table`` (wire senders re-ship the same pools), so a
     steady sender set hashes + uploads each pool exactly once and the
     fused call's tables are warm device constants thereafter."""
+    key = (strings, service_vocab, name_vocab)
+    with _table_lock:
+        hit = _table_cache.get(key)
+        if hit is not None:
+            _table_cache.move_to_end(key)
+            return hit[0], hit[1]
+
     import jax.numpy as jnp
 
     svc = _hash_table(strings, service_vocab)
@@ -163,7 +177,22 @@ def _device_tables(strings: tuple[str, ...], service_vocab: int,
     nam_p = np.zeros(tb, np.int32)
     svc_p[:len(svc)] = svc
     nam_p[:len(nam)] = nam
-    return jnp.asarray(svc_p), jnp.asarray(nam_p)
+    dsvc, dnam = jnp.asarray(svc_p), jnp.asarray(nam_p)
+    with _table_lock:
+        _table_cache[key] = (dsvc, dnam,
+                             int(dsvc.nbytes) + int(dnam.nbytes))
+        while len(_table_cache) > _TABLE_LRU:
+            _table_cache.popitem(last=False)
+    return dsvc, dnam
+
+
+def device_table_bytes() -> int:
+    """Device bytes currently pinned by the resident gather tables —
+    the fused route's invisible-since-PR-17 footprint, published as
+    ``odigos_device_table_bytes{site=fused.tables}`` by the device
+    runtime collector."""
+    with _table_lock:
+        return sum(entry[2] for entry in _table_cache.values())
 
 
 class FusedSequenceBackend(SequenceBackend):
@@ -179,9 +208,19 @@ class FusedSequenceBackend(SequenceBackend):
     def __init__(self, cfg, mesh: Any = None):
         super().__init__(cfg, mesh=mesh)
         self._fused_score_jit = None
+        self.fused_site: Optional[str] = None
         # (span bucket, rows) shapes this backend has already compiled —
         # the fused analogue of BucketLadder's warm set, for bucket_hit
         self._fused_shapes: OrderedDict = OrderedDict()
+        # sampled intra-fused attribution (ISSUE 20): armed by config,
+        # built lazily so the import stays jax-free on the off path
+        self._attrib = None
+        self.last_attrib: Optional[dict] = None
+        self.last_span_bucket: Optional[int] = None
+        if getattr(cfg, "device_attribution", False):
+            from .deviceattrib import DeviceAttribution
+            self._attrib = DeviceAttribution(
+                self, getattr(cfg, "device_attribution_stride", 32))
 
     @property
     def supports_fused(self) -> bool:
@@ -220,8 +259,27 @@ class FusedSequenceBackend(SequenceBackend):
         self._fused_shapes[key] = True
         if len(self._fused_shapes) > 16:
             self._fused_shapes.popitem(last=False)
-        dev = self._fused_score()(self._fused_variables(), *tables, *arrays,
-                               rows=R)
+        self.last_span_bucket = N
+        variables = self._fused_variables()
+        fn = self._fused_score()
+        sample = self._attrib is not None and self._attrib.tick()
+        if not sample:
+            # the PR 17 hot path, untouched: one non-blocking call
+            self.last_attrib = None
+            dev = fn(variables, *tables, *arrays, rows=R)
+        else:
+            dev, self.last_attrib = self._attrib.run(
+                fn, variables, tables, arrays, R, n_real)
+        if not self.last_bucket_hit:
+            # this bucket's warm moment: capture XLA's cost model for
+            # the shape (tracing only — no second compile unless the
+            # attribution sampler asked for memory depth)
+            from ..models.costmodel import cost_ledger
+            cost_ledger.capture(
+                self.fused_site or "fused", f"r{R}x{L}", fn,
+                (variables, *tables, *arrays), {"rows": R},
+                n_real=n_real, n_padded=N,
+                memory=self._attrib is not None)
         return ("fused", dev, n_real)
 
     def harvest(self, handle: Any) -> np.ndarray:
@@ -321,6 +379,7 @@ class FusedSequenceBackend(SequenceBackend):
             site = ("fused.score_packed"
                     if self.cfg.model == "transformer"
                     else "fused.score_spans")
+            self.fused_site = site
             self._fused_score_jit = jitstats.track_jit(
                 site, jax.jit(self._build_fused_impl(),
                               static_argnames=("rows",)))
@@ -331,127 +390,208 @@ class FusedSequenceBackend(SequenceBackend):
         trace-sort → pack (next-fit via searchsorted + pointer-doubling
         row marking) → model forward → inverse scatter to original span
         order. Pure jnp, static shapes; the model forward it inlines is
-        the seam a Pallas kernel can later replace."""
-        L = self.max_len
-        model = self.model
-        quantized = self._quantized
+        the seam a Pallas kernel can later replace.
+
+        Composed from the module-level phase builders below — the same
+        functions the device attribution sampler jits one-by-one — so
+        the fused jaxpr is by construction identical to the sum of its
+        attributable sub-stages."""
         transformer = self.cfg.model == "transformer"
+        pack = _build_pack_packed(self.max_len) if transformer \
+            else _build_pack_spans(self.max_len)
+        fwd = _build_forward_packed(self.model, self._quantized) \
+            if transformer else _build_forward_spans(self.model)
 
         def _impl(variables, service_table, name_table, svc, nam, kind,
                   status, span_lo, span_hi, par_lo, par_hi, start_lo,
                   start_hi, end_lo, end_hi, thi_lo, thi_hi, tlo_lo,
                   tlo_hi, frame, *, rows):
-            import jax
-            import jax.numpy as jnp
-
-            n = svc.shape[0]
             cat, cont = featurize_columns_jax(
                 service_table, name_table, svc, nam, kind, status,
                 span_hi, span_lo, par_hi, par_lo, end_hi, end_lo,
                 start_hi, start_lo, frame)
-            is_pad = frame < 0
-            # trace-major, time-minor sort — the host pack's
-            # np.lexsort((start, lo, hi)) over split keys, with is_pad
-            # primary so padding sorts last and (crucially) never merges
-            # into a real trace that happens to carry trace id 0
-            perm = jnp.lexsort((start_lo, start_hi, tlo_lo, tlo_hi,
-                                thi_lo, thi_hi, is_pad))
-            pad_s = is_pad[perm]
-            thh = thi_hi[perm]
-            thl = thi_lo[perm]
-            tlh = tlo_hi[perm]
-            tll = tlo_lo[perm]
-            cat_s = cat[perm]
-            cont_s = cont[perm]
-            new_trace = jnp.concatenate([
-                jnp.ones(1, bool),
-                (thh[1:] != thh[:-1]) | (thl[1:] != thl[:-1])
-                | (tlh[1:] != tlh[:-1]) | (tll[1:] != tll[:-1])
-                | (pad_s[1:] != pad_s[:-1])])
-            idx = jnp.arange(n)
-            # first sorted index of each trace, forward-filled — the
-            # vectorized cumcount the host gets from run_starts/repeat
-            first_idx = jax.lax.cummax(jnp.where(new_trace, idx, 0))
-            pos_in_trace = idx - first_idx
-            C = cat.shape[1]
-            D = cont.shape[1]
-
-            if not transformer:
-                # sequence route (autoencoder): one row per trace,
-                # truncation at L via the scatter's mode="drop" (same
-                # spans the host's keep-mask drops), squash to (0, 1)
-                # in-kernel (the host does it at harvest)
-                trace_ord = jnp.cumsum(new_trace) - 1
-                row_eff = jnp.where(pad_s, rows, trace_ord)
-                col = pos_in_trace
-                catp = jnp.zeros((rows, L, C), jnp.int32) \
-                    .at[row_eff, col].set(cat_s, mode="drop")
-                contp = jnp.zeros((rows, L, D), jnp.float32) \
-                    .at[row_eff, col].set(cont_s, mode="drop")
-                mask = jnp.zeros((rows, L), bool) \
-                    .at[row_eff, col].set(~pad_s, mode="drop")
-                errs, _ = model.score_spans(variables, catp, contp, mask)
-                sq = 1.0 - jnp.exp(-errs)
-                safe_row = jnp.minimum(row_eff, rows - 1)
-                safe_col = jnp.minimum(col, L - 1)
-                val = jnp.where(pad_s | (col >= L), 0.0,
-                                sq[safe_row, safe_col])
-                return jnp.zeros(n, jnp.float32).at[perm].set(val)
-
-            # packed route (transformer / quantized): chunk each trace
-            # into <= L-span segments, then next-fit segments into rows
-            pos_in_chunk = (pos_in_trace % L).astype(jnp.int32)
-            seg_new = pos_in_chunk == 0
-            span_seg = jnp.cumsum(seg_new) - 1
-            seg_len = jax.ops.segment_sum(
-                jnp.ones(n, jnp.int32), span_seg, num_segments=n)
-            cum = jnp.cumsum(seg_len)
-            cum_prev = cum - seg_len
-            # next-fit: a row starting at segment s ends before the
-            # first segment whose cumulative length exceeds the row
-            # budget — the device twin of the host's bisect_right over
-            # cum (side="right" also skips the zero-length tail)
-            nxt = jnp.minimum(
-                jnp.searchsorted(cum, cum_prev + L, side="right"),
-                n).astype(jnp.int32)
-            # row starts = the orbit of segment 0 under nxt, computed by
-            # pointer doubling (log2 rounds replace the host's per-row
-            # Python loop); n is the self-looping "done" sentinel
-            ptr = jnp.concatenate([nxt, jnp.full((1,), n, jnp.int32)])
-            marked = jnp.zeros(n + 1, bool).at[0].set(True)
-            for _ in range(max(int(n).bit_length() + 1, 1)):
-                hit = jax.ops.segment_sum(
-                    marked.astype(jnp.int32), ptr,
-                    num_segments=n + 1) > 0
-                marked = marked | hit
-                ptr = ptr[ptr]
-            is_start = marked[:n]
-            row_of_seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
-            base = jax.lax.cummax(jnp.where(is_start, cum_prev, 0))
-            seg_off = cum_prev - base
-            seg_idx = jnp.arange(n)
-            seg_slot = (seg_idx - jax.lax.cummax(
-                jnp.where(is_start, seg_idx, 0)) + 1).astype(jnp.int32)
-            span_row = row_of_seg[span_seg]
-            span_col = seg_off[span_seg] + pos_in_chunk
-            row_eff = jnp.where(pad_s, rows, span_row)
-            catp = jnp.zeros((rows, L, C), jnp.int32) \
-                .at[row_eff, span_col].set(cat_s, mode="drop")
-            contp = jnp.zeros((rows, L, D), jnp.float32) \
-                .at[row_eff, span_col].set(cont_s, mode="drop")
-            segs = jnp.zeros((rows, L), jnp.int32) \
-                .at[row_eff, span_col].set(seg_slot[span_seg],
-                                           mode="drop")
-            poss = jnp.zeros((rows, L), jnp.int32) \
-                .at[row_eff, span_col].set(pos_in_chunk, mode="drop")
-            if quantized is not None:
-                mat = quantized.score_packed(catp, contp, segs, poss)
-            else:
-                mat = model.score_packed(variables, catp, contp, segs,
-                                         poss)
-            safe_row = jnp.minimum(row_eff, rows - 1)
-            safe_col = jnp.clip(span_col, 0, L - 1)
-            val = jnp.where(pad_s, 0.0, mat[safe_row, safe_col])
-            return jnp.zeros(n, jnp.float32).at[perm].set(val)
+            packed = pack(cat, cont, start_lo, start_hi, thi_lo, thi_hi,
+                          tlo_lo, tlo_hi, frame, rows=rows)
+            return fwd(variables, *packed, rows=rows)
 
         return _impl
+
+
+# ------------------------------------------------- fused phase builders
+#
+# PACK and FORWARD as standalone jnp functions, closed over the static
+# geometry/model exactly like the old inline body. ``_build_fused_impl``
+# composes them under one jit (identical trace to the pre-split code);
+# serving/deviceattrib.py jits each one separately to stamp the
+# sampled intra-fused waterfall.
+
+
+def _sorted_trace_layout(start_lo, start_hi, thi_lo, thi_hi, tlo_lo,
+                         tlo_hi, frame):
+    """Shared head of both pack routes: the trace-major/time-minor sort
+    and per-trace position arithmetic."""
+    import jax
+    import jax.numpy as jnp
+
+    n = frame.shape[0]
+    is_pad = frame < 0
+    # trace-major, time-minor sort — the host pack's
+    # np.lexsort((start, lo, hi)) over split keys, with is_pad
+    # primary so padding sorts last and (crucially) never merges
+    # into a real trace that happens to carry trace id 0
+    perm = jnp.lexsort((start_lo, start_hi, tlo_lo, tlo_hi,
+                        thi_lo, thi_hi, is_pad))
+    pad_s = is_pad[perm]
+    thh = thi_hi[perm]
+    thl = thi_lo[perm]
+    tlh = tlo_hi[perm]
+    tll = tlo_lo[perm]
+    new_trace = jnp.concatenate([
+        jnp.ones(1, bool),
+        (thh[1:] != thh[:-1]) | (thl[1:] != thl[:-1])
+        | (tlh[1:] != tlh[:-1]) | (tll[1:] != tll[:-1])
+        | (pad_s[1:] != pad_s[:-1])])
+    idx = jnp.arange(n)
+    # first sorted index of each trace, forward-filled — the
+    # vectorized cumcount the host gets from run_starts/repeat
+    first_idx = jax.lax.cummax(jnp.where(new_trace, idx, 0))
+    pos_in_trace = idx - first_idx
+    return perm, pad_s, new_trace, pos_in_trace
+
+
+def _build_pack_spans(L: int):
+    """Sequence-route (autoencoder) pack: one row per trace, truncation
+    at L via the scatter's mode="drop" (same spans the host's keep-mask
+    drops)."""
+
+    def _pack(cat, cont, start_lo, start_hi, thi_lo, thi_hi, tlo_lo,
+              tlo_hi, frame, *, rows):
+        import jax.numpy as jnp
+
+        perm, pad_s, new_trace, pos_in_trace = _sorted_trace_layout(
+            start_lo, start_hi, thi_lo, thi_hi, tlo_lo, tlo_hi, frame)
+        cat_s = cat[perm]
+        cont_s = cont[perm]
+        C = cat.shape[1]
+        D = cont.shape[1]
+        trace_ord = jnp.cumsum(new_trace) - 1
+        row_eff = jnp.where(pad_s, rows, trace_ord)
+        col = pos_in_trace
+        catp = jnp.zeros((rows, L, C), jnp.int32) \
+            .at[row_eff, col].set(cat_s, mode="drop")
+        contp = jnp.zeros((rows, L, D), jnp.float32) \
+            .at[row_eff, col].set(cont_s, mode="drop")
+        mask = jnp.zeros((rows, L), bool) \
+            .at[row_eff, col].set(~pad_s, mode="drop")
+        return catp, contp, mask, perm, row_eff, col, pad_s
+
+    return _pack
+
+
+def _build_forward_spans(model):
+    """Sequence-route forward: score, squash to (0, 1) in-kernel (the
+    host does it at harvest), inverse-scatter to original span order."""
+
+    def _forward(variables, catp, contp, mask, perm, row_eff, col,
+                 pad_s, *, rows):
+        import jax.numpy as jnp
+
+        L = catp.shape[1]
+        n = perm.shape[0]
+        errs, _ = model.score_spans(variables, catp, contp, mask)
+        sq = 1.0 - jnp.exp(-errs)
+        safe_row = jnp.minimum(row_eff, rows - 1)
+        safe_col = jnp.minimum(col, L - 1)
+        val = jnp.where(pad_s | (col >= L), 0.0,
+                        sq[safe_row, safe_col])
+        return jnp.zeros(n, jnp.float32).at[perm].set(val)
+
+    return _forward
+
+
+def _build_pack_packed(L: int):
+    """Packed-route (transformer / quantized) pack: chunk each trace
+    into <= L-span segments, then next-fit segments into rows."""
+
+    def _pack(cat, cont, start_lo, start_hi, thi_lo, thi_hi, tlo_lo,
+              tlo_hi, frame, *, rows):
+        import jax
+        import jax.numpy as jnp
+
+        perm, pad_s, new_trace, pos_in_trace = _sorted_trace_layout(
+            start_lo, start_hi, thi_lo, thi_hi, tlo_lo, tlo_hi, frame)
+        cat_s = cat[perm]
+        cont_s = cont[perm]
+        C = cat.shape[1]
+        D = cont.shape[1]
+        n = frame.shape[0]
+        pos_in_chunk = (pos_in_trace % L).astype(jnp.int32)
+        seg_new = pos_in_chunk == 0
+        span_seg = jnp.cumsum(seg_new) - 1
+        seg_len = jax.ops.segment_sum(
+            jnp.ones(n, jnp.int32), span_seg, num_segments=n)
+        cum = jnp.cumsum(seg_len)
+        cum_prev = cum - seg_len
+        # next-fit: a row starting at segment s ends before the
+        # first segment whose cumulative length exceeds the row
+        # budget — the device twin of the host's bisect_right over
+        # cum (side="right" also skips the zero-length tail)
+        nxt = jnp.minimum(
+            jnp.searchsorted(cum, cum_prev + L, side="right"),
+            n).astype(jnp.int32)
+        # row starts = the orbit of segment 0 under nxt, computed by
+        # pointer doubling (log2 rounds replace the host's per-row
+        # Python loop); n is the self-looping "done" sentinel
+        ptr = jnp.concatenate([nxt, jnp.full((1,), n, jnp.int32)])
+        marked = jnp.zeros(n + 1, bool).at[0].set(True)
+        for _ in range(max(int(n).bit_length() + 1, 1)):
+            hit = jax.ops.segment_sum(
+                marked.astype(jnp.int32), ptr,
+                num_segments=n + 1) > 0
+            marked = marked | hit
+            ptr = ptr[ptr]
+        is_start = marked[:n]
+        row_of_seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+        base = jax.lax.cummax(jnp.where(is_start, cum_prev, 0))
+        seg_off = cum_prev - base
+        seg_idx = jnp.arange(n)
+        seg_slot = (seg_idx - jax.lax.cummax(
+            jnp.where(is_start, seg_idx, 0)) + 1).astype(jnp.int32)
+        span_row = row_of_seg[span_seg]
+        span_col = seg_off[span_seg] + pos_in_chunk
+        row_eff = jnp.where(pad_s, rows, span_row)
+        catp = jnp.zeros((rows, L, C), jnp.int32) \
+            .at[row_eff, span_col].set(cat_s, mode="drop")
+        contp = jnp.zeros((rows, L, D), jnp.float32) \
+            .at[row_eff, span_col].set(cont_s, mode="drop")
+        segs = jnp.zeros((rows, L), jnp.int32) \
+            .at[row_eff, span_col].set(seg_slot[span_seg],
+                                       mode="drop")
+        poss = jnp.zeros((rows, L), jnp.int32) \
+            .at[row_eff, span_col].set(pos_in_chunk, mode="drop")
+        return catp, contp, segs, poss, perm, row_eff, span_col, pad_s
+
+    return _pack
+
+
+def _build_forward_packed(model, quantized):
+    """Packed-route forward: the (possibly int8-quantized) transformer
+    matmul core — the Pallas seam — plus the inverse scatter."""
+
+    def _forward(variables, catp, contp, segs, poss, perm, row_eff,
+                 span_col, pad_s, *, rows):
+        import jax.numpy as jnp
+
+        L = catp.shape[1]
+        n = perm.shape[0]
+        if quantized is not None:
+            mat = quantized.score_packed(catp, contp, segs, poss)
+        else:
+            mat = model.score_packed(variables, catp, contp, segs,
+                                     poss)
+        safe_row = jnp.minimum(row_eff, rows - 1)
+        safe_col = jnp.clip(span_col, 0, L - 1)
+        val = jnp.where(pad_s, 0.0, mat[safe_row, safe_col])
+        return jnp.zeros(n, jnp.float32).at[perm].set(val)
+
+    return _forward

@@ -135,6 +135,13 @@ CONTRACT: dict[str, dict] = {
     "inc": {"endpoint": "/api/incidents",
             "fields": ["enabled", "incidents", "events_total",
                        "suppressed", "incidents_evicted"]},
+    # device plane panel (ISSUE 20): sampled intra-fused attribution,
+    # XLA cost/efficiency ledger rows, recent compile events, resident
+    # table footprint; per-row objects are reached via locals
+    # (ab/row/ev) — top-level containers validated here (always served,
+    # empty until a fused engine arms attribution)
+    "dev": {"endpoint": "/api/device",
+            "fields": ["attribution", "cost", "compiles", "tables"]},
     # workload drill-down (the reference UI's describe view)
     "desc": {"endpoint": "/api/describe/workload", "fields": ["text"]},
     # SSE store-event JSON (validated in test_sse_event_shape)

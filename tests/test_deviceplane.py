@@ -1,0 +1,536 @@
+"""Device-plane deep observability tests (ISSUE 20): the XLA
+cost/efficiency ledger (capture → observe join, self-normalized
+efficiency, graceful no-op on analysis-free backends), compile events
+as first-class incidents (ring + filters, warm events never storm, the
+storm detector freezing a bundle past the startup grace), sampled
+intra-fused attribution (closed sub-stage waterfall, warmup discard,
+parity guard, live kill switch resuming on the same grid, off-path
+bit-parity), the latency ledger's device burn table + worst-fused
+exemplar join, the shared device_snapshot() surface — and the tier-1
+<2% host-wall overhead guard for the armed 1-in-N sampler (the
+flight-recorder guard's paired-interleaved discipline)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from odigos_tpu.models import TransformerConfig, jitstats
+from odigos_tpu.models.costmodel import CostLedger, cost_ledger
+from odigos_tpu.models.jitstats import (
+    STORM_THRESHOLD, record_compile_event, recent_compiles)
+from odigos_tpu.pdata import synthesize_traces
+from odigos_tpu.selftelemetry.flightrecorder import flight_recorder
+from odigos_tpu.selftelemetry.latency import StageClock, latency_ledger
+from odigos_tpu.selftelemetry.profiler import device_snapshot, engines
+from odigos_tpu.serving.deviceattrib import (
+    SKIP_REASONS, SUB_STAGES, DeviceAttribution, attribution_enabled)
+from odigos_tpu.serving.engine import EngineConfig, ScoringEngine
+from odigos_tpu.serving.fused import extract_columns
+from odigos_tpu.utils.telemetry import meter
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    jitstats.reset()
+    cost_ledger.reset()
+    flight_recorder.reset()
+    latency_ledger.reset()
+    meter.reset()
+    os.environ.pop("ODIGOS_DEVICE_ATTRIB", None)
+    yield
+    os.environ.pop("ODIGOS_DEVICE_ATTRIB", None)
+    jitstats.reset()
+    cost_ledger.reset()
+    flight_recorder.reset()
+    latency_ledger.reset()
+
+
+@pytest.fixture(scope="module")
+def fused_env():
+    """One warmed fused transformer backend (tiny geometry) shared by the
+    attribution tests: the stride-4 sampler armed, the sub-stage jits
+    built, and at least one full waterfall published. Tests that need a
+    different stride build a fresh DeviceAttribution SHARING these warm
+    jits/keys (dict-copied before any mutation), so no test recompiles."""
+    os.environ.pop("ODIGOS_DEVICE_ATTRIB", None)
+    os.environ.pop("ODIGOS_DEVICE_ATTRIB_N", None)
+    cfg = EngineConfig(
+        model="transformer",
+        model_config=TransformerConfig(d_model=32, n_layers=1, d_ff=64,
+                                       n_heads=2, max_len=16,
+                                       dtype=jnp.float32),
+        max_len=16, trace_bucket=32,
+        device_attribution=True, device_attribution_stride=4)
+    eng = ScoringEngine(cfg)  # unstarted: direct backend drive
+    backend = eng.backend
+    attrib = backend._attrib
+    assert attrib is not None and attrib.stride == 4
+    fcfg = eng.cfg.featurizer
+    col_sets = []
+    for v in range(3):
+        cols, reason = extract_columns(synthesize_traces(192, seed=870 + v),
+                                       fcfg)
+        assert cols is not None, reason
+        col_sets.append([cols])
+    # drive sampled ticks until a full waterfall publishes (the first
+    # sampled tick per (bucket, rows) key is the discarded warmup pass)
+    for i in range(6 * attrib.stride):
+        backend.harvest(backend.dispatch_columns(col_sets[i % 3]))
+        if attrib.sampled >= 1:
+            break
+    assert attrib.sampled >= 1, attrib.stats()
+    yield eng, backend, col_sets
+    backend._attrib = attrib  # whatever a failing test left behind
+
+
+def _drive(backend, col_sets, n):
+    for i in range(n):
+        backend.harvest(backend.dispatch_columns(col_sets[i % len(col_sets)]))
+
+
+def _shared_attrib(backend, stride, warm=True):
+    """Fresh sampler riding the module backend's already-built sub-stage
+    jits (copied dict — corruption tests must not poison the shared
+    one) and, when ``warm``, its warm key set (skips the warmup pass)."""
+    a = DeviceAttribution(backend, stride=stride)
+    a._jits = dict(backend._attrib._stage_jits())
+    if warm:
+        a._warm_keys = set(backend._attrib._warm_keys)
+    return a
+
+
+# --------------------------------------------------------------------------
+# XLA cost/efficiency ledger
+
+
+class TestCostLedger:
+    def test_capture_observe_and_self_normalized_efficiency(self):
+        led = CostLedger()
+        f = jax.jit(lambda x: x @ x)
+        x = jnp.ones((64, 64), jnp.float32)
+        row = led.capture("t.mm", "r64", f, (x,), n_real=48, n_padded=64)
+        assert row is not None
+        assert row["flops"] > 0
+        assert row["bytes_accessed"] > 0
+        assert row["flop_waste_frac"] == 0.25
+        # first observation defines the site's best FLOP/s: reads 1.0
+        assert led.observe_device_ms("t.mm", "r64", 5.0) == 1.0
+        # half the speed -> half the self-normalized efficiency
+        assert led.observe_device_ms("t.mm", "r64", 10.0) == 0.5
+        snap = led.snapshot()
+        assert len(snap["rows"]) == 1
+        r = snap["rows"][0]
+        assert r["observations"] == 2
+        assert r["last_device_ms"] == 10.0
+        assert "t.mm" in snap["best_flops_per_s"]
+
+    def test_memory_depth(self):
+        led = CostLedger()
+        f = jax.jit(lambda x: x * 2.0)
+        row = led.capture("t.mem", "r8", f, (jnp.ones((8, 8)),),
+                          memory=True)
+        # memory=True AOT-compiles; either the stats landed as ints or
+        # the whole capture degraded to the counted no-op — never a raise
+        if row is not None:
+            assert row["memory"] is None or all(
+                isinstance(v, int) for v in row["memory"].values())
+        else:
+            assert led.snapshot()["captures_skipped"] == 1
+
+    def test_graceful_noop_without_analysis(self):
+        led = CostLedger()
+
+        def plain(x):  # no .lower(): the analysis-free backend stand-in
+            return x
+
+        assert led.capture("t.plain", "r1", plain, (1.0,)) is None
+        assert led.snapshot()["captures_skipped"] == 1
+        # observing a never-captured (site, bucket) is a None, not a row
+        assert led.observe_device_ms("t.plain", "r1", 1.0) is None
+        assert led.snapshot()["rows"] == []
+
+    def test_reset(self):
+        led = CostLedger()
+        f = jax.jit(lambda x: x + 1.0)
+        assert led.capture("t.r", "r4", f, (jnp.ones((4,)),)) is not None
+        led.reset()
+        assert led.snapshot() == {"rows": [], "best_flops_per_s": {},
+                                  "captures_skipped": 0}
+
+
+# --------------------------------------------------------------------------
+# compile events + storm detector
+
+
+def _bypass_grace():
+    """Arm the storm detector: plant the process-first-compile marker
+    deep in the past so subsequent events are outside the startup
+    grace (the soak-ramp protection the live path keeps)."""
+    record_compile_event("t.seed", 0.01, shape="r0", warm=True)
+    jitstats._first_event_mono = time.monotonic() - 1000.0
+
+
+class TestCompileEvents:
+    def test_ring_and_filters(self):
+        record_compile_event("t.a", 0.5, shape="r64x16",
+                             trace_id="ab" * 16)
+        record_compile_event("t.b", 0.2, shape="r128x16", warm=True)
+        events = recent_compiles()
+        assert [e["site"] for e in events] == ["t.b", "t.a"]  # newest first
+        assert all("t_mono" not in e for e in events)
+        assert events[1]["shape"] == "r64x16"
+        assert events[1]["trace_id"] == "ab" * 16
+        assert events[0]["warm"] is True and events[1]["warm"] is False
+        assert [e["site"] for e in recent_compiles(site="t.a")] == ["t.a"]
+        assert [e["site"] for e in recent_compiles(shape="r128x16")] \
+            == ["t.b"]
+        assert recent_compiles(site="t.a", shape="r128x16") == []
+
+    def test_warm_events_never_storm(self):
+        _bypass_grace()
+        for i in range(3 * STORM_THRESHOLD):
+            record_compile_event("t.warm", 0.1, shape=f"r{i}", warm=True)
+        assert [i for i in flight_recorder.incidents()
+                if i["trigger"] == "compile_storm"] == []
+
+    def test_storm_freezes_incident_past_grace(self):
+        _bypass_grace()
+        for i in range(STORM_THRESHOLD):
+            record_compile_event("t.storm", 0.2, shape=f"r{64 << i}x16")
+        [inc] = [i for i in flight_recorder.incidents()
+                 if i["trigger"] == "compile_storm"]
+        assert f"{STORM_THRESHOLD} shape(s) recompiled" in inc["detail"]
+        assert "t.storm:r64x16" in inc["detail"]
+        # the bundle carries the compile events themselves: the black
+        # box mirror is what makes the incident stand alone offline
+        assert any(e.get("kind") == "compile" for e in inc["events"])
+
+    def test_under_threshold_is_not_a_storm(self):
+        _bypass_grace()
+        for i in range(STORM_THRESHOLD - 1):
+            record_compile_event("t.calm", 0.2, shape=f"r{i}")
+        assert [i for i in flight_recorder.incidents()
+                if i["trigger"] == "compile_storm"] == []
+
+    def test_grace_window_protects_startup_ramp(self):
+        # no bypass: every event sits inside STORM_GRACE_S of the first
+        for i in range(3 * STORM_THRESHOLD):
+            record_compile_event("t.ramp", 0.2, shape=f"r{i}")
+        assert [i for i in flight_recorder.incidents()
+                if i["trigger"] == "compile_storm"] == []
+
+
+# --------------------------------------------------------------------------
+# sampled intra-fused attribution
+
+
+class TestDeviceAttribution:
+    def test_published_waterfall_closed_vocabulary(self, fused_env):
+        _, backend, col_sets = fused_env
+        wf = backend._attrib.last_waterfall
+        assert wf is not None
+        assert set(wf["stages"]) == set(SUB_STAGES)
+        assert all(wf["stages"][s] >= 0.0 for s in SUB_STAGES)
+        assert wf["bucket"].startswith("r") and "x16" in wf["bucket"]
+        assert wf["n_spans"] in {sum(len(c) for c in cs)
+                                 for cs in col_sets}
+        assert wf["total_ms"] == pytest.approx(
+            sum(wf["stages"].values()), abs=0.01)
+        assert wf["fused_device_ms"] > 0
+        assert wf["reconcile_ratio"] > 0
+
+    def test_skip_reason_keys_closed(self, fused_env):
+        _, backend, _ = fused_env
+        assert set(backend._attrib.skipped) == set(SKIP_REASONS)
+
+    def test_warmup_pass_discarded_then_publishes(self, fused_env):
+        _, backend, col_sets = fused_env
+        armed = backend._attrib
+        a = _shared_attrib(backend, stride=1, warm=False)
+        backend._attrib = a
+        try:
+            _drive(backend, col_sets[:1], 1)
+            # cold (bucket, rows) key: stamps compile-contaminated,
+            # discarded and counted — never published
+            assert a.skipped["warmup"] == 1
+            assert a.sampled == 0 and a.last_waterfall is None
+            _drive(backend, col_sets[:1], 1)
+            assert a.sampled == 1 and a.last_waterfall is not None
+        finally:
+            backend._attrib = armed
+
+    def test_kill_switch_skips_and_resumes_on_grid(self, fused_env):
+        _, backend, col_sets = fused_env
+        a = backend._attrib
+        sampled0, disabled0 = a.sampled, a.skipped["disabled"]
+        # align to the grid: drive until the NEXT tick is the sampled one
+        while a._ordinal % a.stride != 0:
+            _drive(backend, col_sets, 1)
+        os.environ["ODIGOS_DEVICE_ATTRIB"] = "0"
+        assert not attribution_enabled()
+        _drive(backend, col_sets, a.stride)  # exactly one sampled tick
+        assert a.skipped["disabled"] == disabled0 + 1
+        assert a.sampled == sampled0
+        assert backend.last_attrib is None
+        # re-enable: the ordinal kept advancing while killed, so the
+        # very next grid point samples again — same cadence, no restart
+        del os.environ["ODIGOS_DEVICE_ATTRIB"]
+        assert attribution_enabled()
+        _drive(backend, col_sets, a.stride)
+        assert a.sampled == sampled0 + 1
+
+    def test_off_path_bit_identical(self, fused_env):
+        _, backend, col_sets = fused_env
+        armed = backend._attrib
+        try:
+            # armed but non-sampled tick vs attribution compiled out:
+            # both must take the identical one-call PR 17 hot path
+            a = _shared_attrib(backend, stride=1 << 20)
+            a.tick()  # consume the grid point: next ticks are unsampled
+            backend._attrib = a
+            on = backend.harvest(backend.dispatch_columns(col_sets[0]))
+            assert backend.last_attrib is None
+            backend._attrib = None
+            off = backend.harvest(backend.dispatch_columns(col_sets[0]))
+            np.testing.assert_array_equal(on, off)
+        finally:
+            backend._attrib = armed
+
+    def test_parity_divergence_discards_waterfall(self, fused_env):
+        _, backend, col_sets = fused_env
+        armed = backend._attrib
+        a = _shared_attrib(backend, stride=1)
+        fwd = a._jits["forward"]
+        a._jits["forward"] = lambda *args, **kw: fwd(*args, **kw) + 1.0
+        backend._attrib = a
+        try:
+            _drive(backend, col_sets[:1], 1)
+            assert a.skipped["parity"] == 1
+            assert a.sampled == 0 and a.last_waterfall is None
+        finally:
+            backend._attrib = armed
+
+    def test_substage_error_never_fails_the_frame(self, fused_env):
+        _, backend, col_sets = fused_env
+        armed = backend._attrib
+
+        def boom(*args, **kw):
+            raise RuntimeError("sub-stage exploded")
+
+        a = _shared_attrib(backend, stride=1)
+        a._jits["forward"] = boom
+        backend._attrib = a
+        try:
+            scores = backend.harvest(backend.dispatch_columns(col_sets[0]))
+            # the frame still scored, every real span covered
+            assert len(scores) == sum(len(c) for c in col_sets[0])
+            assert a.skipped["error"] == 1 and a.sampled == 0
+        finally:
+            backend._attrib = armed
+
+    def test_stats_surface(self, fused_env):
+        _, backend, _ = fused_env
+        st = backend._attrib.stats()
+        assert st["stride"] == 4 and st["enabled"] is True
+        assert st["sampled"] >= 1
+        assert st["frames_seen"] > st["sampled"]
+        assert set(st["skipped"]) == set(SKIP_REASONS)
+        assert set(st["last_waterfall"]["stages"]) == set(SUB_STAGES)
+
+    def test_cost_row_captured_at_fused_warm_moment(self, fused_env):
+        _, backend, col_sets = fused_env
+        # a never-seen span count -> new bucket key -> cold dispatch
+        # captures XLA's cost model for the fused site at warm time
+        cols, reason = extract_columns(
+            synthesize_traces(700, seed=901), backend.cfg.featurizer)
+        assert cols is not None, reason
+        backend.harvest(backend.dispatch_columns([cols]))
+        bucket = f"r{backend.last_shape[0]}x{backend.last_shape[1]}"
+        rows = [r for r in cost_ledger.snapshot()["rows"]
+                if r["bucket"] == bucket]
+        assert rows and rows[0]["flops"] > 0
+
+
+# --------------------------------------------------------------------------
+# latency ledger: device burn table + worst-fused exemplar join
+
+
+def _fused_clock(fused_ms=3.0, bucket="r64x16", attrib=None,
+                 ctx=(0xabc, 0xdef)):
+    clock = StageClock(ctx=ctx)
+    t = time.monotonic_ns()
+    ms = 1_000_000
+    clock.merge_engine({
+        "fused": True, "pack0": t,
+        "dispatch": t + int(fused_ms * ms),
+        "harvest0": t + int((fused_ms + 1) * ms),
+        "end": t + int((fused_ms + 2) * ms),
+        "overlap_ms": 0.0,
+        "device_attrib": attrib, "fused_bucket": bucket,
+    })
+    return clock
+
+
+class TestLatencyDeviceBurn:
+    def test_burn_table_folds_sampled_waterfalls(self):
+        rec = latency_ledger.recorder("traces/devburn")
+        attrib = {"stages": {s: 1.0 for s in SUB_STAGES},
+                  "fused_device_ms": 5.5, "total_ms": 5.0,
+                  "reconcile_ratio": 0.9091, "bucket": "r64x16",
+                  "n_spans": 10, "shape": [64, 16], "t": time.time()}
+        rec.observe(_fused_clock(attrib=attrib), scored=True)
+        rec.observe(_fused_clock(), scored=True)  # unsampled: no fold
+        db = rec.device_burn()
+        assert db is not None
+        assert db["sampled_frames"] == 1
+        assert set(db["stages"]) == set(SUB_STAGES)
+        assert db["stages"]["forward"] == {"mean_ms": 1.0, "count": 1}
+        assert db["substage_sum_ms"] == 5.0
+        assert db["fused_mean_ms"] == 5.5
+        assert db["reconcile_ratio"] == pytest.approx(5.0 / 5.5, abs=1e-3)
+        assert len(db["recent"]) == 1
+        assert rec.burn()["device"]["sampled_frames"] == 1
+
+    def test_no_device_section_until_sampled(self):
+        rec = latency_ledger.recorder("traces/devoff")
+        rec.observe(_fused_clock(), scored=True)
+        assert rec.device_burn() is None
+        assert "device" not in rec.burn()  # PR 17 payload untouched
+
+    def test_worst_fused_exemplar_joins_compile_and_cost(self):
+        rec = latency_ledger.recorder("traces/devjoin")
+        rec.observe(_fused_clock(fused_ms=2.0, bucket="r32x16",
+                                 ctx=(1, 2)), scored=True)
+        rec.observe(_fused_clock(fused_ms=9.0, bucket="r64x16",
+                                 ctx=(0xfeed, 0xbeef)), scored=True)
+        record_compile_event("fused.join", 0.3, shape="r64x16")
+        f = jax.jit(lambda x: x * 2.0)
+        assert cost_ledger.capture("fused.join", "r64x16", f,
+                                   (jnp.ones((8, 8)),)) is not None
+        [entry] = [e for e in rec.worst_frames() if e["scope"] == "fused"]
+        # the worst fused frame, by the fused stamp itself
+        assert entry["fused_ms"] == pytest.approx(9.0, abs=0.5)
+        assert entry["wall_ms"] == entry["fused_ms"]  # the sort key
+        assert entry["bucket"] == "r64x16"
+        assert entry["trace_id"] == f"{0xfeed:032x}"
+        assert entry["last_compile"]["site"] == "fused.join"
+        assert entry["cost"]["site"] == "fused.join"
+        assert entry["cost"]["flops"] > 0
+        # the ledger-level sort across every scope must hold too
+        assert latency_ledger.worst_frames()
+
+    def test_join_absent_when_bucket_never_compiled(self):
+        rec = latency_ledger.recorder("traces/devnojoin")
+        rec.observe(_fused_clock(bucket="r999x16"), scored=True)
+        [entry] = [e for e in rec.worst_frames() if e["scope"] == "fused"]
+        assert "last_compile" not in entry and "cost" not in entry
+
+
+# --------------------------------------------------------------------------
+# the shared device_snapshot() surface
+
+
+class TestDeviceSnapshot:
+    def test_containers_always_present(self):
+        snap = device_snapshot()
+        assert snap["attribution"] == []
+        assert snap["cost"]["rows"] == []
+        assert snap["compiles"] == []
+        assert isinstance(snap["tables"], dict)
+
+    def test_live_engine_join(self, fused_env):
+        eng, backend, col_sets = fused_env
+        _drive(backend, col_sets, 1)
+        record_compile_event("fused.snap", 0.2, shape="r1x1")
+        engines.register(eng)
+        try:
+            snap = device_snapshot()
+        finally:
+            engines.unregister(eng)
+        [ab] = snap["attribution"]
+        assert ab["site"] == (backend.fused_site or "fused")
+        assert ab["stride"] == 4 and ab["sampled"] >= 1
+        assert set(ab["last_waterfall"]["stages"]) == set(SUB_STAGES)
+        assert any(e["site"] == "fused.snap" for e in snap["compiles"])
+        assert snap["tables"].get("fused.tables", 0) > 0
+
+
+# --------------------------------------------------------------------------
+# tier-1 overhead guard
+
+
+class TestOverheadGuard:
+    def test_armed_sampler_overhead_under_2_percent(self):
+        """Armed-vs-disarmed host wall of ``dispatch_columns`` on the
+        warmed SOAK-geometry fused backend with the 1-in-32 sampler
+        (bench.py's ``device_attribution_overhead_bench`` pairing, as
+        a tier-1 bar): the identical frame dispatched in both modes
+        back to back on one backend, within-pair order alternating,
+        harvest blocking OUTSIDE the timer, median of the paired
+        ratios. The bound is the 31-of-32 claim — a non-sampled armed
+        frame pays only the ordinal tick and a None check — so each
+        window aligns to the grid with the sampled tick consumed
+        OUTSIDE it: the sampled frame's own waterfall cost is the
+        price of the feature, reported separately by the bench, and
+        its ~300× dispatch mid-window measurably disturbs the frames
+        after it (allocator/clock state) in both modes. Up to three
+        windows: one clean window proves the sampler CAN run under
+        2%, a preempted one cannot refute it. The tiny-geometry
+        backend the other tests share is deliberately NOT used here:
+        sub-millisecond frames put scheduler noise at the same scale
+        as the bound."""
+        cfg = EngineConfig(
+            model="transformer",
+            model_config=TransformerConfig(d_model=64, n_layers=2,
+                                           d_ff=256, n_heads=4,
+                                           max_len=32, dtype=jnp.float32),
+            max_len=32, trace_bucket=64,
+            device_attribution=True, device_attribution_stride=32)
+        eng = ScoringEngine(cfg)  # unstarted: direct backend A/B
+        backend = eng.backend
+        a = backend._attrib
+        col_sets = []
+        for v in range(4):
+            cols, reason = extract_columns(
+                synthesize_traces(256, seed=70 + v), eng.cfg.featurizer)
+            assert cols is not None, reason
+            col_sets.append([cols])
+        for i in range(4 * a.stride):  # warm jits + grid: publish once
+            _drive(backend, [col_sets[i % 4]], 1)
+            if a.sampled >= 1:
+                break
+        assert a.sampled >= 1, a.stats()
+
+        def measure():
+            # burn to just past the grid point: ordinals 1..stride-1
+            # cannot sample, so the window holds only steady frames
+            while a._ordinal % a.stride != 1:
+                _drive(backend, [col_sets[0]], 1)
+            ratios = []
+            for i in range(a.stride - 1):
+                cols = col_sets[i % len(col_sets)]
+                t = {}
+                modes = ("on", "off") if i % 2 else ("off", "on")
+                for mode in modes:
+                    backend._attrib = a if mode == "on" else None
+                    t0 = time.perf_counter()
+                    h = backend.dispatch_columns(cols)
+                    t[mode] = time.perf_counter() - t0
+                    backend.harvest(h)
+                ratios.append(t["on"] / max(t["off"], 1e-9))
+            backend._attrib = a
+            ratios.sort()
+            return ratios[len(ratios) // 2]
+
+        medians = []
+        for _ in range(3):
+            medians.append(measure())
+            if medians[-1] <= 1.02:
+                break
+        assert min(medians) <= 1.02, \
+            f"armed sampler overhead {medians} (bound 1.02)"

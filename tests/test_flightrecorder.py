@@ -384,7 +384,8 @@ class TestDropTraceWitnesses:
         # sites; this pins the set itself)
         assert set(TRIGGERS) == {
             "alert_firing", "actuator_rollback", "breaker_trip",
-            "conservation_leak", "patch_fallback", "chaos_injection"}
+            "conservation_leak", "patch_fallback", "chaos_injection",
+            "compile_storm"}
 
 
 # ------------------------------------------------------- overhead guard

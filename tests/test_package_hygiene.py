@@ -1517,3 +1517,126 @@ class TestFlightTriggerHygiene:
                      fr.INCIDENTS_EVICTED_METRIC):
             assert name.startswith("odigos_flightrecorder_"), name
             assert name in registry, name
+
+
+class TestDeviceSubStageHygiene:
+    """Device-attribution vocabulary lint (ISSUE 20 satellite):
+    ``SUB_STAGES`` is the closed intra-fused sub-stage vocabulary, so it
+    must stay honest in both directions — every entry has exactly one
+    ``_stage_<name>`` builder in ``serving/deviceattrib.py`` (an entry
+    with no builder is a stale vocabulary row the waterfall can never
+    fill), and every ``_stage_*`` builder names a vocabulary entry (a
+    builder outside the vocabulary would publish an unaggregatable
+    stage). Same discipline for ``SKIP_REASONS`` against the literal
+    ``_skip("reason")`` call sites, with stale-entry oracles for both
+    scans, plus the ISSUE 3 name-registry check for the new
+    ``odigos_xla_*`` / ``odigos_device_*`` metric families."""
+
+    DEVICEATTRIB = os.path.join(PKG_ROOT, "serving", "deviceattrib.py")
+
+    @classmethod
+    def _builder_names(cls) -> dict:
+        """sub-stage name -> lineno for every module-level
+        ``_stage_<name>`` def in serving/deviceattrib.py."""
+        with open(cls.DEVICEATTRIB) as f:
+            tree = ast.parse(f.read())
+        out = {}
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name.startswith("_stage_"):
+                out[node.name[len("_stage_"):]] = node.lineno
+        return out
+
+    @classmethod
+    def _skip_call_sites(cls) -> dict:
+        """reason -> [lineno, ...] for every literal
+        ``<recv>._skip("reason")`` call in serving/deviceattrib.py."""
+        with open(cls.DEVICEATTRIB) as f:
+            tree = ast.parse(f.read())
+        out: dict = {}
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_skip"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                out.setdefault(node.args[0].value, []).append(node.lineno)
+        return out
+
+    @staticmethod
+    def _check(vocab, sites, what) -> list:
+        problems = []
+        for name in vocab:
+            if name not in sites:
+                problems.append(
+                    f"{what} {name!r} declared but has no call/builder "
+                    f"site (stale entry)")
+        for name in sorted(sites):
+            if name not in vocab:
+                problems.append(
+                    f"{what} {name!r} present in code at {sites[name]} "
+                    f"but not in the declared vocabulary")
+        return problems
+
+    def test_substage_vocabulary_closed_both_directions(self):
+        from odigos_tpu.serving.deviceattrib import (
+            _STAGE_BUILDERS, SUB_STAGES)
+
+        builders = self._builder_names()
+        assert builders, "no _stage_* builders found at all?"
+        assert self._check(SUB_STAGES, builders, "sub-stage") == []
+        # the dispatch table agrees with both sides and keeps order
+        assert tuple(_STAGE_BUILDERS) == SUB_STAGES
+
+    def test_skip_reasons_closed_both_directions(self):
+        from odigos_tpu.serving.deviceattrib import SKIP_REASONS
+
+        sites = self._skip_call_sites()
+        assert sites, "no _skip call sites found at all?"
+        assert self._check(SKIP_REASONS, sites, "skip reason") == []
+
+    def test_stale_entry_oracle(self):
+        """The scans' own oracle: a ghost vocabulary entry with no
+        builder/site, and a builder/site outside the vocabulary, must
+        both be flagged (guards against either scan degenerating into
+        a no-op)."""
+        from odigos_tpu.serving.deviceattrib import (
+            SKIP_REASONS, SUB_STAGES)
+
+        builders = self._builder_names()
+        problems = self._check(SUB_STAGES + ("_ghost",), builders,
+                               "sub-stage")
+        assert any("_ghost" in p and "stale" in p for p in problems)
+        doctored = dict(builders)
+        doctored["_rogue"] = 1
+        problems = self._check(SUB_STAGES, doctored, "sub-stage")
+        assert any("_rogue" in p and "vocabulary" in p for p in problems)
+        sites = self._skip_call_sites()
+        problems = self._check(SKIP_REASONS + ("_ghost",), sites,
+                               "skip reason")
+        assert any("_ghost" in p and "stale" in p for p in problems)
+
+    def test_device_metric_names_registered(self):
+        """The odigos_xla_* / odigos_device_* / compile-event metric
+        families must resolve against the registered name registry (the
+        TestFleetRuleHygiene scan) — the constants must stay string
+        literals for the AST scan to see them."""
+        from odigos_tpu.models import costmodel, jitstats
+        from odigos_tpu.serving import deviceattrib
+
+        registry = TestFleetRuleHygiene._registered_metric_names()
+        for name in (costmodel.XLA_FLOPS_METRIC,
+                     costmodel.XLA_BYTES_METRIC,
+                     costmodel.XLA_WASTE_METRIC,
+                     costmodel.XLA_EFFICIENCY_METRIC):
+            assert name.startswith("odigos_xla_"), name
+            assert name in registry, name
+        for name in (deviceattrib.ATTRIB_FRAMES_METRIC,
+                     deviceattrib.ATTRIB_SKIPPED_METRIC):
+            assert name.startswith("odigos_device_attrib_"), name
+            assert name in registry, name
+        assert jitstats.COMPILE_EVENTS_METRIC in registry
+        # the footprint gauge is published with a literal name in the
+        # DeviceRuntimeCollector — the registry scan must see it
+        assert "odigos_device_table_bytes" in registry

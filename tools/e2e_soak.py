@@ -98,7 +98,29 @@ SOAK_ALERTS = [
     {"name": "admission-shed-sustained",
      "expr": "rate(odigos_admission_rejected_frames_total[10s]) > 100",
      "for_s": 2.0, "severity": "warning"},
+    # unplanned recompile burst (ISSUE 20): warm=false compile events
+    # are supposed to be extinct once the startup ramp warms the live
+    # shapes — a sustained rate mid-soak is the classic silent latency
+    # cliff. The threshold sits well above the ramp itself (a handful
+    # of cold fused buckets compiling in the first seconds reads
+    # ~0.1/s over this window) so a clean soak stays incident-clean;
+    # a genuine storm (shapes churning off the ladder every frame)
+    # reads >= 1/s and pages
+    {"name": "compile-storm",
+     "expr": "rate(odigos_jit_compile_events_total{warm=false}[60s])"
+             " > 0.5",
+     "for_s": 5.0, "severity": "critical"},
 ]
+
+# --device-attrib (ISSUE 20): sampled sub-stage sum vs the opaque fused
+# stamp. ~1.0 on an idle box (the composition is op-identical; the
+# residue is lost cross-stage XLA fusion + per-stage dispatch), but
+# under full soak load the fused stamp also absorbs queue-behind-
+# previous-work time the sub-stage replay does not, so the bounds are
+# deliberately wide — the gate catches a BROKEN decomposition (a stage
+# not running, a stamp off by orders of magnitude), not scheduling
+# noise
+DEVICE_RECONCILE_BOUNDS = (0.2, 10.0)
 
 # extra rules the --chaos run loads (ISSUE 13): the injected faults
 # must fire exactly these — a failover trip and a retry backlog are the
@@ -255,6 +277,13 @@ def run_soak(args, fast_path: bool) -> dict:
                              "dtype": "float32"},
             "trace_bucket": 64, "max_len": 32, "bucket_ladder": 4,
             "max_batch": 4096})
+    if args.device_attrib:
+        # device-plane attribution (ISSUE 20): 1-in-N sampled frames
+        # rerun the fused call as its five jitted sub-stages and publish
+        # the intra-fused waterfall; everything else rides the normal
+        # fused route untouched
+        tpu_cfg["device_attribution"] = True
+        tpu_cfg["device_attribution_stride"] = args.device_attrib_stride
     if args.mesh:
         tpu_cfg["mesh"] = _parse_mesh(args.mesh)
     cfg = {
@@ -796,6 +825,42 @@ def run_soak(args, fast_path: bool) -> dict:
                 "t_s": round(time.perf_counter() - t0, 3),
                 **_fused_counters()})
 
+    # ---- device-attribution kill slice (ISSUE 20): ODIGOS_DEVICE_ATTRIB=0
+    # flipped at 10% of the run and restored at 35% — BEFORE the fused
+    # kill slice (40-60%), deliberately: with ODIGOS_FUSED=0 the fused
+    # route dispatches no columns at all, so the attribution sampler
+    # ticks no ordinals and a slice overlapping it would starve the
+    # fell-back evidence. While killed, every sampled tick is counted
+    # under skipped{reason=disabled} and the frame runs the plain fused
+    # call; on restore, sampling resumes on the very next aligned tick.
+    # Sampler-counter snapshots at both boundaries are the evidence.
+    device_events: list = []
+
+    def _attrib_counters() -> dict:
+        a = getattr(engine.backend, "_attrib", None)
+        st = a.stats() if a is not None else {}
+        return {
+            "frames_seen": int(st.get("frames_seen", 0)),
+            "sampled": int(st.get("sampled", 0)),
+            "skipped_disabled": int(
+                (st.get("skipped") or {}).get("disabled", 0)),
+        }
+
+    def device_kill_schedule() -> None:
+        T = args.seconds
+        for at_s, action in ((0.10 * T, "kill"), (0.35 * T, "restore")):
+            delay = at_s - (time.perf_counter() - t0)
+            if delay > 0 and stop.wait(delay):
+                return
+            if action == "kill":
+                os.environ["ODIGOS_DEVICE_ATTRIB"] = "0"
+            else:
+                os.environ.pop("ODIGOS_DEVICE_ATTRIB", None)
+            device_events.append({
+                "event": f"attrib_kill_{action}",
+                "t_s": round(time.perf_counter() - t0, 3),
+                **_attrib_counters()})
+
     threads = [threading.Thread(target=sender, args=(i,), daemon=True)
                for i in range(args.senders)]
     probe_thread = threading.Thread(target=prober, daemon=True)
@@ -823,6 +888,11 @@ def run_soak(args, fast_path: bool) -> dict:
         fused_thread = threading.Thread(target=fused_kill_schedule,
                                         daemon=True)
         fused_thread.start()
+    device_thread = None
+    if args.device_attrib and fast_path:
+        device_thread = threading.Thread(target=device_kill_schedule,
+                                         daemon=True)
+        device_thread.start()
     # fleet publish/evaluate cadence (ISSUE 10): the soak's main wait
     # doubles as the plane timer — each tick delta-publishes the
     # collector's snapshot + rollup under {collector=} and advances the
@@ -861,6 +931,9 @@ def run_soak(args, fast_path: bool) -> dict:
         # never leak the kill switch past the run (a --ab / --find-knee
         # follow-up soak in this process must start with fused armed)
         os.environ.pop("ODIGOS_FUSED", None)
+    if device_thread is not None:
+        device_thread.join(timeout=10)
+        os.environ.pop("ODIGOS_DEVICE_ATTRIB", None)
     if chaos_thread is not None:
         chaos_thread.join(timeout=10)
         # belt and braces: the schedule clears its own faults, but a
@@ -1053,6 +1126,74 @@ def run_soak(args, fast_path: bool) -> dict:
                                        and fused_ms is not None
                                        else None),
             "conservation": bool(conserved),
+        }
+
+    # device-plane evidence (ISSUE 20), read BEFORE shutdown: the
+    # sampler's own counters, the folded sub-stage burn table with its
+    # fused-stamp reconcile ratio, the XLA cost/efficiency ledger rows
+    # for every bucket the route warmed, the compile-event ring (each
+    # event carrying the trace id of the frame that paid it), the
+    # kill-slice timeline, and the /api/device snapshot — plus the
+    # acceptance verdicts main() gates the exit code on
+    device_summary = None
+    if args.device_attrib and fast_path:
+        from odigos_tpu.models import jitstats
+        from odigos_tpu.models.costmodel import cost_ledger
+        from odigos_tpu.selftelemetry.profiler import device_snapshot
+        from odigos_tpu.serving.deviceattrib import SUB_STAGES
+
+        attrib = getattr(engine.backend, "_attrib", None)
+        astats = attrib.stats() if attrib is not None else {}
+        burn = latency_ledger.recorder("traces/in").device_burn()
+        cost = cost_ledger.snapshot()
+        compiles = jitstats.recent_compiles()
+        # buckets the fused route actually warmed this run, in the
+        # ledger's r{rows}x{len} labeling (the LRU keys are (span
+        # bucket, padded rows))
+        warmed = sorted(
+            "r{}x{}".format(r, engine.backend.max_len)
+            for (_n, r) in getattr(engine.backend, "_fused_shapes", {}))
+        cost_buckets = {r["bucket"] for r in cost["rows"]}
+        devents = {e["event"]: e for e in device_events}
+        dkill, drestore = (devents.get("attrib_kill_kill"),
+                           devents.get("attrib_kill_restore"))
+        reconcile = (burn or {}).get("reconcile_ratio")
+        lo, hi = DEVICE_RECONCILE_BOUNDS
+        device_summary = {
+            "stride": astats.get("stride"),
+            "sampler": astats,
+            "device_burn": burn,
+            "cost_ledger": cost,
+            "compile_events": compiles,
+            "device_plane": device_snapshot(),
+            "kill_switch": device_events,
+            "warmed_buckets": warmed,
+            # the sampled waterfall exists and speaks only the closed
+            # sub-stage vocabulary
+            "waterfall_nonempty": bool(
+                burn and burn.get("sampled_frames", 0) >= 1
+                and set(burn.get("stages", {})) == set(SUB_STAGES)),
+            # sampled sub-stage sum vs the opaque fused stamp
+            "reconcile_ratio": reconcile,
+            "reconcile_bounds": [lo, hi],
+            "reconcile_ok": bool(reconcile is not None
+                                 and lo <= reconcile <= hi),
+            # the kill slice actually fell back (disabled skips grew
+            # across it) and sampling resumed after restore
+            "kill_switch_fell_back": bool(
+                dkill and drestore
+                and drestore["skipped_disabled"]
+                > dkill["skipped_disabled"]),
+            "resumed_after_restore": bool(
+                drestore and int(astats.get("sampled", 0))
+                > drestore["sampled"]),
+            # every warmed bucket has a cost/efficiency row (captured
+            # at the cold dispatch that warmed it)
+            "cost_rows_cover_buckets": bool(
+                warmed and set(warmed) <= cost_buckets),
+            # at least one compile event names the frame that paid it
+            "compile_event_with_trace": any(
+                e.get("trace_id") for e in compiles),
         }
 
     # chaos evidence (ISSUE 13), read BEFORE shutdown: the injected
@@ -1296,6 +1437,7 @@ def run_soak(args, fast_path: bool) -> dict:
         # fused-route evidence (ISSUE 19): frames fused vs fallback,
         # parity-gate verdict, kill-switch slice, host wall delta
         "fused": fused_summary,
+        "device": device_summary,
         "latency_note": ("probe batches ride the same wire/pipeline as "
                          "the load; p* = send-to-export wall time under "
                          f"full multi-sender soak load, CPU {args.model} "
@@ -1475,6 +1617,28 @@ def main() -> None:
                          "never-fused run, or a kill slice that did "
                          "not fall back. Requires --model transformer "
                          "(zscore has no fused kernel)")
+    ap.add_argument("--device-attrib", action="store_true",
+                    help="arm sampled intra-fused device attribution "
+                         "(ISSUE 20) on the fused route: 1-in-N frames "
+                         "rerun the fused call as its five jitted "
+                         "sub-stages and publish the intra-fused "
+                         "waterfall, the XLA cost/efficiency ledger "
+                         "prices every warmed bucket, and compile "
+                         "events land in the ring with the paying "
+                         "frame's trace id. Flips ODIGOS_DEVICE_"
+                         "ATTRIB=0 for the 10-35%% slice of the "
+                         "window; the record becomes DEVICE.json with "
+                         "a 'device' section and the run exits "
+                         "non-zero on an empty waterfall, a reconcile "
+                         "ratio outside bounds, a kill slice that did "
+                         "not fall back or resume, a warmed bucket "
+                         "with no cost row, or no compile event with "
+                         "a trace id. Requires --fused")
+    ap.add_argument("--device-attrib-stride", type=int, default=32,
+                    help="1-in-N sampling stride for --device-attrib "
+                         "(the production default is 32; short runs "
+                         "may need a denser grid to publish enough "
+                         "waterfalls on both sides of the kill slice)")
     ap.add_argument("--model", default="zscore",
                     choices=["zscore", "transformer"],
                     help="scoring backend for the soak route")
@@ -1509,6 +1673,10 @@ def main() -> None:
         # the mesh partition plan keeps its own sharded call graph —
         # supports_fused is False and the soak would soak the fallback
         ap.error("--fused requires a single-device engine (no --mesh)")
+    if args.device_attrib and not args.fused:
+        # attribution decomposes the FUSED call; without the fused
+        # route there is nothing to attribute
+        ap.error("--device-attrib rides the fused route; add --fused")
 
     knee = None
     knee_sweep = []
@@ -1594,7 +1762,8 @@ def main() -> None:
     # precedent) so the standing knee/A-B SOAK.json record survives
     record = "CHAOS.json" if args.chaos else (
         "RELOAD.json" if args.reload_storm else (
-            "ACTUATOR.json" if args.actuate else "SOAK.json"))
+            "ACTUATOR.json" if args.actuate else (
+                "DEVICE.json" if args.device_attrib else "SOAK.json")))
     with open(os.path.join(REPO, record), "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
@@ -1657,6 +1826,32 @@ def main() -> None:
                   f"{fu['frames_fused']} kill_fell_back="
                   f"{fu['kill_switch_fell_back']} resumed="
                   f"{fu['resumed_after_restore']}", file=sys.stderr)
+            sys.exit(1)
+    if args.device_attrib:
+        dv = result["device"]
+        ok = (dv["waterfall_nonempty"]
+              and dv["reconcile_ok"]
+              and dv["kill_switch_fell_back"]
+              and dv["resumed_after_restore"]
+              and dv["cost_rows_cover_buckets"]
+              and dv["compile_event_with_trace"])
+        if not ok:
+            # the acceptance verdict: the sampled intra-fused waterfall
+            # exists and speaks the closed sub-stage vocabulary, its
+            # sub-stage sum reconciles with the opaque fused stamp
+            # within the documented bounds, the mid-window kill slice
+            # fell back (sampled ticks counted as skipped{disabled})
+            # AND sampling resumed after restore, every warmed bucket
+            # has an XLA cost/efficiency row, and at least one compile
+            # event carries the trace id of the frame that paid it
+            print(f"DEVICE: attribution verdict failed — waterfall="
+                  f"{dv['waterfall_nonempty']} reconcile="
+                  f"{dv['reconcile_ratio']} (bounds "
+                  f"{dv['reconcile_bounds']}) kill_fell_back="
+                  f"{dv['kill_switch_fell_back']} resumed="
+                  f"{dv['resumed_after_restore']} cost_rows="
+                  f"{dv['cost_rows_cover_buckets']} compile_trace="
+                  f"{dv['compile_event_with_trace']}", file=sys.stderr)
             sys.exit(1)
     if args.reload_storm and not (
             result["reload_storm"]["count"] == args.reload_storm
